@@ -1,0 +1,23 @@
+"""daccord_tpu — a TPU-native long-read consensus / error-correction framework.
+
+A ground-up re-design of the capabilities of gt1/daccord (non-hybrid PacBio/ONT
+consensus by per-window local de Bruijn graph assembly over DALIGNER alignment
+piles) for TPU hardware:
+
+- ``formats``  : Dazzler DB / LAS / FASTA / track I/O (readers AND writers).
+- ``sim``      : synthetic genome/read/alignment generator (test + bench data).
+- ``oracle``   : pure numpy executable spec of the consensus algorithm.
+- ``kernels``  : batched, fixed-shape JAX/Pallas implementation of the
+                 per-window consensus (the reference's ``handleWindow`` seam).
+- ``runtime``  : host pipeline streaming LAS piles -> window batches -> device.
+- ``parallel`` : jax.sharding Mesh / shard_map scale-out of window batches.
+- ``tools``    : CLI tools mirroring the reference tool suite.
+
+Reference provenance: the upstream tree at /root/reference was empty when this
+framework was designed (see SURVEY.md §0); behavior follows the daccord paper
+(Tischler & Myers, bioRxiv 106252) and the driver-pinned seam description in
+BASELINE.json. File:line citations must be backfilled per SURVEY.md §8 once the
+reference mount is populated.
+"""
+
+__version__ = "0.1.0"

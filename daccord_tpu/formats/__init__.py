@@ -1,0 +1,21 @@
+from .fasta import read_fasta, write_fasta, FastaRecord
+from .dazzdb import DazzDB, DazzRead, write_db, read_db, write_track, read_track
+from .las import Overlap, LasFile, write_las, read_las, index_las, OVL_COMP
+
+__all__ = [
+    "read_fasta",
+    "write_fasta",
+    "FastaRecord",
+    "DazzDB",
+    "DazzRead",
+    "write_db",
+    "read_db",
+    "write_track",
+    "read_track",
+    "Overlap",
+    "LasFile",
+    "write_las",
+    "read_las",
+    "index_las",
+    "OVL_COMP",
+]

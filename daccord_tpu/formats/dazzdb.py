@@ -1,0 +1,237 @@
+"""Dazzler database (.db / .idx / .bps) reader and writer, plus track I/O.
+
+Equivalent of libmaus2 ``dazzler/db/DatabaseFile`` + ``Track*`` (SURVEY.md
+§2.2; reference file:line citations pending backfill — the reference mount was
+empty, SURVEY.md §0). The binary layout below follows the public DAZZ_DB
+``DB.h`` structures as written to disk by ``fwrite(&db, sizeof(DAZZ_DB), ...)``
+on LP64 platforms:
+
+``.<name>.idx``::
+
+    DAZZ_DB header, 112 bytes:
+      int32  ureads, treads, cutoff, allarr        @ 0,4,8,12
+      f32[4] freq                                  @ 16
+      int32  maxlen                                @ 32   (+4 pad)
+      int64  totlen                                @ 40
+      int32  nreads, trimmed, part, ufirst, tfirst @ 48..67 (+4 pad)
+      ptr    path                                  @ 72  (garbage on disk)
+      int32  loaded                                @ 80   (+4 pad)
+      ptr    bases, reads, tracks                  @ 88,96,104 (garbage)
+    then ureads records of DAZZ_READ, 40 bytes each:
+      int32 origin, rlen, fpulse                   @ 0,4,8 (+4 pad)
+      int64 boff, coff                             @ 16,24
+      int32 flags                                  @ 32   (+4 pad)
+
+``.<name>.bps``::   2-bit packed bases, 4/byte, first base in the top bits.
+
+``<name>.db``  ::   small text stub (file list + block partition), kept
+                    human-compatible with ``fasta2DB`` output.
+
+Track files ``.<name>.<track>.anno`` / ``.data`` follow the variable-length
+Dazzler track convention used by daccord's ``inqual`` track: the .anno file is
+``int32 nreads, int32 size(=0)`` followed by ``nreads+1`` int64 byte offsets
+into ``.data``.
+
+Byte-level parity with DAZZ_DB must be re-verified against the reference tree
+when it appears (SURVEY.md §8 item 6); all internal producers/consumers in this
+framework go through this module, so the framework is self-consistent either
+way.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..utils.bases import pack_2bit, unpack_2bit
+
+_HDR_FMT = "<4i4fi4xq5i4x8si4x8s8s8s"  # 112 bytes, pointers as opaque 8-byte pads
+_HDR_SIZE = struct.calcsize(_HDR_FMT)
+assert _HDR_SIZE == 112, _HDR_SIZE
+
+_READ_FMT = "<3i4x2qi4x"  # 40 bytes
+_READ_SIZE = struct.calcsize(_READ_FMT)
+assert _READ_SIZE == 40, _READ_SIZE
+
+DB_BEST = 0x8  # DAZZ_READ flags (public DB.h values)
+DB_CCS = 0x400
+
+
+@dataclass
+class DazzRead:
+    origin: int
+    rlen: int
+    fpulse: int
+    boff: int
+    coff: int = -1
+    flags: int = 0
+
+
+@dataclass
+class DazzDB:
+    """In-memory handle over a Dazzler DB; bases stay packed until asked for."""
+
+    path: str
+    nreads: int
+    totlen: int
+    maxlen: int
+    cutoff: int
+    reads: list[DazzRead]
+    bps: np.ndarray = field(repr=False)  # uint8 packed base store
+    names: list[str] = field(default_factory=list, repr=False)
+
+    def read_bases(self, i: int) -> np.ndarray:
+        """Decode read ``i`` to an int8 array of 0..3."""
+        r = self.reads[i]
+        nbytes = (r.rlen + 3) // 4
+        return unpack_2bit(self.bps[r.boff : r.boff + nbytes], r.rlen)
+
+    def read_length(self, i: int) -> int:
+        return self.reads[i].rlen
+
+    def __len__(self) -> int:
+        return self.nreads
+
+
+def _db_stems(path: str) -> tuple[str, str]:
+    """Return (dir, stem) for a ``foo.db`` path."""
+    d, b = os.path.split(path)
+    if b.endswith(".db"):
+        b = b[:-3]
+    return d, b
+
+
+def write_db(path: str, seqs: list[np.ndarray], names: list[str] | None = None, cutoff: int = 0) -> DazzDB:
+    """Write reads (int8 arrays of 0..3) as a Dazzler DB triple (.db/.idx/.bps)."""
+    d, stem = _db_stems(path)
+    names = names or [f"read/{i}/0_{len(s)}" for i, s in enumerate(seqs)]
+
+    reads: list[DazzRead] = []
+    bps_chunks: list[bytes] = []
+    boff = 0
+    counts = np.zeros(4, dtype=np.int64)
+    for i, s in enumerate(seqs):
+        s = np.asarray(s, dtype=np.int8)
+        packed = pack_2bit(s)
+        reads.append(DazzRead(origin=i, rlen=len(s), fpulse=0, boff=boff))
+        bps_chunks.append(packed)
+        boff += len(packed)
+        binc = np.bincount(s.astype(np.int64), minlength=4)[:4]
+        counts += binc
+
+    totlen = int(sum(len(s) for s in seqs))
+    maxlen = int(max((len(s) for s in seqs), default=0))
+    freq = (counts / max(totlen, 1)).astype(np.float32)
+    n = len(seqs)
+
+    bps_path = os.path.join(d, f".{stem}.bps")
+    idx_path = os.path.join(d, f".{stem}.idx")
+    db_path = os.path.join(d, f"{stem}.db")
+
+    with open(bps_path, "wb") as fh:
+        for c in bps_chunks:
+            fh.write(c)
+
+    with open(idx_path, "wb") as fh:
+        hdr = struct.pack(
+            _HDR_FMT,
+            n, n, cutoff, 1,              # ureads, treads, cutoff, allarr
+            *freq.tolist(),
+            maxlen,
+            totlen,
+            n, 1, -1, 0, 0,               # nreads, trimmed, part(-1=whole), ufirst, tfirst
+            b"\0" * 8, 0, b"\0" * 8, b"\0" * 8, b"\0" * 8,
+        )
+        fh.write(hdr)
+        for r in reads:
+            fh.write(struct.pack(_READ_FMT, r.origin, r.rlen, r.fpulse, r.boff, r.coff, r.flags))
+
+    with open(db_path, "wt") as fh:
+        fh.write("files =         1\n")
+        fh.write(f"{n:>11} {stem} {stem}\n")
+        fh.write("blocks =         1\n")
+        fh.write(f"size = {200000000:>11} cutoff = {cutoff:>10} all = 1\n")
+        fh.write(f"{0:>11} {0:>11}\n")
+        fh.write(f"{n:>11} {n:>11}\n")
+
+    name_path = os.path.join(d, f".{stem}.names")
+    with open(name_path, "wt") as fh:
+        for nm in names:
+            fh.write(nm + "\n")
+
+    return DazzDB(path=db_path, nreads=n, totlen=totlen, maxlen=maxlen,
+                  cutoff=cutoff, reads=reads,
+                  bps=np.frombuffer(b"".join(bps_chunks), dtype=np.uint8),
+                  names=names)
+
+
+def read_db(path: str) -> DazzDB:
+    """Load a DB triple written by :func:`write_db` (or DAZZ_DB-compatible)."""
+    d, stem = _db_stems(path)
+    idx_path = os.path.join(d, f".{stem}.idx")
+    bps_path = os.path.join(d, f".{stem}.bps")
+
+    with open(idx_path, "rb") as fh:
+        hdr = fh.read(_HDR_SIZE)
+        (ureads, _treads, cutoff, _allarr,
+         _f0, _f1, _f2, _f3,
+         maxlen, totlen,
+         nreads, _trimmed, _part, _ufirst, _tfirst,
+         _p0, _loaded, _p1, _p2, _p3) = struct.unpack(_HDR_FMT, hdr)
+        reads = []
+        raw = fh.read(_READ_SIZE * ureads)
+        for i in range(ureads):
+            origin, rlen, fpulse, boff, coff, flags = struct.unpack_from(_READ_FMT, raw, i * _READ_SIZE)
+            reads.append(DazzRead(origin, rlen, fpulse, boff, coff, flags))
+
+    bps = np.fromfile(bps_path, dtype=np.uint8)
+
+    names: list[str] = []
+    name_path = os.path.join(d, f".{stem}.names")
+    if os.path.exists(name_path):
+        with open(name_path) as fh:
+            names = [ln.rstrip("\n") for ln in fh]
+
+    return DazzDB(path=os.path.join(d, f"{stem}.db"), nreads=nreads, totlen=totlen,
+                  maxlen=maxlen, cutoff=cutoff, reads=reads, bps=bps, names=names)
+
+
+# ---------------------------------------------------------------------------
+# Tracks (variable-length per-read byte payloads; e.g. daccord's `inqual`)
+# ---------------------------------------------------------------------------
+
+def write_track(db_path: str, track: str, payloads: list[bytes | np.ndarray]) -> None:
+    """Write a variable-length Dazzler track (.anno = offsets, .data = bytes)."""
+    d, stem = _db_stems(db_path)
+    anno_path = os.path.join(d, f".{stem}.{track}.anno")
+    data_path = os.path.join(d, f".{stem}.{track}.data")
+
+    blobs = [bytes(np.asarray(p, dtype=np.uint8).tobytes()) if isinstance(p, np.ndarray) else bytes(p)
+             for p in payloads]
+    offsets = np.zeros(len(blobs) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+
+    with open(anno_path, "wb") as fh:
+        fh.write(struct.pack("<2i", len(blobs), 0))
+        fh.write(offsets.tobytes())
+    with open(data_path, "wb") as fh:
+        for b in blobs:
+            fh.write(b)
+
+
+def read_track(db_path: str, track: str) -> list[np.ndarray]:
+    """Read a variable-length track back as per-read uint8 arrays."""
+    d, stem = _db_stems(db_path)
+    anno_path = os.path.join(d, f".{stem}.{track}.anno")
+    data_path = os.path.join(d, f".{stem}.{track}.data")
+
+    with open(anno_path, "rb") as fh:
+        nreads, size = struct.unpack("<2i", fh.read(8))
+        if size != 0:
+            raise ValueError(f"unsupported fixed-size track (size={size})")
+        offsets = np.frombuffer(fh.read(8 * (nreads + 1)), dtype=np.int64)
+    data = np.fromfile(data_path, dtype=np.uint8)
+    return [data[offsets[i] : offsets[i + 1]] for i in range(nreads)]

@@ -1,0 +1,70 @@
+"""Minimal streaming FASTA reader/writer.
+
+Equivalent of libmaus2 ``fastx/FastAReader`` (reference path per SURVEY.md §2.2;
+file:line backfill pending — reference mount empty, SURVEY.md §0). The writer
+wraps at 80 columns like the reference tool output.
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+from typing import Iterable, Iterator
+
+
+@dataclass
+class FastaRecord:
+    name: str
+    seq: str
+
+
+def read_fasta(path_or_file) -> Iterator[FastaRecord]:
+    """Stream records from a FASTA file path or text file object."""
+    if isinstance(path_or_file, (str, bytes)):
+        fh = open(path_or_file, "rt")
+        own = True
+    else:
+        fh = path_or_file
+        own = False
+    try:
+        name = None
+        chunks: list[str] = []
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith(">"):
+                if name is not None:
+                    yield FastaRecord(name, "".join(chunks))
+                name = line[1:].split()[0] if len(line) > 1 else ""
+                chunks = []
+            else:
+                chunks.append(line)
+        if name is not None:
+            yield FastaRecord(name, "".join(chunks))
+    finally:
+        if own:
+            fh.close()
+
+
+def write_fasta(path_or_file, records: Iterable[FastaRecord | tuple], width: int = 80) -> None:
+    if isinstance(path_or_file, (str, bytes)):
+        fh: io.TextIOBase = open(path_or_file, "wt")
+        own = True
+    else:
+        fh = path_or_file
+        own = False
+    try:
+        for rec in records:
+            if isinstance(rec, tuple):
+                rec = FastaRecord(*rec)
+            fh.write(f">{rec.name}\n")
+            s = rec.seq
+            for i in range(0, len(s), width):
+                fh.write(s[i : i + width])
+                fh.write("\n")
+            if not s:
+                fh.write("\n")
+    finally:
+        if own:
+            fh.close()

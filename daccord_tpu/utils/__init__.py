@@ -1,0 +1,19 @@
+from .bases import (
+    BASES,
+    seq_to_ints,
+    ints_to_seq,
+    revcomp_ints,
+    revcomp_seq,
+    pack_2bit,
+    unpack_2bit,
+)
+
+__all__ = [
+    "BASES",
+    "seq_to_ints",
+    "ints_to_seq",
+    "revcomp_ints",
+    "revcomp_seq",
+    "pack_2bit",
+    "unpack_2bit",
+]

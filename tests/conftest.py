@@ -1,0 +1,14 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform.
+
+This is the "fake backend" of SURVEY.md §4 item 4 — multi-chip sharding tests
+run against 8 virtual CPU devices so no pod is needed. Must run before any
+`import jax` anywhere in the test session.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,157 @@
+"""Round-trip and layout tests for the Dazzler DB / LAS / FASTA format layer."""
+
+import io
+import struct
+
+import numpy as np
+import pytest
+
+from daccord_tpu.formats import (
+    FastaRecord,
+    LasFile,
+    Overlap,
+    index_las,
+    read_db,
+    read_fasta,
+    read_las,
+    read_track,
+    write_db,
+    write_fasta,
+    write_las,
+    write_track,
+)
+from daccord_tpu.formats.las import shard_ranges, OVL_COMP
+from daccord_tpu.utils import (
+    ints_to_seq,
+    pack_2bit,
+    revcomp_seq,
+    seq_to_ints,
+    unpack_2bit,
+)
+
+
+def test_base_coding_roundtrip():
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 4, size=1001, dtype=np.int8)
+    s = ints_to_seq(arr)
+    assert len(s) == 1001
+    np.testing.assert_array_equal(seq_to_ints(s), arr)
+    np.testing.assert_array_equal(unpack_2bit(pack_2bit(arr), len(arr)), arr)
+
+
+def test_revcomp():
+    assert revcomp_seq("ACGTT") == "AACGT"
+    assert revcomp_seq(revcomp_seq("GATTACA")) == "GATTACA"
+
+
+def test_fasta_roundtrip(tmp_path):
+    recs = [FastaRecord("r1", "ACGT" * 50), FastaRecord("r2 extra words", "TTT")]
+    p = tmp_path / "x.fasta"
+    write_fasta(str(p), recs, width=13)
+    back = list(read_fasta(str(p)))
+    assert back[0].name == "r1" and back[0].seq == "ACGT" * 50
+    assert back[1].name == "r2" and back[1].seq == "TTT"
+    # stream from file object too
+    back2 = list(read_fasta(io.StringIO(p.read_text())))
+    assert back2[0].seq == back[0].seq
+
+
+def test_db_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    seqs = [rng.integers(0, 4, size=n, dtype=np.int8) for n in (13, 200, 1, 77)]
+    db = write_db(str(tmp_path / "toy.db"), seqs)
+    back = read_db(str(tmp_path / "toy.db"))
+    assert back.nreads == 4
+    assert back.totlen == sum(len(s) for s in seqs)
+    assert back.maxlen == 200
+    for i, s in enumerate(seqs):
+        np.testing.assert_array_equal(back.read_bases(i), s)
+    assert back.names == db.names
+
+
+def test_db_header_layout(tmp_path):
+    """The .idx header must be exactly 112 bytes with nreads at offset 48."""
+    seqs = [np.zeros(5, dtype=np.int8)]
+    write_db(str(tmp_path / "h.db"), seqs)
+    raw = (tmp_path / ".h.idx").read_bytes()
+    assert struct.unpack_from("<i", raw, 48)[0] == 1  # nreads
+    assert struct.unpack_from("<q", raw, 40)[0] == 5  # totlen
+    assert len(raw) == 112 + 40  # header + one DAZZ_READ
+
+
+def test_track_roundtrip(tmp_path):
+    write_db(str(tmp_path / "t.db"), [np.zeros(10, dtype=np.int8)] * 3)
+    payloads = [np.array([1, 2, 3], dtype=np.uint8), np.array([], dtype=np.uint8), np.array([9], dtype=np.uint8)]
+    write_track(str(tmp_path / "t.db"), "inqual", payloads)
+    back = read_track(str(tmp_path / "t.db"), "inqual")
+    assert len(back) == 3
+    for a, b in zip(payloads, back):
+        np.testing.assert_array_equal(a, b)
+
+
+def _mk_ovl(aread, bread, abpos=0, aepos=250, tspace=100, flags=0):
+    o = Overlap(aread=aread, bread=bread, abpos=abpos, aepos=aepos,
+                bbpos=abpos, bepos=aepos, flags=flags)
+    nt = o.ntiles(tspace)
+    bounds = o.tile_bounds(tspace)
+    trace = np.stack([np.arange(nt, dtype=np.int32) % 5,
+                      np.diff(bounds).astype(np.int32)], axis=1)
+    o.trace = trace
+    return o
+
+
+def test_las_roundtrip(tmp_path):
+    p = str(tmp_path / "a.las")
+    ovls = [_mk_ovl(0, 1), _mk_ovl(0, 2, abpos=37, aepos=213, flags=OVL_COMP), _mk_ovl(3, 0)]
+    n = write_las(p, 100, ovls)
+    assert n == 3
+    tspace, back = read_las(p)
+    assert tspace == 100
+    assert [o.aread for o in back] == [0, 0, 3]
+    assert back[1].is_comp
+    np.testing.assert_array_equal(back[1].trace, ovls[1].trace)
+    assert back[1].abpos == 37 and back[1].aepos == 213
+
+
+def test_tile_bounds():
+    o = Overlap(aread=0, bread=0, abpos=37, aepos=213, bbpos=0, bepos=0)
+    b = o.tile_bounds(100)
+    np.testing.assert_array_equal(b, [37, 100, 200, 213])
+    assert o.ntiles(100) == 3
+    o2 = Overlap(aread=0, bread=0, abpos=0, aepos=100, bbpos=0, bepos=0)
+    np.testing.assert_array_equal(o2.tile_bounds(100), [0, 100])
+
+
+def test_las_index_and_shards(tmp_path):
+    p = str(tmp_path / "b.las")
+    ovls = []
+    for a in range(10):
+        for b in range(3):
+            ovls.append(_mk_ovl(a, 20 + b))
+    write_las(p, 100, ovls)
+    idx = index_las(p)
+    assert idx.shape == (10, 2)
+    assert list(idx[:, 0]) == list(range(10))
+
+    ranges = shard_ranges(p, 4)
+    assert len(ranges) == 4
+    f = LasFile(p)
+    seen = []
+    for s, e in ranges:
+        seen.extend(o.aread for o in f.iter_range(s, e))
+    assert seen == [o.aread for o in ovls]  # partition, no loss, in order
+
+    # piles grouping
+    piles = list(f.iter_piles())
+    assert len(piles) == 10
+    assert all(len(pile) == 3 for _, pile in piles)
+
+
+def test_las_trace_u16(tmp_path):
+    """tspace > 125 switches the trace to uint16."""
+    p = str(tmp_path / "c.las")
+    o = _mk_ovl(0, 1, abpos=0, aepos=1000, tspace=500)
+    write_las(p, 500, [o])
+    tspace, back = read_las(p)
+    assert tspace == 500
+    np.testing.assert_array_equal(back[0].trace, o.trace)
