@@ -1,0 +1,13 @@
+from .align import edit_distance, align_path, overlap_suffix_prefix, infix_distance
+from .windows import refine_overlap, cut_windows, build_pile_windows, WindowSegments, RefinedOverlap
+from .profile import ErrorProfile, OffsetLikely, estimate_profile
+from .dbg import DBGParams, WindowResult, window_consensus
+from .consensus import ConsensusConfig, CorrectedRead, correct_read, solve_window, make_offset_likely, estimate_profile_two_pass
+
+__all__ = [
+    "edit_distance", "align_path", "overlap_suffix_prefix", "infix_distance",
+    "refine_overlap", "cut_windows", "build_pile_windows", "WindowSegments", "RefinedOverlap",
+    "ErrorProfile", "OffsetLikely", "estimate_profile",
+    "DBGParams", "WindowResult", "window_consensus",
+    "ConsensusConfig", "CorrectedRead", "correct_read", "solve_window", "make_offset_likely", "estimate_profile_two_pass",
+]

@@ -1,0 +1,155 @@
+"""Pairwise alignment primitives (numpy banded edit-distance DP).
+
+Oracle-side equivalent of libmaus2 ``lcs/NP.hpp`` / ``lcs/NNP.hpp`` /
+``AlignmentTraceContainer`` (SURVEY.md §2.2; reference file:line citations
+pending backfill — mount empty, SURVEY.md §0). Used to
+
+  (a) refine LAS trace-point tiles to base-accurate A->B correspondence when
+      cutting windows (the reference's NP role), and
+  (b) rescore consensus candidates against window segments (NNP role) in the
+      oracle; the production rescorer is the batched device DP in
+      ``kernels.rescore``.
+
+The DP is plain unit-cost Levenshtein with an adaptive band, which matches the
+reference's edit-distance semantics (NP is an exact O(nd) edit-distance
+aligner; a wide-enough band gives the identical optimum).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_BIG = 1 << 30
+
+
+def edit_distance(a: np.ndarray, b: np.ndarray, band: int | None = None) -> int:
+    """Unit-cost edit distance between int8 base arrays (banded)."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    n, m = len(a), len(b)
+    if n == 0:
+        return m
+    if m == 0:
+        return n
+    if band is None:
+        band = abs(n - m) + max(16, (max(n, m) >> 2))
+    band = max(band, abs(n - m) + 1)
+    prev = np.arange(m + 1, dtype=np.int32)
+    for i in range(1, n + 1):
+        lo = max(1, i - band)
+        hi = min(m, i + band)
+        cur = np.full(m + 1, _BIG, dtype=np.int32)
+        if lo == 1:
+            cur[0] = i
+        seg = b[lo - 1 : hi]
+        sub = prev[lo - 1 : hi] + (seg != a[i - 1])
+        dele = prev[lo : hi + 1] + 1
+        best = np.minimum(sub, dele)
+        # insertion scan cur[j] = min(best[j], cur[j-1]+1) as a prefix-min:
+        # cur[j] = min_{j0<=j} vals[j0] + (j - j0)
+        vals = np.concatenate(([cur[lo - 1]], best))
+        ar = np.arange(len(vals), dtype=np.int32)
+        cur[lo - 1 + 1 : hi + 1] = (np.minimum.accumulate(vals - ar) + ar)[1:]
+        prev = cur
+    d = int(prev[m])
+    return d
+
+
+def align_path(a: np.ndarray, b: np.ndarray, band: int | None = None) -> tuple[int, np.ndarray]:
+    """Full DP with backtrack.
+
+    Returns (distance, a2b) where ``a2b`` has length ``len(a)+1`` and maps every
+    A prefix boundary to the aligned B prefix boundary (monotone). This is the
+    shape consumed by window cutting: B position of A position ``i`` is
+    ``a2b[i]``.
+    """
+    a = np.asarray(a)
+    b = np.asarray(b)
+    n, m = len(a), len(b)
+    D = np.empty((n + 1, m + 1), dtype=np.int32)
+    D[0] = np.arange(m + 1)
+    D[:, 0] = np.arange(n + 1)
+    ar = np.arange(m + 1, dtype=np.int32)
+    for i in range(1, n + 1):
+        sub = D[i - 1, :m] + (b != a[i - 1])
+        dele = D[i - 1, 1:] + 1
+        best = np.minimum(sub, dele)
+        vals = np.concatenate(([D[i, 0]], best + 0))
+        vals[1:] -= ar[1:]
+        D[i, 1:] = (np.minimum.accumulate(vals) + ar)[1:]
+    # backtrack, preferring diagonal moves
+    a2b = np.zeros(n + 1, dtype=np.int64)
+    i, j = n, m
+    a2b[n] = m
+    while i > 0:
+        if j > 0 and D[i, j] == D[i - 1, j - 1] + (a[i - 1] != b[j - 1]):
+            i -= 1
+            j -= 1
+        elif D[i, j] == D[i - 1, j] + 1:
+            i -= 1
+        else:
+            j -= 1
+            continue
+        a2b[i] = j
+    a2b[0] = 0  # global alignment: boundary 0 maps to boundary 0
+    return int(D[n, m]), a2b
+
+
+def infix_distance(needle: np.ndarray, haystack: np.ndarray) -> int:
+    """Best edit distance of ``needle`` against any infix of ``haystack``.
+
+    Free start/end gaps in the haystack (classic semi-global alignment); used
+    by the Q-score harness to score corrected fragments against the truth.
+    """
+    a = np.asarray(needle)
+    b = np.asarray(haystack)
+    n, m = len(a), len(b)
+    if n == 0:
+        return 0
+    prev = np.zeros(m + 1, dtype=np.int32)  # free start in haystack
+    ar = np.arange(m + 1, dtype=np.int32)
+    for i in range(1, n + 1):
+        sub = prev[:m] + (b != a[i - 1])
+        dele = prev[1:] + 1
+        best = np.minimum(sub, dele)
+        vals = np.concatenate(([np.int32(i)], best))
+        vals[1:] -= ar[1:]
+        prev = np.minimum.accumulate(vals) + ar
+    return int(prev.min())
+
+
+def overlap_suffix_prefix(a: np.ndarray, b: np.ndarray) -> tuple[int, int, int]:
+    """Best alignment of a suffix of ``a`` against a prefix of ``b``.
+
+    Used by window stitching: returns (cost, a_start, b_end) minimizing
+    edit cost of a[a_start:] vs b[:b_end], normalized against trivial empty
+    overlaps by requiring the aligned span to score better than its length.
+    """
+    a = np.asarray(a)
+    b = np.asarray(b)
+    n, m = len(a), len(b)
+    # D[i, j] = best cost aligning a[i:] started anywhere (free a_start) ...
+    # classic formulation: free start in a (first row 0), free end in b.
+    D = np.empty((n + 1, m + 1), dtype=np.int32)
+    ptr_start = np.empty((n + 1, m + 1), dtype=np.int32)
+    D[:, 0] = 0  # suffix start is free
+    ptr_start[:, 0] = np.arange(n + 1)
+    D[0, :] = np.arange(m + 1)  # b prefix must be consumed from 0
+    ptr_start[0, :] = 0
+    for i in range(1, n + 1):
+        for j in range(1, m + 1):
+            c_sub = D[i - 1, j - 1] + (a[i - 1] != b[j - 1])
+            c_del = D[i - 1, j] + 1
+            c_ins = D[i, j - 1] + 1
+            c = min(c_sub, c_del, c_ins)
+            D[i, j] = c
+            if c == c_sub:
+                ptr_start[i, j] = ptr_start[i - 1, j - 1]
+            elif c == c_del:
+                ptr_start[i, j] = ptr_start[i - 1, j]
+            else:
+                ptr_start[i, j] = ptr_start[i, j - 1]
+    # choose b_end minimizing cost - 0.5 * matched_len  (favor long overlaps)
+    costs = D[n, :].astype(np.float64) - 0.5 * np.arange(m + 1)
+    b_end = int(np.argmin(costs))
+    return int(D[n, b_end]), int(ptr_start[n, b_end]), b_end

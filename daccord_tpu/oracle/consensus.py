@@ -1,0 +1,156 @@
+"""Full-read consensus: k-tier escalation, window stitching, read splitting.
+
+Oracle equivalent of the reference's per-read driver around ``handleWindow``
+(SURVEY.md §3.1: window loop, k escalation on failure, stitching of
+overlapping window consensi, read split at unsolved windows; reference
+file:line backfill pending — mount empty, SURVEY.md §0).
+
+Stitching: consecutive windows overlap by ``w - adv`` bases; each new window
+consensus is spliced onto the accumulated sequence by aligning a suffix of the
+accumulator against a prefix of the new consensus (the reference stitches by
+agreement over the overlap region). An unsolved window either splits the read
+(daccord's default: emit corrected fragments) or, in ``patch`` mode, keeps the
+original A bases for that span.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .align import overlap_suffix_prefix
+from .dbg import DBGParams, WindowResult, window_consensus
+from .profile import ErrorProfile, OffsetLikely, profile_vs_consensus, rough_profile
+from .windows import RefinedOverlap, WindowSegments
+
+
+@dataclass
+class ConsensusConfig:
+    w: int = 40
+    adv: int = 10
+    # escalation ladder: (k, min_count, edge_min_count). Larger k resolves
+    # in-window repeats (the reference's escalate-k-on-failure); the final
+    # low-count tier rescues sparse piles where a true k-mer fell under the
+    # frequency filter.
+    tiers: tuple[tuple[int, int, int], ...] = ((8, 2, 2), (10, 2, 2), (12, 2, 2), (8, 1, 1))
+    dbg: DBGParams = field(default_factory=DBGParams)
+    mode: str = "split"          # "split" | "patch"
+    min_fragment: int = 40
+
+    @property
+    def k_values(self) -> tuple[int, ...]:
+        return tuple(sorted({t[0] for t in self.tiers}))
+
+
+@dataclass
+class CorrectedRead:
+    fragments: list[np.ndarray]
+    n_windows: int = 0
+    n_solved: int = 0
+    k_histogram: dict = field(default_factory=dict)
+
+
+def make_offset_likely(profile: ErrorProfile, cfg: ConsensusConfig) -> dict[int, OffsetLikely]:
+    """One OL table per k tier (P spans the admissible DP lengths)."""
+    tables = {}
+    for k in cfg.k_values:
+        P = cfg.w - k + 1 + cfg.dbg.len_slack
+        O = cfg.w + 16
+        tables[k] = OffsetLikely(profile, positions=P, max_offset=O)
+    return tables
+
+
+def estimate_profile_two_pass(refined: list[RefinedOverlap],
+                              windows: list[WindowSegments],
+                              cfg: ConsensusConfig,
+                              sample: int = 48) -> ErrorProfile:
+    """Reference-style error-profile pass: rough estimate from trace diffs,
+    then true single-read rates from segments aligned to a sample consensus
+    (SURVEY.md §3.1 'error-profile estimation pass')."""
+    rough = rough_profile(refined)
+    ol1 = make_offset_likely(rough, cfg)
+    stride = max(1, len(windows) // sample)
+    pairs: list[tuple[np.ndarray, np.ndarray]] = []
+    for ws in windows[::stride]:
+        res = solve_window(ws, ol1, cfg)
+        if res.seq is not None:
+            pairs.extend((res.seq, seg) for seg in ws.segments)
+    if not pairs:
+        return rough
+    return profile_vs_consensus(pairs)
+
+
+def solve_window(ws: WindowSegments, ol_tables: dict[int, OffsetLikely],
+                 cfg: ConsensusConfig) -> WindowResult:
+    """Try escalation tiers in order until one solves the window."""
+    best = WindowResult(None, reason="depth")
+    for k, mc, emc in cfg.tiers:
+        p = DBGParams(**{**cfg.dbg.__dict__, "k": k,
+                         "min_count": mc, "edge_min_count": emc})
+        res = window_consensus(ws.segments, ol_tables[k], p, wlen=ws.wlen)
+        if res.seq is not None:
+            return res
+        best = res
+    return best
+
+
+def _splice(acc: np.ndarray, nxt: np.ndarray, nominal_olap: int) -> np.ndarray | None:
+    """Splice window consensus ``nxt`` onto accumulator ``acc``.
+
+    The true overlap is ~``nominal_olap`` bases; align acc's tail against nxt's
+    head and join at the best correspondence. Returns None when the overlap
+    disagrees too much (stitch failure -> split).
+    """
+    tail = min(len(acc), nominal_olap + 10)
+    head = min(len(nxt), nominal_olap + 10)
+    cost, a_start, b_end = overlap_suffix_prefix(acc[len(acc) - tail :], nxt[:head])
+    olap_len = max(tail - a_start, b_end)
+    if olap_len < max(4, nominal_olap // 4) or cost > 0.35 * olap_len:
+        return None
+    return np.concatenate([acc, nxt[b_end:]])
+
+
+def correct_read(a_bases: np.ndarray, windows: list[WindowSegments],
+                 ol_tables: dict[int, OffsetLikely], cfg: ConsensusConfig) -> CorrectedRead:
+    frags: list[np.ndarray] = []
+    acc: np.ndarray | None = None
+    acc_end = 0                     # A coordinate the accumulator extends to
+    n_solved = 0
+    khist: dict = {}
+
+    def flush():
+        nonlocal acc
+        if acc is not None and len(acc) >= cfg.min_fragment:
+            frags.append(acc)
+        acc = None
+
+    for ws in windows:
+        res = solve_window(ws, ol_tables, cfg)
+        if res.seq is None:
+            if cfg.mode == "patch":
+                patch = np.asarray(a_bases[ws.wstart : ws.wstart + ws.wlen], dtype=np.int8)
+                if acc is None:
+                    acc = patch
+                else:
+                    olap = acc_end - ws.wstart
+                    acc = np.concatenate([acc[: len(acc) - max(olap, 0)], patch]) if olap > 0 else np.concatenate([acc, patch])
+                acc_end = ws.wstart + ws.wlen
+            else:
+                flush()
+            continue
+        n_solved += 1
+        khist[res.k] = khist.get(res.k, 0) + 1
+        if acc is None:
+            acc = res.seq
+        else:
+            spliced = _splice(acc, res.seq, nominal_olap=acc_end - ws.wstart)
+            if spliced is None:
+                flush()
+                acc = res.seq
+            else:
+                acc = spliced
+        acc_end = ws.wstart + ws.wlen
+    flush()
+    return CorrectedRead(fragments=frags, n_windows=len(windows), n_solved=n_solved,
+                         k_histogram=khist)

@@ -1,0 +1,144 @@
+"""Error-profile estimation and OffsetLikely position-weight tables.
+
+Equivalent of the reference's error-profile estimation pass and ``OffsetLikely``
+structure (``src/daccord.cpp``; named as a real reference structure by
+BASELINE.json's north_star — file:line backfill pending, SURVEY.md §0/§8; the
+algorithmic role follows the daccord paper, Tischler & Myers bioRxiv 106252).
+
+``OffsetLikely`` answers: for a consensus position ``p`` inside a window, what
+is the probability that the segment base realizing it sits at segment offset
+``o``? Indels shift offsets; the distribution of the offset of consensus
+position ``p`` is the p-fold convolution of the per-base length-increment
+distribution
+
+    P(0)      = p_del                      (base missing from the segment)
+    P(1 + i)  = (1 - p_del) (1-p_ins) p_ins^i   (base + i following insertions)
+
+The table ``OL[p, o]`` is consumed as a matmul against per-k-mer offset
+occurrence counts to produce per-k-mer position weights (BASELINE.json:
+"OffsetLikely position-weight scoring runs as a batched matmul").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .windows import RefinedOverlap
+
+
+@dataclass
+class ErrorProfile:
+    p_ins: float
+    p_del: float
+    p_sub: float
+
+    @property
+    def p_err(self) -> float:
+        return self.p_ins + self.p_del + self.p_sub
+
+
+def estimate_profile(refined: list[RefinedOverlap], a_len_total: int | None = None) -> ErrorProfile:
+    """Estimate indel/sub rates from base-accurate refined overlaps.
+
+    Op counts come from the a2b prefix maps: an A position whose map advances 0
+    is (locally) a deletion in B; advances of 1+i imply i insertions. Since the
+    pair error rate is the sum of both reads' error rates, per-read rates are
+    half the pair rates (both reads drawn from the same noise process — the
+    reference's estimator likewise works on pair alignments).
+    """
+    n_adv0 = 0       # pair deletions
+    n_ins = 0        # pair inserted bases
+    n_bases = 0
+    n_diffs = 0
+    for r in refined:
+        steps = np.diff(r.a2b)
+        n_adv0 += int(np.sum(steps == 0))
+        n_ins += int(np.sum(np.maximum(steps - 1, 0)))
+        n_bases += len(steps)
+        n_diffs += r.diffs
+    if n_bases == 0:
+        return ErrorProfile(0.08, 0.04, 0.015)
+    pair_del = n_adv0 / n_bases
+    pair_ins = n_ins / n_bases
+    pair_sub = max(n_diffs / n_bases - pair_del - pair_ins, 0.0)
+    return ErrorProfile(p_ins=pair_ins / 2, p_del=pair_del / 2, p_sub=pair_sub / 2)
+
+
+def rough_profile(refined: list[RefinedOverlap]) -> ErrorProfile:
+    """First-pass profile from trace diffs alone.
+
+    Pair alignments cannot identify per-read insertion/deletion rates (A and B
+    drifts cancel), so the total error rate comes from per-tile diff counts
+    (halved: a pair alignment sees both reads' errors) and is split by typical
+    long-read proportions. Refined by :func:`profile_vs_consensus` in pass two.
+    """
+    n_diffs = sum(r.diffs for r in refined)
+    n_bases = sum(len(r.a2b) - 1 for r in refined)
+    e = 0.5 * n_diffs / max(n_bases, 1)
+    e = min(max(e, 0.01), 0.35)
+    return ErrorProfile(p_ins=0.55 * e, p_del=0.30 * e, p_sub=0.15 * e)
+
+
+def profile_vs_consensus(pairs: list[tuple[np.ndarray, np.ndarray]]) -> ErrorProfile:
+    """Second-pass profile: ops of (segment vs consensus) alignments.
+
+    Each pair is (consensus, segment); the consensus stands in for the truth,
+    so op counts give the *single-read* error process directly: a consensus
+    base consuming 0 segment bases is a deletion, 2+ an insertion run, and a
+    mismatching 1-step a substitution.
+    """
+    from .align import align_path  # local import to avoid cycle at module load
+
+    n_del = n_ins = n_sub = n_pos = 0
+    for cons, seg in pairs:
+        if len(cons) == 0:
+            continue
+        _, c2s = align_path(cons, seg)
+        steps = np.diff(c2s)
+        n_del += int(np.sum(steps == 0))
+        n_ins += int(np.sum(np.maximum(steps - 1, 0)))
+        one = steps == 1
+        if np.any(one):
+            idx = np.nonzero(one)[0]
+            n_sub += int(np.sum(cons[idx] != seg[c2s[idx]]))
+        n_pos += len(steps)
+    if n_pos == 0:
+        return ErrorProfile(0.08, 0.04, 0.015)
+    return ErrorProfile(p_ins=n_ins / n_pos, p_del=n_del / n_pos, p_sub=n_sub / n_pos)
+
+
+class OffsetLikely:
+    """OL[p, o] tables for p in [0, P) and o in [0, O)."""
+
+    def __init__(self, profile: ErrorProfile, positions: int, max_offset: int,
+                 ins_tail: int = 6):
+        self.profile = profile
+        self.P = positions
+        self.O = max_offset
+        # per-base length increment distribution, truncated at 1 + ins_tail
+        p_del, p_ins = profile.p_del, profile.p_ins
+        inc = np.zeros(2 + ins_tail)
+        inc[0] = p_del
+        rem = 1.0 - p_del
+        for i in range(ins_tail + 1):
+            inc[1 + i] = rem * (1 - p_ins) * (p_ins ** i)
+        inc /= inc.sum()
+        self.inc = inc
+
+        ol = np.zeros((positions, max_offset), dtype=np.float64)
+        cur = np.zeros(max_offset)
+        cur[0] = 1.0  # position 0 sits at offset 0 by construction of the cut
+        ol[0] = cur
+        for p in range(1, positions):
+            cur = np.convolve(cur, inc)[:max_offset]
+            s = cur.sum()
+            if s > 0:
+                cur = cur / s
+            ol[p] = cur
+        self.table = ol.astype(np.float32)
+
+    def weights(self, occ: np.ndarray) -> np.ndarray:
+        """occ: [n_kmers, O] offset occurrence counts -> [n_kmers, P] weights."""
+        return occ.astype(np.float32) @ self.table.T

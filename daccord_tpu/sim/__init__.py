@@ -1,0 +1,3 @@
+from .synth import SimConfig, SimResult, simulate, make_dataset
+
+__all__ = ["SimConfig", "SimResult", "simulate", "make_dataset"]

@@ -1,0 +1,275 @@
+"""Synthetic long-read dataset generator: genome -> noisy reads -> true LAS.
+
+The reference pipeline consumes DALIGNER output on real sequencing data; it has
+no simulator. This module is the framework's test/bench fixture factory
+(SURVEY.md §4 item 2): it fabricates a genome, samples strand-aware noisy reads
+with PacBio-like error profiles, and emits
+
+  - a Dazzler DB of the reads,
+  - a .las of all true pairwise overlaps (both (A,B) and (B,A) records, sorted
+    by aread, with exact per-tile trace points derived from the generative
+    alignment — no aligner needed),
+  - per-read truth (genome interval, strand, clean sequence) for Q-score
+    evaluation.
+
+Coordinate conventions follow DALIGNER: the A read is used as stored; when the
+B read's orientation differs, the overlap carries OVL_COMP and bbpos/bepos are
+coordinates in the *complemented* B read.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, asdict
+
+import numpy as np
+
+from ..formats.dazzdb import write_db, DazzDB
+from ..formats.las import Overlap, write_las, OVL_COMP
+from ..utils.bases import revcomp_ints
+
+
+@dataclass
+class SimConfig:
+    genome_len: int = 20_000
+    coverage: float = 25.0
+    read_len_mean: float = 2_000.0
+    read_len_sigma: float = 0.3       # lognormal sigma on length
+    p_ins: float = 0.08
+    p_del: float = 0.04
+    p_sub: float = 0.015
+    min_overlap: int = 500
+    tspace: int = 100
+    repeat_fraction: float = 0.0      # fraction of genome covered by a planted repeat
+    seed: int = 0
+
+
+@dataclass
+class SimRead:
+    """One sampled read plus its generative alignment to the genome.
+
+    ``g_of_r`` maps stored-read position -> genome position (non-strictly
+    monotone; inserted bases repeat the previous base's genome position).
+    Direction is increasing for strand 0, decreasing for strand 1.
+    ``err`` marks stored-read positions that are insertions or substitutions.
+    ``dels`` lists genome positions deleted from this read (sorted ascending).
+    """
+
+    start: int
+    end: int
+    strand: int
+    seq: np.ndarray
+    g_of_r: np.ndarray
+    err: np.ndarray
+    dels: np.ndarray
+
+
+@dataclass
+class SimResult:
+    genome: np.ndarray
+    reads: list[SimRead]
+    overlaps: list[Overlap]
+    config: SimConfig
+
+
+def _sample_noisy(genome: np.ndarray, start: int, end: int, cfg: SimConfig,
+                  rng: np.random.Generator) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Apply sub/ins/del noise to genome[start:end] (forward orientation).
+
+    Returns (read_fwd, g_of_r_fwd, err_fwd, dels) where g_of_r is monotone
+    non-decreasing over genome positions start..end-1.
+    """
+    seg = genome[start:end]
+    n = len(seg)
+    u = rng.random(n)
+    is_del = u < cfg.p_del
+    is_sub = (~is_del) & (u < cfg.p_del + cfg.p_sub)
+    n_ins = rng.geometric(1.0 - cfg.p_ins, size=n) - 1  # insertions after each base
+
+    out: list[np.ndarray] = []
+    gpos: list[np.ndarray] = []
+    errm: list[np.ndarray] = []
+    for i in range(n):
+        if is_del[i]:
+            pass
+        else:
+            b = seg[i]
+            if is_sub[i]:
+                b = (b + rng.integers(1, 4)) % 4
+            out.append(np.array([b], dtype=np.int8))
+            gpos.append(np.array([start + i], dtype=np.int64))
+            errm.append(np.array([1 if is_sub[i] else 0], dtype=np.int8))
+        k = int(n_ins[i])
+        if k:
+            out.append(rng.integers(0, 4, size=k, dtype=np.int8))
+            gpos.append(np.full(k, start + i, dtype=np.int64))
+            errm.append(np.ones(k, dtype=np.int8))
+    if out:
+        read = np.concatenate(out)
+        g_of_r = np.concatenate(gpos)
+        err = np.concatenate(errm)
+    else:
+        read = np.zeros(0, dtype=np.int8)
+        g_of_r = np.zeros(0, dtype=np.int64)
+        err = np.zeros(0, dtype=np.int8)
+    dels = (start + np.nonzero(is_del)[0]).astype(np.int64)
+    return read, g_of_r, err, dels
+
+
+def _make_genome(cfg: SimConfig, rng: np.random.Generator) -> np.ndarray:
+    g = rng.integers(0, 4, size=cfg.genome_len, dtype=np.int8)
+    if cfg.repeat_fraction > 0:
+        # plant a tandem-ish repeat: copy one segment to another location
+        rep_len = int(cfg.genome_len * cfg.repeat_fraction / 2)
+        if rep_len > 100:
+            src = rng.integers(0, cfg.genome_len - rep_len)
+            dst = rng.integers(0, cfg.genome_len - rep_len)
+            g[dst : dst + rep_len] = g[src : src + rep_len]
+    return g
+
+
+def _oriented_maps(r: SimRead, comp: bool) -> tuple[np.ndarray, np.ndarray]:
+    """(g_of_r, err) in the requested orientation of the stored read."""
+    if not comp:
+        return r.g_of_r, r.err
+    return r.g_of_r[::-1], r.err[::-1]
+
+
+def _positions_in(g_of_r: np.ndarray, glo: int, ghi: int, ascending: bool) -> tuple[int, int]:
+    """Half-open index range of read positions whose genome pos is in [glo, ghi)."""
+    if ascending:
+        lo = int(np.searchsorted(g_of_r, glo, side="left"))
+        hi = int(np.searchsorted(g_of_r, ghi, side="left"))
+    else:
+        # descending: negate
+        neg = -g_of_r
+        lo = int(np.searchsorted(neg, -(ghi - 1), side="left"))
+        hi = int(np.searchsorted(neg, -(glo - 1), side="left"))
+    return lo, hi
+
+
+def _true_overlap(a: SimRead, b: SimRead, ai: int, bi: int, cfg: SimConfig) -> Overlap | None:
+    """Construct the true overlap record (A as stored; B possibly complemented)."""
+    glo = max(a.start, b.start)
+    ghi = min(a.end, b.end)
+    if ghi - glo < cfg.min_overlap:
+        return None
+    comp = a.strand != b.strand
+    # orientation chosen so B traverses the genome in the same direction as A
+    gB, errB = _oriented_maps(b, comp)
+    a_asc = a.strand == 0
+    abpos, aepos = _positions_in(a.g_of_r, glo, ghi, a_asc)
+    bbpos, bepos = _positions_in(gB, glo, ghi, a_asc)
+    if aepos - abpos < cfg.min_overlap // 2 or bepos - bbpos < cfg.min_overlap // 2:
+        return None
+
+    # trace points: cut A range at multiples of tspace, map each boundary to B
+    ovl = Overlap(aread=ai, bread=bi, abpos=abpos, aepos=aepos,
+                  bbpos=bbpos, bepos=bepos, flags=OVL_COMP if comp else 0)
+    bounds = ovl.tile_bounds(cfg.tspace)
+    # genome coordinate of each A boundary position
+    gb = np.empty(len(bounds), dtype=np.int64)
+    gb[:-1] = a.g_of_r[bounds[:-1]]
+    gb[-1] = ghi  # end boundary maps to overlap end
+    # map genome coords to B positions
+    bpos = np.empty(len(bounds), dtype=np.int64)
+    for j, g in enumerate(gb):
+        if a_asc:
+            bpos[j] = np.searchsorted(gB, g, side="left")
+        else:
+            bpos[j] = np.searchsorted(-gB, -g, side="left")
+    bpos[0] = bbpos
+    bpos[-1] = bepos
+    bpos = np.maximum.accumulate(np.clip(bpos, bbpos, bepos))
+
+    # per-tile diffs (approximation: A-edits + B-edits vs genome in the tile;
+    # exact pair diffs are not needed — consumers use these only for error-rate
+    # estimation, mirroring the trace-point diff semantics)
+    a_err_cum = np.concatenate([[0], np.cumsum(a.err)])
+    b_err_cum = np.concatenate([[0], np.cumsum(errB)])
+    ntiles = len(bounds) - 1
+    trace = np.zeros((ntiles, 2), dtype=np.int32)
+    for t in range(ntiles):
+        a0, a1 = bounds[t], bounds[t + 1]
+        b0, b1 = bpos[t], bpos[t + 1]
+        a_ed = int(a_err_cum[a1] - a_err_cum[a0])
+        b_ed = int(b_err_cum[b1] - b_err_cum[b0])
+        # deletions against the genome inside the tile's genome span
+        g0, g1 = min(gb[t], gb[t + 1]), max(gb[t], gb[t + 1])
+        a_dl = int(np.searchsorted(a.dels, g1) - np.searchsorted(a.dels, g0))
+        b_dl = int(np.searchsorted(b.dels, g1) - np.searchsorted(b.dels, g0))
+        trace[t, 0] = min(a_ed + a_dl + b_ed + b_dl, 255 if cfg.tspace <= 125 else 65535)
+        trace[t, 1] = b1 - b0
+    ovl.trace = trace
+    ovl.diffs = int(trace[:, 0].sum())
+    return ovl
+
+
+def simulate(cfg: SimConfig) -> SimResult:
+    rng = np.random.default_rng(cfg.seed)
+    genome = _make_genome(cfg, rng)
+
+    nbases_target = cfg.genome_len * cfg.coverage
+    reads: list[SimRead] = []
+    total = 0
+    while total < nbases_target:
+        ln = int(rng.lognormal(np.log(cfg.read_len_mean), cfg.read_len_sigma))
+        ln = max(300, min(ln, cfg.genome_len))
+        start = int(rng.integers(0, cfg.genome_len - ln + 1))
+        strand = int(rng.integers(0, 2))
+        fwd, g_of_r, err, dels = _sample_noisy(genome, start, start + ln, cfg, rng)
+        if len(fwd) < 100:
+            continue
+        if strand == 1:
+            seq = revcomp_ints(fwd)
+            g_of_r = g_of_r[::-1].copy()
+            err = err[::-1].copy()
+        else:
+            seq = fwd
+        reads.append(SimRead(start=start, end=start + ln, strand=strand,
+                             seq=seq, g_of_r=g_of_r, err=err, dels=dels))
+        total += len(fwd)
+
+    # all true pairwise overlaps, both directions, sorted by aread
+    overlaps: list[Overlap] = []
+    order = np.argsort([r.start for r in reads], kind="stable")
+    starts = np.array([r.start for r in reads])[order]
+    for ai in range(len(reads)):
+        a = reads[ai]
+        # candidate B reads: start before a.end (and end after a.start)
+        hi = int(np.searchsorted(starts, a.end))
+        for oj in range(hi):
+            bi = int(order[oj])
+            if bi == ai:
+                continue
+            b = reads[bi]
+            if b.end <= a.start:
+                continue
+            ovl = _true_overlap(a, b, ai, bi, cfg)
+            if ovl is not None:
+                overlaps.append(ovl)
+    overlaps.sort(key=lambda o: (o.aread, o.bread))
+    return SimResult(genome=genome, reads=reads, overlaps=overlaps, config=cfg)
+
+
+def make_dataset(outdir: str, cfg: SimConfig, name: str = "sim") -> dict:
+    """Materialize a SimResult as DB + LAS + truth files; returns paths."""
+    os.makedirs(outdir, exist_ok=True)
+    res = simulate(cfg)
+    db_path = os.path.join(outdir, f"{name}.db")
+    las_path = os.path.join(outdir, f"{name}.las")
+    truth_path = os.path.join(outdir, f"{name}.truth.npz")
+
+    write_db(db_path, [r.seq for r in res.reads])
+    write_las(las_path, cfg.tspace, res.overlaps)
+    np.savez_compressed(
+        truth_path,
+        genome=res.genome,
+        starts=np.array([r.start for r in res.reads], dtype=np.int64),
+        ends=np.array([r.end for r in res.reads], dtype=np.int64),
+        strands=np.array([r.strand for r in res.reads], dtype=np.int8),
+    )
+    with open(os.path.join(outdir, f"{name}.config.json"), "wt") as fh:
+        json.dump(asdict(cfg), fh, indent=2)
+    return {"db": db_path, "las": las_path, "truth": truth_path, "result": res}

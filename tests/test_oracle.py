@@ -1,0 +1,127 @@
+"""Oracle consensus: alignment primitives, profile estimation, Q-score uplift."""
+
+import numpy as np
+import pytest
+
+from daccord_tpu.oracle import (
+    ConsensusConfig,
+    correct_read,
+    cut_windows,
+    edit_distance,
+    estimate_profile_two_pass,
+    infix_distance,
+    make_offset_likely,
+    refine_overlap,
+    solve_window,
+)
+from daccord_tpu.oracle.profile import ErrorProfile, OffsetLikely
+from daccord_tpu.sim import SimConfig, simulate
+from daccord_tpu.utils import revcomp_ints, seq_to_ints
+
+
+def _brute_ed(a, b):
+    n, m = len(a), len(b)
+    D = np.zeros((n + 1, m + 1), dtype=int)
+    D[0] = np.arange(m + 1)
+    D[:, 0] = np.arange(n + 1)
+    for i in range(1, n + 1):
+        for j in range(1, m + 1):
+            D[i, j] = min(D[i - 1, j - 1] + (a[i - 1] != b[j - 1]), D[i - 1, j] + 1, D[i, j - 1] + 1)
+    return D[n, m]
+
+
+def test_edit_distance_matches_bruteforce():
+    rng = np.random.default_rng(3)
+    for _ in range(25):
+        a = rng.integers(0, 4, rng.integers(0, 40), np.int8)
+        b = rng.integers(0, 4, rng.integers(0, 40), np.int8)
+        assert edit_distance(a, b) == _brute_ed(a, b)
+
+
+def test_infix_distance():
+    hay = seq_to_ints("ACGTACGTACGTTTTACGT")
+    assert infix_distance(seq_to_ints("GTACGT"), hay) == 0
+    assert infix_distance(seq_to_ints("GTACCT"), hay) == 1
+    assert infix_distance(np.zeros(0, np.int8), hay) == 0
+
+
+def test_offset_likely_shape_and_drift():
+    prof = ErrorProfile(p_ins=0.08, p_del=0.04, p_sub=0.015)
+    ol = OffsetLikely(prof, positions=40, max_offset=56)
+    assert ol.table.shape == (40, 56)
+    np.testing.assert_allclose(ol.table.sum(axis=1), 1.0, atol=1e-3)
+    # positive drift: mean offset at position 30 should exceed 30
+    mean30 = (ol.table[30] * np.arange(56)).sum()
+    assert 30.0 < mean30 < 33.0
+
+
+@pytest.fixture(scope="module")
+def pile_fixture():
+    cfg = SimConfig(genome_len=3000, coverage=18, read_len_mean=900, seed=7)
+    res = simulate(cfg)
+    # choose a read comfortably inside the genome
+    aread = max(range(len(res.reads)),
+                key=lambda i: min(res.reads[i].start, cfg.genome_len - res.reads[i].end) > 200 and len(res.reads[i].seq) or 0)
+    pile = [o for o in res.overlaps if o.aread == aread]
+    a = res.reads[aread].seq
+    refined = [refine_overlap(o, a, res.reads[o.bread].seq, cfg.tspace) for o in pile]
+    return cfg, res, aread, a, refined
+
+
+def test_refine_overlap_maps_are_monotone(pile_fixture):
+    _, _, _, _, refined = pile_fixture
+    for r in refined[:10]:
+        assert np.all(np.diff(r.a2b) >= 0)
+        assert r.a2b[0] == r.ovl.bbpos and r.a2b[-1] == r.ovl.bepos
+
+
+def test_profile_estimation(pile_fixture):
+    cfg, _, _, a, refined = pile_fixture
+    ccfg = ConsensusConfig()
+    windows = cut_windows(a, refined, w=ccfg.w, adv=ccfg.adv)
+    prof = estimate_profile_two_pass(refined, windows, ccfg, sample=24)
+    # within a factor ~2 of the generative rates
+    assert 0.03 < prof.p_ins < 0.16
+    assert 0.015 < prof.p_del < 0.09
+    assert prof.p_sub < 0.06
+
+
+def test_qscore_uplift(pile_fixture):
+    cfg, res, aread, a, refined = pile_fixture
+    ccfg = ConsensusConfig()
+    windows = cut_windows(a, refined, w=ccfg.w, adv=ccfg.adv)
+    prof = estimate_profile_two_pass(refined, windows, ccfg, sample=24)
+    ols = make_offset_likely(prof, ccfg)
+    corr = correct_read(a, windows, ols, ccfg)
+    assert corr.n_solved / corr.n_windows > 0.9
+
+    r = res.reads[aread]
+    truth = res.genome[r.start : r.end]
+    if r.strand == 1:
+        truth = revcomp_ints(truth)
+    raw_err = edit_distance(r.seq, truth) / len(truth)
+    tot_e = sum(infix_distance(f, truth) for f in corr.fragments)
+    tot_l = sum(len(f) for f in corr.fragments)
+    assert tot_l > 0.9 * len(truth)
+    corr_err = tot_e / tot_l
+    # >= 10x error-rate reduction (about +10 Q)
+    assert corr_err < raw_err / 10, (corr_err, raw_err)
+
+
+def test_unsolved_window_splits_or_patches(pile_fixture):
+    """A window with no segments must split the read in split mode and be
+    patched with raw bases in patch mode."""
+    cfg, res, aread, a, refined = pile_fixture
+    ccfg = ConsensusConfig()
+    windows = cut_windows(a, refined, w=ccfg.w, adv=ccfg.adv)
+    prof = estimate_profile_two_pass(refined, windows, ccfg, sample=16)
+    ols = make_offset_likely(prof, ccfg)
+    # poison the middle window
+    mid = len(windows) // 2
+    windows[mid].segments = []
+    corr = correct_read(a, windows, ols, ccfg)
+    assert len(corr.fragments) >= 2
+
+    ccfg2 = ConsensusConfig(mode="patch")
+    corr2 = correct_read(a, windows, ols, ccfg2)
+    assert len(corr2.fragments) == 1

@@ -1,0 +1,69 @@
+"""Synthetic dataset generator invariants."""
+
+import numpy as np
+
+from daccord_tpu.formats import LasFile, read_db
+from daccord_tpu.oracle import edit_distance
+from daccord_tpu.sim import SimConfig, make_dataset, simulate
+from daccord_tpu.utils import revcomp_ints
+
+CFG = SimConfig(genome_len=3000, coverage=12, read_len_mean=800, seed=5)
+
+
+def test_simulate_basic():
+    res = simulate(CFG)
+    assert len(res.reads) > 10
+    assert len(res.overlaps) > 50
+    # piles sorted by aread
+    areads = [o.aread for o in res.overlaps]
+    assert areads == sorted(areads)
+    # both orientations appear
+    assert any(o.is_comp for o in res.overlaps)
+    assert any(not o.is_comp for o in res.overlaps)
+    # symmetry: (a,b) implies (b,a)
+    pairs = {(o.aread, o.bread) for o in res.overlaps}
+    assert all((b, a) in pairs for a, b in pairs)
+
+
+def test_trace_consistency():
+    res = simulate(CFG)
+    for o in res.overlaps[:100]:
+        assert o.trace[:, 1].sum() == o.bepos - o.bbpos
+        assert o.trace.shape[0] == o.ntiles(CFG.tspace)
+        assert 0 <= o.abpos < o.aepos <= len(res.reads[o.aread].seq)
+        blen = len(res.reads[o.bread].seq)
+        assert 0 <= o.bbpos < o.bepos <= blen
+
+
+def test_overlap_segments_align():
+    """Tile segments must actually align: pair error rate < 3x single-read."""
+    res = simulate(CFG)
+    e = CFG.p_ins + CFG.p_del + CFG.p_sub
+    checked = 0
+    for o in res.overlaps[:20]:
+        a = res.reads[o.aread].seq
+        b = res.reads[o.bread].seq
+        b_or = revcomp_ints(b) if o.is_comp else b
+        bounds = o.tile_bounds(CFG.tspace)
+        bpos = o.bbpos
+        for t in range(len(bounds) - 1):
+            atile = a[bounds[t] : bounds[t + 1]]
+            btile = b_or[bpos : bpos + int(o.trace[t, 1])]
+            bpos += int(o.trace[t, 1])
+            d = edit_distance(atile, btile)
+            assert d <= 3.0 * e * len(atile) + 8, (o.aread, o.bread, t, d, len(atile))
+            checked += 1
+    assert checked > 50
+
+
+def test_make_dataset_roundtrip(tmp_path):
+    out = make_dataset(str(tmp_path), CFG, name="t")
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    assert db.nreads == len(out["result"].reads)
+    assert las.novl == len(out["result"].overlaps)
+    tru = np.load(out["truth"])
+    assert len(tru["genome"]) == CFG.genome_len
+    assert len(tru["starts"]) == db.nreads
+    # read bases round-trip through the DB
+    np.testing.assert_array_equal(db.read_bases(0), out["result"].reads[0].seq)
