@@ -1,0 +1,92 @@
+"""Window batch tensorization: ragged piles -> fixed-shape device tensors.
+
+The reference processes one ragged window at a time inside ``handleWindow``;
+the TPU path instead packs W windows x D segments x L bases into padded int8
+tensors (PAD=4) with explicit lengths, the shape the batched kernel consumes
+(SURVEY.md §7.1 item 3 "Tensorization"). Depth above ``max_depth`` is capped
+(the A-read segment, placed first, always survives the cap).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..oracle.windows import WindowSegments
+from ..utils.bases import PAD
+
+
+@dataclass
+class BatchShape:
+    depth: int = 32       # D: max segments per window
+    seg_len: int = 64     # L: max segment length
+    wlen: int = 40        # w: window length (static for the kernel)
+
+
+@dataclass
+class WindowBatch:
+    """Fixed-shape batch of windows. Arrays are host numpy; runtime ships them
+    to the device (DLPack/zero-copy where possible)."""
+
+    seqs: np.ndarray      # int8 [B, D, L], PAD=4 beyond lens
+    lens: np.ndarray      # int32 [B, D], 0 for absent segments
+    nsegs: np.ndarray     # int32 [B]
+    shape: BatchShape
+    # bookkeeping for scatter-back (parallel lists, length B)
+    read_ids: np.ndarray  # int64 [B]
+    wstarts: np.ndarray   # int64 [B]
+
+    @property
+    def size(self) -> int:
+        return len(self.nsegs)
+
+    def pad_waste(self) -> float:
+        """Fraction of seq cells that are padding (the §7.3 metric)."""
+        total = self.seqs.size
+        used = int(self.lens.sum())
+        return 1.0 - used / max(total, 1)
+
+
+def tensorize_windows(items: list[tuple[int, WindowSegments]],
+                      shape: BatchShape) -> WindowBatch:
+    """Pack (read_id, WindowSegments) pairs into one WindowBatch."""
+    B = len(items)
+    D, L = shape.depth, shape.seg_len
+    seqs = np.full((B, D, L), PAD, dtype=np.int8)
+    lens = np.zeros((B, D), dtype=np.int32)
+    nsegs = np.zeros(B, dtype=np.int32)
+    read_ids = np.zeros(B, dtype=np.int64)
+    wstarts = np.zeros(B, dtype=np.int64)
+    for b, (rid, ws) in enumerate(items):
+        read_ids[b] = rid
+        wstarts[b] = ws.wstart
+        d = 0
+        for seg in ws.segments:
+            if d >= D:
+                break
+            s = np.asarray(seg, dtype=np.int8)[:L]
+            seqs[b, d, : len(s)] = s
+            lens[b, d] = len(s)
+            d += 1
+        nsegs[b] = d
+    return WindowBatch(seqs=seqs, lens=lens, nsegs=nsegs, shape=shape,
+                       read_ids=read_ids, wstarts=wstarts)
+
+
+def pad_batch(batch: WindowBatch, target: int) -> WindowBatch:
+    """Pad a batch to ``target`` windows (static batch shapes for jit)."""
+    B = batch.size
+    if B == target:
+        return batch
+    assert B < target
+    pad = target - B
+    D, L = batch.shape.depth, batch.shape.seg_len
+    return WindowBatch(
+        seqs=np.concatenate([batch.seqs, np.full((pad, D, L), PAD, dtype=np.int8)]),
+        lens=np.concatenate([batch.lens, np.zeros((pad, D), dtype=np.int32)]),
+        nsegs=np.concatenate([batch.nsegs, np.zeros(pad, dtype=np.int32)]),
+        shape=batch.shape,
+        read_ids=np.concatenate([batch.read_ids, np.full(pad, -1, dtype=np.int64)]),
+        wstarts=np.concatenate([batch.wstarts, np.zeros(pad, dtype=np.int64)]),
+    )

@@ -1,0 +1,110 @@
+"""Host-side escalation ladder over the batched window kernel.
+
+The reference escalates k inside ``handleWindow`` per window; on device that
+would force data-dependent control flow, so the ladder runs per *batch*: tier
+1 solves ~90%+ of windows, later tiers re-run only if failures remain (each
+tier is its own jitted program with static k — SURVEY.md §7.3 item 4 "adaptive
+k without recompilation storms": fixed tiers, per-tier jitted fns, failure
+routing on host).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..oracle.consensus import ConsensusConfig
+from ..oracle.profile import ErrorProfile, OffsetLikely
+from .tensorize import WindowBatch
+from .window_kernel import KernelParams, solve_window_batch
+
+
+@dataclass
+class TierLadder:
+    params: list[KernelParams]
+    tables: dict[int, jnp.ndarray]   # k -> OL table [P, O] f32
+
+    @classmethod
+    def from_config(cls, profile: ErrorProfile, cfg: ConsensusConfig,
+                    max_kmers: int = 64, rescue_max_kmers: int = 256) -> "TierLadder":
+        tables = {}
+        for k in cfg.k_values:
+            P = cfg.w - k + 1 + cfg.dbg.len_slack
+            O = cfg.w + 16
+            tables[k] = jnp.asarray(OffsetLikely(profile, positions=P, max_offset=O).table)
+        params = [
+            KernelParams(k=k, min_count=mc, edge_min_count=emc,
+                         count_frac=cfg.dbg.count_frac,
+                         anchor_slack=cfg.dbg.anchor_slack,
+                         end_slack=cfg.dbg.end_slack,
+                         len_slack=cfg.dbg.len_slack,
+                         n_candidates=cfg.dbg.n_candidates,
+                         min_depth=cfg.dbg.min_depth,
+                         max_err=cfg.dbg.max_err,
+                         # min_count=1 tiers keep every count-1 k-mer; they need
+                         # a much larger active set or the rescue fails on the
+                         # arbitrary truncation (run compacted, so affordable)
+                         max_kmers=rescue_max_kmers if mc <= 1 else max_kmers,
+                         wlen=cfg.w)
+            for k, mc, emc in cfg.tiers
+        ]
+        return cls(params=params, tables=tables)
+
+
+def solve_tiered(batch: WindowBatch, ladder: TierLadder,
+                 compact_size: int = 64) -> dict:
+    """Run the escalation ladder; returns host numpy results per window.
+
+    Tier 0 runs on the full batch; failures are *compacted* into fixed-size
+    sub-batches of ``compact_size`` (padded) for the escalation tiers, so the
+    expensive rescue tiers only pay for the <10% of windows that need them and
+    every tier keeps a single static shape (no recompilation storms).
+
+    Output dict: cons int8 [B, CL], cons_len i32 [B], err f32 [B],
+    solved bool [B], tier i32 [B] (-1 = unsolved).
+    """
+    B = batch.size
+    CL = ladder.params[0].cons_len
+    cons = np.full((B, CL), 4, dtype=np.int8)
+    cons_len = np.zeros(B, dtype=np.int32)
+    err = np.full(B, np.inf, dtype=np.float32)
+    solved = np.zeros(B, dtype=bool)
+    tier_of = np.full(B, -1, dtype=np.int32)
+
+    p0 = ladder.params[0]
+    out = solve_window_batch(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
+                             jnp.asarray(batch.nsegs), ladder.tables[p0.k], p0)
+    o_solved = np.asarray(out["solved"])
+    if o_solved.any():
+        cons[o_solved] = np.asarray(out["cons"])[o_solved]
+        cons_len[o_solved] = np.asarray(out["cons_len"])[o_solved]
+        err[o_solved] = np.asarray(out["err"])[o_solved]
+        solved[o_solved] = True
+        tier_of[o_solved] = 0
+
+    for ti, p in enumerate(ladder.params[1:], start=1):
+        idx = np.nonzero(~solved & (batch.nsegs >= p.min_depth))[0]
+        if len(idx) == 0:
+            break
+        for c0 in range(0, len(idx), compact_size):
+            sub = idx[c0 : c0 + compact_size]
+            n = len(sub)
+            sseqs = np.full((compact_size,) + batch.seqs.shape[1:], 4, dtype=np.int8)
+            slens = np.zeros((compact_size, batch.lens.shape[1]), dtype=np.int32)
+            snsegs = np.zeros(compact_size, dtype=np.int32)
+            sseqs[:n] = batch.seqs[sub]
+            slens[:n] = batch.lens[sub]
+            snsegs[:n] = batch.nsegs[sub]
+            out = solve_window_batch(jnp.asarray(sseqs), jnp.asarray(slens),
+                                     jnp.asarray(snsegs), ladder.tables[p.k], p)
+            s_solved = np.asarray(out["solved"])[:n]
+            take = sub[s_solved]
+            if len(take):
+                cons[take] = np.asarray(out["cons"])[:n][s_solved]
+                cons_len[take] = np.asarray(out["cons_len"])[:n][s_solved]
+                err[take] = np.asarray(out["err"])[:n][s_solved]
+                solved[take] = True
+                tier_of[take] = ti
+    return dict(cons=cons, cons_len=cons_len, err=err, solved=solved, tier=tier_of)

@@ -1,0 +1,230 @@
+"""Batched fixed-shape ``handleWindow`` on device (jit/vmap JAX).
+
+Device-side equivalent of the reference's L4 consensus core
+(``handleWindow`` / ``DebruijnGraph<k>`` / ``OffsetLikely`` in
+``src/daccord.cpp`` — structures named by BASELINE.json north_star; file:line
+backfill pending, SURVEY.md §0/§8), re-designed for the MXU/VPU:
+
+- k-mer extraction/packing and (k,k+1)-mer frequency filtering as vmapped jnp
+  sort/segment ops (BASELINE.json: "vmapped jnp ops");
+- per-window graph compaction to the top-M surviving k-mers; M x M adjacency
+  from (k+1)-mer support;
+- OffsetLikely position weights as one batched matmul (occ [M,O] x OL [O,P]);
+- heaviest path as bounded-length max-plus DP over lax.scan (cycles are
+  harmless under a length bound — the reference instead escalates k);
+- candidate rescoring as a batched full edit-distance DP with an
+  associative-scan prefix-min for the insertion recurrence.
+
+Semantics intentionally mirror ``oracle.dbg.window_consensus`` (tie-breaking
+included: k-mers kept in code-sorted order, argmax-first DP ties, t-major end
+state order); the parity harness in tests/test_kernels.py enforces this.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG = jnp.float32(-1e30)
+PAD = 4
+
+
+@dataclass(frozen=True)
+class KernelParams:
+    k: int = 8
+    min_count: int = 2
+    count_frac: float = 0.0
+    edge_min_count: int = 2
+    anchor_slack: int = 2
+    end_slack: int = 3
+    len_slack: int = 8
+    n_candidates: int = 3
+    min_depth: int = 3
+    max_err: float = 0.3
+    max_kmers: int = 64
+    wlen: int = 40
+
+    @property
+    def cons_len(self) -> int:
+        # P - 1 + k == wlen + len_slack for every k: one uniform output shape
+        return self.wlen + self.len_slack
+
+    @property
+    def positions(self) -> int:
+        return self.wlen - self.k + 1 + self.len_slack
+
+
+def _kmer_ids(seqs: jnp.ndarray, lens: jnp.ndarray, k: int) -> jnp.ndarray:
+    """[D, L] int8 -> [D, L-k+1] int32 codes; invalid positions = 4**k."""
+    D, L = seqs.shape
+    npos = L - k + 1
+    s = seqs.astype(jnp.int32)
+    ids = jnp.zeros((D, npos), dtype=jnp.int32)
+    for j in range(k):
+        ids = ids * 4 + s[:, j : j + npos]
+    valid = (jnp.arange(npos)[None, :] + k) <= lens[:, None]
+    return jnp.where(valid, ids, jnp.int32(4**k))
+
+
+def _edit_distance_row_scan(cand: jnp.ndarray, cand_len: jnp.ndarray,
+                            seg: jnp.ndarray, seg_len: jnp.ndarray) -> jnp.ndarray:
+    """Unit-cost edit distance of cand[:cand_len] vs seg[:seg_len] (full DP)."""
+    L = seg.shape[0]
+    ar = jnp.arange(L + 1, dtype=jnp.int32)
+
+    def row(prev, ci):
+        cb, i = ci
+        sub = prev[:L] + (seg != cb).astype(jnp.int32)
+        dele = prev[1:] + 1
+        best = jnp.minimum(sub, dele)
+        vals = jnp.concatenate([jnp.array([i], dtype=jnp.int32), best - ar[1:]])
+        cur = jax.lax.associative_scan(jnp.minimum, vals) + ar
+        return cur, cur[seg_len]
+
+    init = ar
+    _, outs = jax.lax.scan(row, init, (cand.astype(jnp.int32),
+                                       jnp.arange(1, cand.shape[0] + 1, dtype=jnp.int32)))
+    # outs[i-1] = D[i, seg_len]; i = cand_len
+    return jnp.where(cand_len == 0, seg_len,
+                     outs[jnp.clip(cand_len - 1, 0, cand.shape[0] - 1)])
+
+
+def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
+               ol: jnp.ndarray, p: KernelParams):
+    """Solve one window. seqs [D, L] int8, lens [D] i32, ol [P, O] f32."""
+    k, M = p.k, p.max_kmers
+    D, L = seqs.shape
+    npos = L - k + 1
+    SENT = jnp.int32(4**k)
+    P, O = ol.shape
+
+    # ---- k-mer counting + top-M compaction -----------------------------
+    ids = _kmer_ids(seqs, lens, k)                       # [D, npos]
+    flat = ids.reshape(-1)
+    N = flat.shape[0]
+    sorted_ids = jnp.sort(flat)
+    newrun = jnp.concatenate([jnp.array([True]), sorted_ids[1:] != sorted_ids[:-1]])
+    is_start = newrun & (sorted_ids < SENT)
+    run_id = jnp.cumsum(newrun.astype(jnp.int32)) - 1
+    counts = jax.ops.segment_sum((sorted_ids < SENT).astype(jnp.int32), run_id, num_segments=N)
+    start_counts = jnp.where(is_start, counts[run_id], 0)
+    thresh = jnp.maximum(jnp.int32(p.min_count),
+                         jnp.ceil(p.count_frac * nsegs).astype(jnp.int32))
+    start_counts = jnp.where(start_counts >= thresh, start_counts, 0)
+    topv, topi = jax.lax.top_k(start_counts, M)
+    sel = jnp.where(topv > 0, sorted_ids[topi], SENT)
+    sel = jnp.sort(sel)                                   # oracle order: code-ascending
+    sel_valid = sel < SENT
+
+    # ---- occurrences, anchors ------------------------------------------
+    eq = (ids[:, :, None] == sel[None, None, :]) & (ids < SENT)[:, :, None]  # [D,npos,M]
+    occ_pos = jnp.sum(eq, axis=0).astype(jnp.float32)     # [npos, M]
+    o_idx = jnp.minimum(jnp.arange(npos), O - 1)
+    occ = jax.ops.segment_sum(occ_pos, o_idx, num_segments=O).T  # [M, O]
+
+    offs = jnp.arange(npos)[None, :, None]
+    src_ok = jnp.any(eq & (offs <= p.anchor_slack), axis=(0, 1))
+    end_lo = (lens - k - p.end_slack)[:, None, None]
+    snk_ok = jnp.any(eq & (offs >= end_lo), axis=(0, 1))
+
+    # ---- (k+1)-mer edge support ----------------------------------------
+    ids1 = _kmer_ids(seqs, lens, k + 1).reshape(-1)
+    sorted1 = jnp.sort(ids1)
+    q = sel[:, None] * 4 + jnp.arange(4)[None, :]         # [M, 4]
+    ext = (jnp.searchsorted(sorted1, q.reshape(-1), side="right")
+           - jnp.searchsorted(sorted1, q.reshape(-1), side="left")).reshape(M, 4)
+    mask_km1 = jnp.int32(4 ** (k - 1) - 1)
+    compat = (sel[:, None] & mask_km1) == (sel[None, :] >> 2)
+    support = jnp.take_along_axis(ext, (sel & 3)[None, :].repeat(M, axis=0), axis=1)
+    adj = (compat & (support >= p.edge_min_count)
+           & sel_valid[:, None] & sel_valid[None, :])
+
+    # ---- position weights + heaviest-path DP ---------------------------
+    W = occ @ ol.T                                        # [M, P]
+    adjW = jnp.where(adj, jnp.float32(0), NEG)
+    score0 = jnp.where(src_ok & sel_valid, W[:, 0], NEG)
+
+    def step(s_prev, t):
+        cand = s_prev[:, None] + adjW                     # [u, v]
+        best_u = jnp.argmax(cand, axis=0)
+        best = jnp.max(cand, axis=0)
+        s_new = jnp.where(best > NEG / 2, best + W[:, t], NEG)
+        return s_new, (s_new, best_u.astype(jnp.int32))
+
+    _, (scores_rest, ptrs_rest) = jax.lax.scan(step, score0, jnp.arange(1, P))
+    scores = jnp.concatenate([score0[None], scores_rest])  # [P, M]
+    ptrs = jnp.concatenate([jnp.zeros((1, M), jnp.int32), ptrs_rest])
+
+    t_lo = max(0, p.wlen - k - p.len_slack)
+    t_hi = min(P - 1, p.wlen - k + p.len_slack)
+    t_ok = (jnp.arange(P) >= t_lo) & (jnp.arange(P) <= t_hi)
+    final = jnp.where(t_ok[:, None] & snk_ok[None, :], scores, NEG)
+
+    # ---- candidates: top states with distinct final k-mer --------------
+    CL = p.cons_len
+    seg_total = jnp.maximum(jnp.sum(lens), 1).astype(jnp.float32)
+
+    def backtrack(t_best, v_best):
+        def back(v, t):
+            node = jnp.where(t == t_best, v_best, v)
+            node = jnp.clip(node, 0, M - 1)
+            nxt = jnp.where((t <= t_best) & (t > 0), ptrs[t, node], node)
+            return nxt, node
+        _, nodes_rev = jax.lax.scan(back, jnp.int32(0), jnp.arange(P - 1, -1, -1))
+        path = nodes_rev[::-1]                            # [P]
+        first = sel[path[0]]
+        j = jnp.arange(CL)
+        shifts = 2 * (k - 1 - j)
+        head = (first >> jnp.clip(shifts, 0, 30)) & 3
+        tt = jnp.clip(j - k + 1, 0, P - 1)
+        tail = sel[path[tt]] & 3
+        base = jnp.where(j < k, head, tail)
+        cons = jnp.where(j < t_best + k, base, PAD).astype(jnp.int8)
+        return cons, (t_best + k).astype(jnp.int32)
+
+    def rescore(cons, cons_len):
+        dists = jax.vmap(lambda sg, sl: _edit_distance_row_scan(cons, cons_len, sg, sl))(
+            seqs, lens)
+        dists = jnp.where(lens > 0, dists, 0)
+        return jnp.sum(dists).astype(jnp.float32) / seg_total
+
+    chosen = jnp.zeros(M, dtype=bool)
+    best_err = jnp.float32(jnp.inf)
+    best_cons = jnp.full(CL, PAD, dtype=jnp.int8)
+    best_len = jnp.int32(0)
+    any_path = jnp.bool_(False)
+    for _ in range(p.n_candidates):
+        fmask = jnp.where(chosen[None, :], NEG, final)
+        idx = jnp.argmax(fmask.reshape(-1))
+        sc = fmask.reshape(-1)[idx]
+        ok = sc > NEG / 2
+        t_best = (idx // M).astype(jnp.int32)
+        v_best = (idx % M).astype(jnp.int32)
+        cons, clen = backtrack(t_best, v_best)
+        err = jnp.where(ok, rescore(cons, clen), jnp.float32(jnp.inf))
+        better = ok & (err < best_err)
+        best_err = jnp.where(better, err, best_err)
+        best_cons = jnp.where(better, cons, best_cons)
+        best_len = jnp.where(better, clen, best_len)
+        any_path = any_path | ok
+        chosen = chosen.at[v_best].set(True)
+
+    solved = (any_path & (best_err <= p.max_err) & (nsegs >= p.min_depth))
+    out_cons = jnp.where(solved, best_cons, PAD).astype(jnp.int8)
+    return dict(cons=out_cons,
+                cons_len=jnp.where(solved, best_len, 0),
+                err=jnp.where(any_path, best_err, jnp.float32(jnp.inf)),
+                solved=solved)
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def solve_window_batch(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
+                       ol: jnp.ndarray, params: KernelParams):
+    """Solve a batch: seqs [B,D,L] int8, lens [B,D] i32, nsegs [B] i32,
+    ol [P,O] f32 (the OffsetLikely table for params.k)."""
+    fn = functools.partial(_solve_one, p=params)
+    return jax.vmap(fn, in_axes=(0, 0, 0, None))(seqs, lens, nsegs, ol)

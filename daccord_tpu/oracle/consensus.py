@@ -111,13 +111,19 @@ def _splice(acc: np.ndarray, nxt: np.ndarray, nominal_olap: int) -> np.ndarray |
     return np.concatenate([acc, nxt[b_end:]])
 
 
-def correct_read(a_bases: np.ndarray, windows: list[WindowSegments],
-                 ol_tables: dict[int, OffsetLikely], cfg: ConsensusConfig) -> CorrectedRead:
+def stitch_results(a_bases: np.ndarray,
+                   results: list[tuple[int, int, np.ndarray | None]],
+                   cfg: ConsensusConfig) -> list[np.ndarray]:
+    """Stitch per-window consensi into corrected fragments.
+
+    ``results`` rows are (wstart, wlen, consensus-or-None) in window order.
+    Separated from the solving loop so the device pipeline (which solves
+    windows in large cross-read batches) can reuse the exact stitching
+    semantics of the oracle.
+    """
     frags: list[np.ndarray] = []
     acc: np.ndarray | None = None
-    acc_end = 0                     # A coordinate the accumulator extends to
-    n_solved = 0
-    khist: dict = {}
+    acc_end = 0
 
     def flush():
         nonlocal acc
@@ -125,32 +131,44 @@ def correct_read(a_bases: np.ndarray, windows: list[WindowSegments],
             frags.append(acc)
         acc = None
 
-    for ws in windows:
-        res = solve_window(ws, ol_tables, cfg)
-        if res.seq is None:
+    for wstart, wlen, seq in results:
+        if seq is None:
             if cfg.mode == "patch":
-                patch = np.asarray(a_bases[ws.wstart : ws.wstart + ws.wlen], dtype=np.int8)
+                patch = np.asarray(a_bases[wstart : wstart + wlen], dtype=np.int8)
                 if acc is None:
                     acc = patch
                 else:
-                    olap = acc_end - ws.wstart
+                    olap = acc_end - wstart
                     acc = np.concatenate([acc[: len(acc) - max(olap, 0)], patch]) if olap > 0 else np.concatenate([acc, patch])
-                acc_end = ws.wstart + ws.wlen
+                acc_end = wstart + wlen
             else:
                 flush()
             continue
-        n_solved += 1
-        khist[res.k] = khist.get(res.k, 0) + 1
         if acc is None:
-            acc = res.seq
+            acc = seq
         else:
-            spliced = _splice(acc, res.seq, nominal_olap=acc_end - ws.wstart)
+            spliced = _splice(acc, seq, nominal_olap=acc_end - wstart)
             if spliced is None:
                 flush()
-                acc = res.seq
+                acc = seq
             else:
                 acc = spliced
-        acc_end = ws.wstart + ws.wlen
+        acc_end = wstart + wlen
     flush()
+    return frags
+
+
+def correct_read(a_bases: np.ndarray, windows: list[WindowSegments],
+                 ol_tables: dict[int, OffsetLikely], cfg: ConsensusConfig) -> CorrectedRead:
+    rows: list[tuple[int, int, np.ndarray | None]] = []
+    n_solved = 0
+    khist: dict = {}
+    for ws in windows:
+        res = solve_window(ws, ol_tables, cfg)
+        rows.append((ws.wstart, ws.wlen, res.seq))
+        if res.seq is not None:
+            n_solved += 1
+            khist[res.k] = khist.get(res.k, 0) + 1
+    frags = stitch_results(a_bases, rows, cfg)
     return CorrectedRead(fragments=frags, n_windows=len(windows), n_solved=n_solved,
                          k_histogram=khist)

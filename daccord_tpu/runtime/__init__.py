@@ -1,0 +1,3 @@
+from .pipeline import PipelineConfig, PipelineStats, correct_shard, correct_to_fasta, estimate_profile_for_shard
+
+__all__ = ["PipelineConfig", "PipelineStats", "correct_shard", "correct_to_fasta", "estimate_profile_for_shard"]

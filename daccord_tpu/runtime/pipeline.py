@@ -1,0 +1,194 @@
+"""End-to-end correction pipeline: LAS piles -> window batches -> device -> FASTA.
+
+The reference's L5 orchestration (SimpleThreadPool work packages fanning reads
+to handleWindow, ordered output — SURVEY.md §3.1) re-imagined as a host->device
+pipeline: the host streams piles from the LAS byte range, refines trace points,
+cuts windows, and accumulates them into fixed-size cross-read batches; the
+device solves batches through the tier ladder; results scatter back to their
+reads and each completed read is stitched and written in input order.
+
+The profile pass (reference: error-profile estimation over sampled piles)
+runs once up front on the first piles of the shard.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..formats.dazzdb import DazzDB, read_db
+from ..formats.fasta import FastaRecord, write_fasta
+from ..formats.las import LasFile
+from ..kernels.tensorize import BatchShape, pad_batch, tensorize_windows
+from ..kernels.tiers import TierLadder, solve_tiered
+from ..oracle.consensus import ConsensusConfig, estimate_profile_two_pass, stitch_results
+from ..oracle.profile import ErrorProfile
+from ..oracle.windows import WindowSegments, build_pile_windows, cut_windows, refine_overlap
+from ..utils.bases import ints_to_seq
+
+
+@dataclass
+class PipelineConfig:
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    batch_size: int = 512
+    depth: int = 32
+    seg_len: int = 64
+    profile_sample_piles: int = 4
+    verbose: bool = False
+
+
+@dataclass
+class PipelineStats:
+    n_reads: int = 0
+    n_windows: int = 0
+    n_solved: int = 0
+    n_fragments: int = 0
+    bases_in: int = 0
+    bases_out: int = 0
+    tier_histogram: dict = field(default_factory=dict)
+    pad_waste: float = 0.0
+    wall_s: float = 0.0
+    device_s: float = 0.0
+
+    def bases_per_sec(self) -> float:
+        return self.bases_out / self.wall_s if self.wall_s > 0 else 0.0
+
+
+class _PendingRead:
+    __slots__ = ("aread", "a_bases", "n_windows", "results", "n_done")
+
+    def __init__(self, aread: int, a_bases: np.ndarray, n_windows: int):
+        self.aread = aread
+        self.a_bases = a_bases
+        self.n_windows = n_windows
+        self.results: list = [None] * n_windows
+        self.n_done = 0
+
+
+def estimate_profile_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
+                               start: int | None = None, end: int | None = None) -> ErrorProfile:
+    """Profile pass over the first piles of the shard."""
+    refined_all = []
+    windows_all: list[WindowSegments] = []
+    for i, (aread, pile) in enumerate(las.iter_piles(start, end)):
+        if i >= cfg.profile_sample_piles:
+            break
+        a_bases = db.read_bases(aread)
+        refined = [refine_overlap(o, a_bases, db.read_bases(o.bread), las.tspace) for o in pile]
+        refined_all.extend(refined)
+        windows_all.extend(cut_windows(a_bases, refined, w=cfg.consensus.w, adv=cfg.consensus.adv))
+    return estimate_profile_two_pass(refined_all, windows_all, cfg.consensus, sample=32)
+
+
+def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
+                  start: int | None = None, end: int | None = None,
+                  profile: ErrorProfile | None = None,
+                  solver=None):
+    """Correct every pile in the byte range; yields (aread, [fragments]).
+
+    ``solver`` maps a WindowBatch to the solve_tiered output dict; defaults to
+    the local single-device ladder. The parallel backend passes a sharded one.
+    """
+    stats = PipelineStats()
+    t_start = time.time()
+    if profile is None:
+        profile = estimate_profile_for_shard(db, las, cfg, start, end)
+    ladder = TierLadder.from_config(profile, cfg.consensus)
+    if solver is None:
+        def solver(batch):
+            return solve_tiered(batch, ladder)
+
+    shape = BatchShape(depth=cfg.depth, seg_len=cfg.seg_len, wlen=cfg.consensus.w)
+    queue: list[tuple[int, WindowSegments]] = []
+    pending: dict[int, _PendingRead] = {}
+    order: list[int] = []
+    ready: dict[int, list[np.ndarray]] = {}
+    emit_idx = 0
+    pad_cells = pad_used = 0
+
+    def flush_batch(final: bool):
+        nonlocal queue, pad_cells, pad_used, emit_idx
+        while queue and (len(queue) >= cfg.batch_size or final):
+            chunk, queue = queue[: cfg.batch_size], queue[cfg.batch_size :]
+            batch = pad_batch(tensorize_windows(chunk, shape), cfg.batch_size)
+            t0 = time.time()
+            out = solver(batch)
+            stats.device_s += time.time() - t0
+            pad_cells += batch.seqs.size
+            pad_used += int(batch.lens.sum())
+            for i, (rid, ws) in enumerate(chunk):
+                pr = pending[rid]
+                widx = (ws.wstart // cfg.consensus.adv)
+                seq = (np.asarray(out["cons"][i][: out["cons_len"][i]], dtype=np.int8)
+                       if out["solved"][i] else None)
+                pr.results[widx] = (ws.wstart, ws.wlen, seq)
+                pr.n_done += 1
+                if out["solved"][i]:
+                    stats.n_solved += 1
+                    t = int(out["tier"][i])
+                    stats.tier_histogram[t] = stats.tier_histogram.get(t, 0) + 1
+                if pr.n_done == pr.n_windows:
+                    rows = [r for r in pr.results if r is not None]
+                    frags = stitch_results(pr.a_bases, rows, cfg.consensus)
+                    ready[rid] = frags
+                    del pending[rid]
+
+    for aread, pile in las.iter_piles(start, end):
+        a_bases = db.read_bases(aread)
+        stats.bases_in += len(a_bases)
+        refined = [refine_overlap(o, a_bases, db.read_bases(o.bread), las.tspace) for o in pile]
+        windows = cut_windows(a_bases, refined, w=cfg.consensus.w, adv=cfg.consensus.adv)
+        stats.n_reads += 1
+        stats.n_windows += len(windows)
+        pr = _PendingRead(aread, a_bases, len(windows))
+        pending[aread] = pr
+        order.append(aread)
+        if not windows:
+            ready[aread] = []
+            del pending[aread]
+        queue.extend((aread, ws) for ws in windows)
+        flush_batch(final=False)
+        # emit completed reads in order
+        while emit_idx < len(order) and order[emit_idx] in ready:
+            rid = order[emit_idx]
+            frags = ready.pop(rid)
+            stats.n_fragments += len(frags)
+            stats.bases_out += sum(len(f) for f in frags)
+            yield rid, frags, stats
+            emit_idx += 1
+
+    flush_batch(final=True)
+    while emit_idx < len(order):
+        rid = order[emit_idx]
+        frags = ready.pop(rid, [])
+        stats.n_fragments += len(frags)
+        stats.bases_out += sum(len(f) for f in frags)
+        yield rid, frags, stats
+        emit_idx += 1
+    stats.wall_s = time.time() - t_start
+
+
+def correct_to_fasta(db_path: str, las_path: str, out_path, cfg: PipelineConfig | None = None,
+                     start: int | None = None, end: int | None = None) -> PipelineStats:
+    """Run the pipeline and write corrected fragments as FASTA (stdout with '-')."""
+    cfg = cfg or PipelineConfig()
+    db = read_db(db_path)
+    las = LasFile(las_path)
+    t0 = time.time()
+    stats: PipelineStats | None = None
+    recs = []
+    for rid, frags, st in correct_shard(db, las, cfg, start, end):
+        stats = st
+        for fi, f in enumerate(frags):
+            recs.append(FastaRecord(f"read{rid}/{fi}", ints_to_seq(f)))
+    if out_path == "-":
+        write_fasta(sys.stdout, recs)
+    else:
+        write_fasta(out_path, recs)
+    if stats is None:
+        stats = PipelineStats()
+    stats.wall_s = time.time() - t0
+    return stats
