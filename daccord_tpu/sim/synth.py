@@ -117,16 +117,19 @@ def _sample_noisy(genome: np.ndarray, start: int, end: int, cfg: SimConfig,
     return read, g_of_r, err, dels
 
 
-def _make_genome(cfg: SimConfig, rng: np.random.Generator) -> np.ndarray:
+def _make_genome(cfg: SimConfig, rng: np.random.Generator) -> tuple[np.ndarray, tuple | None]:
+    """Returns (genome, repeat) where repeat = (src, dst, rep_len) or None."""
     g = rng.integers(0, 4, size=cfg.genome_len, dtype=np.int8)
+    rep = None
     if cfg.repeat_fraction > 0:
-        # plant a tandem-ish repeat: copy one segment to another location
+        # plant a two-copy exact repeat: copy one segment to another location
         rep_len = int(cfg.genome_len * cfg.repeat_fraction / 2)
         if rep_len > 100:
-            src = rng.integers(0, cfg.genome_len - rep_len)
-            dst = rng.integers(0, cfg.genome_len - rep_len)
+            src = int(rng.integers(0, cfg.genome_len // 2 - rep_len))
+            dst = int(rng.integers(cfg.genome_len // 2, cfg.genome_len - rep_len))
             g[dst : dst + rep_len] = g[src : src + rep_len]
-    return g
+            rep = (src, dst, rep_len)
+    return g, rep
 
 
 def _oriented_maps(r: SimRead, comp: bool) -> tuple[np.ndarray, np.ndarray]:
@@ -149,10 +152,20 @@ def _positions_in(g_of_r: np.ndarray, glo: int, ghi: int, ascending: bool) -> tu
     return lo, hi
 
 
-def _true_overlap(a: SimRead, b: SimRead, ai: int, bi: int, cfg: SimConfig) -> Overlap | None:
-    """Construct the true overlap record (A as stored; B possibly complemented)."""
-    glo = max(a.start, b.start)
-    ghi = min(a.end, b.end)
+def _true_overlap(a: SimRead, b: SimRead, ai: int, bi: int, cfg: SimConfig,
+                  shift: int = 0, clamp: tuple[int, int] | None = None) -> Overlap | None:
+    """Construct the true overlap record (A as stored; B possibly complemented).
+
+    ``shift`` maps B's genome coordinates into A's frame (used for overlaps
+    induced by an exact planted repeat copy: B positions g map to A positions
+    g - shift). ``clamp`` restricts the overlap to an A-frame interval (the
+    repeat body — flanks beyond the copy do not match).
+    """
+    glo = max(a.start, b.start - shift)
+    ghi = min(a.end, b.end - shift)
+    if clamp is not None:
+        glo = max(glo, clamp[0])
+        ghi = min(ghi, clamp[1])
     if ghi - glo < cfg.min_overlap:
         return None
     comp = a.strand != b.strand
@@ -160,7 +173,7 @@ def _true_overlap(a: SimRead, b: SimRead, ai: int, bi: int, cfg: SimConfig) -> O
     gB, errB = _oriented_maps(b, comp)
     a_asc = a.strand == 0
     abpos, aepos = _positions_in(a.g_of_r, glo, ghi, a_asc)
-    bbpos, bepos = _positions_in(gB, glo, ghi, a_asc)
+    bbpos, bepos = _positions_in(gB, glo + shift, ghi + shift, a_asc)
     if aepos - abpos < cfg.min_overlap // 2 or bepos - bbpos < cfg.min_overlap // 2:
         return None
 
@@ -176,9 +189,9 @@ def _true_overlap(a: SimRead, b: SimRead, ai: int, bi: int, cfg: SimConfig) -> O
     bpos = np.empty(len(bounds), dtype=np.int64)
     for j, g in enumerate(gb):
         if a_asc:
-            bpos[j] = np.searchsorted(gB, g, side="left")
+            bpos[j] = np.searchsorted(gB, g + shift, side="left")
         else:
-            bpos[j] = np.searchsorted(-gB, -g, side="left")
+            bpos[j] = np.searchsorted(-gB, -(g + shift), side="left")
     bpos[0] = bbpos
     bpos[-1] = bepos
     bpos = np.maximum.accumulate(np.clip(bpos, bbpos, bepos))
@@ -198,7 +211,7 @@ def _true_overlap(a: SimRead, b: SimRead, ai: int, bi: int, cfg: SimConfig) -> O
         # deletions against the genome inside the tile's genome span
         g0, g1 = min(gb[t], gb[t + 1]), max(gb[t], gb[t + 1])
         a_dl = int(np.searchsorted(a.dels, g1) - np.searchsorted(a.dels, g0))
-        b_dl = int(np.searchsorted(b.dels, g1) - np.searchsorted(b.dels, g0))
+        b_dl = int(np.searchsorted(b.dels, g1 + shift) - np.searchsorted(b.dels, g0 + shift))
         trace[t, 0] = min(a_ed + a_dl + b_ed + b_dl, 255 if cfg.tspace <= 125 else 65535)
         trace[t, 1] = b1 - b0
     ovl.trace = trace
@@ -208,7 +221,7 @@ def _true_overlap(a: SimRead, b: SimRead, ai: int, bi: int, cfg: SimConfig) -> O
 
 def simulate(cfg: SimConfig) -> SimResult:
     rng = np.random.default_rng(cfg.seed)
-    genome = _make_genome(cfg, rng)
+    genome, rep = _make_genome(cfg, rng)
 
     nbases_target = cfg.genome_len * cfg.coverage
     reads: list[SimRead] = []
@@ -249,6 +262,35 @@ def simulate(cfg: SimConfig) -> SimResult:
             ovl = _true_overlap(a, b, ai, bi, cfg)
             if ovl is not None:
                 overlaps.append(ovl)
+
+    # repeat-induced overlaps: reads over the two exact copies align to each
+    # other within the copy body (what daligner would report on a repeat)
+    if rep is not None:
+        src, dst, rep_len = rep
+        shift = dst - src
+        in_src = [i for i, r in enumerate(reads) if r.start < src + rep_len and r.end > src]
+        in_dst = [i for i, r in enumerate(reads) if r.start < dst + rep_len and r.end > dst]
+        for ai in range(len(reads)):
+            a = reads[ai]
+            if a.start < src + rep_len and a.end > src:
+                # A over copy 1, B over copy 2: B coords map down by shift
+                for bi in in_dst:
+                    if bi == ai:
+                        continue
+                    ovl = _true_overlap(a, reads[bi], ai, bi, cfg, shift=shift,
+                                        clamp=(src, src + rep_len))
+                    if ovl is not None:
+                        overlaps.append(ovl)
+            if a.start < dst + rep_len and a.end > dst:
+                # A over copy 2, B over copy 1: B coords map up by -shift
+                for bi in in_src:
+                    if bi == ai:
+                        continue
+                    ovl = _true_overlap(a, reads[bi], ai, bi, cfg, shift=-shift,
+                                        clamp=(dst, dst + rep_len))
+                    if ovl is not None:
+                        overlaps.append(ovl)
+
     overlaps.sort(key=lambda o: (o.aread, o.bread))
     return SimResult(genome=genome, reads=reads, overlaps=overlaps, config=cfg)
 

@@ -1,0 +1,4 @@
+from . import lastools
+from .cli import main
+
+__all__ = ["lastools", "main"]

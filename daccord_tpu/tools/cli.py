@@ -1,0 +1,166 @@
+"""Command-line tools mirroring the reference tool suite.
+
+One ``main()`` per tool (reference: one binary per ``src/*.cpp``, SURVEY.md
+§2.1), exposed both as console entry points and as ``python -m
+daccord_tpu.tools.cli <tool> ...``. Flag names keep reference parity where
+sensible (``-w`` window, ``-a`` advance, ``-d`` depth, ``-J i,n`` sharding;
+SURVEY.md §5 config row) so published recipes translate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..formats.dazzdb import read_db
+from ..formats.las import LasFile, shard_ranges
+from ..oracle.consensus import ConsensusConfig
+from ..runtime.pipeline import PipelineConfig, correct_to_fasta
+from . import lastools
+
+
+def _add_J(p: argparse.ArgumentParser):
+    p.add_argument("-J", default=None, metavar="i,n",
+                   help="process shard i of n (aread-aligned LAS byte ranges)")
+
+
+def _resolve_range(args, las_path: str):
+    if args.J is None:
+        return None, None
+    i, n = (int(x) for x in args.J.split(","))
+    if not (0 <= i < n):
+        raise SystemExit(f"bad -J {args.J}")
+    r = shard_ranges(las_path, n)
+    return r[i]
+
+
+def daccord_main(argv=None) -> int:
+    """daccord-tpu: consensus/error correction (reference tool ``daccord``)."""
+    p = argparse.ArgumentParser(prog="daccord-tpu", description=daccord_main.__doc__)
+    p.add_argument("db", help="Dazzler DB path (.db)")
+    p.add_argument("las", help="LAS alignments (sorted by aread)")
+    p.add_argument("-o", "--out", default="-", help="output FASTA ('-' = stdout)")
+    p.add_argument("-w", type=int, default=40, help="window size")
+    p.add_argument("-a", type=int, default=10, help="window advance")
+    p.add_argument("-b", "--batch", type=int, default=512, help="device batch size")
+    p.add_argument("--depth", type=int, default=32, help="max segments per window")
+    p.add_argument("--seg-len", type=int, default=64, help="max segment length")
+    p.add_argument("--mode", choices=("split", "patch"), default="split",
+                   help="unsolved windows split the read or get patched with raw bases")
+    p.add_argument("--stats", default=None, help="write run stats JSON here")
+    _add_J(p)
+    args = p.parse_args(argv)
+
+    start, end = _resolve_range(args, args.las)
+    ccfg = ConsensusConfig(w=args.w, adv=args.a, mode=args.mode)
+    cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
+                         depth=args.depth, seg_len=args.seg_len)
+    stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start, end=end)
+    line = {
+        "reads": stats.n_reads, "windows": stats.n_windows, "solved": stats.n_solved,
+        "fragments": stats.n_fragments, "bases_in": stats.bases_in,
+        "bases_out": stats.bases_out, "wall_s": round(stats.wall_s, 3),
+        "device_s": round(stats.device_s, 3),
+        "tier_histogram": stats.tier_histogram,
+    }
+    print(json.dumps(line), file=sys.stderr)
+    if args.stats:
+        with open(args.stats, "wt") as fh:
+            json.dump(line, fh)
+    return 0
+
+
+def intrinsicqv_main(argv=None) -> int:
+    """compute-inqual: intrinsic QV track (reference ``computeintrinsicqv``)."""
+    p = argparse.ArgumentParser(prog="compute-inqual", description=intrinsicqv_main.__doc__)
+    p.add_argument("db")
+    p.add_argument("las")
+    p.add_argument("-d", type=int, default=20, help="expected coverage depth")
+    args = p.parse_args(argv)
+    db = read_db(args.db)
+    las = LasFile(args.las)
+    lastools.compute_intrinsic_qv(db, las, depth=args.d)
+    return 0
+
+
+def detectrepeats_main(argv=None) -> int:
+    """las-detect-repeats: repeat intervals (reference ``lasdetectsimplerepeats``)."""
+    p = argparse.ArgumentParser(prog="las-detect-repeats", description=detectrepeats_main.__doc__)
+    p.add_argument("db")
+    p.add_argument("las")
+    p.add_argument("-d", type=int, default=20, help="expected coverage depth")
+    p.add_argument("--factor", type=float, default=2.0, help="over-coverage factor")
+    args = p.parse_args(argv)
+    db = read_db(args.db)
+    las = LasFile(args.las)
+    lastools.detect_repeats(db, las, depth=args.d, cov_factor=args.factor)
+    return 0
+
+
+def filteralignments_main(argv=None) -> int:
+    """las-filter: drop repeat-inconsistent alignments (reference ``lasfilteralignments``)."""
+    p = argparse.ArgumentParser(prog="las-filter", description=filteralignments_main.__doc__)
+    p.add_argument("db")
+    p.add_argument("las")
+    p.add_argument("out")
+    p.add_argument("--max-err", type=float, default=None)
+    args = p.parse_args(argv)
+    db = read_db(args.db)
+    las = LasFile(args.las)
+    n = lastools.filter_alignments(db, las, args.out, max_err=args.max_err)
+    print(f"kept {n} of {las.novl}", file=sys.stderr)
+    return 0
+
+
+def filtersym_main(argv=None) -> int:
+    """las-filter-sym: symmetrize a filtered LAS (reference ``filtersym``)."""
+    p = argparse.ArgumentParser(prog="las-filter-sym", description=filtersym_main.__doc__)
+    p.add_argument("las")
+    p.add_argument("out")
+    p.add_argument("--db", default=None, help="DB for exact complement mirroring")
+    args = p.parse_args(argv)
+    db = read_db(args.db) if args.db else None
+    n = lastools.filter_symmetric(args.las, args.out, db=db)
+    print(f"kept {n}", file=sys.stderr)
+    return 0
+
+
+def lassort_main(argv=None) -> int:
+    """las-sort: sort a LAS by (aread, bread) (reference LAS sort/merge role)."""
+    p = argparse.ArgumentParser(prog="las-sort", description=lassort_main.__doc__)
+    p.add_argument("las")
+    p.add_argument("out")
+    args = p.parse_args(argv)
+    las = LasFile(args.las)
+    ovls = sorted(las, key=lambda o: (o.aread, o.bread, o.abpos))
+    from ..formats.las import write_las
+    write_las(args.out, las.tspace, ovls)
+    return 0
+
+
+_TOOLS = {
+    "daccord": daccord_main,
+    "inqual": intrinsicqv_main,
+    "repeats": detectrepeats_main,
+    "filter": filteralignments_main,
+    "filtersym": filtersym_main,
+    "lassort": lassort_main,
+}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m daccord_tpu.tools.cli <tool> [args]\n"
+              f"tools: {', '.join(_TOOLS)}")
+        return 0
+    tool = argv.pop(0)
+    if tool not in _TOOLS:
+        print(f"unknown tool {tool!r}; tools: {', '.join(_TOOLS)}", file=sys.stderr)
+        return 2
+    return _TOOLS[tool](argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

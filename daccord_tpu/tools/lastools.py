@@ -1,0 +1,211 @@
+"""Preprocessing tool logic: intrinsic QV, repeat detection, alignment filtering.
+
+Equivalents of the reference tools (SURVEY.md §2.1, §3.2, §3.4; reference
+file:line citations pending backfill — mount empty, SURVEY.md §0):
+
+- ``computeintrinsicqv``  -> :func:`compute_intrinsic_qv`  (writes track
+  ``inqual``: one QV byte per tspace tile per read)
+- ``lasdetectsimplerepeats`` -> :func:`detect_repeats` (writes interval track
+  ``rep``: int64 start/end pairs per read)
+- ``lasfilteralignments`` -> :func:`filter_alignments` (drops alignments whose
+  error profile is inconsistent with the unique-region profile)
+- ``filtersym`` -> :func:`filter_symmetric` (keep A->B iff B->A kept)
+
+These are cheap single-pass streaming passes over LAS piles (the reference
+runs them as separate processes composed via the filesystem; kept that way —
+each is independently restartable, which is the checkpoint/resume model of
+SURVEY.md §5).
+
+QV convention: ``qv = clip(round(200 * rate), 0, 250)`` where ``rate`` is the
+per-tile error rate of the depth-d quantile alignment; 251..255 reserved
+(255 = no coverage). Downstream consumers in this framework use the same
+convention, making the pipeline self-consistent.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..formats.dazzdb import DazzDB, read_track, write_track
+from ..formats.las import LasFile, Overlap, write_las
+
+QV_NOCOV = 255
+QV_SCALE = 200.0
+
+
+def _pile_tile_rates(db: DazzDB, aread: int, pile: list[Overlap], tspace: int):
+    """Per-tile lists of alignment error rates for one A read."""
+    rlen = db.read_length(aread)
+    ntiles = (rlen + tspace - 1) // tspace
+    rates: list[list[float]] = [[] for _ in range(ntiles)]
+    for o in pile:
+        bounds = o.tile_bounds(tspace)
+        for t in range(len(bounds) - 1):
+            a0, a1 = int(bounds[t]), int(bounds[t + 1])
+            tl = a1 - a0
+            if tl <= 0:
+                continue
+            g = a0 // tspace
+            # pair diffs count both reads' errors; halve for a per-read rate
+            rates[g].append(0.5 * float(o.trace[t, 0]) / tl)
+    return rates
+
+
+def compute_intrinsic_qv(db: DazzDB, las: LasFile, depth: int = 20,
+                         track: str = "inqual") -> list[np.ndarray]:
+    """Per-read per-tile intrinsic QVs from pile error statistics.
+
+    The depth-d quantile (d-th lowest rate) is robust to repeat-induced piles:
+    repeats inflate coverage with *worse* alignments, leaving the best d
+    mostly intact (reference ``computeintrinsicqv -d``).
+    """
+    tspace = las.tspace
+    payloads: list[np.ndarray] = [np.zeros(0, dtype=np.uint8)] * db.nreads
+    for aread, pile in las.iter_piles():
+        rates = _pile_tile_rates(db, aread, pile, tspace)
+        qv = np.full(len(rates), QV_NOCOV, dtype=np.uint8)
+        for t, rl in enumerate(rates):
+            if not rl:
+                continue
+            rl = sorted(rl)
+            q = rl[min(max(depth // 2, 1), len(rl)) - 1]
+            qv[t] = min(int(round(QV_SCALE * q)), 250)
+        payloads[aread] = qv
+    # reads with no pile get all-NOCOV tracks of the right length
+    for i in range(db.nreads):
+        if len(payloads[i]) == 0:
+            nt = (db.read_length(i) + tspace - 1) // tspace
+            payloads[i] = np.full(nt, QV_NOCOV, dtype=np.uint8)
+    write_track(db.path, track, payloads)
+    return payloads
+
+
+def detect_repeats(db: DazzDB, las: LasFile, depth: int = 20,
+                   cov_factor: float = 2.0, track: str = "rep") -> list[np.ndarray]:
+    """Detect simple-repeat intervals from pile over-coverage.
+
+    A tile whose alignment coverage exceeds ``cov_factor * depth`` is repeat-
+    annotated; adjacent repeat tiles merge into intervals (int64 start/end
+    pairs per read, written as track ``rep``).
+    """
+    tspace = las.tspace
+    payloads: list[np.ndarray] = [np.zeros(0, dtype=np.uint8)] * db.nreads
+    for aread, pile in las.iter_piles():
+        rlen = db.read_length(aread)
+        ntiles = (rlen + tspace - 1) // tspace
+        cov = np.zeros(ntiles, dtype=np.int64)
+        for o in pile:
+            g0 = o.abpos // tspace
+            g1 = (max(o.aepos - 1, o.abpos)) // tspace
+            cov[g0 : g1 + 1] += 1
+        hot = cov > cov_factor * depth
+        ivals: list[int] = []
+        t = 0
+        while t < ntiles:
+            if hot[t]:
+                t0 = t
+                while t < ntiles and hot[t]:
+                    t += 1
+                ivals.extend([t0 * tspace, min(t * tspace, rlen)])
+            else:
+                t += 1
+        payloads[aread] = np.asarray(ivals, dtype=np.int64).view(np.uint8)
+    for i in range(db.nreads):
+        if payloads[i] is None:
+            payloads[i] = np.zeros(0, dtype=np.uint8)
+    write_track(db.path, track, payloads)
+    return payloads
+
+
+def read_repeat_track(db: DazzDB, track: str = "rep") -> list[np.ndarray]:
+    """Interval track back as [n, 2] int64 arrays."""
+    raw = read_track(db.path, track)
+    return [r.view(np.int64).reshape(-1, 2) if len(r) else np.zeros((0, 2), dtype=np.int64)
+            for r in raw]
+
+
+def filter_alignments(db: DazzDB, las: LasFile, out_path: str,
+                      max_err: float | None = None,
+                      repeat_track: str | None = "rep",
+                      min_unique_span: int = 100) -> int:
+    """Drop alignments inconsistent with the unique-region error profile.
+
+    The paper's "local genomic consistency analysis" at the file level
+    (reference ``lasfilteralignments``): an alignment whose error rate over
+    the A read's *non-repeat* tiles is far above the pile median is likely a
+    repeat-induced mis-pile; drop it. Alignments confined entirely to repeat
+    intervals (< ``min_unique_span`` unique bases) are dropped too.
+    """
+    tspace = las.tspace
+    reps = None
+    if repeat_track is not None:
+        try:
+            reps = read_repeat_track(db, repeat_track)
+        except FileNotFoundError:
+            reps = None
+
+    def unique_span(aread: int, o: Overlap) -> int:
+        if reps is None or aread >= len(reps):
+            return o.aepos - o.abpos
+        span = o.aepos - o.abpos
+        for s, e in reps[aread]:
+            span -= max(0, min(o.aepos, e) - max(o.abpos, s))
+        return span
+
+    kept: list[Overlap] = []
+    for aread, pile in las.iter_piles():
+        prates = []
+        for o in pile:
+            alen = max(o.aepos - o.abpos, 1)
+            prates.append(float(o.trace[:, 0].sum()) / alen)
+        med = float(np.median(prates)) if prates else 0.0
+        cut = max_err if max_err is not None else max(2.0 * med, med + 0.15)
+        for o, r in zip(pile, prates):
+            if r <= cut and unique_span(aread, o) >= min_unique_span:
+                kept.append(o)
+    write_las(out_path, tspace, kept)
+    return len(kept)
+
+
+def filter_symmetric(las_path: str, out_path: str, db: DazzDB | None = None) -> int:
+    """Keep A->B overlaps iff a matching B->A record exists (reference
+    ``filtersym``).
+
+    With a DB (read lengths known) the match is exact: for plain overlaps the
+    mirror of (a,b,[ab,ae),[bb,be)) is (b,a,[bb,be),[ab,ae)); for complemented
+    overlaps both sides' coordinates flip through their read length
+    (DALIGNER complement-space symmetry). Without a DB, matching falls back to
+    per-(a,b,comp) record counts.
+    """
+    las = LasFile(las_path)
+
+    if db is not None:
+        keys: set = set()
+        for o in las:
+            keys.add((o.aread, o.bread, o.is_comp, o.abpos, o.aepos, o.bbpos, o.bepos))
+
+        def mirror_key(o: Overlap):
+            if not o.is_comp:
+                return (o.bread, o.aread, False, o.bbpos, o.bepos, o.abpos, o.aepos)
+            alen = db.read_length(o.aread)
+            blen = db.read_length(o.bread)
+            return (o.bread, o.aread, True,
+                    blen - o.bepos, blen - o.bbpos,
+                    alen - o.aepos, alen - o.abpos)
+
+        kept = [o for o in las if mirror_key(o) in keys]
+    else:
+        from collections import Counter
+
+        counts: Counter = Counter()
+        for o in las:
+            counts[(o.aread, o.bread, o.is_comp)] += 1
+        budget: Counter = Counter()
+        kept = []
+        for o in las:
+            key = (o.aread, o.bread, o.is_comp)
+            quota = min(counts[key], counts[(o.bread, o.aread, o.is_comp)])
+            if budget[key] < quota:
+                budget[key] += 1
+                kept.append(o)
+    return write_las(out_path, las.tspace, kept)

@@ -1,0 +1,71 @@
+"""End-to-end pipeline test: synthetic dataset -> corrected FASTA -> Q uplift."""
+
+import os
+
+import numpy as np
+import pytest
+
+from daccord_tpu.formats import read_fasta
+from daccord_tpu.oracle import edit_distance, infix_distance
+from daccord_tpu.runtime import PipelineConfig, correct_to_fasta
+from daccord_tpu.sim import SimConfig, make_dataset
+from daccord_tpu.utils import revcomp_ints, seq_to_ints
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("e2e"))
+    cfg = SimConfig(genome_len=2000, coverage=15, read_len_mean=600, min_overlap=250, seed=13)
+    return make_dataset(d, cfg, name="p"), d
+
+
+def test_pipeline_end_to_end(dataset):
+    out, d = dataset
+    res = out["result"]
+    fasta = os.path.join(d, "corr.fasta")
+    stats = correct_to_fasta(out["db"], out["las"], fasta, PipelineConfig(batch_size=256))
+    piled = {o.aread for o in res.overlaps}
+    assert stats.n_reads == len(piled)
+    assert stats.n_solved / stats.n_windows > 0.9
+    assert stats.bases_out > 0.75 * stats.bases_in
+
+    tot_e = tot_l = 0
+    for rec in read_fasta(fasta):
+        rid = int(rec.name[4:].split("/")[0])
+        r = res.reads[rid]
+        truth = res.genome[r.start : r.end]
+        if r.strand == 1:
+            truth = revcomp_ints(truth)
+        f = seq_to_ints(rec.seq)
+        tot_e += infix_distance(f, truth)
+        tot_l += len(f)
+    corr_err = tot_e / tot_l
+
+    raw_e = raw_l = 0
+    for r in res.reads[:8]:
+        truth = res.genome[r.start : r.end]
+        if r.strand == 1:
+            truth = revcomp_ints(truth)
+        raw_e += edit_distance(r.seq, truth)
+        raw_l += len(truth)
+    raw_err = raw_e / raw_l
+    assert corr_err < raw_err / 8, (corr_err, raw_err)
+
+
+def test_pipeline_byte_range_shard(dataset):
+    """Correcting a byte-range shard touches only that shard's reads."""
+    out, d = dataset
+    from daccord_tpu.formats import LasFile, read_db
+    from daccord_tpu.formats.las import shard_ranges
+    from daccord_tpu.runtime import correct_shard
+
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    ranges = shard_ranges(out["las"], 2)
+    rids0 = [rid for rid, _, _ in correct_shard(db, las, PipelineConfig(batch_size=256),
+                                                start=ranges[0][0], end=ranges[0][1])]
+    rids1 = [rid for rid, _, _ in correct_shard(db, las, PipelineConfig(batch_size=256),
+                                                start=ranges[1][0], end=ranges[1][1])]
+    assert set(rids0).isdisjoint(rids1)
+    all_areads = sorted({o.aread for o in out["result"].overlaps})
+    assert sorted(rids0 + rids1) == all_areads

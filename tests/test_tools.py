@@ -1,0 +1,97 @@
+"""Preprocessing tool suite: intrinsic QV, repeats, filters, CLI."""
+
+import numpy as np
+import pytest
+
+from daccord_tpu.formats import LasFile, read_db, read_track
+from daccord_tpu.sim import SimConfig, make_dataset
+from daccord_tpu.tools import lastools
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tools"))
+    cfg = SimConfig(genome_len=3000, coverage=14, read_len_mean=800, seed=17)
+    return make_dataset(d, cfg, name="t"), d
+
+
+def test_intrinsic_qv(dataset):
+    out, d = dataset
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    payloads = lastools.compute_intrinsic_qv(db, las, depth=14)
+    assert len(payloads) == db.nreads
+    back = read_track(out["db"], "inqual")
+    covered = np.concatenate([p[p != lastools.QV_NOCOV] for p in back])
+    assert len(covered) > 100
+    # typical per-read rate ~13.5% -> qv around 200*0.135/2-ish after halving;
+    # just require sane dispersion within covered tiles
+    e = out["result"].config.p_ins + out["result"].config.p_del + out["result"].config.p_sub
+    assert 0.2 * lastools.QV_SCALE * e < covered.mean() < 2.5 * lastools.QV_SCALE * e
+    # tile counts match read lengths
+    tspace = las.tspace
+    for i in range(db.nreads):
+        assert len(back[i]) == (db.read_length(i) + tspace - 1) // tspace
+
+
+def test_detect_repeats_planted(tmp_path):
+    cfg = SimConfig(genome_len=4000, coverage=12, read_len_mean=900,
+                    repeat_fraction=0.4, seed=23)
+    out = make_dataset(str(tmp_path), cfg, name="r")
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    lastools.detect_repeats(db, las, depth=12, cov_factor=1.8)
+    reps = lastools.read_repeat_track(db)
+    n_with = sum(1 for r in reps if len(r))
+    assert n_with > 0  # the planted repeat inflates some piles
+    for r in reps:
+        for s, e in r:
+            assert 0 <= s < e
+
+
+def test_filter_alignments(dataset, tmp_path):
+    out, d = dataset
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    outp = str(tmp_path / "filt.las")
+    n = lastools.filter_alignments(db, las, outp, repeat_track=None)
+    assert 0 < n <= las.novl
+    filt = LasFile(outp)
+    assert filt.novl == n
+    # order by aread preserved
+    areads = [o.aread for o in filt]
+    assert areads == sorted(areads)
+
+
+def test_filter_symmetric(dataset, tmp_path):
+    out, d = dataset
+    db = read_db(out["db"])
+    src = out["las"]
+    outp = str(tmp_path / "sym.las")
+    # the simulator emits symmetric pairs, so everything survives
+    n = lastools.filter_symmetric(src, outp, db=db)
+    assert n == LasFile(src).novl
+
+    # drop one record; its mirror must then be dropped by the filter
+    las = LasFile(src)
+    ovls = list(las)
+    victim = ovls[0]
+    asym = str(tmp_path / "asym.las")
+    from daccord_tpu.formats import write_las
+    write_las(asym, las.tspace, ovls[1:])
+    n2 = lastools.filter_symmetric(asym, str(tmp_path / "sym2.las"), db=db)
+    assert n2 == len(ovls) - 2
+
+
+def test_cli_entrypoints(dataset, tmp_path, capsys):
+    out, d = dataset
+    from daccord_tpu.tools.cli import main
+
+    assert main(["inqual", out["db"], out["las"], "-d", "14"]) == 0
+    assert main(["repeats", out["db"], out["las"], "-d", "14"]) == 0
+    filt = str(tmp_path / "f.las")
+    assert main(["filter", out["db"], out["las"], filt]) == 0
+    assert main(["filtersym", filt, str(tmp_path / "fs.las"), "--db", out["db"]]) == 0
+    assert main(["lassort", filt, str(tmp_path / "sorted.las")]) == 0
+    assert main(["nonsense"]) == 2
+    assert main([]) == 0
