@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Benchmark: consensus bases/sec/chip of the batched window solver.
+
+Prints ONE JSON line:
+  {"metric": "consensus_bases_per_sec_per_chip", "value": N, "unit": "bases/s",
+   "vs_baseline": R, ...}
+
+The metric is BASELINE.json's "consensus bases/sec/chip". The reference
+publishes no number (BASELINE.md: ``published: {}``) and the reference binary
+is unavailable to measure, so ``vs_baseline`` is the ratio against the
+framework's own single-core numpy oracle (the executable spec of the same
+algorithm) measured in the same run — an honest, reproducible stand-in until
+the C++ reference can be built (SURVEY.md §7.3 item 6).
+
+The window set is a synthetic PacBio-like dataset (sim module); the tensorized
+batches are cached under .bench_cache/ so reruns skip the host prep.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+N_BENCH_WINDOWS = 32768
+BATCH = 1024
+DEPTH, SEG_LEN, WLEN = 32, 64, 40
+
+
+def build_windows() -> dict:
+    os.makedirs(CACHE, exist_ok=True)
+    npz = os.path.join(CACHE, "windows_v1.npz")
+    if os.path.exists(npz):
+        d = np.load(npz)
+        return {k: d[k] for k in d.files}
+
+    from daccord_tpu.kernels import BatchShape, tensorize_windows
+    from daccord_tpu.oracle import (
+        ConsensusConfig,
+        cut_windows,
+        estimate_profile_two_pass,
+        refine_overlap,
+    )
+    from daccord_tpu.sim import SimConfig, simulate
+
+    cfg = SimConfig(genome_len=20_000, coverage=20, read_len_mean=2_000, seed=42)
+    res = simulate(cfg)
+    ccfg = ConsensusConfig()
+    shape = BatchShape(depth=DEPTH, seg_len=SEG_LEN, wlen=WLEN)
+    items = []
+    prof = None
+    piles: dict[int, list] = {}
+    for o in res.overlaps:
+        piles.setdefault(o.aread, []).append(o)
+    for aread, pile in piles.items():
+        a = res.reads[aread].seq
+        refined = [refine_overlap(o, a, res.reads[o.bread].seq, cfg.tspace) for o in pile]
+        windows = cut_windows(a, refined, w=ccfg.w, adv=ccfg.adv)
+        if prof is None:
+            prof = estimate_profile_two_pass(refined, windows, ccfg, sample=24)
+        items.extend((aread, ws) for ws in windows)
+        if len(items) >= N_BENCH_WINDOWS:
+            break
+    batch = tensorize_windows(items[:N_BENCH_WINDOWS], shape)
+    out = dict(seqs=batch.seqs, lens=batch.lens, nsegs=batch.nsegs,
+               p_ins=np.float64(prof.p_ins), p_del=np.float64(prof.p_del),
+               p_sub=np.float64(prof.p_sub))
+    np.savez_compressed(npz, **out)
+    return out
+
+
+def oracle_baseline(data: dict, n: int = 48) -> float:
+    """Single-core numpy oracle throughput (consensus bases/sec)."""
+    from daccord_tpu.oracle.consensus import ConsensusConfig, make_offset_likely, solve_window
+    from daccord_tpu.oracle.profile import ErrorProfile
+    from daccord_tpu.oracle.windows import WindowSegments
+
+    prof = ErrorProfile(float(data["p_ins"]), float(data["p_del"]), float(data["p_sub"]))
+    ccfg = ConsensusConfig()
+    ols = make_offset_likely(prof, ccfg)
+    idx = np.linspace(0, len(data["nsegs"]) - 1, n).astype(int)
+    t0 = time.perf_counter()
+    bases = 0
+    for i in idx:
+        segs = [data["seqs"][i, d, : data["lens"][i, d]] for d in range(int(data["nsegs"][i]))]
+        ws = WindowSegments(wstart=0, wlen=WLEN, segments=segs, breads=[0] * len(segs))
+        r = solve_window(ws, ols, ccfg)
+        if r.seq is not None:
+            bases += len(r.seq)
+    dt = time.perf_counter() - t0
+    return bases / dt if dt > 0 else 0.0
+
+
+def device_throughput(data: dict) -> tuple[float, dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch
+    from daccord_tpu.kernels.tiers import TierLadder, solve_tiered
+    from daccord_tpu.oracle.consensus import ConsensusConfig
+    from daccord_tpu.oracle.profile import ErrorProfile
+
+    prof = ErrorProfile(float(data["p_ins"]), float(data["p_del"]), float(data["p_sub"]))
+    ccfg = ConsensusConfig()
+    ladder = TierLadder.from_config(prof, ccfg)
+    shape = BatchShape(depth=DEPTH, seg_len=SEG_LEN, wlen=WLEN)
+
+    N = len(data["nsegs"])
+    nb = N // BATCH
+
+    def make_batch(i):
+        sl = slice(i * BATCH, (i + 1) * BATCH)
+        return WindowBatch(seqs=data["seqs"][sl], lens=data["lens"][sl],
+                           nsegs=data["nsegs"][sl], shape=shape,
+                           read_ids=np.zeros(BATCH, np.int64),
+                           wstarts=np.zeros(BATCH, np.int64))
+
+    # warmup / compile all tier shapes
+    solve_tiered(make_batch(0), ladder)
+
+    t0 = time.perf_counter()
+    bases = 0
+    solved = 0
+    for i in range(nb):
+        out = solve_tiered(make_batch(i), ladder)
+        bases += int(out["cons_len"].sum())
+        solved += int(out["solved"].sum())
+    dt = time.perf_counter() - t0
+    info = dict(windows=nb * BATCH, solved=solved, wall_s=round(dt, 3),
+                device=str(jax.devices()[0]).replace(" ", ""),
+                solve_rate=round(solved / (nb * BATCH), 4))
+    return bases / dt, info
+
+
+def main() -> None:
+    data = build_windows()
+    dev_bps, info = device_throughput(data)
+    orc_bps = oracle_baseline(data)
+    line = {
+        "metric": "consensus_bases_per_sec_per_chip",
+        "value": round(dev_bps, 1),
+        "unit": "bases/s",
+        "vs_baseline": round(dev_bps / orc_bps, 2) if orc_bps > 0 else None,
+        "baseline": "single-core numpy oracle (reference binary unavailable; BASELINE.md published:{})",
+        "oracle_bases_per_sec": round(orc_bps, 1),
+        **info,
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

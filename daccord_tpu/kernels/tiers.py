@@ -54,7 +54,7 @@ class TierLadder:
 
 
 def solve_tiered(batch: WindowBatch, ladder: TierLadder,
-                 compact_size: int = 64) -> dict:
+                 compact_size: int = 64, skip_tier0: bool = False) -> dict:
     """Run the escalation ladder; returns host numpy results per window.
 
     Tier 0 runs on the full batch; failures are *compacted* into fixed-size
@@ -73,16 +73,17 @@ def solve_tiered(batch: WindowBatch, ladder: TierLadder,
     solved = np.zeros(B, dtype=bool)
     tier_of = np.full(B, -1, dtype=np.int32)
 
-    p0 = ladder.params[0]
-    out = solve_window_batch(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
-                             jnp.asarray(batch.nsegs), ladder.tables[p0.k], p0)
-    o_solved = np.asarray(out["solved"])
-    if o_solved.any():
-        cons[o_solved] = np.asarray(out["cons"])[o_solved]
-        cons_len[o_solved] = np.asarray(out["cons_len"])[o_solved]
-        err[o_solved] = np.asarray(out["err"])[o_solved]
-        solved[o_solved] = True
-        tier_of[o_solved] = 0
+    if not skip_tier0:
+        p0 = ladder.params[0]
+        out = solve_window_batch(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
+                                 jnp.asarray(batch.nsegs), ladder.tables[p0.k], p0)
+        o_solved = np.asarray(out["solved"])
+        if o_solved.any():
+            cons[o_solved] = np.asarray(out["cons"])[o_solved]
+            cons_len[o_solved] = np.asarray(out["cons_len"])[o_solved]
+            err[o_solved] = np.asarray(out["err"])[o_solved]
+            solved[o_solved] = True
+            tier_of[o_solved] = 0
 
     for ti, p in enumerate(ladder.params[1:], start=1):
         idx = np.nonzero(~solved & (batch.nsegs >= p.min_depth))[0]

@@ -85,7 +85,8 @@ def _edit_distance_row_scan(cand: jnp.ndarray, cand_len: jnp.ndarray,
         cur = jax.lax.associative_scan(jnp.minimum, vals) + ar
         return cur, cur[seg_len]
 
-    init = ar
+    # derive the carry from data so its varying-axes match under shard_map
+    init = ar + 0 * seg_len
     _, outs = jax.lax.scan(row, init, (cand.astype(jnp.int32),
                                        jnp.arange(1, cand.shape[0] + 1, dtype=jnp.int32)))
     # outs[i-1] = D[i, seg_len]; i = cand_len
@@ -174,7 +175,7 @@ def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
             node = jnp.clip(node, 0, M - 1)
             nxt = jnp.where((t <= t_best) & (t > 0), ptrs[t, node], node)
             return nxt, node
-        _, nodes_rev = jax.lax.scan(back, jnp.int32(0), jnp.arange(P - 1, -1, -1))
+        _, nodes_rev = jax.lax.scan(back, 0 * v_best, jnp.arange(P - 1, -1, -1))
         path = nodes_rev[::-1]                            # [P]
         first = sel[path[0]]
         j = jnp.arange(CL)

@@ -1,0 +1,4 @@
+from .mesh import make_mesh, make_sharded_solver
+from .launch import init_distributed, run_shard, merge_shards
+
+__all__ = ["make_mesh", "make_sharded_solver", "init_distributed", "run_shard", "merge_shards"]

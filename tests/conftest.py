@@ -1,14 +1,21 @@
 """Test configuration: force JAX onto a virtual 8-device CPU platform.
 
 This is the "fake backend" of SURVEY.md §4 item 4 — multi-chip sharding tests
-run against 8 virtual CPU devices so no pod is needed. Must run before any
-`import jax` anywhere in the test session.
+run against 8 virtual CPU devices so no pod is needed.
+
+Note: this image's axon TPU plugin pre-imports jax's config machinery at
+interpreter startup, so setting JAX_PLATFORMS via os.environ here is too late;
+``jax.config.update`` after import is the reliable override. XLA_FLAGS is
+still read lazily at CPU backend init, so the device-count flag works from
+here as long as no backend has been touched yet.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
