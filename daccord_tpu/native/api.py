@@ -1,0 +1,110 @@
+"""Numpy-facing wrappers over the native library (ctypes marshalling)."""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from . import load
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+class ColumnarLas:
+    """Whole-file columnar LAS arrays (native parse)."""
+
+    __slots__ = ("tspace", "novl", "aread", "bread", "abpos", "aepos", "bbpos",
+                 "bepos", "comp", "diffs", "trace_off", "trace_flat", "pile_starts")
+
+    def __init__(self, path: str, start: int | None = None, end: int | None = None):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        b0 = 0 if start is None else int(start)
+        b1 = 0 if end is None else int(end)
+        novl = ctypes.c_int64()
+        tspace = ctypes.c_int32()
+        telems = ctypes.c_int64()
+        rc = lib.las_scan(path.encode(), b0, b1, ctypes.byref(novl),
+                          ctypes.byref(tspace), ctypes.byref(telems))
+        if rc != 0:
+            raise IOError(f"las_scan({path}) failed: {rc}")
+        n, te = novl.value, telems.value
+        self.novl, self.tspace = n, tspace.value
+        self.aread = np.empty(n, np.int32)
+        self.bread = np.empty(n, np.int32)
+        self.abpos = np.empty(n, np.int32)
+        self.aepos = np.empty(n, np.int32)
+        self.bbpos = np.empty(n, np.int32)
+        self.bepos = np.empty(n, np.int32)
+        self.comp = np.empty(n, np.uint8)
+        self.diffs = np.empty(n, np.int32)
+        self.trace_off = np.empty(n + 1, np.int64)
+        self.trace_flat = np.empty(te, np.int32)
+        rc = lib.las_load(path.encode(), b0, b1, n, _ptr(self.aread), _ptr(self.bread),
+                          _ptr(self.abpos), _ptr(self.aepos), _ptr(self.bbpos),
+                          _ptr(self.bepos), _ptr(self.comp), _ptr(self.diffs),
+                          _ptr(self.trace_off), _ptr(self.trace_flat))
+        if rc != 0:
+            raise IOError(f"las_load({path}) failed: {rc}")
+        # pile boundaries (file sorted by aread)
+        if n:
+            change = np.nonzero(np.diff(self.aread))[0] + 1
+            self.pile_starts = np.concatenate([[0], change, [n]]).astype(np.int64)
+        else:
+            self.pile_starts = np.zeros(1, np.int64)
+
+    def piles(self):
+        for p in range(len(self.pile_starts) - 1):
+            s, e = int(self.pile_starts[p]), int(self.pile_starts[p + 1])
+            yield int(self.aread[s]), s, e
+
+
+def process_pile_native(a_bases: np.ndarray, col: ColumnarLas, s: int, e: int,
+                        b_reads: list[np.ndarray],
+                        w: int, adv: int, D: int, L: int,
+                        include_a: bool = True):
+    """Windows of one pile as batch tensors via the native hot path.
+
+    ``b_reads``: decoded stored-orientation B bases per overlap in [s, e).
+    Returns (seqs [nwin,D,L] int8, lens [nwin,D] i32, nsegs [nwin] i32).
+    """
+    lib = load()
+    novl = e - s
+    alen = len(a_bases)
+    nwin = 0 if alen < w else (alen - w) // adv + 1
+    seqs = np.full((nwin, D, L), 4, dtype=np.int8)
+    lens = np.zeros((nwin, D), dtype=np.int32)
+    nsegs = np.zeros(nwin, dtype=np.int32)
+    if nwin == 0:
+        return seqs, lens, nsegs
+
+    b_off = np.zeros(novl + 1, np.int64)
+    np.cumsum([len(b) for b in b_reads], out=b_off[1:])
+    b_concat = (np.concatenate(b_reads) if b_reads else np.zeros(0, np.int8)).astype(np.int8, copy=False)
+    b_len = np.asarray([len(b) for b in b_reads], dtype=np.int32)
+    # rebase trace offsets for the pile slice
+    toff = (col.trace_off[s : e + 1] - col.trace_off[s]).astype(np.int64)
+    tflat = col.trace_flat[col.trace_off[s] : col.trace_off[e]]
+    tflat = np.ascontiguousarray(tflat, dtype=np.int32)
+    a_c = np.ascontiguousarray(a_bases, dtype=np.int8)
+
+    abpos = np.ascontiguousarray(col.abpos[s:e])
+    aepos = np.ascontiguousarray(col.aepos[s:e])
+    bbpos = np.ascontiguousarray(col.bbpos[s:e])
+    bepos = np.ascontiguousarray(col.bepos[s:e])
+    comp = np.ascontiguousarray(col.comp[s:e])
+
+    rc = lib.process_pile(_ptr(a_c), alen, novl,
+                          _ptr(abpos), _ptr(aepos), _ptr(bbpos), _ptr(bepos),
+                          _ptr(comp),
+                          _ptr(b_concat), _ptr(b_off), _ptr(b_len),
+                          _ptr(tflat), _ptr(toff),
+                          col.tspace, w, adv, D, L, 1 if include_a else 0,
+                          _ptr(seqs), _ptr(lens), _ptr(nsegs), nwin)
+    if rc != 0:
+        raise RuntimeError(f"process_pile failed: {rc}")
+    return seqs, lens, nsegs

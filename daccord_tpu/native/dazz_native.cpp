@@ -1,0 +1,224 @@
+// Native host layer: LAS columnar loader + pile -> window-tensor extraction.
+//
+// C++ equivalents of the reference's hot host-side components (SURVEY.md
+// §2.2/§2.4): the libmaus2 dazzler/align streaming parser and the
+// trace-point -> base-accurate window segmentation done with lcs::NP inside
+// src/daccord.cpp (file:line citations pending backfill — reference mount
+// empty, SURVEY.md §0). Exposed as a C ABI for ctypes; built by
+// daccord_tpu/native/build.py with g++ -O3 (no pybind11 in this image).
+//
+// The tile realignment replicates oracle.align.align_path exactly (full
+// unit-cost DP, backtrack preferring diagonal, then deletion, then insertion,
+// a2b[0] = 0) so the native path is bit-identical to the Python oracle.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+constexpr int8_t PAD = 4;
+
+// full unit-cost edit DP with backtrack -> prefix map a2b (len n+1)
+// D matrix kept as int32; tiles are ~tspace long so this is tiny.
+void align_path(const int8_t* a, int n, const int8_t* b, int m,
+                std::vector<int32_t>& Dbuf, int64_t* a2b) {
+  const int W = m + 1;
+  Dbuf.resize((size_t)(n + 1) * W);
+  int32_t* D = Dbuf.data();
+  for (int j = 0; j <= m; ++j) D[j] = j;
+  for (int i = 1; i <= n; ++i) {
+    int32_t* row = D + (size_t)i * W;
+    const int32_t* prev = row - W;
+    row[0] = i;
+    const int8_t ai = a[i - 1];
+    for (int j = 1; j <= m; ++j) {
+      int32_t best = prev[j - 1] + (b[j - 1] != ai);
+      int32_t del = prev[j] + 1;
+      if (del < best) best = del;
+      int32_t ins = row[j - 1] + 1;
+      if (ins < best) best = ins;
+      row[j] = best;
+    }
+  }
+  // backtrack (diagonal > deletion > insertion), matching oracle.align
+  int i = n, j = m;
+  a2b[n] = m;
+  while (i > 0) {
+    const int32_t* row = D + (size_t)i * W;
+    const int32_t* prev = row - W;
+    if (j > 0 && row[j] == prev[j - 1] + (a[i - 1] != b[j - 1])) {
+      --i; --j;
+      a2b[i] = j;
+    } else if (row[j] == prev[j] + 1) {
+      --i;
+      a2b[i] = j;
+    } else {
+      --j;
+    }
+  }
+  a2b[0] = 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// LAS columnar loader
+// ---------------------------------------------------------------------------
+// pass 1: header + totals so the caller can allocate numpy arrays.
+// byte_start/byte_end restrict to an aread-aligned shard range (0,0 = whole
+// file) — the multi-host data-plane unit (SURVEY.md §2.3 DP row).
+int las_scan(const char* path, int64_t byte_start, int64_t byte_end,
+             int64_t* novl, int32_t* tspace, int64_t* trace_elems) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  struct { int64_t novl; int32_t tspace; int32_t pad; } hdr;
+  if (fread(&hdr, 16, 1, f) != 1) { fclose(f); return -2; }
+  *tspace = hdr.tspace;
+  const int tsize = hdr.tspace <= 125 ? 1 : 2;
+  if (byte_start > 16 && fseek(f, (long)byte_start, SEEK_SET) != 0) { fclose(f); return -3; }
+  int64_t total = 0, count = 0;
+  struct Rec { int32_t tlen, diffs, abpos, bbpos, aepos, bepos; uint32_t flags; int32_t aread, bread, pad; } rec;
+  static_assert(sizeof(Rec) == 40, "record layout");
+  while ((byte_end <= 0 || ftell(f) < byte_end) && fread(&rec, sizeof(Rec), 1, f) == 1) {
+    total += rec.tlen;
+    ++count;
+    if (fseek(f, (long)rec.tlen * tsize, SEEK_CUR) != 0) { fclose(f); return -3; }
+  }
+  *novl = count;
+  *trace_elems = total;
+  fclose(f);
+  return 0;
+}
+
+// pass 2: fill caller-allocated columnar arrays
+int las_load(const char* path, int64_t byte_start, int64_t byte_end, int64_t novl_expect,
+             int32_t* aread, int32_t* bread,
+             int32_t* abpos, int32_t* aepos,
+             int32_t* bbpos, int32_t* bepos,
+             uint8_t* comp, int32_t* diffs,
+             int64_t* trace_off,          // [novl+1]
+             int32_t* trace_flat) {       // [trace_elems] (d,b) interleaved
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  struct { int64_t novl; int32_t tspace; int32_t pad; } hdr;
+  if (fread(&hdr, 16, 1, f) != 1) { fclose(f); return -2; }
+  const int tsize = hdr.tspace <= 125 ? 1 : 2;
+  if (byte_start > 16 && fseek(f, (long)byte_start, SEEK_SET) != 0) { fclose(f); return -3; }
+  struct Rec { int32_t tlen, diffs, abpos, bbpos, aepos, bepos; uint32_t flags; int32_t aread, bread, pad; } rec;
+  int64_t k = 0, off = 0;
+  std::vector<uint8_t> tbuf;
+  while ((byte_end <= 0 || ftell(f) < byte_end) && k < novl_expect
+         && fread(&rec, sizeof(Rec), 1, f) == 1) {
+    aread[k] = rec.aread; bread[k] = rec.bread;
+    abpos[k] = rec.abpos; aepos[k] = rec.aepos;
+    bbpos[k] = rec.bbpos; bepos[k] = rec.bepos;
+    comp[k] = (uint8_t)(rec.flags & 1u);
+    diffs[k] = rec.diffs;
+    trace_off[k] = off;
+    tbuf.resize((size_t)rec.tlen * tsize);
+    if (rec.tlen && fread(tbuf.data(), tsize, rec.tlen, f) != (size_t)rec.tlen) { fclose(f); return -3; }
+    if (tsize == 1) {
+      for (int t = 0; t < rec.tlen; ++t) trace_flat[off + t] = tbuf[t];
+    } else {
+      const uint16_t* p = (const uint16_t*)tbuf.data();
+      for (int t = 0; t < rec.tlen; ++t) trace_flat[off + t] = p[t];
+    }
+    off += rec.tlen;
+    ++k;
+  }
+  trace_off[k] = off;
+  fclose(f);
+  return (int)(k == novl_expect ? 0 : -4);
+}
+
+// ---------------------------------------------------------------------------
+// pile -> window tensors (the reference's L3 hot path, SURVEY.md §3.1)
+// ---------------------------------------------------------------------------
+// b_concat holds each overlap's B read bases in STORED orientation at
+// b_off[i]..b_off[i]+b_len[i]; complementing happens here.
+// out_seqs must be pre-filled with PAD by the caller ([nwin, D, L] int8);
+// out_lens/out_nsegs are zero-filled by the caller.
+int process_pile(const int8_t* a, int32_t alen,
+                 int32_t novl,
+                 const int32_t* abpos, const int32_t* aepos,
+                 const int32_t* bbpos, const int32_t* bepos,
+                 const uint8_t* comp,
+                 const int8_t* b_concat, const int64_t* b_off, const int32_t* b_len,
+                 const int32_t* trace_flat, const int64_t* trace_off,
+                 int32_t tspace, int32_t w, int32_t adv,
+                 int32_t D, int32_t L, int32_t include_a,
+                 int8_t* out_seqs, int32_t* out_lens, int32_t* out_nsegs,
+                 int32_t nwin) {
+  // refine every overlap to a base-accurate prefix map
+  std::vector<std::vector<int64_t>> a2bs(novl);
+  std::vector<std::vector<int8_t>> orient(novl);
+  std::vector<int32_t> Dbuf;
+  for (int i = 0; i < novl; ++i) {
+    const int32_t ab = abpos[i], ae = aepos[i];
+    const int32_t blen = b_len[i];
+    const int8_t* bsrc = b_concat + b_off[i];
+    std::vector<int8_t>& bo = orient[i];
+    bo.resize(blen);
+    if (comp[i]) {
+      for (int32_t j = 0; j < blen; ++j) bo[j] = (int8_t)(3 - bsrc[blen - 1 - j]);
+    } else {
+      std::memcpy(bo.data(), bsrc, blen);
+    }
+    std::vector<int64_t>& a2b = a2bs[i];
+    a2b.assign((size_t)(ae - ab) + 1, 0);
+    // tile bounds: [ab, next multiple of tspace, ..., ae]
+    int64_t bpos = bbpos[i];
+    const int32_t* tr = trace_flat + trace_off[i];
+    int32_t t = 0;
+    int32_t a0 = ab;
+    while (a0 < ae) {
+      int32_t a1 = std::min(((a0 / tspace) + 1) * tspace, ae);
+      if (a1 <= a0) a1 = ae;
+      const int32_t tb = tr[2 * t + 1];  // b bases in tile
+      align_path(a + a0, a1 - a0, bo.data() + bpos, tb, Dbuf, a2b.data() + (a0 - ab));
+      // align_path wrote offsets relative to the tile; rebase to absolute
+      for (int32_t x = a0 - ab; x <= a1 - ab; ++x) a2b[x] += bpos;
+      bpos += tb;
+      a0 = a1;
+      ++t;
+    }
+    a2b[ae - ab] = bpos;
+  }
+
+  // cut windows
+  const int32_t n_expected = alen < w ? 0 : (alen - w) / adv + 1;
+  if (n_expected != nwin) return -5;
+  for (int32_t j = 0; j < nwin; ++j) {
+    const int32_t ws = j * adv, we = ws + w;
+    int32_t d = 0;
+    int8_t* wrow = out_seqs + (size_t)j * D * L;
+    if (include_a && d < D) {
+      const int32_t n = std::min(w, L);
+      std::memcpy(wrow, a + ws, n);
+      out_lens[(size_t)j * D] = n;
+      ++d;
+    }
+    for (int i = 0; i < novl && d < D; ++i) {
+      if (abpos[i] <= ws && aepos[i] >= we) {
+        const std::vector<int64_t>& a2b = a2bs[i];
+        const int64_t b0 = a2b[ws - abpos[i]];
+        const int64_t b1 = a2b[we - abpos[i]];
+        if (b1 > b0) {
+          const int32_t n = (int32_t)std::min<int64_t>(b1 - b0, L);
+          std::memcpy(wrow + (size_t)d * L, orient[i].data() + b0, n);
+          out_lens[(size_t)j * D + d] = n;
+          ++d;
+        }
+      }
+    }
+    out_nsegs[j] = d;
+  }
+  return 0;
+}
+
+}  // extern "C"

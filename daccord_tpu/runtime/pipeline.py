@@ -2,10 +2,11 @@
 
 The reference's L5 orchestration (SimpleThreadPool work packages fanning reads
 to handleWindow, ordered output — SURVEY.md §3.1) re-imagined as a host->device
-pipeline: the host streams piles from the LAS byte range, refines trace points,
-cuts windows, and accumulates them into fixed-size cross-read batches; the
-device solves batches through the tier ladder; results scatter back to their
-reads and each completed read is stitched and written in input order.
+pipeline: the host streams piles from the LAS byte range, refines trace points
+and cuts windows (native C++ hot path when built, bit-identical Python
+fallback), accumulates fixed-size cross-read window batches, the device solves
+them through the tier ladder, and results scatter back to their reads; each
+completed read is stitched and emitted in input order.
 
 The profile pass (reference: error-profile estimation over sampled piles)
 runs once up front on the first piles of the shard.
@@ -22,11 +23,11 @@ import numpy as np
 from ..formats.dazzdb import DazzDB, read_db
 from ..formats.fasta import FastaRecord, write_fasta
 from ..formats.las import LasFile
-from ..kernels.tensorize import BatchShape, pad_batch, tensorize_windows
+from ..kernels.tensorize import BatchShape, WindowBatch, pad_batch, tensorize_windows
 from ..kernels.tiers import TierLadder, solve_tiered
 from ..oracle.consensus import ConsensusConfig, estimate_profile_two_pass, stitch_results
 from ..oracle.profile import ErrorProfile
-from ..oracle.windows import WindowSegments, build_pile_windows, cut_windows, refine_overlap
+from ..oracle.windows import WindowSegments, cut_windows, refine_overlap
 from ..utils.bases import ints_to_seq
 
 
@@ -37,6 +38,7 @@ class PipelineConfig:
     depth: int = 32
     seg_len: int = 64
     profile_sample_piles: int = 4
+    use_native: bool = True      # C++ host path when available
     verbose: bool = False
 
 
@@ -49,9 +51,10 @@ class PipelineStats:
     bases_in: int = 0
     bases_out: int = 0
     tier_histogram: dict = field(default_factory=dict)
-    pad_waste: float = 0.0
+    native_host: bool = False
     wall_s: float = 0.0
     device_s: float = 0.0
+    host_s: float = 0.0
 
     def bases_per_sec(self) -> float:
         return self.bases_out / self.wall_s if self.wall_s > 0 else 0.0
@@ -70,7 +73,8 @@ class _PendingRead:
 
 def estimate_profile_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                start: int | None = None, end: int | None = None) -> ErrorProfile:
-    """Profile pass over the first piles of the shard."""
+    """Profile pass over the first piles of the shard (oracle path: the sample
+    is tiny and this doubles as a continuous cross-check of the native path)."""
     refined_all = []
     windows_all: list[WindowSegments] = []
     for i, (aread, pile) in enumerate(las.iter_piles(start, end)):
@@ -83,14 +87,42 @@ def estimate_profile_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     return estimate_profile_two_pass(refined_all, windows_all, cfg.consensus, sample=32)
 
 
+def _iter_pile_blocks(db: DazzDB, las: LasFile, cfg: PipelineConfig,
+                      start, end, native_ok: bool):
+    """Yield (aread, a_bases, seqs [nwin,D,L], lens [nwin,D], nsegs [nwin])."""
+    w, adv = cfg.consensus.w, cfg.consensus.adv
+    D, L = cfg.depth, cfg.seg_len
+    if native_ok:
+        from ..native.api import ColumnarLas, process_pile_native
+
+        col = ColumnarLas(las.path, start, end)
+        for aread, s, e in col.piles():
+            a = db.read_bases(aread)
+            b_reads = [db.read_bases(int(col.bread[i])) for i in range(s, e)]
+            seqs, lens, nsegs = process_pile_native(a, col, s, e, b_reads, w, adv, D, L)
+            yield aread, a, seqs, lens, nsegs
+    else:
+        shape = BatchShape(depth=D, seg_len=L, wlen=w)
+        for aread, pile in las.iter_piles(start, end):
+            a = db.read_bases(aread)
+            refined = [refine_overlap(o, a, db.read_bases(o.bread), las.tspace) for o in pile]
+            windows = cut_windows(a, refined, w=w, adv=adv)
+            if windows:
+                b = tensorize_windows([(aread, ws) for ws in windows], shape)
+                yield aread, a, b.seqs, b.lens, b.nsegs
+            else:
+                yield aread, a, np.zeros((0, D, L), np.int8), np.zeros((0, D), np.int32), np.zeros(0, np.int32)
+
+
 def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                   start: int | None = None, end: int | None = None,
                   profile: ErrorProfile | None = None,
                   solver=None):
-    """Correct every pile in the byte range; yields (aread, [fragments]).
+    """Correct every pile in the byte range; yields (aread, fragments, stats).
 
-    ``solver`` maps a WindowBatch to the solve_tiered output dict; defaults to
-    the local single-device ladder. The parallel backend passes a sharded one.
+    ``solver`` maps a WindowBatch to a solve_tiered-style output dict; defaults
+    to the local single-device ladder. The parallel backend passes the
+    mesh-sharded one.
     """
     stats = PipelineStats()
     t_start = time.time()
@@ -101,74 +133,104 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         def solver(batch):
             return solve_tiered(batch, ladder)
 
-    shape = BatchShape(depth=cfg.depth, seg_len=cfg.seg_len, wlen=cfg.consensus.w)
-    queue: list[tuple[int, WindowSegments]] = []
+    try:
+        from ..native import available as native_available
+        native_ok = cfg.use_native and native_available()
+    except Exception:
+        native_ok = False
+    stats.native_host = native_ok
+
+    D, L = cfg.depth, cfg.seg_len
+    adv = cfg.consensus.adv
+    w = cfg.consensus.w
+    shape = BatchShape(depth=D, seg_len=L, wlen=w)
+
     pending: dict[int, _PendingRead] = {}
     order: list[int] = []
     ready: dict[int, list[np.ndarray]] = {}
     emit_idx = 0
-    pad_cells = pad_used = 0
+    # row buffer: parallel lists of blocks + their (rid, widx) bookkeeping
+    blk_seqs: list[np.ndarray] = []
+    blk_lens: list[np.ndarray] = []
+    blk_nsegs: list[np.ndarray] = []
+    blk_rid: list[np.ndarray] = []
+    blk_widx: list[np.ndarray] = []
+    nrows = 0
 
-    def flush_batch(final: bool):
-        nonlocal queue, pad_cells, pad_used, emit_idx
-        while queue and (len(queue) >= cfg.batch_size or final):
-            chunk, queue = queue[: cfg.batch_size], queue[cfg.batch_size :]
-            batch = pad_batch(tensorize_windows(chunk, shape), cfg.batch_size)
+    def run_batches(final: bool):
+        nonlocal nrows, emit_idx
+        while nrows >= cfg.batch_size or (final and nrows > 0):
+            take = min(cfg.batch_size, nrows)
+            seqs = np.concatenate(blk_seqs) if len(blk_seqs) > 1 else blk_seqs[0]
+            lens = np.concatenate(blk_lens) if len(blk_lens) > 1 else blk_lens[0]
+            nsg = np.concatenate(blk_nsegs) if len(blk_nsegs) > 1 else blk_nsegs[0]
+            rid = np.concatenate(blk_rid) if len(blk_rid) > 1 else blk_rid[0]
+            widx = np.concatenate(blk_widx) if len(blk_widx) > 1 else blk_widx[0]
+            blk_seqs.clear(); blk_lens.clear(); blk_nsegs.clear()
+            blk_rid.clear(); blk_widx.clear()
+            if len(nsg) > take:
+                blk_seqs.append(seqs[take:]); blk_lens.append(lens[take:])
+                blk_nsegs.append(nsg[take:]); blk_rid.append(rid[take:])
+                blk_widx.append(widx[take:])
+            nrows = len(nsg) - take
+            batch = WindowBatch(seqs=seqs[:take], lens=lens[:take], nsegs=nsg[:take],
+                                shape=shape, read_ids=rid[:take],
+                                wstarts=widx[:take].astype(np.int64) * adv)
+            batch = pad_batch(batch, cfg.batch_size)
             t0 = time.time()
             out = solver(batch)
             stats.device_s += time.time() - t0
-            pad_cells += batch.seqs.size
-            pad_used += int(batch.lens.sum())
-            for i, (rid, ws) in enumerate(chunk):
-                pr = pending[rid]
-                widx = (ws.wstart // cfg.consensus.adv)
+            for i in range(take):
+                r = int(rid[i])
+                pr = pending[r]
                 seq = (np.asarray(out["cons"][i][: out["cons_len"][i]], dtype=np.int8)
                        if out["solved"][i] else None)
-                pr.results[widx] = (ws.wstart, ws.wlen, seq)
+                wj = int(widx[i])
+                pr.results[wj] = (wj * adv, w, seq)
                 pr.n_done += 1
                 if out["solved"][i]:
                     stats.n_solved += 1
                     t = int(out["tier"][i])
                     stats.tier_histogram[t] = stats.tier_histogram.get(t, 0) + 1
                 if pr.n_done == pr.n_windows:
-                    rows = [r for r in pr.results if r is not None]
-                    frags = stitch_results(pr.a_bases, rows, cfg.consensus)
-                    ready[rid] = frags
-                    del pending[rid]
+                    rows = [x for x in pr.results if x is not None]
+                    ready[r] = stitch_results(pr.a_bases, rows, cfg.consensus)
+                    del pending[r]
 
-    for aread, pile in las.iter_piles(start, end):
-        a_bases = db.read_bases(aread)
-        stats.bases_in += len(a_bases)
-        refined = [refine_overlap(o, a_bases, db.read_bases(o.bread), las.tspace) for o in pile]
-        windows = cut_windows(a_bases, refined, w=cfg.consensus.w, adv=cfg.consensus.adv)
+    t_host0 = time.time()
+    for aread, a_bases, seqs, lens, nsegs in _iter_pile_blocks(db, las, cfg, start, end, native_ok):
         stats.n_reads += 1
-        stats.n_windows += len(windows)
-        pr = _PendingRead(aread, a_bases, len(windows))
-        pending[aread] = pr
+        stats.bases_in += len(a_bases)
+        nwin = len(nsegs)
+        stats.n_windows += nwin
         order.append(aread)
-        if not windows:
+        if nwin == 0:
             ready[aread] = []
-            del pending[aread]
-        queue.extend((aread, ws) for ws in windows)
-        flush_batch(final=False)
-        # emit completed reads in order
+        else:
+            pending[aread] = _PendingRead(aread, a_bases, nwin)
+            blk_seqs.append(seqs); blk_lens.append(lens); blk_nsegs.append(nsegs)
+            blk_rid.append(np.full(nwin, aread, dtype=np.int64))
+            blk_widx.append(np.arange(nwin, dtype=np.int64))
+            nrows += nwin
+        run_batches(final=False)
         while emit_idx < len(order) and order[emit_idx] in ready:
-            rid = order[emit_idx]
-            frags = ready.pop(rid)
+            r = order[emit_idx]
+            frags = ready.pop(r)
             stats.n_fragments += len(frags)
             stats.bases_out += sum(len(f) for f in frags)
-            yield rid, frags, stats
+            yield r, frags, stats
             emit_idx += 1
 
-    flush_batch(final=True)
+    run_batches(final=True)
     while emit_idx < len(order):
-        rid = order[emit_idx]
-        frags = ready.pop(rid, [])
+        r = order[emit_idx]
+        frags = ready.pop(r, [])
         stats.n_fragments += len(frags)
         stats.bases_out += sum(len(f) for f in frags)
-        yield rid, frags, stats
+        yield r, frags, stats
         emit_idx += 1
     stats.wall_s = time.time() - t_start
+    stats.host_s = stats.wall_s - stats.device_s
 
 
 def correct_to_fasta(db_path: str, las_path: str, out_path, cfg: PipelineConfig | None = None,
