@@ -99,7 +99,7 @@ def device_throughput(data: dict) -> tuple[float, dict]:
     import jax.numpy as jnp
 
     from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch
-    from daccord_tpu.kernels.tiers import TierLadder, solve_tiered
+    from daccord_tpu.kernels.tiers import TierLadder, solve_ladder
     from daccord_tpu.oracle.consensus import ConsensusConfig
     from daccord_tpu.oracle.profile import ErrorProfile
 
@@ -119,13 +119,13 @@ def device_throughput(data: dict) -> tuple[float, dict]:
                            wstarts=np.zeros(BATCH, np.int64))
 
     # warmup / compile all tier shapes
-    solve_tiered(make_batch(0), ladder)
+    solve_ladder(make_batch(0), ladder)
 
     t0 = time.perf_counter()
     bases = 0
     solved = 0
     for i in range(nb):
-        out = solve_tiered(make_batch(i), ladder)
+        out = solve_ladder(make_batch(i), ladder)
         bases += int(out["cons_len"].sum())
         solved += int(out["solved"].sum())
     dt = time.perf_counter() - t0

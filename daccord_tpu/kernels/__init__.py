@@ -1,6 +1,6 @@
 from .tensorize import BatchShape, WindowBatch, tensorize_windows, pad_batch
 from .window_kernel import KernelParams, solve_window_batch
-from .tiers import TierLadder, solve_tiered
+from .tiers import TierLadder, solve_tiered, solve_ladder
 
 __all__ = ["BatchShape", "WindowBatch", "tensorize_windows", "pad_batch",
-           "KernelParams", "solve_window_batch", "TierLadder", "solve_tiered"]
+           "KernelParams", "solve_window_batch", "TierLadder", "solve_tiered", "solve_ladder"]

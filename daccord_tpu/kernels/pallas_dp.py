@@ -1,0 +1,90 @@
+"""Pallas TPU kernel: bounded-length max-plus heaviest-path DP.
+
+The graph-traversal stage of the window solver as a hand-written TPU kernel
+(BASELINE.json north_star: "graph construction and heaviest-path traversal
+become a Pallas kernel"). One grid step per window; the adjacency block, the
+OffsetLikely-weighted position scores, and the DP state all live in VMEM for
+the whole P-step recurrence, so the only HBM traffic is one read of the
+inputs and one write of the score/backpointer stacks.
+
+Semantics are identical to the lax.scan formulation in ``window_kernel``
+(max-plus transition, first-argmax tie-breaking); ``tests/test_pallas.py``
+enforces bit-parity. Falls back to interpret mode off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = jnp.float32(-1e30)
+
+
+def _dp_kernel(adjW_ref, wt_ref, s0_ref, scores_ref, ptrs_ref):
+    P = wt_ref.shape[0]
+    s = s0_ref[0, :]
+    scores_ref[0, :] = s
+    ptrs_ref[0, :] = jnp.zeros_like(ptrs_ref[0, :])
+
+    def body(t, s):
+        cand = s[:, None] + adjW_ref[:, :]          # [u, v]
+        best = jnp.max(cand, axis=0)
+        best_u = jnp.argmax(cand, axis=0).astype(jnp.int32)
+        s_new = jnp.where(best > NEG / 2, best + wt_ref[t, :], NEG)
+        scores_ref[t, :] = s_new
+        ptrs_ref[t, :] = best_u
+        return s_new
+
+    jax.lax.fori_loop(1, P, body, s)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def heaviest_path_batch(adjW: jnp.ndarray, wt: jnp.ndarray, s0: jnp.ndarray,
+                        interpret: bool = False):
+    """adjW [B,M,M] f32 (0 / -inf), wt [B,P,M] f32, s0 [B,M] f32 ->
+    (scores [B,P,M] f32, ptrs [B,P,M] i32)."""
+    B, M, _ = adjW.shape
+    P = wt.shape[1]
+    grid = (B,)
+    out = pl.pallas_call(
+        _dp_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, M, M), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, P, M), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, M), lambda b: (b, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, P, M), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, P, M), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, P, M), jnp.float32),
+            jax.ShapeDtypeStruct((B, P, M), jnp.int32),
+        ],
+        interpret=interpret,
+    )(adjW, wt, s0)
+    return out
+
+
+def _dp_kernel_blocked(adjW_ref, wt_ref, s0_ref, scores_ref, ptrs_ref):
+    # block shapes carry a leading singleton window axis
+    P = wt_ref.shape[1]
+    s = s0_ref[0, :]
+    scores_ref[0, 0, :] = s
+    ptrs_ref[0, 0, :] = jnp.zeros_like(ptrs_ref[0, 0, :])
+
+    def body(t, s):
+        cand = s[:, None] + adjW_ref[0, :, :]
+        best = jnp.max(cand, axis=0)
+        best_u = jnp.argmax(cand, axis=0).astype(jnp.int32)
+        s_new = jnp.where(best > NEG / 2, best + wt_ref[0, t, :], NEG)
+        scores_ref[0, t, :] = s_new
+        ptrs_ref[0, t, :] = best_u
+        return s_new
+
+    jax.lax.fori_loop(1, P, body, s)

@@ -3,9 +3,14 @@
 The reference's only intra-process parallelism is a pthread pool over
 reads/windows (SimpleThreadPool, SURVEY.md §2.3); the TPU equivalent shards
 the *window batch dimension* across a 1-D device mesh. Piles are independent,
-so there is no cross-window communication — the only collective is the stats
-reduction (psum), deliberately preserving the reference's zero-communication
-design (SURVEY.md §5 "Distributed communication backend").
+so there is no cross-window communication — the only collective is the psum
+of the escalation-overflow counter, deliberately preserving the reference's
+zero-communication design (SURVEY.md §5 "Distributed communication backend").
+
+The full escalation ladder (tier 0 + device-compacted rescue tiers, see
+``kernels.tiers.ladder_core``) runs INSIDE shard_map: each device solves and
+escalates its own slice, so one sharded batch costs one dispatch and one
+fetch regardless of mesh size.
 
 Multi-host scale-out composes this with host-side LAS byte-range sharding
 (``formats.las.shard_ranges``): every process corrects its own aread range on
@@ -22,8 +27,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..kernels.tensorize import WindowBatch, pad_batch
-from ..kernels.tiers import TierLadder
-from ..kernels.window_kernel import KernelParams, _solve_one
+from ..kernels.tiers import TierLadder, ladder_core
+from ..kernels.window_kernel import KernelParams
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -35,73 +40,44 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), axis_names=("d",))
 
 
-@functools.partial(jax.jit, static_argnames=("params", "mesh"))
-def _solve_sharded(seqs, lens, nsegs, ol, params: KernelParams, mesh: Mesh):
-    """Batch-sharded solve: inputs sharded on the window axis, OL replicated.
-
-    Implemented with shard_map so the partitioning is explicit: each device
-    runs the identical per-window program on its slice (SPMD over ICI); a
-    psum-reduced solve counter rides along as the collective.
-    """
+@functools.partial(jax.jit, static_argnames=("params", "esc_cap", "mesh"))
+def _ladder_sharded(seqs, lens, nsegs, tables, params, esc_cap, mesh):
     from jax.experimental.shard_map import shard_map
 
-    def local(seqs, lens, nsegs, ol):
-        out = jax.vmap(functools.partial(_solve_one, p=params),
-                       in_axes=(0, 0, 0, None))(seqs, lens, nsegs, ol)
-        n_solved = jax.lax.psum(jnp.sum(out["solved"].astype(jnp.int32)), "d")
-        return out, n_solved
+    def local(seqs, lens, nsegs, tables):
+        out = ladder_core(seqs, lens, nsegs, tables, params, esc_cap)
+        out["esc_overflow"] = jax.lax.psum(out["esc_overflow"], "d")
+        return out
 
     fn = shard_map(local, mesh=mesh,
                    in_specs=(P("d"), P("d"), P("d"), P()),
-                   out_specs=({"cons": P("d"), "cons_len": P("d"),
-                               "err": P("d"), "solved": P("d")}, P()))
-    return fn(seqs, lens, nsegs, ol)
+                   out_specs={"cons": P("d"), "cons_len": P("d"), "err": P("d"),
+                              "solved": P("d"), "tier": P("d"),
+                              "esc_overflow": P()})
+    return fn(seqs, lens, nsegs, tables)
 
 
-def make_sharded_solver(ladder: TierLadder, mesh: Mesh, compact_size: int = 64):
-    """WindowBatch -> results dict, tier-0 sharded over the mesh.
+def make_sharded_solver(ladder: TierLadder, mesh: Mesh, esc_cap: int = 64):
+    """WindowBatch -> results dict, the full ladder sharded over the mesh.
 
-    Escalation tiers run compacted on device 0 (they see <10% of windows;
-    sharding them wastes ICI latency on tiny batches). The returned callable
-    is a drop-in ``solver`` for ``runtime.pipeline.correct_shard``.
+    ``esc_cap`` is the per-device escalation capacity. A drop-in ``solver``
+    for ``runtime.pipeline.correct_shard``.
     """
-    from ..kernels.tiers import solve_tiered
-
     nd = mesh.devices.size
     sharding = NamedSharding(mesh, P("d"))
+    tables = tuple(ladder.tables[p.k] for p in ladder.params)
+    params = tuple(ladder.params)
 
     def solver(batch: WindowBatch) -> dict:
         B0 = batch.size
         target = ((B0 + nd - 1) // nd) * nd
         batch = pad_batch(batch, target) if target != B0 else batch
-        p0 = ladder.params[0]
-        args = (jax.device_put(jnp.asarray(batch.seqs), sharding),
-                jax.device_put(jnp.asarray(batch.lens), sharding),
-                jax.device_put(jnp.asarray(batch.nsegs), sharding),
-                jnp.asarray(ladder.tables[p0.k]))
-        out, _ = _solve_sharded(*args, params=p0, mesh=mesh)
-        cons = np.array(out["cons"][:B0])
-        cons_len = np.array(out["cons_len"][:B0])
-        err = np.array(out["err"][:B0])
-        solved = np.array(out["solved"][:B0])
-        tier_of = np.where(solved, 0, -1).astype(np.int32)
-
-        # escalation on the (small) failure set: reuse the host ladder with the
-        # tier-0 results pre-filled
-        idx = np.nonzero(~solved)[0]
-        if len(idx):
-            from ..kernels.tensorize import BatchShape, WindowBatch as WB
-            sub = WB(seqs=batch.seqs[idx], lens=batch.lens[idx],
-                     nsegs=batch.nsegs[idx], shape=batch.shape,
-                     read_ids=batch.read_ids[idx], wstarts=batch.wstarts[idx])
-            rest = solve_tiered(sub, ladder, compact_size=compact_size, skip_tier0=True)
-            take = idx[rest["solved"]]
-            if len(take):
-                cons[take] = rest["cons"][rest["solved"]]
-                cons_len[take] = rest["cons_len"][rest["solved"]]
-                err[take] = rest["err"][rest["solved"]]
-                solved[take] = True
-                tier_of[take] = rest["tier"][rest["solved"]]
-        return dict(cons=cons, cons_len=cons_len, err=err, solved=solved, tier=tier_of)
+        out = _ladder_sharded(
+            jax.device_put(jnp.asarray(batch.seqs), sharding),
+            jax.device_put(jnp.asarray(batch.lens), sharding),
+            jax.device_put(jnp.asarray(batch.nsegs), sharding),
+            tables, params=params, esc_cap=esc_cap, mesh=mesh)
+        host = jax.device_get(out)
+        return {k: np.asarray(v)[:B0] if np.ndim(v) else v for k, v in host.items()}
 
     return solver

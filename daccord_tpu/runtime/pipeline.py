@@ -24,7 +24,7 @@ from ..formats.dazzdb import DazzDB, read_db
 from ..formats.fasta import FastaRecord, write_fasta
 from ..formats.las import LasFile
 from ..kernels.tensorize import BatchShape, WindowBatch, pad_batch, tensorize_windows
-from ..kernels.tiers import TierLadder, solve_tiered
+from ..kernels.tiers import TierLadder, solve_ladder
 from ..oracle.consensus import ConsensusConfig, estimate_profile_two_pass, stitch_results
 from ..oracle.profile import ErrorProfile
 from ..oracle.windows import WindowSegments, cut_windows, refine_overlap
@@ -130,8 +130,20 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         profile = estimate_profile_for_shard(db, las, cfg, start, end)
     ladder = TierLadder.from_config(profile, cfg.consensus)
     if solver is None:
-        def solver(batch):
-            return solve_tiered(batch, ladder)
+        import jax
+
+        if jax.default_backend() == "cpu":
+            # host-routed ladder: skips escalation tiers when nothing failed
+            # (cheap syncs; right trade-off for local CPU execution)
+            from ..kernels.tiers import solve_tiered
+
+            def solver(batch):
+                return solve_tiered(batch, ladder)
+        else:
+            # single-dispatch device ladder: one round trip per batch (the TPU
+            # sits behind a ~65 ms tunnel; blocking syncs dominate otherwise)
+            def solver(batch):
+                return solve_ladder(batch, ladder)
 
     try:
         from ..native import available as native_available
