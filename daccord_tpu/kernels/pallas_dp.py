@@ -21,25 +21,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG = jnp.float32(-1e30)
-
-
-def _dp_kernel(adjW_ref, wt_ref, s0_ref, scores_ref, ptrs_ref):
-    P = wt_ref.shape[0]
-    s = s0_ref[0, :]
-    scores_ref[0, :] = s
-    ptrs_ref[0, :] = jnp.zeros_like(ptrs_ref[0, :])
-
-    def body(t, s):
-        cand = s[:, None] + adjW_ref[:, :]          # [u, v]
-        best = jnp.max(cand, axis=0)
-        best_u = jnp.argmax(cand, axis=0).astype(jnp.int32)
-        s_new = jnp.where(best > NEG / 2, best + wt_ref[t, :], NEG)
-        scores_ref[t, :] = s_new
-        ptrs_ref[t, :] = best_u
-        return s_new
-
-    jax.lax.fori_loop(1, P, body, s)
+NEG = -1e30  # python float: jnp constants may not be captured by pallas kernels
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -49,6 +31,7 @@ def heaviest_path_batch(adjW: jnp.ndarray, wt: jnp.ndarray, s0: jnp.ndarray,
     (scores [B,P,M] f32, ptrs [B,P,M] i32)."""
     B, M, _ = adjW.shape
     P = wt.shape[1]
+    s0 = s0[:, None, :]   # [B, 1, M]: TPU block shapes need >=2 trailing dims
     grid = (B,)
     out = pl.pallas_call(
         _dp_kernel,
@@ -56,7 +39,7 @@ def heaviest_path_batch(adjW: jnp.ndarray, wt: jnp.ndarray, s0: jnp.ndarray,
         in_specs=[
             pl.BlockSpec((1, M, M), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, P, M), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, M), lambda b: (b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, M), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, P, M), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
@@ -71,19 +54,29 @@ def heaviest_path_batch(adjW: jnp.ndarray, wt: jnp.ndarray, s0: jnp.ndarray,
     return out
 
 
-def _dp_kernel_blocked(adjW_ref, wt_ref, s0_ref, scores_ref, ptrs_ref):
-    # block shapes carry a leading singleton window axis
+def _dp_kernel(adjW_ref, wt_ref, s0_ref, scores_ref, ptrs_ref):
+    # block shapes carry a leading singleton window axis; state stays 2-D
+    # ([1, M] rows) throughout — Mosaic's layout inference dislikes 1-D<->2-D
+    # reshapes, so the u-axis broadcast goes through broadcast_in_dim.
     P = wt_ref.shape[1]
-    s = s0_ref[0, :]
-    scores_ref[0, 0, :] = s
+    M = adjW_ref.shape[1]
+    s = s0_ref[0, :, :]                    # [1, M]
+    scores_ref[0, 0, :] = s[0, :]
     ptrs_ref[0, 0, :] = jnp.zeros_like(ptrs_ref[0, 0, :])
 
     def body(t, s):
-        cand = s[:, None] + adjW_ref[0, :, :]
-        best = jnp.max(cand, axis=0)
-        best_u = jnp.argmax(cand, axis=0).astype(jnp.int32)
-        s_new = jnp.where(best > NEG / 2, best + wt_ref[0, t, :], NEG)
-        scores_ref[0, t, :] = s_new
+        # cand[u, v] = s[u] + adjW[u, v]; s is a row over v, broadcast over u
+        s_row = jax.lax.broadcast_in_dim(s, (M, M), (0, 1))  # s_row[x, v] = s[0, v]
+        cand = jnp.transpose(s_row) + adjW_ref[0, :, :]      # cand[u, v] = s[0, u] + adjW
+
+        best = jnp.max(cand, axis=0, keepdims=True)           # [1, M]
+        # explicit first-max tie-break: Mosaic's argmax tie order differs from
+        # XLA's; parity with the scan formulation requires the lowest index
+        iota_u = jax.lax.broadcasted_iota(jnp.int32, (M, M), 0)
+        best_bc = jax.lax.broadcast_in_dim(best, (M, M), (0, 1))
+        best_u = jnp.min(jnp.where(cand == best_bc, iota_u, M), axis=0).astype(jnp.int32)
+        s_new = jnp.where(best > -5e29, best + wt_ref[0, pl.ds(t, 1), :], -1e30)
+        scores_ref[0, t, :] = s_new[0, :]
         ptrs_ref[0, t, :] = best_u
         return s_new
 
