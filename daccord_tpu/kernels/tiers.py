@@ -123,14 +123,27 @@ def _ladder_jit(seqs, lens, nsegs, tables, params, esc_cap):
     return ladder_core(seqs, lens, nsegs, tables, params, esc_cap)
 
 
+def solve_ladder_async(batch: WindowBatch, ladder: TierLadder, esc_cap: int = 256):
+    """Dispatch the full ladder; returns device arrays without blocking.
+
+    Pair with :func:`fetch` — the pipeline keeps a couple of batches in flight
+    so host windowing, device compute, and the tunnel transfer overlap.
+    """
+    tables = tuple(ladder.tables[p.k] for p in ladder.params)
+    return _ladder_jit(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
+                       jnp.asarray(batch.nsegs), tables,
+                       tuple(ladder.params), esc_cap)
+
+
+def fetch(out) -> dict:
+    """Materialize a solver result on host (no-op for numpy dicts)."""
+    host = jax.device_get(out)
+    return {k: np.asarray(v) for k, v in host.items()}
+
+
 def solve_ladder(batch: WindowBatch, ladder: TierLadder, esc_cap: int = 256) -> dict:
     """Single-dispatch full-ladder solve; host numpy results."""
-    tables = tuple(ladder.tables[p.k] for p in ladder.params)
-    out = _ladder_jit(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
-                      jnp.asarray(batch.nsegs), tables,
-                      tuple(ladder.params), esc_cap)
-    host = jax.device_get(out)     # one transfer for the whole pytree
-    return {k: np.asarray(v) for k, v in host.items()}
+    return fetch(solve_ladder_async(batch, ladder, esc_cap))
 
 
 def solve_tiered(batch: WindowBatch, ladder: TierLadder,

@@ -66,10 +66,13 @@ class ColumnarLas:
 def process_pile_native(a_bases: np.ndarray, col: ColumnarLas, s: int, e: int,
                         b_reads: list[np.ndarray],
                         w: int, adv: int, D: int, L: int,
-                        include_a: bool = True):
+                        include_a: bool = True,
+                        order: np.ndarray | None = None):
     """Windows of one pile as batch tensors via the native hot path.
 
-    ``b_reads``: decoded stored-orientation B bases per overlap in [s, e).
+    ``b_reads``: decoded stored-orientation B bases per overlap, already in
+    ``order`` if one is given. ``order`` permutes the pile (indices into
+    [0, e-s)) — used for quality-ranked depth capping.
     Returns (seqs [nwin,D,L] int8, lens [nwin,D] i32, nsegs [nwin] i32).
     """
     lib = load()
@@ -86,17 +89,32 @@ def process_pile_native(a_bases: np.ndarray, col: ColumnarLas, s: int, e: int,
     np.cumsum([len(b) for b in b_reads], out=b_off[1:])
     b_concat = (np.concatenate(b_reads) if b_reads else np.zeros(0, np.int8)).astype(np.int8, copy=False)
     b_len = np.asarray([len(b) for b in b_reads], dtype=np.int32)
-    # rebase trace offsets for the pile slice
-    toff = (col.trace_off[s : e + 1] - col.trace_off[s]).astype(np.int64)
-    tflat = col.trace_flat[col.trace_off[s] : col.trace_off[e]]
-    tflat = np.ascontiguousarray(tflat, dtype=np.int32)
     a_c = np.ascontiguousarray(a_bases, dtype=np.int8)
 
-    abpos = np.ascontiguousarray(col.abpos[s:e])
-    aepos = np.ascontiguousarray(col.aepos[s:e])
-    bbpos = np.ascontiguousarray(col.bbpos[s:e])
-    bepos = np.ascontiguousarray(col.bepos[s:e])
-    comp = np.ascontiguousarray(col.comp[s:e])
+    if order is None:
+        # rebase trace offsets for the contiguous pile slice
+        toff = (col.trace_off[s : e + 1] - col.trace_off[s]).astype(np.int64)
+        tflat = col.trace_flat[col.trace_off[s] : col.trace_off[e]]
+        tflat = np.ascontiguousarray(tflat, dtype=np.int32)
+        sel = slice(s, e)
+        abpos = np.ascontiguousarray(col.abpos[sel])
+        aepos = np.ascontiguousarray(col.aepos[sel])
+        bbpos = np.ascontiguousarray(col.bbpos[sel])
+        bepos = np.ascontiguousarray(col.bepos[sel])
+        comp = np.ascontiguousarray(col.comp[sel])
+    else:
+        gi = s + np.asarray(order, dtype=np.int64)
+        abpos = np.ascontiguousarray(col.abpos[gi])
+        aepos = np.ascontiguousarray(col.aepos[gi])
+        bbpos = np.ascontiguousarray(col.bbpos[gi])
+        bepos = np.ascontiguousarray(col.bepos[gi])
+        comp = np.ascontiguousarray(col.comp[gi])
+        tlens = (col.trace_off[gi + 1] - col.trace_off[gi]).astype(np.int64)
+        toff = np.zeros(novl + 1, np.int64)
+        np.cumsum(tlens, out=toff[1:])
+        tflat = np.empty(int(toff[-1]), np.int32)
+        for j, g in enumerate(gi):
+            tflat[toff[j] : toff[j + 1]] = col.trace_flat[col.trace_off[g] : col.trace_off[g + 1]]
 
     rc = lib.process_pile(_ptr(a_c), alen, novl,
                           _ptr(abpos), _ptr(aepos), _ptr(bbpos), _ptr(bepos),

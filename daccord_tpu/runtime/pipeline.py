@@ -39,6 +39,9 @@ class PipelineConfig:
     seg_len: int = 64
     profile_sample_piles: int = 4
     use_native: bool = True      # C++ host path when available
+    depth_rank: bool = True      # best-alignments-first before depth capping
+    max_inflight: int = 2        # device batches in flight (double buffering)
+    log_path: str | None = None  # jsonl event log ('-' = stderr)
     verbose: bool = False
 
 
@@ -52,9 +55,15 @@ class PipelineStats:
     bases_out: int = 0
     tier_histogram: dict = field(default_factory=dict)
     native_host: bool = False
+    pad_cells: int = 0
+    used_cells: int = 0
     wall_s: float = 0.0
     device_s: float = 0.0
     host_s: float = 0.0
+
+    @property
+    def pad_waste(self) -> float:
+        return 1.0 - self.used_cells / self.pad_cells if self.pad_cells else 0.0
 
     def bases_per_sec(self) -> float:
         return self.bases_out / self.wall_s if self.wall_s > 0 else 0.0
@@ -98,13 +107,23 @@ def _iter_pile_blocks(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         col = ColumnarLas(las.path, start, end)
         for aread, s, e in col.piles():
             a = db.read_bases(aread)
-            b_reads = [db.read_bases(int(col.bread[i])) for i in range(s, e)]
-            seqs, lens, nsegs = process_pile_native(a, col, s, e, b_reads, w, adv, D, L)
+            order = None
+            if cfg.depth_rank:
+                # quality-ranked depth capping (SURVEY.md §7.3 item 1): best
+                # alignments (lowest trace-diff rate) fill the depth slots
+                span = np.maximum(col.aepos[s:e] - col.abpos[s:e], 1)
+                order = np.argsort(col.diffs[s:e] / span, kind="stable")
+            idxs = range(s, e) if order is None else (s + order)
+            b_reads = [db.read_bases(int(col.bread[i])) for i in idxs]
+            seqs, lens, nsegs = process_pile_native(a, col, s, e, b_reads, w, adv, D, L,
+                                                    order=order)
             yield aread, a, seqs, lens, nsegs
     else:
         shape = BatchShape(depth=D, seg_len=L, wlen=w)
         for aread, pile in las.iter_piles(start, end):
             a = db.read_bases(aread)
+            if cfg.depth_rank:
+                pile = sorted(pile, key=lambda o: o.diffs / max(o.aepos - o.abpos, 1))
             refined = [refine_overlap(o, a, db.read_bases(o.bread), las.tspace) for o in pile]
             windows = cut_windows(a, refined, w=w, adv=adv)
             if windows:
@@ -129,7 +148,12 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     if profile is None:
         profile = estimate_profile_for_shard(db, las, cfg, start, end)
     ladder = TierLadder.from_config(profile, cfg.consensus)
-    if solver is None:
+    from ..utils.obs import JsonlLogger
+
+    log = JsonlLogger(cfg.log_path)
+    if solver is not None:
+        dispatch_fn, fetch_fn = solver, (lambda h: h)
+    else:
         import jax
 
         if jax.default_backend() == "cpu":
@@ -137,13 +161,13 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             # (cheap syncs; right trade-off for local CPU execution)
             from ..kernels.tiers import solve_tiered
 
-            def solver(batch):
-                return solve_tiered(batch, ladder)
+            dispatch_fn, fetch_fn = (lambda b: solve_tiered(b, ladder)), (lambda h: h)
         else:
-            # single-dispatch device ladder: one round trip per batch (the TPU
-            # sits behind a ~65 ms tunnel; blocking syncs dominate otherwise)
-            def solver(batch):
-                return solve_ladder(batch, ladder)
+            # async device ladder: one dispatch per batch, fetched a batch
+            # later so host windowing overlaps device compute + tunnel RTT
+            from ..kernels.tiers import fetch as _fetch, solve_ladder_async
+
+            dispatch_fn, fetch_fn = (lambda b: solve_ladder_async(b, ladder)), _fetch
 
     try:
         from ..native import available as native_available
@@ -169,6 +193,42 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     blk_widx: list[np.ndarray] = []
     nrows = 0
 
+    from collections import deque
+
+    inflight: deque = deque()    # (handle, rid, widx, take, t_dispatch)
+
+    def scatter(out, rid, widx, take):
+        n_batch_solved = 0
+        for i in range(take):
+            r = int(rid[i])
+            pr = pending[r]
+            seq = (np.asarray(out["cons"][i][: out["cons_len"][i]], dtype=np.int8)
+                   if out["solved"][i] else None)
+            wj = int(widx[i])
+            pr.results[wj] = (wj * adv, w, seq)
+            pr.n_done += 1
+            if out["solved"][i]:
+                stats.n_solved += 1
+                n_batch_solved += 1
+                t = int(out["tier"][i])
+                stats.tier_histogram[t] = stats.tier_histogram.get(t, 0) + 1
+            if pr.n_done == pr.n_windows:
+                rows = [x for x in pr.results if x is not None]
+                ready[r] = stitch_results(pr.a_bases, rows, cfg.consensus)
+                del pending[r]
+        return n_batch_solved
+
+    def drain(to_depth: int):
+        while len(inflight) > to_depth:
+            handle, rid, widx, take, t0 = inflight.popleft()
+            out = fetch_fn(handle)
+            dt = time.time() - t0
+            stats.device_s += dt
+            n_s = scatter(out, rid, widx, take)
+            log.log("batch", windows=take, solved=n_s,
+                    overflow=int(out.get("esc_overflow", 0)),
+                    inflight=len(inflight), t_turnaround=round(dt, 4))
+
     def run_batches(final: bool):
         nonlocal nrows, emit_idx
         while nrows >= cfg.batch_size or (final and nrows > 0):
@@ -189,25 +249,13 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                 shape=shape, read_ids=rid[:take],
                                 wstarts=widx[:take].astype(np.int64) * adv)
             batch = pad_batch(batch, cfg.batch_size)
-            t0 = time.time()
-            out = solver(batch)
-            stats.device_s += time.time() - t0
-            for i in range(take):
-                r = int(rid[i])
-                pr = pending[r]
-                seq = (np.asarray(out["cons"][i][: out["cons_len"][i]], dtype=np.int8)
-                       if out["solved"][i] else None)
-                wj = int(widx[i])
-                pr.results[wj] = (wj * adv, w, seq)
-                pr.n_done += 1
-                if out["solved"][i]:
-                    stats.n_solved += 1
-                    t = int(out["tier"][i])
-                    stats.tier_histogram[t] = stats.tier_histogram.get(t, 0) + 1
-                if pr.n_done == pr.n_windows:
-                    rows = [x for x in pr.results if x is not None]
-                    ready[r] = stitch_results(pr.a_bases, rows, cfg.consensus)
-                    del pending[r]
+            stats.pad_cells += batch.seqs.size
+            stats.used_cells += int(batch.lens.sum())
+            handle = dispatch_fn(batch)
+            inflight.append((handle, rid, widx, take, time.time()))
+            drain(cfg.max_inflight - 1)
+        if final:
+            drain(0)
 
     t_host0 = time.time()
     for aread, a_bases, seqs, lens, nsegs in _iter_pile_blocks(db, las, cfg, start, end, native_ok):
@@ -243,6 +291,11 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         emit_idx += 1
     stats.wall_s = time.time() - t_start
     stats.host_s = stats.wall_s - stats.device_s
+    log.log("shard_done", reads=stats.n_reads, windows=stats.n_windows,
+            solved=stats.n_solved, bases_out=stats.bases_out,
+            pad_waste=round(stats.pad_waste, 4), wall_s=round(stats.wall_s, 3),
+            tiers=stats.tier_histogram, native=stats.native_host)
+    log.close()
 
 
 def correct_to_fasta(db_path: str, las_path: str, out_path, cfg: PipelineConfig | None = None,

@@ -49,20 +49,33 @@ def daccord_main(argv=None) -> int:
     p.add_argument("--mode", choices=("split", "patch"), default="split",
                    help="unsolved windows split the read or get patched with raw bases")
     p.add_argument("--stats", default=None, help="write run stats JSON here")
+    p.add_argument("--log", default=None, help="jsonl event log path ('-' = stderr)")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler device trace into DIR")
+    p.add_argument("--no-native", action="store_true", help="disable C++ host path")
     _add_J(p)
     args = p.parse_args(argv)
 
     start, end = _resolve_range(args, args.las)
     ccfg = ConsensusConfig(w=args.w, adv=args.a, mode=args.mode)
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
-                         depth=args.depth, seg_len=args.seg_len)
-    stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start, end=end)
+                         depth=args.depth, seg_len=args.seg_len,
+                         log_path=args.log, use_native=not args.no_native)
+    if args.profile:
+        import jax
+
+        with jax.profiler.trace(args.profile):
+            stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start, end=end)
+    else:
+        stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start, end=end)
     line = {
         "reads": stats.n_reads, "windows": stats.n_windows, "solved": stats.n_solved,
         "fragments": stats.n_fragments, "bases_in": stats.bases_in,
         "bases_out": stats.bases_out, "wall_s": round(stats.wall_s, 3),
         "device_s": round(stats.device_s, 3),
         "tier_histogram": stats.tier_histogram,
+        "pad_waste": round(stats.pad_waste, 4),
+        "native_host": stats.native_host,
     }
     print(json.dumps(line), file=sys.stderr)
     if args.stats:

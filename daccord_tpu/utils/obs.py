@@ -1,0 +1,39 @@
+"""Observability: structured jsonl event log + counters.
+
+The reference's observability is unstructured stderr prints plus
+``util::Histogram`` dumps (SURVEY.md §5); here every pipeline event is a JSON
+line so runs are machine-checkable: windows/sec, bases/sec/chip, per-tier
+solve counts, pad-waste ratio — the metrics BASELINE.json tracks.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+class JsonlLogger:
+    def __init__(self, path: str | None = None, stream=None):
+        self._fh = None
+        if path == "-":
+            self._fh = stream or sys.stderr
+        elif path:
+            self._fh = open(path, "at")
+        self._t0 = time.time()
+
+    def log(self, event: str, **fields) -> None:
+        if self._fh is None:
+            return
+        rec = {"t": round(time.time() - self._t0, 3), "event": event, **fields}
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None and self._fh is not sys.stderr:
+            self._fh.close()
+
+
+class NullLogger(JsonlLogger):
+    def __init__(self):
+        super().__init__(None)
