@@ -1,0 +1,96 @@
+"""Native C++ host path: bit-parity with the Python oracle path."""
+
+import numpy as np
+import pytest
+
+from daccord_tpu.formats import LasFile, read_db
+from daccord_tpu.kernels import BatchShape, tensorize_windows
+from daccord_tpu.oracle import cut_windows, refine_overlap
+from daccord_tpu.sim import SimConfig, make_dataset
+
+native = pytest.importorskip("daccord_tpu.native")
+if not native.available():
+    pytest.skip("native library unavailable", allow_module_level=True)
+
+from daccord_tpu.native.api import ColumnarLas, process_pile_native
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("native"))
+    cfg = SimConfig(genome_len=3000, coverage=16, read_len_mean=800, seed=19)
+    return make_dataset(d, cfg, name="n"), d
+
+
+def test_columnar_las_matches_python_reader(dataset):
+    out, d = dataset
+    col = ColumnarLas(out["las"])
+    las = LasFile(out["las"])
+    ovls = list(las)
+    assert col.novl == len(ovls)
+    assert col.tspace == las.tspace
+    for i in (0, 1, len(ovls) // 2, len(ovls) - 1):
+        o = ovls[i]
+        assert (col.aread[i], col.bread[i]) == (o.aread, o.bread)
+        assert (col.abpos[i], col.aepos[i]) == (o.abpos, o.aepos)
+        assert (col.bbpos[i], col.bepos[i]) == (o.bbpos, o.bepos)
+        assert bool(col.comp[i]) == o.is_comp
+        tr = col.trace_flat[col.trace_off[i] : col.trace_off[i + 1]].reshape(-1, 2)
+        np.testing.assert_array_equal(tr, o.trace)
+
+
+def test_columnar_byte_range(dataset):
+    out, d = dataset
+    from daccord_tpu.formats.las import shard_ranges
+
+    r = shard_ranges(out["las"], 2)
+    c0 = ColumnarLas(out["las"], r[0][0], r[0][1])
+    c1 = ColumnarLas(out["las"], r[1][0], r[1][1])
+    full = ColumnarLas(out["las"])
+    assert c0.novl + c1.novl == full.novl
+    np.testing.assert_array_equal(np.concatenate([c0.aread, c1.aread]), full.aread)
+
+
+def test_process_pile_bit_parity(dataset):
+    out, d = dataset
+    db = read_db(out["db"])
+    col = ColumnarLas(out["las"])
+    las = LasFile(out["las"])
+    piles = dict(las.iter_piles())
+    shape = BatchShape(depth=32, seg_len=64, wlen=40)
+    checked = 0
+    for aread, s, e in list(col.piles())[:6]:
+        a = db.read_bases(aread)
+        b_reads = [db.read_bases(int(col.bread[i])) for i in range(s, e)]
+        seqs, lens, nsegs = process_pile_native(a, col, s, e, b_reads, 40, 10, 32, 64)
+        refined = [refine_overlap(o, a, db.read_bases(o.bread), col.tspace) for o in piles[aread]]
+        windows = cut_windows(a, refined)
+        batch = tensorize_windows([(aread, ws) for ws in windows], shape)
+        np.testing.assert_array_equal(batch.seqs, seqs)
+        np.testing.assert_array_equal(batch.lens, lens)
+        np.testing.assert_array_equal(batch.nsegs, nsegs)
+        checked += 1
+    assert checked == 6
+
+
+def test_process_pile_with_order(dataset):
+    """Quality-ranked order must match reordering the Python pile."""
+    out, d = dataset
+    db = read_db(out["db"])
+    col = ColumnarLas(out["las"])
+    las = LasFile(out["las"])
+    piles = dict(las.iter_piles())
+    shape = BatchShape(depth=32, seg_len=64, wlen=40)
+    aread, s, e = next(iter(col.piles()))
+    a = db.read_bases(aread)
+    span = np.maximum(col.aepos[s:e] - col.abpos[s:e], 1)
+    order = np.argsort(col.diffs[s:e] / span, kind="stable")
+    b_reads = [db.read_bases(int(col.bread[s + int(j)])) for j in order]
+    seqs, lens, nsegs = process_pile_native(a, col, s, e, b_reads, 40, 10, 32, 64, order=order)
+
+    pile = sorted(piles[aread], key=lambda o: o.diffs / max(o.aepos - o.abpos, 1))
+    refined = [refine_overlap(o, a, db.read_bases(o.bread), col.tspace) for o in pile]
+    windows = cut_windows(a, refined)
+    batch = tensorize_windows([(aread, ws) for ws in windows], shape)
+    np.testing.assert_array_equal(batch.seqs, seqs)
+    np.testing.assert_array_equal(batch.lens, lens)
