@@ -51,6 +51,10 @@ def load():
                                  c.POINTER(c.c_int64)]
         lib.las_load.restype = c.c_int
         lib.las_load.argtypes = [c.c_char_p, c.c_int64, c.c_int64, c.c_int64] + [c.c_void_p] * 10
+        lib.suffix_prefix.restype = c.c_int
+        lib.suffix_prefix.argtypes = [c.c_void_p, c.c_int32, c.c_void_p, c.c_int32,
+                                      c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+                                      c.POINTER(c.c_int32)]
         lib.process_pile.restype = c.c_int
         lib.process_pile.argtypes = (
             [c.c_void_p, c.c_int32, c.c_int32]        # a, alen, novl

@@ -221,4 +221,55 @@ int process_pile(const int8_t* a, int32_t alen,
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// stitch splice: best suffix(a) x prefix(b) semi-global alignment
+// ---------------------------------------------------------------------------
+// Exact port of oracle.align.overlap_suffix_prefix (free start in a, free end
+// in b, end chosen minimizing cost - len/2, ties to the lower index;
+// backtrack tie order substitution > deletion > insertion).
+int suffix_prefix(const int8_t* a, int32_t n, const int8_t* b, int32_t m,
+                  int32_t* out_cost, int32_t* out_a_start, int32_t* out_b_end) {
+  std::vector<int32_t> Dbuf((size_t)(n + 1) * (m + 1));
+  int32_t* D = Dbuf.data();
+  const int W = m + 1;
+  for (int j = 0; j <= m; ++j) D[j] = j;
+  for (int i = 1; i <= n; ++i) {
+    int32_t* row = D + (size_t)i * W;
+    const int32_t* prev = row - W;
+    row[0] = 0;
+    const int8_t ai = a[i - 1];
+    for (int j = 1; j <= m; ++j) {
+      int32_t best = prev[j - 1] + (b[j - 1] != ai);
+      int32_t del = prev[j] + 1;
+      if (del < best) best = del;
+      int32_t ins = row[j - 1] + 1;
+      if (ins < best) best = ins;
+      row[j] = best;
+    }
+  }
+  const int32_t* last = D + (size_t)n * W;
+  int b_end = 0;
+  int64_t bestc = 2LL * last[0];
+  for (int j = 1; j <= m; ++j) {
+    int64_t c = 2LL * last[j] - j;
+    if (c < bestc) { bestc = c; b_end = j; }
+  }
+  int i = n, j = b_end;
+  while (j > 0) {
+    const int32_t* row = D + (size_t)i * W;
+    const int32_t* prev = row - W;
+    if (i > 0 && row[j] == prev[j - 1] + (b[j - 1] != a[i - 1])) {
+      --i; --j;
+    } else if (i > 0 && row[j] == prev[j] + 1) {
+      --i;
+    } else {
+      --j;
+    }
+  }
+  *out_cost = last[b_end];
+  *out_a_start = i;
+  *out_b_end = b_end;
+  return 0;
+}
+
 }  // extern "C"

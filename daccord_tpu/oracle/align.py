@@ -22,6 +22,16 @@ import numpy as np
 _BIG = 1 << 30
 
 
+def _native_lib():
+    """Soft dependency on the C++ host library (None when unavailable)."""
+    try:
+        from ..native import load
+
+        return load()
+    except Exception:
+        return None
+
+
 def edit_distance(a: np.ndarray, b: np.ndarray, band: int | None = None) -> int:
     """Unit-cost edit distance between int8 base arrays (banded)."""
     a = np.asarray(a)
@@ -125,31 +135,47 @@ def overlap_suffix_prefix(a: np.ndarray, b: np.ndarray) -> tuple[int, int, int]:
     edit cost of a[a_start:] vs b[:b_end], normalized against trivial empty
     overlaps by requiring the aligned span to score better than its length.
     """
-    a = np.asarray(a)
-    b = np.asarray(b)
+    a = np.ascontiguousarray(a, dtype=np.int8)
+    b = np.ascontiguousarray(b, dtype=np.int8)
     n, m = len(a), len(b)
-    # D[i, j] = best cost aligning a[i:] started anywhere (free a_start) ...
-    # classic formulation: free start in a (first row 0), free end in b.
+    lib = _native_lib()
+    if lib is not None and n and m:
+        import ctypes
+
+        cost = ctypes.c_int32()
+        a_start = ctypes.c_int32()
+        b_end = ctypes.c_int32()
+        lib.suffix_prefix(a.ctypes.data_as(ctypes.c_void_p), n,
+                          b.ctypes.data_as(ctypes.c_void_p), m,
+                          ctypes.byref(cost), ctypes.byref(a_start), ctypes.byref(b_end))
+        return cost.value, a_start.value, b_end.value
+    # classic semi-global formulation: free start in a (first column 0), free
+    # end in b. Vectorized rows (this runs once per window during stitching —
+    # a Python cell loop here dominated whole-pipeline wall time).
     D = np.empty((n + 1, m + 1), dtype=np.int32)
-    ptr_start = np.empty((n + 1, m + 1), dtype=np.int32)
     D[:, 0] = 0  # suffix start is free
-    ptr_start[:, 0] = np.arange(n + 1)
     D[0, :] = np.arange(m + 1)  # b prefix must be consumed from 0
-    ptr_start[0, :] = 0
+    ar = np.arange(m + 1, dtype=np.int32)
     for i in range(1, n + 1):
-        for j in range(1, m + 1):
-            c_sub = D[i - 1, j - 1] + (a[i - 1] != b[j - 1])
-            c_del = D[i - 1, j] + 1
-            c_ins = D[i, j - 1] + 1
-            c = min(c_sub, c_del, c_ins)
-            D[i, j] = c
-            if c == c_sub:
-                ptr_start[i, j] = ptr_start[i - 1, j - 1]
-            elif c == c_del:
-                ptr_start[i, j] = ptr_start[i - 1, j]
-            else:
-                ptr_start[i, j] = ptr_start[i, j - 1]
+        sub = D[i - 1, :m] + (b != a[i - 1])
+        dele = D[i - 1, 1:] + 1
+        best = np.minimum(sub, dele)
+        vals = np.concatenate(([D[i, 0]], best))
+        vals[1:] -= ar[1:]
+        D[i, 1:] = (np.minimum.accumulate(vals) + ar)[1:]
     # choose b_end minimizing cost - 0.5 * matched_len  (favor long overlaps)
     costs = D[n, :].astype(np.float64) - 0.5 * np.arange(m + 1)
     b_end = int(np.argmin(costs))
-    return int(D[n, b_end]), int(ptr_start[n, b_end]), b_end
+    cost = int(D[n, b_end])
+    # backtrack for the a-suffix start, with the tie order of the original
+    # fill (substitution, then deletion, then insertion)
+    i, j = n, b_end
+    while j > 0:
+        if i > 0 and D[i, j] == D[i - 1, j - 1] + (a[i - 1] != b[j - 1]):
+            i -= 1
+            j -= 1
+        elif i > 0 and D[i, j] == D[i - 1, j] + 1:
+            i -= 1
+        else:
+            j -= 1
+    return cost, i, b_end
