@@ -12,8 +12,9 @@ backfill pending, SURVEY.md §0/§8), re-designed for the MXU/VPU:
 - OffsetLikely position weights as one batched matmul (occ [M,O] x OL [O,P]);
 - heaviest path as bounded-length max-plus DP over lax.scan (cycles are
   harmless under a length bound — the reference instead escalates k);
-- candidate rescoring as a batched full edit-distance DP with an
-  associative-scan prefix-min for the insertion recurrence.
+- candidate rescoring as a batched bit-parallel (Myers/Hyyrö) edit-distance
+  DP: the whole DP column packed into uint32 lanes, one scan step per
+  segment base.
 
 Semantics intentionally mirror ``oracle.dbg.window_consensus`` (tie-breaking
 included: k-mers kept in code-sorted order, argmax-first DP ties, t-major end
@@ -29,7 +30,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-NEG = jnp.float32(-1e30)
+# numpy (not jnp) scalars: a module-level jnp constant would initialize the
+# default backend at import time — importing the library must not touch a
+# device (the CLI's --backend=cpu override runs after import)
+NEG = np.float32(-1e30)
 PAD = 4
 
 
@@ -70,7 +74,7 @@ def _kmer_ids(seqs: jnp.ndarray, lens: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.where(valid, ids, jnp.int32(4**k))
 
 
-_BIG = jnp.int32(1 << 20)
+_BIG = np.int32(1 << 20)
 
 
 def _edit_distance_row_scan(cand: jnp.ndarray, cand_len: jnp.ndarray,
@@ -105,9 +109,9 @@ def _edit_distance_antidiag(cand: jnp.ndarray, cand_len: jnp.ndarray,
     """Exact edit distance via an anti-diagonal wavefront.
 
     All three DP dependencies of diagonal ``d`` live on ``d-1``/``d-2``, so
-    every cell of a diagonal is computed in one vector op — no sequential
-    insertion recurrence (the associative-scan per row of the row formulation
-    is the TPU bottleneck; SURVEY.md §7.1 'anti-diagonal wavefront').
+    every cell of a diagonal is computed in one vector op. Superseded on the
+    hot path by :func:`_edit_distance_myers` (fewer steps, 4 uint32 of state
+    per pair instead of two length-``n+1`` carries); kept for cross-checking.
     """
     n = cand.shape[0]
     m = seg.shape[0]
@@ -139,6 +143,84 @@ def _edit_distance_antidiag(cand: jnp.ndarray, cand_len: jnp.ndarray,
     return outs[cand_len + seg_len]
 
 
+def _edit_distance_myers(cand: jnp.ndarray, cand_len: jnp.ndarray,
+                         seg: jnp.ndarray, seg_len: jnp.ndarray) -> jnp.ndarray:
+    """Exact edit distance via Myers/Hyyrö bit-parallel DP (2x uint32 words).
+
+    The whole DP column lives in four uint32 lanes (VP/VN over two 32-bit
+    words), so each of the ``m`` scan steps is ~20 scalar bitwise ops per
+    (candidate, segment) pair — versus the anti-diagonal wavefront's
+    ``n+m`` steps over an ``n+1``-vector. Hot-path rescore formulation;
+    bit-parity with :func:`_edit_distance_antidiag` is enforced in tests.
+    Supports cand_len <= 64 (cons_len is 48 at the default w=40).
+    """
+    n = cand.shape[0]
+    if n > 64:  # static shape: only two 32-bit words of DP column are kept
+        return _edit_distance_antidiag(cand, cand_len, seg, seg_len)
+    u32 = jnp.uint32
+    pos = jnp.arange(n)
+    valid = pos < cand_len
+    w_of = (pos >> 5).astype(jnp.int32)
+    b_of = (pos & 31).astype(u32)
+
+    def peq_word(c, w):
+        hit = valid & (cand.astype(jnp.int32) == c) & (w_of == w)
+        return jnp.sum(jnp.where(hit, u32(1) << b_of, u32(0)), dtype=u32)
+
+    peq = jnp.stack([jnp.stack([peq_word(c, w) for w in range(2)])
+                     for c in range(4)])                     # [4, 2] u32
+    nn = cand_len.astype(u32)
+
+    def ones_mask(k):                                         # k low one-bits
+        k = jnp.minimum(k, u32(32))
+        return jnp.where(k == 0, u32(0), u32(0xFFFFFFFF) >> (u32(32) - k))
+
+    vp0_i = ones_mask(jnp.minimum(nn, u32(32)))
+    vp1_i = ones_mask(jnp.where(nn > 32, nn - u32(32), u32(0)))
+    hb_w = ((cand_len - 1) >> 5).astype(jnp.int32)            # top-bit word/bit
+    hb_b = ((cand_len - 1) & 31).astype(u32)
+    hb0 = jnp.where(hb_w == 0, u32(1) << hb_b, u32(0))
+    hb1 = jnp.where(hb_w == 1, u32(1) << hb_b, u32(0))
+
+    def step(carry, ct):
+        vp0, vp1, vn0, vn1, score = carry
+        sel4 = jnp.arange(4) == ct                            # PAD(4) -> Eq=0
+        e0 = jnp.sum(jnp.where(sel4, peq[:, 0], u32(0)), dtype=u32)
+        e1 = jnp.sum(jnp.where(sel4, peq[:, 1], u32(0)), dtype=u32)
+        x0 = e0 | vn0
+        x1 = e1 | vn1
+        a0 = x0 & vp0
+        a1 = x1 & vp1
+        s0 = vp0 + a0                                         # add with carry
+        s1 = vp1 + a1 + (s0 < a0).astype(u32)
+        d00 = (s0 ^ vp0) | x0
+        d01 = (s1 ^ vp1) | x1
+        hn0 = vp0 & d00
+        hn1 = vp1 & d01
+        hp0 = vn0 | ~(vp0 | d00)
+        hp1 = vn1 | ~(vp1 | d01)
+        up = ((hp0 & hb0) | (hp1 & hb1)) != 0
+        dn = ((hn0 & hb0) | (hn1 & hb1)) != 0
+        score = score + jnp.where(up, 1, jnp.where(dn, -1, 0))
+        x20 = (hp0 << 1) | u32(1)                             # D[0,j]=j carry-in
+        x21 = (hp1 << 1) | (hp0 >> 31)
+        h20 = hn0 << 1
+        h21 = (hn1 << 1) | (hn0 >> 31)
+        vn0 = x20 & d00
+        vn1 = x21 & d01
+        vp0 = h20 | ~(x20 | d00)
+        vp1 = h21 | ~(x21 | d01)
+        return (vp0, vp1, vn0, vn1, score), score
+
+    # derive every carry component from data so varying-axes match under
+    # shard_map (an unvarying literal init vs a varying carry output is a
+    # scan type error)
+    init = (vp0_i, vp1_i, u32(0) * nn, u32(0) * nn, cand_len.astype(jnp.int32))
+    _, outs = jax.lax.scan(step, init, seg.astype(jnp.int32))
+    outs = jnp.concatenate([cand_len.astype(jnp.int32)[None], outs])
+    return jnp.where(cand_len == 0, seg_len, outs[seg_len])
+
+
 def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
                ol: jnp.ndarray, p: KernelParams):
     """Solve one window. seqs [D, L] int8, lens [D] i32, ol [P, O] f32."""
@@ -155,9 +237,15 @@ def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
     sorted_ids = jnp.sort(flat)
     newrun = jnp.concatenate([jnp.array([True]), sorted_ids[1:] != sorted_ids[:-1]])
     is_start = newrun & (sorted_ids < SENT)
-    run_id = jnp.cumsum(newrun.astype(jnp.int32)) - 1
-    counts = jax.ops.segment_sum((sorted_ids < SENT).astype(jnp.int32), run_id, num_segments=N)
-    start_counts = jnp.where(is_start, counts[run_id], 0)
+    # run length at each run start = next run start - this index, via a reverse
+    # cummin of run-start indices (no segment scatter, no gather — both are
+    # serialization points on TPU; invalid ids sort last so every valid run is
+    # terminated by the sentinel run or the array end)
+    ar_n = jnp.arange(N, dtype=jnp.int32)
+    starts = jnp.where(newrun, ar_n, jnp.int32(N))
+    nxt = jnp.concatenate([starts[1:], jnp.array([N], jnp.int32)])
+    nxt = jax.lax.associative_scan(jnp.minimum, nxt, reverse=True)
+    start_counts = jnp.where(is_start, nxt - ar_n, 0)
     thresh = jnp.maximum(jnp.int32(p.min_count),
                          jnp.ceil(p.count_frac * nsegs).astype(jnp.int32))
     start_counts = jnp.where(start_counts >= thresh, start_counts, 0)
@@ -215,48 +303,54 @@ def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
     CL = p.cons_len
     seg_total = jnp.maximum(jnp.sum(lens), 1).astype(jnp.float32)
 
+    # gather-free backtrack: the pointer chase and the path->k-mer lookup both
+    # run as one-hot multiply-reduces over the M lanes (per-step dynamic
+    # gathers serialize on TPU; this was the kernel's largest cost)
+    rev_ptrs = ptrs[::-1]
+    ts_rev = jnp.arange(P - 1, -1, -1)
+    ar_m = jnp.arange(M, dtype=jnp.int32)
+
     def backtrack(t_best, v_best):
-        def back(v, t):
+        def back(v, xt):
+            ptr_t, t = xt
             node = jnp.where(t == t_best, v_best, v)
             node = jnp.clip(node, 0, M - 1)
-            nxt = jnp.where((t <= t_best) & (t > 0), ptrs[t, node], node)
-            return nxt, node
-        _, nodes_rev = jax.lax.scan(back, 0 * v_best, jnp.arange(P - 1, -1, -1))
-        path = nodes_rev[::-1]                            # [P]
-        first = sel[path[0]]
+            onehot = ar_m == node
+            kmer = jnp.sum(jnp.where(onehot, sel, 0))
+            ptr_val = jnp.sum(jnp.where(onehot, ptr_t, 0))
+            nxt = jnp.where((t <= t_best) & (t > 0), ptr_val, node)
+            return nxt, kmer
+        _, kmers_rev = jax.lax.scan(back, 0 * v_best, (rev_ptrs, ts_rev))
+        kpath = kmers_rev[::-1]                           # [P] k-mer codes
+        first = kpath[0]
         j = jnp.arange(CL)
         shifts = 2 * (k - 1 - j)
         head = (first >> jnp.clip(shifts, 0, 30)) & 3
-        tt = jnp.clip(j - k + 1, 0, P - 1)
-        tail = sel[path[tt]] & 3
+        tt = jnp.clip(j - k + 1, 0, P - 1)                # constant indices
+        tail = kpath[tt] & 3
         base = jnp.where(j < k, head, tail)
         cons = jnp.where(j < t_best + k, base, PAD).astype(jnp.int8)
         return cons, (t_best + k).astype(jnp.int32)
 
-    # pick the top-n_candidates end states with distinct final k-mers, then
-    # backtrack each; rescoring runs as ONE batched anti-diagonal DP over
-    # [n_candidates, D] pairs (the rescore is the kernel's hottest stage)
+    # pick the top-n_candidates end states with distinct final k-mers (cheap
+    # argmax loop), then backtrack all of them in ONE vmapped scan
     chosen = jnp.zeros(M, dtype=bool)
-    cands = []
-    clens = []
-    oks = []
+    tbs, vbs, oks = [], [], []
     for _ in range(p.n_candidates):
         fmask = jnp.where(chosen[None, :], NEG, final)
         idx = jnp.argmax(fmask.reshape(-1))
         sc = fmask.reshape(-1)[idx]
         t_best = (idx // M).astype(jnp.int32)
         v_best = (idx % M).astype(jnp.int32)
-        cons, clen = backtrack(t_best, v_best)
-        cands.append(cons)
-        clens.append(clen)
+        tbs.append(t_best)
+        vbs.append(v_best)
         oks.append(sc > NEG / 2)
-        chosen = chosen.at[v_best].set(True)
-    cand_arr = jnp.stack(cands)                       # [C, CL]
-    clen_arr = jnp.stack(clens)                       # [C]
+        chosen = chosen | (ar_m == v_best)
+    cand_arr, clen_arr = jax.vmap(backtrack)(jnp.stack(tbs), jnp.stack(vbs))
     ok_arr = jnp.stack(oks)                           # [C]
 
     def rescore_one(cons, cons_len):
-        dists = jax.vmap(lambda sg, sl: _edit_distance_antidiag(cons, cons_len, sg, sl))(
+        dists = jax.vmap(lambda sg, sl: _edit_distance_myers(cons, cons_len, sg, sl))(
             seqs, lens)
         dists = jnp.where(lens > 0, dists, 0)
         return jnp.sum(dists).astype(jnp.float32) / seg_total

@@ -53,8 +53,17 @@ def daccord_main(argv=None) -> int:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler device trace into DIR")
     p.add_argument("--no-native", action="store_true", help="disable C++ host path")
+    p.add_argument("--backend", choices=("auto", "cpu", "tpu"), default="auto",
+                   help="device backend (SURVEY.md §5 config row); 'cpu' forces the "
+                        "host platform before any backend init — the only reliable "
+                        "override under this image's axon plugin")
     _add_J(p)
     args = p.parse_args(argv)
+
+    if args.backend == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     start, end = _resolve_range(args, args.las)
     ccfg = ConsensusConfig(w=args.w, adv=args.a, mode=args.mode)

@@ -100,6 +100,43 @@ def test_kernel_handles_empty_and_shallow_windows(fixture):
     assert not np.asarray(out["solved"]).any()
 
 
+def test_edit_distance_formulations_agree():
+    """Myers bit-parallel (hot path) == anti-diagonal == row-scan, including
+    empty candidate/segment edges and lengths straddling the 32-bit word
+    boundary."""
+    import jax
+    import jax.numpy as jnp
+
+    from daccord_tpu.kernels.window_kernel import (
+        _edit_distance_antidiag,
+        _edit_distance_myers,
+        _edit_distance_row_scan,
+    )
+
+    rng = np.random.default_rng(7)
+    CN, SN = 48, 64
+    cases = [(0, 17), (5, 0), (1, 1), (31, 40), (32, 40), (33, 64), (48, 64)]
+    cases += [(int(rng.integers(0, CN + 1)), int(rng.integers(0, SN + 1)))
+              for _ in range(40)]
+    cands = np.full((len(cases), CN), 4, np.int8)
+    segs = np.full((len(cases), SN), 4, np.int8)
+    cls = np.zeros(len(cases), np.int32)
+    sls = np.zeros(len(cases), np.int32)
+    for i, (cl, sl) in enumerate(cases):
+        cands[i, :cl] = rng.integers(0, 4, cl)
+        segs[i, :sl] = rng.integers(0, 4, sl)
+        cls[i], sls[i] = cl, sl
+    f_my = jax.jit(jax.vmap(_edit_distance_myers))
+    f_ad = jax.jit(jax.vmap(_edit_distance_antidiag))
+    f_rs = jax.jit(jax.vmap(_edit_distance_row_scan))
+    args = (jnp.asarray(cands), jnp.asarray(cls), jnp.asarray(segs), jnp.asarray(sls))
+    d_my = np.asarray(f_my(*args))
+    d_ad = np.asarray(f_ad(*args))
+    d_rs = np.asarray(f_rs(*args))
+    np.testing.assert_array_equal(d_my, d_ad)
+    np.testing.assert_array_equal(d_my, d_rs)
+
+
 def test_tensorize_caps_and_padding(fixture):
     ccfg, windows, prof, ols, batch, shape = fixture
     assert batch.seqs.shape == (batch.size, shape.depth, shape.seg_len)
