@@ -19,8 +19,8 @@ import json
 import os
 
 from ..formats.dazzdb import read_db
-from ..formats.las import LasFile, shard_ranges
-from ..runtime.pipeline import PipelineConfig, correct_to_fasta
+from ..formats.las import LasFile, index_las, shard_ranges
+from ..runtime.pipeline import PipelineConfig, correct_shard, correct_to_fasta
 
 
 def init_distributed(coordinator: str | None = None, num_processes: int | None = None,
@@ -45,34 +45,143 @@ def shard_paths(outdir: str, shard: int) -> dict:
     return {
         "fasta": os.path.join(outdir, f"shard{shard:04d}.fasta"),
         "manifest": os.path.join(outdir, f"shard{shard:04d}.json"),
+        "progress": os.path.join(outdir, f"shard{shard:04d}.progress.json"),
     }
 
 
 def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int,
-              cfg: PipelineConfig | None = None, force: bool = False) -> dict:
+              cfg: PipelineConfig | None = None, force: bool = False,
+              checkpoint_every: int = 0) -> dict:
     """Correct one LAS byte-range shard to its own FASTA + manifest.
 
     Idempotent: an existing manifest (unless ``force``) short-circuits, so a
     failed multi-host run is resumed by re-submitting the same command.
+
+    With ``checkpoint_every=N`` the shard also checkpoints every N emitted
+    reads: a progress JSON records the count of fully-emitted piles, the
+    pile-aligned LAS byte offset to resume from, and the FASTA byte size at
+    that point (SURVEY.md §5 checkpoint row: per-shard progress manifest
+    enabling window-range resume). A crashed run restarted with the same
+    command truncates the partial FASTA tail and resumes mid-shard instead of
+    redoing the whole byte range.
     """
     os.makedirs(outdir, exist_ok=True)
     paths = shard_paths(outdir, shard)
     if not force and os.path.exists(paths["manifest"]):
         with open(paths["manifest"]) as fh:
             return json.load(fh)
+    if force and os.path.exists(paths["progress"]):
+        # --force means recompute from scratch, not resume the old run
+        os.remove(paths["progress"])
     ranges = shard_ranges(las_path, nshards)
     start, end = ranges[shard]
-    stats = correct_to_fasta(db_path, las_path, paths["fasta"], cfg,
-                             start=start, end=end)
+    if not checkpoint_every:
+        stats = correct_to_fasta(db_path, las_path, paths["fasta"], cfg,
+                                 start=start, end=end)
+        counters = {"reads": stats.n_reads, "windows": stats.n_windows,
+                    "solved": stats.n_solved, "bases_out": stats.bases_out,
+                    "wall_s": stats.wall_s}
+    else:
+        counters = _run_shard_checkpointed(db_path, las_path, paths, start, end,
+                                           cfg, checkpoint_every)
     manifest = {
         "shard": shard, "nshards": nshards, "byte_range": [start, end],
-        "reads": stats.n_reads, "windows": stats.n_windows,
-        "solved": stats.n_solved, "bases_out": stats.bases_out,
-        "wall_s": stats.wall_s, "fasta": paths["fasta"],
+        **counters, "fasta": paths["fasta"],
     }
     with open(paths["manifest"], "wt") as fh:
         json.dump(manifest, fh)
+    if os.path.exists(paths["progress"]):
+        os.remove(paths["progress"])
     return manifest
+
+
+def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
+                            start: int, end: int, cfg: PipelineConfig | None,
+                            every: int) -> dict:
+    """Stream one shard with periodic progress checkpoints; resumes from an
+    existing progress file (piles emit in input order, so `emitted` piles map
+    1:1 onto the first `emitted` pile offsets of the byte range)."""
+    import time
+
+    from ..formats.fasta import FastaRecord, write_fasta
+    from ..oracle.profile import ErrorProfile
+    from ..runtime.pipeline import estimate_profile_for_shard
+    from ..utils.bases import ints_to_seq
+
+    cfg = cfg or PipelineConfig()
+    t0 = time.time()
+
+    emitted = 0
+    base = {"reads": 0, "windows": 0, "solved": 0, "bases_out": 0, "wall_s": 0.0}
+    fasta_bytes = 0
+    resumed = None
+    prog = None
+    if os.path.exists(paths["progress"]):
+        with open(paths["progress"]) as fh:
+            prog = json.load(fh)
+        # a progress file is only valid for the same byte range (resharding
+        # with a different n would map `emitted` onto different piles) and
+        # only while its FASTA prefix still exists
+        if prog.get("byte_range") != [start, end]:
+            prog = None
+        elif not os.path.exists(paths["fasta"]):
+            prog = None
+        if prog is not None:
+            emitted = prog["emitted"]
+            base = prog["counters"]
+            fasta_bytes = prog["fasta_bytes"]
+            resumed = emitted
+    if emitted:
+        # pile-aligned offsets are only needed on resume (index_las is a full
+        # file scan; a fresh run skips it)
+        idx = index_las(las_path)
+        offs = [int(o) for _, o in idx if start <= o < end] + [end]
+        resume_off = offs[min(emitted, len(offs) - 1)]
+    else:
+        resume_off = start
+
+    db = read_db(db_path)
+    las = LasFile(las_path)
+    # the error profile is estimated ONCE (from the shard's own start) and
+    # persisted, so a resumed run reproduces the uninterrupted run's output
+    # byte-for-byte rather than re-estimating from the resume point
+    if prog is not None and "profile" in prog:
+        profile = ErrorProfile(*prog["profile"])
+    else:
+        profile = estimate_profile_for_shard(db, las, cfg, start, end)
+    prof_row = [float(profile.p_ins), float(profile.p_del), float(profile.p_sub)]
+    counters = dict(base)
+    # truncate any partial tail past the last checkpoint, then append
+    mode = "r+t" if emitted else "wt"
+    with open(paths["fasta"], mode) as out:
+        out.truncate(fasta_bytes)
+        out.seek(fasta_bytes)
+        since = 0
+        for rid, frags, st in correct_shard(db, las, cfg, resume_off, end,
+                                            profile=profile):
+            write_fasta(out, [FastaRecord(f"read{rid}/{fi}", ints_to_seq(f))
+                              for fi, f in enumerate(frags)])
+            emitted += 1
+            since += 1
+            # st counters are cumulative over this run; add the pre-resume base
+            counters = {"reads": base["reads"] + emitted - (resumed or 0),
+                        "windows": base["windows"] + st.n_windows,
+                        "solved": base["solved"] + st.n_solved,
+                        "bases_out": base["bases_out"] + st.bases_out,
+                        "wall_s": round(base["wall_s"] + (time.time() - t0), 3)}
+            if since >= every:
+                out.flush()
+                tmp = paths["progress"] + ".tmp"
+                with open(tmp, "wt") as fh:
+                    json.dump({"emitted": emitted, "fasta_bytes": out.tell(),
+                               "counters": counters, "profile": prof_row,
+                               "byte_range": [start, end]}, fh)
+                os.replace(tmp, paths["progress"])
+                since = 0
+    counters["wall_s"] = round(base["wall_s"] + (time.time() - t0), 3)
+    if resumed is not None:
+        counters["resumed_at_read"] = resumed
+    return counters
 
 
 def merge_shards(outdir: str, nshards: int, out_fasta: str) -> int:

@@ -161,8 +161,54 @@ def lassort_main(argv=None) -> int:
     return 0
 
 
+def shard_main(argv=None) -> int:
+    """daccord-shard: run one LAS shard with manifest + mid-shard checkpoints
+    (the reference's -J array-job model with resumability)."""
+    p = argparse.ArgumentParser(prog="daccord-shard", description=shard_main.__doc__)
+    p.add_argument("db")
+    p.add_argument("las")
+    p.add_argument("outdir")
+    p.add_argument("-J", required=True, metavar="i,n", help="shard i of n")
+    p.add_argument("-b", "--batch", type=int, default=512)
+    p.add_argument("--checkpoint-every", type=int, default=64,
+                   help="checkpoint progress every N emitted reads (0 = off)")
+    p.add_argument("--force", action="store_true", help="recompute even if manifest exists")
+    p.add_argument("--backend", choices=("auto", "cpu", "tpu"), default="auto")
+    args = p.parse_args(argv)
+    if args.backend == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    i, n = (int(x) for x in args.J.split(","))
+    if not (0 <= i < n):
+        raise SystemExit(f"bad -J {args.J}")
+    from ..parallel.launch import run_shard
+
+    m = run_shard(args.db, args.las, args.outdir, i, n,
+                  PipelineConfig(batch_size=args.batch),
+                  force=args.force, checkpoint_every=args.checkpoint_every)
+    print(json.dumps(m), file=sys.stderr)
+    return 0
+
+
+def merge_main(argv=None) -> int:
+    """daccord-merge: concatenate shard FASTAs in order (reference merge step)."""
+    p = argparse.ArgumentParser(prog="daccord-merge", description=merge_main.__doc__)
+    p.add_argument("outdir")
+    p.add_argument("n", type=int, help="number of shards")
+    p.add_argument("out_fasta")
+    args = p.parse_args(argv)
+    from ..parallel.launch import merge_shards
+
+    n = merge_shards(args.outdir, args.n, args.out_fasta)
+    print(f"merged {n} fragments", file=sys.stderr)
+    return 0
+
+
 _TOOLS = {
     "daccord": daccord_main,
+    "shard": shard_main,
+    "merge": merge_main,
     "inqual": intrinsicqv_main,
     "repeats": detectrepeats_main,
     "filter": filteralignments_main,

@@ -1,5 +1,7 @@
 """Multi-chip sharding tests on the 8-device virtual CPU mesh (SURVEY.md §4)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -100,3 +102,52 @@ def test_multihost_shard_model(tmp_path):
     merged = str(tmp_path / "all.fasta")
     n = merge_shards(outdir, 2, merged)
     assert n == m0.get("fragments", 0) + m1.get("fragments", 0) or n >= 0
+
+
+def test_checkpoint_resume_mid_shard(tmp_path, monkeypatch):
+    """A crash between checkpoints resumes mid-shard and produces byte-identical
+    FASTA vs an uninterrupted run (SURVEY.md §5 checkpoint row)."""
+    import daccord_tpu.parallel.launch as launch
+    from daccord_tpu.runtime import PipelineConfig
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path)
+    out = make_dataset(d, SimConfig(genome_len=1500, coverage=12, read_len_mean=500,
+                                    min_overlap=250, seed=11), name="ck")
+    cfg = PipelineConfig(batch_size=64)
+
+    # reference: uninterrupted run
+    ref_dir = str(tmp_path / "ref")
+    m_ref = launch.run_shard(out["db"], out["las"], ref_dir, 0, 1, cfg,
+                             checkpoint_every=3)
+    assert m_ref["reads"] >= 8, m_ref
+    ref_fasta = open(launch.shard_paths(ref_dir, 0)["fasta"]).read()
+
+    # crashing run: die after 5 emitted reads (checkpoint every 2 -> progress
+    # records 4, the 5th read's partial FASTA tail must be truncated on resume)
+    crash_dir = str(tmp_path / "crash")
+    real = launch.correct_shard
+
+    def crashing(db, las, c, start=None, end=None, **kw):
+        for i, item in enumerate(real(db, las, c, start, end, **kw)):
+            if i == 5:
+                raise RuntimeError("injected crash")
+            yield item
+
+    monkeypatch.setattr(launch, "correct_shard", crashing)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        launch.run_shard(out["db"], out["las"], crash_dir, 0, 1, cfg,
+                         checkpoint_every=2)
+    prog_path = launch.shard_paths(crash_dir, 0)["progress"]
+    import json as _json
+    prog = _json.load(open(prog_path))
+    assert prog["emitted"] == 4
+    monkeypatch.setattr(launch, "correct_shard", real)
+
+    m_res = launch.run_shard(out["db"], out["las"], crash_dir, 0, 1, cfg,
+                             checkpoint_every=2)
+    assert m_res["resumed_at_read"] == 4
+    assert m_res["reads"] == m_ref["reads"]
+    res_fasta = open(launch.shard_paths(crash_dir, 0)["fasta"]).read()
+    assert res_fasta == ref_fasta
+    assert not os.path.exists(prog_path)
