@@ -41,6 +41,12 @@ class PipelineConfig:
     use_native: bool = True      # C++ host path when available
     depth_rank: bool = True      # best-alignments-first before depth capping
     max_inflight: int = 2        # device batches in flight (double buffering)
+    feeder_threads: int = 0      # host windowing threads (0 = synchronous);
+                                 # the reference's -t fan-out re-imagined as a
+                                 # feeder pool ahead of the device queue — the
+                                 # native pile processor releases the GIL, so
+                                 # piles window in parallel while the device
+                                 # solves earlier batches
     log_path: str | None = None  # jsonl event log ('-' = stderr)
     verbose: bool = False
 
@@ -96,28 +102,38 @@ def estimate_profile_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     return estimate_profile_two_pass(refined_all, windows_all, cfg.consensus, sample=32)
 
 
+def _window_one_pile(db: DazzDB, col, cfg: PipelineConfig, aread: int, s: int, e: int):
+    """Window one pile via the native path; shared by the synchronous and
+    threaded feeders so their outputs stay byte-identical by construction."""
+    from ..native.api import process_pile_native
+
+    w, adv = cfg.consensus.w, cfg.consensus.adv
+    D, L = cfg.depth, cfg.seg_len
+    a = db.read_bases(aread)
+    order = None
+    if cfg.depth_rank:
+        # quality-ranked depth capping (SURVEY.md §7.3 item 1): best
+        # alignments (lowest trace-diff rate) fill the depth slots
+        span = np.maximum(col.aepos[s:e] - col.abpos[s:e], 1)
+        order = np.argsort(col.diffs[s:e] / span, kind="stable")
+    idxs = range(s, e) if order is None else (s + order)
+    b_reads = [db.read_bases(int(col.bread[i])) for i in idxs]
+    seqs, lens, nsegs = process_pile_native(a, col, s, e, b_reads, w, adv, D, L,
+                                            order=order)
+    return aread, a, seqs, lens, nsegs
+
+
 def _iter_pile_blocks(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                       start, end, native_ok: bool):
     """Yield (aread, a_bases, seqs [nwin,D,L], lens [nwin,D], nsegs [nwin])."""
     w, adv = cfg.consensus.w, cfg.consensus.adv
     D, L = cfg.depth, cfg.seg_len
     if native_ok:
-        from ..native.api import ColumnarLas, process_pile_native
+        from ..native.api import ColumnarLas
 
         col = ColumnarLas(las.path, start, end)
         for aread, s, e in col.piles():
-            a = db.read_bases(aread)
-            order = None
-            if cfg.depth_rank:
-                # quality-ranked depth capping (SURVEY.md §7.3 item 1): best
-                # alignments (lowest trace-diff rate) fill the depth slots
-                span = np.maximum(col.aepos[s:e] - col.abpos[s:e], 1)
-                order = np.argsort(col.diffs[s:e] / span, kind="stable")
-            idxs = range(s, e) if order is None else (s + order)
-            b_reads = [db.read_bases(int(col.bread[i])) for i in idxs]
-            seqs, lens, nsegs = process_pile_native(a, col, s, e, b_reads, w, adv, D, L,
-                                                    order=order)
-            yield aread, a, seqs, lens, nsegs
+            yield _window_one_pile(db, col, cfg, aread, s, e)
     else:
         shape = BatchShape(depth=D, seg_len=L, wlen=w)
         for aread, pile in las.iter_piles(start, end):
@@ -131,6 +147,39 @@ def _iter_pile_blocks(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 yield aread, a, b.seqs, b.lens, b.nsegs
             else:
                 yield aread, a, np.zeros((0, D, L), np.int8), np.zeros((0, D), np.int32), np.zeros(0, np.int32)
+
+
+def _iter_pile_blocks_threaded(db: DazzDB, las: LasFile, cfg: PipelineConfig,
+                               start, end, nthreads: int):
+    """Same stream as :func:`_iter_pile_blocks` (native path), but piles are
+    windowed by a thread pool with bounded in-order prefetch. Output order —
+    and therefore every downstream byte — is identical to the synchronous
+    path; only wall-clock changes."""
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..native.api import ColumnarLas
+
+    col = ColumnarLas(las.path, start, end)
+    piles = list(col.piles())
+
+    def job(item):
+        aread, s, e = item
+        return _window_one_pile(db, col, cfg, aread, s, e)
+
+    with ThreadPoolExecutor(max_workers=nthreads) as ex:
+        inflight: deque = deque()
+        it = iter(piles)
+        budget = nthreads + 2
+        for item in it:
+            inflight.append(ex.submit(job, item))
+            if len(inflight) >= budget:
+                break
+        while inflight:
+            yield inflight.popleft().result()
+            for item in it:
+                inflight.append(ex.submit(job, item))
+                break
 
 
 def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
@@ -258,7 +307,15 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             drain(0)
 
     t_host0 = time.time()
-    for aread, a_bases, seqs, lens, nsegs in _iter_pile_blocks(db, las, cfg, start, end, native_ok):
+    if native_ok and cfg.feeder_threads > 0:
+        blocks = _iter_pile_blocks_threaded(db, las, cfg, start, end, cfg.feeder_threads)
+    else:
+        if cfg.feeder_threads > 0:
+            print("daccord-tpu: feeder_threads ignored (native host path "
+                  "unavailable or disabled)", file=sys.stderr)
+            log.log("warn", msg="feeder_threads ignored: no native host path")
+        blocks = _iter_pile_blocks(db, las, cfg, start, end, native_ok)
+    for aread, a_bases, seqs, lens, nsegs in blocks:
         stats.n_reads += 1
         stats.bases_in += len(a_bases)
         nwin = len(nsegs)

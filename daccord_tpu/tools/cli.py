@@ -44,6 +44,8 @@ def daccord_main(argv=None) -> int:
     p.add_argument("-w", type=int, default=40, help="window size")
     p.add_argument("-a", type=int, default=10, help="window advance")
     p.add_argument("-b", "--batch", type=int, default=512, help="device batch size")
+    p.add_argument("-t", "--threads", type=int, default=0,
+                   help="host windowing threads (reference -t; 0 = synchronous)")
     p.add_argument("--depth", type=int, default=32, help="max segments per window")
     p.add_argument("--seg-len", type=int, default=64, help="max segment length")
     p.add_argument("--mode", choices=("split", "patch"), default="split",
@@ -69,7 +71,8 @@ def daccord_main(argv=None) -> int:
     ccfg = ConsensusConfig(w=args.w, adv=args.a, mode=args.mode)
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
                          depth=args.depth, seg_len=args.seg_len,
-                         log_path=args.log, use_native=not args.no_native)
+                         log_path=args.log, use_native=not args.no_native,
+                         feeder_threads=args.threads)
     if args.profile:
         import jax
 

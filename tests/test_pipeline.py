@@ -52,6 +52,22 @@ def test_pipeline_end_to_end(dataset):
     assert corr_err < raw_err / 8, (corr_err, raw_err)
 
 
+def test_threaded_feeder_is_deterministic(dataset):
+    """feeder_threads>0 must produce byte-identical FASTA to the synchronous
+    path (in-order prefetch; only wall-clock may differ)."""
+    from daccord_tpu.native import available as native_available
+
+    if not native_available():
+        pytest.skip("native host path unavailable")
+    out, d = dataset
+    f_sync = os.path.join(d, "sync.fasta")
+    f_thr = os.path.join(d, "thr.fasta")
+    correct_to_fasta(out["db"], out["las"], f_sync, PipelineConfig(batch_size=256))
+    correct_to_fasta(out["db"], out["las"], f_thr,
+                     PipelineConfig(batch_size=256, feeder_threads=4))
+    assert open(f_sync).read() == open(f_thr).read()
+
+
 def test_pipeline_byte_range_shard(dataset):
     """Correcting a byte-range shard touches only that shard's reads."""
     out, d = dataset
