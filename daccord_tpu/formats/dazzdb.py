@@ -89,6 +89,22 @@ class DazzDB:
         nbytes = (r.rlen + 3) // 4
         return unpack_2bit(self.bps[r.boff : r.boff + nbytes], r.rlen)
 
+    def read_bases_batch(self, ids) -> list[np.ndarray]:
+        """Decode many reads at once (native 2-bit batch decode when built —
+        SURVEY.md §2.4; bit-identical Python fallback otherwise)."""
+        ids = list(ids)
+        try:
+            from ..native import available
+            from ..native.api import decode_reads_batch
+
+            if available():
+                boffs = np.asarray([self.reads[i].boff for i in ids], np.int64)
+                rlens = np.asarray([self.reads[i].rlen for i in ids], np.int32)
+                return decode_reads_batch(self.bps, boffs, rlens)
+        except Exception:
+            pass
+        return [self.read_bases(i) for i in ids]
+
     def read_length(self, i: int) -> int:
         return self.reads[i].rlen
 

@@ -23,6 +23,11 @@ _tried = False
 def _build() -> bool:
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
            _SRC, "-o", _SO]
+    if os.environ.get("DACCORD_NATIVE_TSAN"):
+        # race-detection build (SURVEY.md §5 race row): the library is called
+        # concurrently by the feeder thread pool
+        cmd = ["g++", "-O1", "-g", "-fsanitize=thread", "-shared", "-fPIC",
+               "-std=c++17", _SRC, "-o", _SO]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
         return True
@@ -55,6 +60,9 @@ def load():
         lib.suffix_prefix.argtypes = [c.c_void_p, c.c_int32, c.c_void_p, c.c_int32,
                                       c.POINTER(c.c_int32), c.POINTER(c.c_int32),
                                       c.POINTER(c.c_int32)]
+        lib.decode_reads.restype = c.c_int
+        lib.decode_reads.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                                     c.c_int32, c.c_void_p, c.c_void_p]
         lib.process_pile.restype = c.c_int
         lib.process_pile.argtypes = (
             [c.c_void_p, c.c_int32, c.c_int32]        # a, alen, novl

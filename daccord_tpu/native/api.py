@@ -63,6 +63,26 @@ class ColumnarLas:
             yield int(self.aread[s]), s, e
 
 
+def decode_reads_batch(bps: np.ndarray, boffs: np.ndarray,
+                       rlens: np.ndarray) -> list[np.ndarray]:
+    """Decode a batch of 2-bit packed reads into views over one buffer."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(rlens)
+    boffs = np.ascontiguousarray(boffs, dtype=np.int64)
+    rlens = np.ascontiguousarray(rlens, dtype=np.int32)
+    out_off = np.zeros(n + 1, np.int64)
+    np.cumsum(rlens, out=out_off[1:])
+    out = np.empty(int(out_off[-1]), np.int8)
+    bps = np.ascontiguousarray(bps, dtype=np.uint8)
+    rc = lib.decode_reads(_ptr(bps), _ptr(boffs), _ptr(rlens), n,
+                          _ptr(out), _ptr(out_off))
+    if rc != 0:
+        raise RuntimeError(f"decode_reads failed: {rc}")
+    return [out[out_off[i] : out_off[i + 1]] for i in range(n)]
+
+
 def process_pile_native(a_bases: np.ndarray, col: ColumnarLas, s: int, e: int,
                         b_reads: list[np.ndarray],
                         w: int, adv: int, D: int, L: int,

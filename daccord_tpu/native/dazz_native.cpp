@@ -272,4 +272,28 @@ int suffix_prefix(const int8_t* a, int32_t n, const int8_t* b, int32_t m,
   return 0;
 }
 
+// 2-bit .bps batch decode (SURVEY.md §2.4 native obligation: "2-bit decode
+// straight into host buffers"). n reads decoded from the packed base store
+// into one contiguous int8 buffer; layout per formats/dazzdb.py (4 bases per
+// byte, first base in the two top bits — Dazzler order).
+int decode_reads(const uint8_t* bps, const int64_t* boff, const int32_t* rlen,
+                 int32_t n, int8_t* out, const int64_t* out_off) {
+  for (int32_t i = 0; i < n; ++i) {
+    const uint8_t* src = bps + boff[i];
+    int8_t* dst = out + out_off[i];
+    const int32_t len = rlen[i];
+    const int32_t full = len / 4;
+    for (int32_t j = 0; j < full; ++j) {
+      const uint8_t b = src[j];
+      dst[4 * j] = (b >> 6) & 3;
+      dst[4 * j + 1] = (b >> 4) & 3;
+      dst[4 * j + 2] = (b >> 2) & 3;
+      dst[4 * j + 3] = b & 3;
+    }
+    for (int32_t k = 4 * full; k < len; ++k)
+      dst[k] = (src[k / 4] >> (6 - 2 * (k % 4))) & 3;
+  }
+  return 0;
+}
+
 }  // extern "C"

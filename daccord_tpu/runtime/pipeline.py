@@ -117,7 +117,7 @@ def _window_one_pile(db: DazzDB, col, cfg: PipelineConfig, aread: int, s: int, e
         span = np.maximum(col.aepos[s:e] - col.abpos[s:e], 1)
         order = np.argsort(col.diffs[s:e] / span, kind="stable")
     idxs = range(s, e) if order is None else (s + order)
-    b_reads = [db.read_bases(int(col.bread[i])) for i in idxs]
+    b_reads = db.read_bases_batch(int(col.bread[i]) for i in idxs)
     seqs, lens, nsegs = process_pile_native(a, col, s, e, b_reads, w, adv, D, L,
                                             order=order)
     return aread, a, seqs, lens, nsegs

@@ -164,6 +164,49 @@ def lassort_main(argv=None) -> int:
     return 0
 
 
+def fasta2db_main(argv=None) -> int:
+    """fasta2db: build a Dazzler DB triple from FASTA (DAZZ_DB fasta2DB role)."""
+    p = argparse.ArgumentParser(prog="fasta2db", description=fasta2db_main.__doc__)
+    p.add_argument("fasta")
+    p.add_argument("db", help="output .db path")
+    p.add_argument("--cutoff", type=int, default=0,
+                   help="drop reads shorter than this (Dazzler trim semantics)")
+    args = p.parse_args(argv)
+    from ..formats.dazzdb import write_db
+    from ..formats.fasta import read_fasta
+    from ..utils.bases import seq_to_ints
+
+    recs = list(read_fasta(args.fasta))
+    n_all = len(recs)
+    if args.cutoff > 0:
+        recs = [r for r in recs if len(r.seq) >= args.cutoff]
+    db = write_db(args.db, [seq_to_ints(r.seq) for r in recs],
+                  names=[r.name for r in recs], cutoff=args.cutoff)
+    dropped = n_all - len(recs)
+    print(f"{db.nreads} reads, {db.totlen} bases"
+          + (f" ({dropped} below cutoff dropped)" if dropped else ""),
+          file=sys.stderr)
+    return 0
+
+
+def db2fasta_main(argv=None) -> int:
+    """db2fasta: dump a Dazzler DB back to FASTA (DAZZ_DB DB2fasta role)."""
+    p = argparse.ArgumentParser(prog="db2fasta", description=db2fasta_main.__doc__)
+    p.add_argument("db")
+    p.add_argument("-o", "--out", default="-", help="output FASTA ('-' = stdout)")
+    args = p.parse_args(argv)
+    from ..formats.dazzdb import read_db
+    from ..formats.fasta import FastaRecord, write_fasta
+    from ..utils.bases import ints_to_seq
+
+    db = read_db(args.db)
+    recs = [FastaRecord(db.names[i] if i < len(db.names) else f"read{i}",
+                        ints_to_seq(db.read_bases(i)))
+            for i in range(db.nreads)]
+    write_fasta(sys.stdout if args.out == "-" else args.out, recs)
+    return 0
+
+
 def shard_main(argv=None) -> int:
     """daccord-shard: run one LAS shard with manifest + mid-shard checkpoints
     (the reference's -J array-job model with resumability)."""
@@ -217,6 +260,8 @@ _TOOLS = {
     "filter": filteralignments_main,
     "filtersym": filtersym_main,
     "lassort": lassort_main,
+    "fasta2db": fasta2db_main,
+    "db2fasta": db2fasta_main,
 }
 
 

@@ -22,6 +22,17 @@ def dataset(tmp_path_factory):
     return make_dataset(d, cfg, name="n"), d
 
 
+def test_decode_reads_batch_bit_parity(dataset):
+    """Native 2-bit batch decode == per-read Python unpack, including
+    non-multiple-of-4 lengths."""
+    out, d = dataset
+    db = read_db(out["db"])
+    ids = list(range(db.nreads)) + [0, db.nreads - 1]
+    got = db.read_bases_batch(ids)
+    for i, g in zip(ids, got):
+        np.testing.assert_array_equal(g, db.read_bases(i))
+
+
 def test_columnar_las_matches_python_reader(dataset):
     out, d = dataset
     col = ColumnarLas(out["las"])
