@@ -99,6 +99,11 @@ def write_las(path: str, tspace: int, overlaps: Iterable[Overlap]) -> int:
             novl += 1
         fh.seek(0)
         fh.write(struct.pack("<q", novl))
+    # a rewritten LAS invalidates any index sidecar regardless of mtime skew
+    try:
+        os.remove(path + ".idx")
+    except OSError:
+        pass
     return novl
 
 
@@ -154,13 +159,32 @@ def read_las(path: str) -> tuple[int, list[Overlap]]:
     return f.tspace, list(f)
 
 
-def index_las(path: str) -> np.ndarray:
+def index_las(path: str, use_sidecar: bool = True) -> np.ndarray:
     """Build an aread index: rows (aread, byte_offset_of_first_record).
 
     Enables byte-range sharding by aread range (the reference's
     OverlapIndexer role). Rows are emitted once per distinct aread, in file
     order; the file must be sorted by aread (DALIGNER sort order).
+
+    The index persists as a ``<path>.idx`` sidecar (int64 pairs after an
+    8-byte magic+count header) so N array jobs sharing one LAS pay one scan
+    total, not one each; a sidecar older than the LAS is rebuilt.
     """
+    sidecar = path + ".idx"
+    if use_sidecar and os.path.exists(sidecar) \
+            and os.path.getmtime(sidecar) >= os.path.getmtime(path):
+        # any malformed sidecar (truncated header/payload, concurrent-writer
+        # corruption) falls through to a fresh scan instead of erroring
+        try:
+            with open(sidecar, "rb") as fh:
+                hdr = fh.read(8)
+                if len(hdr) == 8:
+                    magic, n = struct.unpack("<4sI", hdr)
+                    payload = fh.read(16 * n)
+                    if magic == b"LIDX" and len(payload) == 16 * n:
+                        return np.frombuffer(payload, dtype=np.int64).reshape(-1, 2)
+        except OSError:
+            pass
     f = LasFile(path)
     rows: list[tuple[int, int]] = []
     with open(path, "rb") as fh:
@@ -178,7 +202,19 @@ def index_las(path: str) -> np.ndarray:
                 rows.append((aread, off))
                 last = aread
             fh.seek(tlen * f._tsize, os.SEEK_CUR)
-    return np.asarray(rows, dtype=np.int64).reshape(-1, 2)
+    idx = np.asarray(rows, dtype=np.int64).reshape(-1, 2)
+    if use_sidecar:
+        try:
+            # per-process tmp name: concurrent array jobs racing to build the
+            # same index must not interleave writes into one tmp inode
+            tmp = f"{sidecar}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(struct.pack("<4sI", b"LIDX", len(idx)))
+                fh.write(idx.tobytes())
+            os.replace(tmp, sidecar)
+        except OSError:
+            pass  # read-only directory: the index simply isn't cached
+    return idx
 
 
 def shard_ranges(path: str, nshards: int) -> list[tuple[int, int]]:

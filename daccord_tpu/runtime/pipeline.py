@@ -351,7 +351,10 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     log.log("shard_done", reads=stats.n_reads, windows=stats.n_windows,
             solved=stats.n_solved, bases_out=stats.bases_out,
             pad_waste=round(stats.pad_waste, 4), wall_s=round(stats.wall_s, 3),
-            tiers=stats.tier_histogram, native=stats.native_host)
+            tiers=stats.tier_histogram, native=stats.native_host,
+            # north-star counters (BASELINE.json metric; SURVEY.md §5 metrics)
+            bases_per_sec=round(stats.bases_per_sec(), 1),
+            windows_per_sec=round(stats.n_windows / stats.wall_s, 1) if stats.wall_s else 0.0)
     log.close()
 
 

@@ -151,6 +151,19 @@ def filtersym_main(argv=None) -> int:
     return 0
 
 
+def lasindex_main(argv=None) -> int:
+    """las-index: build/refresh the aread byte index sidecar (reference
+    OverlapIndexer role); sharded jobs then skip the full-file scan."""
+    p = argparse.ArgumentParser(prog="las-index", description=lasindex_main.__doc__)
+    p.add_argument("las")
+    args = p.parse_args(argv)
+    from ..formats.las import index_las
+
+    idx = index_las(args.las)
+    print(f"{len(idx)} piles -> {args.las}.idx", file=sys.stderr)
+    return 0
+
+
 def lassort_main(argv=None) -> int:
     """las-sort: sort a LAS by (aread, bread) (reference LAS sort/merge role)."""
     p = argparse.ArgumentParser(prog="las-sort", description=lassort_main.__doc__)
@@ -260,6 +273,7 @@ _TOOLS = {
     "filter": filteralignments_main,
     "filtersym": filtersym_main,
     "lassort": lassort_main,
+    "lasindex": lasindex_main,
     "fasta2db": fasta2db_main,
     "db2fasta": db2fasta_main,
 }

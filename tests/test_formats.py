@@ -146,6 +146,18 @@ def test_las_index_and_shards(tmp_path):
     assert len(piles) == 10
     assert all(len(pile) == 3 for _, pile in piles)
 
+    # sidecar: second call reads the cache and matches the fresh scan;
+    # rewriting the LAS invalidates it
+    import os
+
+    assert os.path.exists(p + ".idx")
+    idx2 = index_las(p)
+    np.testing.assert_array_equal(idx, idx2)
+    write_las(p, 100, ovls[:6])
+    assert not os.path.exists(p + ".idx")
+    idx3 = index_las(p)
+    assert idx3.shape[0] == 2
+
 
 def test_las_trace_u16(tmp_path):
     """tspace > 125 switches the trace to uint16."""
