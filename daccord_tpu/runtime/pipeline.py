@@ -47,6 +47,15 @@ class PipelineConfig:
                                  # native pile processor releases the GIL, so
                                  # piles window in parallel while the device
                                  # solves earlier batches
+    depth_buckets: tuple = (8, 16)   # sub-depth buckets below `depth`; windows
+                                 # route to the smallest bucket holding their
+                                 # segment count, so shallow windows don't pay
+                                 # the full-depth kernel cost (SURVEY.md §7.3
+                                 # item 1 pad waste; () = single bucket)
+    bucket_flush_reads: int = 128    # dispatch a partial bucket once its oldest
+                                 # row has waited this many reads — bounds the
+                                 # in-order emission lag (and therefore the
+                                 # pending/ready memory) under bucket skew
     log_path: str | None = None  # jsonl event log ('-' = stderr)
     verbose: bool = False
 
@@ -228,19 +237,25 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     D, L = cfg.depth, cfg.seg_len
     adv = cfg.consensus.adv
     w = cfg.consensus.w
-    shape = BatchShape(depth=D, seg_len=L, wlen=w)
+    # depth buckets: windows route to the smallest bucket >= their segment
+    # count; each bucket is its own statically-shaped batch stream
+    buckets = sorted({b for b in cfg.depth_buckets if 0 < b < D} | {D})
+    shapes = [BatchShape(depth=b, seg_len=L, wlen=w) for b in buckets]
 
     pending: dict[int, _PendingRead] = {}
     order: list[int] = []
     ready: dict[int, list[np.ndarray]] = {}
     emit_idx = 0
-    # row buffer: parallel lists of blocks + their (rid, widx) bookkeeping
-    blk_seqs: list[np.ndarray] = []
-    blk_lens: list[np.ndarray] = []
-    blk_nsegs: list[np.ndarray] = []
-    blk_rid: list[np.ndarray] = []
-    blk_widx: list[np.ndarray] = []
-    nrows = 0
+    # per-bucket row buffers: parallel lists of blocks + (rid, widx) bookkeeping
+    nb = len(buckets)
+    buckets_arr = np.asarray(buckets)
+    blk_seqs: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    blk_lens: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    blk_nsegs: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    blk_rid: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    blk_widx: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    nrows = [0] * nb
+    first_seen = [None] * nb     # read counter when the bucket got its oldest row
 
     from collections import deque
 
@@ -279,30 +294,38 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     inflight=len(inflight), t_turnaround=round(dt, 4))
 
     def run_batches(final: bool):
-        nonlocal nrows, emit_idx
-        while nrows >= cfg.batch_size or (final and nrows > 0):
-            take = min(cfg.batch_size, nrows)
-            seqs = np.concatenate(blk_seqs) if len(blk_seqs) > 1 else blk_seqs[0]
-            lens = np.concatenate(blk_lens) if len(blk_lens) > 1 else blk_lens[0]
-            nsg = np.concatenate(blk_nsegs) if len(blk_nsegs) > 1 else blk_nsegs[0]
-            rid = np.concatenate(blk_rid) if len(blk_rid) > 1 else blk_rid[0]
-            widx = np.concatenate(blk_widx) if len(blk_widx) > 1 else blk_widx[0]
-            blk_seqs.clear(); blk_lens.clear(); blk_nsegs.clear()
-            blk_rid.clear(); blk_widx.clear()
-            if len(nsg) > take:
-                blk_seqs.append(seqs[take:]); blk_lens.append(lens[take:])
-                blk_nsegs.append(nsg[take:]); blk_rid.append(rid[take:])
-                blk_widx.append(widx[take:])
-            nrows = len(nsg) - take
-            batch = WindowBatch(seqs=seqs[:take], lens=lens[:take], nsegs=nsg[:take],
-                                shape=shape, read_ids=rid[:take],
-                                wstarts=widx[:take].astype(np.int64) * adv)
-            batch = pad_batch(batch, cfg.batch_size)
-            stats.pad_cells += batch.seqs.size
-            stats.used_cells += int(batch.lens.sum())
-            handle = dispatch_fn(batch)
-            inflight.append((handle, rid, widx, take, time.time()))
-            drain(cfg.max_inflight - 1)
+        nonlocal emit_idx
+        for bi in range(nb):
+            # partial flush once the bucket's oldest row has waited too long:
+            # bounds the in-order emission lag under bucket skew
+            stale = (first_seen[bi] is not None
+                     and stats.n_reads - first_seen[bi] >= cfg.bucket_flush_reads)
+            while nrows[bi] >= cfg.batch_size or ((final or stale) and nrows[bi] > 0):
+                stale = False
+                take = min(cfg.batch_size, nrows[bi])
+                bs, bl, bn = blk_seqs[bi], blk_lens[bi], blk_nsegs[bi]
+                br, bw = blk_rid[bi], blk_widx[bi]
+                seqs = np.concatenate(bs) if len(bs) > 1 else bs[0]
+                lens = np.concatenate(bl) if len(bl) > 1 else bl[0]
+                nsg = np.concatenate(bn) if len(bn) > 1 else bn[0]
+                rid = np.concatenate(br) if len(br) > 1 else br[0]
+                widx = np.concatenate(bw) if len(bw) > 1 else bw[0]
+                bs.clear(); bl.clear(); bn.clear(); br.clear(); bw.clear()
+                if len(nsg) > take:
+                    bs.append(seqs[take:]); bl.append(lens[take:])
+                    bn.append(nsg[take:]); br.append(rid[take:])
+                    bw.append(widx[take:])
+                nrows[bi] = len(nsg) - take
+                first_seen[bi] = stats.n_reads if nrows[bi] else None
+                batch = WindowBatch(seqs=seqs[:take], lens=lens[:take], nsegs=nsg[:take],
+                                    shape=shapes[bi], read_ids=rid[:take],
+                                    wstarts=widx[:take].astype(np.int64) * adv)
+                batch = pad_batch(batch, cfg.batch_size)
+                stats.pad_cells += batch.seqs.size
+                stats.used_cells += int(batch.lens.sum())
+                handle = dispatch_fn(batch)
+                inflight.append((handle, rid, widx, take, time.time()))
+                drain(cfg.max_inflight - 1)
         if final:
             drain(0)
 
@@ -325,10 +348,31 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             ready[aread] = []
         else:
             pending[aread] = _PendingRead(aread, a_bases, nwin)
-            blk_seqs.append(seqs); blk_lens.append(lens); blk_nsegs.append(nsegs)
-            blk_rid.append(np.full(nwin, aread, dtype=np.int64))
-            blk_widx.append(np.arange(nwin, dtype=np.int64))
-            nrows += nwin
+            rid_arr = np.full(nwin, aread, dtype=np.int64)
+            widx_arr = np.arange(nwin, dtype=np.int64)
+            if nb == 1:
+                # single bucket: append the pile block as-is, zero copies
+                blk_seqs[0].append(seqs); blk_lens[0].append(lens)
+                blk_nsegs[0].append(nsegs); blk_rid[0].append(rid_arr)
+                blk_widx[0].append(widx_arr)
+                nrows[0] += nwin
+                if first_seen[0] is None:
+                    first_seen[0] = stats.n_reads
+            else:
+                assign = np.searchsorted(buckets_arr, nsegs, side="left")
+                for bi in range(nb):
+                    sel = np.nonzero(assign == bi)[0]
+                    if len(sel) == 0:
+                        continue
+                    Db = buckets[bi]
+                    blk_seqs[bi].append(seqs[sel, :Db])
+                    blk_lens[bi].append(lens[sel, :Db])
+                    blk_nsegs[bi].append(nsegs[sel])
+                    blk_rid[bi].append(rid_arr[sel])
+                    blk_widx[bi].append(widx_arr[sel])
+                    nrows[bi] += len(sel)
+                    if first_seen[bi] is None:
+                        first_seen[bi] = stats.n_reads
         run_batches(final=False)
         while emit_idx < len(order) and order[emit_idx] in ready:
             r = order[emit_idx]

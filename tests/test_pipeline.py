@@ -68,6 +68,20 @@ def test_threaded_feeder_is_deterministic(dataset):
     assert open(f_sync).read() == open(f_thr).read()
 
 
+def test_depth_buckets_match_single_bucket(dataset):
+    """Routing windows to depth buckets must not change any consensus byte:
+    trailing all-PAD segment rows are mathematically inert in the kernel."""
+    out, d = dataset
+    f_one = os.path.join(d, "b1.fasta")
+    f_bkt = os.path.join(d, "b3.fasta")
+    correct_to_fasta(out["db"], out["las"], f_one,
+                     PipelineConfig(batch_size=256, depth_buckets=()))
+    correct_to_fasta(out["db"], out["las"], f_bkt,
+                     PipelineConfig(batch_size=256, depth_buckets=(8, 16),
+                                    bucket_flush_reads=4))  # exercise partial flush
+    assert open(f_one).read() == open(f_bkt).read()
+
+
 def test_pipeline_byte_range_shard(dataset):
     """Correcting a byte-range shard touches only that shard's reads."""
     out, d = dataset
