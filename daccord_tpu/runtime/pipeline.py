@@ -294,7 +294,6 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     inflight=len(inflight), t_turnaround=round(dt, 4))
 
     def run_batches(final: bool):
-        nonlocal emit_idx
         for bi in range(nb):
             # partial flush once the bucket's oldest row has waited too long:
             # bounds the in-order emission lag under bucket skew
@@ -316,7 +315,10 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     bn.append(nsg[take:]); br.append(rid[take:])
                     bw.append(widx[take:])
                 nrows[bi] = len(nsg) - take
-                first_seen[bi] = stats.n_reads if nrows[bi] else None
+                # leftover rows keep the pre-dispatch stamp (conservative: may
+                # flush early, never lets a row wait past bucket_flush_reads)
+                if not nrows[bi]:
+                    first_seen[bi] = None
                 batch = WindowBatch(seqs=seqs[:take], lens=lens[:take], nsegs=nsg[:take],
                                     shape=shapes[bi], read_ids=rid[:take],
                                     wstarts=widx[:take].astype(np.int64) * adv)
