@@ -1,0 +1,117 @@
+"""Kernel-stage breakdown bench: where does a ladder batch spend its time?
+
+Usage: ``python -m daccord_tpu.tools.kernelbench [--batch 1024] [--reps 4]``
+Prints one JSON line per timing (full ladder, tier0, and cumulative stage
+prefixes of the window kernel), so kernel optimizations can be attributed to
+stages. Uses the same cached window set as bench.py.
+
+Not run by the driver (bench.py remains the single-line round artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch", type=int, default=1024)
+    p.add_argument("--reps", type=int, default=4)
+    p.add_argument("--backend", choices=("auto", "cpu"), default="auto")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench as round_bench
+    from daccord_tpu.kernels.tiers import TierLadder, fetch, solve_ladder_async
+    from daccord_tpu.kernels.window_kernel import _solve_one
+    from daccord_tpu.oracle.consensus import ConsensusConfig
+    from daccord_tpu.oracle.profile import ErrorProfile
+
+    data = round_bench.build_windows()
+    prof = ErrorProfile(float(data["p_ins"]), float(data["p_del"]), float(data["p_sub"]))
+    ladder = TierLadder.from_config(prof, ConsensusConfig())
+    B = min(args.batch, len(data["nsegs"]))
+    seqs = jnp.asarray(data["seqs"][:B])
+    lens = jnp.asarray(data["lens"][:B])
+    nsegs = jnp.asarray(data["nsegs"][:B])
+    p0 = ladder.params[0]
+    ol = ladder.tables[p0.k]
+
+    def timed(label, fn, *a):
+        out = fn(*a)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            jax.block_until_ready(fn(*a))
+        ms = (time.perf_counter() - t0) / args.reps * 1e3
+        print(json.dumps({"stage": label, "ms_per_batch": round(ms, 2),
+                          "batch": B, "device": str(jax.devices()[0]).replace(" ", "")}))
+        return ms
+
+    # full ladder (what the pipeline dispatches)
+    from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch
+    shape = BatchShape(depth=seqs.shape[1], seg_len=seqs.shape[2], wlen=p0.wlen)
+    wb = WindowBatch(seqs=data["seqs"][:B], lens=data["lens"][:B],
+                     nsegs=data["nsegs"][:B], shape=shape,
+                     read_ids=np.zeros(B, np.int64), wstarts=np.zeros(B, np.int64))
+    timed("ladder_full", lambda: fetch(solve_ladder_async(wb, ladder)))
+
+    # tier0 alone
+    f_t0 = jax.jit(jax.vmap(functools.partial(_solve_one, p=p0),
+                            in_axes=(0, 0, 0, None)))
+    timed("tier0", f_t0, seqs, lens, nsegs, ol)
+
+    # cumulative stage prefixes of the tier0 kernel (deltas attribute time to
+    # each stage; the final prefix differs from tier0 only by fusion effects)
+    from daccord_tpu.kernels.window_kernel import _kmer_ids
+
+    k, M = p0.k, p0.max_kmers
+    SENT = jnp.int32(4 ** k)
+    P, O = ol.shape
+
+    def stage_counts(seqs, lens, nsegs):
+        ids = _kmer_ids(seqs, lens, k)
+        flat = ids.reshape(-1)
+        N = flat.shape[0]
+        si = jnp.sort(flat)
+        newrun = jnp.concatenate([jnp.array([True]), si[1:] != si[:-1]])
+        is_start = newrun & (si < SENT)
+        ar_n = jnp.arange(N, dtype=jnp.int32)
+        starts = jnp.where(newrun, ar_n, jnp.int32(N))
+        nxt = jnp.concatenate([starts[1:], jnp.array([N], jnp.int32)])
+        nxt = jax.lax.associative_scan(jnp.minimum, nxt, reverse=True)
+        sc = jnp.where(is_start, nxt - ar_n, 0)
+        topv, topi = jax.lax.top_k(sc, M)
+        sel = jnp.sort(jnp.where(topv > 0, si[topi], SENT))
+        return ids, sel
+
+    def stage_eq(seqs, lens, nsegs):
+        ids, sel = stage_counts(seqs, lens, nsegs)
+        npos = ids.shape[1]
+        eq = (ids[:, :, None] == sel[None, None, :]) & (ids < SENT)[:, :, None]
+        occ_pos = jnp.sum(eq, axis=0).astype(jnp.float32)
+        o_idx = jnp.minimum(jnp.arange(npos), O - 1)
+        occ = jax.ops.segment_sum(occ_pos, o_idx, num_segments=O).T
+        eqh = eq.astype(jnp.bfloat16)
+        support = jnp.einsum("diu,div->uv", eqh[:, :-1, :], eqh[:, 1:, :],
+                             preferred_element_type=jnp.float32)
+        return occ @ ol.T, support, sel
+
+    for label, fn in (("prefix:counts+topk", stage_counts),
+                      ("prefix:+eq/occ/einsum", stage_eq)):
+        f = jax.jit(jax.vmap(fn, in_axes=(0, 0, 0)))
+        timed(label, f, seqs, lens, nsegs)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
