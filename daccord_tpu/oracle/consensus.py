@@ -95,22 +95,6 @@ def solve_window(ws: WindowSegments, ol_tables: dict[int, OffsetLikely],
     return best
 
 
-def _splice(acc: np.ndarray, nxt: np.ndarray, nominal_olap: int) -> np.ndarray | None:
-    """Splice window consensus ``nxt`` onto accumulator ``acc``.
-
-    The true overlap is ~``nominal_olap`` bases; align acc's tail against nxt's
-    head and join at the best correspondence. Returns None when the overlap
-    disagrees too much (stitch failure -> split).
-    """
-    tail = min(len(acc), nominal_olap + 10)
-    head = min(len(nxt), nominal_olap + 10)
-    cost, a_start, b_end = overlap_suffix_prefix(acc[len(acc) - tail :], nxt[:head])
-    olap_len = max(tail - a_start, b_end)
-    if olap_len < max(4, nominal_olap // 4) or cost > 0.35 * olap_len:
-        return None
-    return np.concatenate([acc, nxt[b_end:]])
-
-
 def stitch_results(a_bases: np.ndarray,
                    results: list[tuple[int, int, np.ndarray | None]],
                    cfg: ConsensusConfig) -> list[np.ndarray]:
@@ -120,39 +104,98 @@ def stitch_results(a_bases: np.ndarray,
     Separated from the solving loop so the device pipeline (which solves
     windows in large cross-read batches) can reuse the exact stitching
     semantics of the oracle.
+
+    The accumulator is a piece list concatenated once per fragment — the
+    splice only ever inspects the accumulator's tail, so growth is O(read
+    length), not O(read length²); long ONT-scale reads (100k+ windows)
+    stitch in linear time.
     """
     frags: list[np.ndarray] = []
-    acc: np.ndarray | None = None
+    pieces: list[np.ndarray] = []
+    plen = 0
+    active = False
     acc_end = 0
 
-    def flush():
-        nonlocal acc
-        if acc is not None and len(acc) >= cfg.min_fragment:
-            frags.append(acc)
-        acc = None
+    def tail(n: int) -> np.ndarray:
+        out: list[np.ndarray] = []
+        need = n
+        for arr in reversed(pieces):
+            if need <= 0:
+                break
+            take = min(len(arr), need)
+            out.append(arr[len(arr) - take :])
+            need -= take
+        if not out:
+            return np.zeros(0, dtype=np.int8)
+        return out[0] if len(out) == 1 else np.concatenate(out[::-1])
+
+    def drop_tail(n: int) -> None:
+        nonlocal plen
+        while n > 0 and pieces:
+            last = pieces[-1]
+            if len(last) <= n:
+                n -= len(last)
+                plen -= len(last)
+                pieces.pop()
+            else:
+                pieces[-1] = last[: len(last) - n]
+                plen -= n
+                n = 0
+
+    def append(arr: np.ndarray) -> None:
+        nonlocal plen
+        if len(arr):
+            pieces.append(arr)
+            plen += len(arr)
+
+    def restart(arr: np.ndarray) -> None:
+        nonlocal pieces, plen, active
+        pieces = [arr]
+        plen = len(arr)
+        active = True
+
+    def flush() -> None:
+        nonlocal pieces, plen, active
+        if pieces:
+            acc = pieces[0] if len(pieces) == 1 else np.concatenate(pieces)
+            if len(acc) >= cfg.min_fragment:
+                frags.append(acc)
+        pieces = []
+        plen = 0
+        active = False
 
     for wstart, wlen, seq in results:
         if seq is None:
             if cfg.mode == "patch":
                 patch = np.asarray(a_bases[wstart : wstart + wlen], dtype=np.int8)
-                if acc is None:
-                    acc = patch
+                if not active:
+                    restart(patch)
                 else:
                     olap = acc_end - wstart
-                    acc = np.concatenate([acc[: len(acc) - max(olap, 0)], patch]) if olap > 0 else np.concatenate([acc, patch])
+                    if olap > 0:
+                        drop_tail(olap)
+                    append(patch)
                 acc_end = wstart + wlen
             else:
                 flush()
             continue
-        if acc is None:
-            acc = seq
+        if not active:
+            restart(seq)
         else:
-            spliced = _splice(acc, seq, nominal_olap=acc_end - wstart)
-            if spliced is None:
+            # splice the next window consensus onto the accumulator: align
+            # acc's tail (~nominal overlap) against seq's head, join at the
+            # best correspondence; strong disagreement => stitch failure
+            # (flush and restart => the read splits)
+            nominal = acc_end - wstart
+            t = min(plen, nominal + 10)
+            head = min(len(seq), nominal + 10)
+            cost, a_start, b_end = overlap_suffix_prefix(tail(t), seq[:head])
+            olap_len = max(t - a_start, b_end)
+            if olap_len < max(4, nominal // 4) or cost > 0.35 * olap_len:
                 flush()
-                acc = seq
+                restart(seq)
             else:
-                acc = spliced
+                append(seq[b_end:])
         acc_end = wstart + wlen
     flush()
     return frags

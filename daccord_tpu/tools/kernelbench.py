@@ -23,14 +23,20 @@ def main(argv=None) -> int:
     p.add_argument("--backend", choices=("auto", "cpu"), default="auto")
     args = p.parse_args(argv)
 
+    import os
+    import sys
+
+    # bench.py lives at the repo root, two levels above this package
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+    import bench as round_bench
+
     import jax
 
-    if args.backend == "cpu":
+    if args.backend == "cpu" or not round_bench._device_alive():
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
-
-    import bench as round_bench
     from daccord_tpu.kernels.tiers import TierLadder, fetch, solve_ladder_async
     from daccord_tpu.kernels.window_kernel import _solve_one
     from daccord_tpu.oracle.consensus import ConsensusConfig
@@ -90,6 +96,9 @@ def main(argv=None) -> int:
         nxt = jnp.concatenate([starts[1:], jnp.array([N], jnp.int32)])
         nxt = jax.lax.associative_scan(jnp.minimum, nxt, reverse=True)
         sc = jnp.where(is_start, nxt - ar_n, 0)
+        thresh = jnp.maximum(jnp.int32(p0.min_count),
+                             jnp.ceil(p0.count_frac * nsegs).astype(jnp.int32))
+        sc = jnp.where(sc >= thresh, sc, 0)
         topv, topi = jax.lax.top_k(sc, M)
         sel = jnp.sort(jnp.where(topv > 0, si[topi], SENT))
         return ids, sel

@@ -125,3 +125,31 @@ def test_unsolved_window_splits_or_patches(pile_fixture):
     ccfg2 = ConsensusConfig(mode="patch")
     corr2 = correct_read(a, windows, ols, ccfg2)
     assert len(corr2.fragments) == 1
+
+
+def test_stitch_long_read_linear_time():
+    """ONT-scale stitching: 20k windows of a 200kb read stitch in seconds
+    (the piece-list accumulator is O(read length), not O(read length^2))."""
+    import time
+
+    from daccord_tpu.oracle.consensus import ConsensusConfig, stitch_results
+
+    rng = np.random.default_rng(3)
+    rlen = 200_000
+    a = rng.integers(0, 4, rlen).astype(np.int8)
+    w, adv = 40, 10
+    nwin = (rlen - w) // adv + 1
+    rows = []
+    for i in range(nwin):
+        ws = i * adv
+        seq = a[ws : ws + w].copy()
+        if rng.random() < 0.002:
+            rows.append((ws, w, None))         # occasional unsolved window
+        else:
+            rows.append((ws, w, seq))
+    t0 = time.perf_counter()
+    frags = stitch_results(a, rows, ConsensusConfig(mode="patch"))
+    dt = time.perf_counter() - t0
+    assert len(frags) == 1
+    assert abs(len(frags[0]) - rlen) < 100
+    assert dt < 30, f"stitching 20k windows took {dt:.1f}s"
