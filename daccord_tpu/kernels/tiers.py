@@ -82,37 +82,46 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
         fail = (~solved) & (nsegs >= p0.min_depth)
         count = jnp.sum(fail.astype(jnp.int32))
         overflow = jnp.maximum(count - E, 0)
-        idx = jnp.nonzero(fail, size=E, fill_value=0)[0]
-        live = jnp.arange(E) < count
-        sseqs = seqs[idx]
-        slens = lens[idx]
-        snsegs = jnp.where(live, nsegs[idx], 0)
-        e_solved = jnp.zeros(E, dtype=bool)
-        CL = cons.shape[1]
-        e_cons = jnp.full((E, CL), 4, dtype=jnp.int8)
-        e_len = jnp.zeros(E, dtype=jnp.int32)
-        e_err = jnp.full(E, jnp.inf, dtype=jnp.float32)
-        e_tier = jnp.full(E, -1, dtype=jnp.int32)
-        for ti in range(1, len(params)):
-            p = params[ti]
-            out_t = jax.vmap(functools.partial(_solve_one, p=p),
-                             in_axes=(0, 0, 0, None))(
-                sseqs, slens, jnp.where(e_solved, 0, snsegs), tables[ti])
-            take = live & out_t["solved"] & ~e_solved
-            e_cons = jnp.where(take[:, None], out_t["cons"], e_cons)
-            e_len = jnp.where(take, out_t["cons_len"], e_len)
-            e_err = jnp.where(take, out_t["err"], e_err)
-            e_tier = jnp.where(take, ti, e_tier)
-            e_solved = e_solved | take
-        # fill slots of the fixed-size nonzero alias index 0; route them out of
-        # bounds and drop, or their stale writes clobber window 0's results
-        B = seqs.shape[0]
-        idx_w = jnp.where(live & e_solved, idx, B)
-        cons = cons.at[idx_w].set(e_cons, mode="drop")
-        cons_len = cons_len.at[idx_w].set(e_len, mode="drop")
-        err = err.at[idx_w].set(e_err, mode="drop")
-        tier = tier.at[idx_w].set(e_tier, mode="drop")
-        solved = solved.at[idx_w].set(True, mode="drop")
+
+        def run_esc(args):
+            cons, cons_len, err, solved, tier = args
+            idx = jnp.nonzero(fail, size=E, fill_value=0)[0]
+            live = jnp.arange(E) < count
+            sseqs = seqs[idx]
+            slens = lens[idx]
+            snsegs = jnp.where(live, nsegs[idx], 0)
+            e_solved = jnp.zeros(E, dtype=bool)
+            CL = cons.shape[1]
+            e_cons = jnp.full((E, CL), 4, dtype=jnp.int8)
+            e_len = jnp.zeros(E, dtype=jnp.int32)
+            e_err = jnp.full(E, jnp.inf, dtype=jnp.float32)
+            e_tier = jnp.full(E, -1, dtype=jnp.int32)
+            for ti in range(1, len(params)):
+                p = params[ti]
+                out_t = jax.vmap(functools.partial(_solve_one, p=p),
+                                 in_axes=(0, 0, 0, None))(
+                    sseqs, slens, jnp.where(e_solved, 0, snsegs), tables[ti])
+                take = live & out_t["solved"] & ~e_solved
+                e_cons = jnp.where(take[:, None], out_t["cons"], e_cons)
+                e_len = jnp.where(take, out_t["cons_len"], e_len)
+                e_err = jnp.where(take, out_t["err"], e_err)
+                e_tier = jnp.where(take, ti, e_tier)
+                e_solved = e_solved | take
+            # fill slots of the fixed-size nonzero alias index 0; route them
+            # out of bounds and drop, or their stale writes clobber window 0
+            B = seqs.shape[0]
+            idx_w = jnp.where(live & e_solved, idx, B)
+            return (cons.at[idx_w].set(e_cons, mode="drop"),
+                    cons_len.at[idx_w].set(e_len, mode="drop"),
+                    err.at[idx_w].set(e_err, mode="drop"),
+                    solved.at[idx_w].set(True, mode="drop"),
+                    tier.at[idx_w].set(e_tier, mode="drop"))
+
+        # batches with zero tier-0 failures (the common case at >99% solve
+        # rate) skip the rescue tiers entirely at runtime
+        cons, cons_len, err, solved, tier = jax.lax.cond(
+            count > 0, run_esc, lambda args: args,
+            (cons, cons_len, err, solved, tier))
 
     return dict(cons=cons, cons_len=cons_len, err=err, solved=solved, tier=tier,
                 esc_overflow=overflow)
