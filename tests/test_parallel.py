@@ -83,6 +83,41 @@ def test_graft_dryrun_multichip():
     ge.dryrun_multichip(8)
 
 
+def test_full_pipeline_through_mesh_solver(tmp_path):
+    """The complete correction pipeline with the 8-device mesh solver produces
+    byte-identical FASTA to the single-device path — long reads' windows shard
+    freely across chips (the SP/long-context model, SURVEY.md §2.3)."""
+    from daccord_tpu.formats import LasFile, read_db
+    from daccord_tpu.kernels import TierLadder
+    from daccord_tpu.parallel.mesh import make_mesh, make_sharded_solver
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+    from daccord_tpu.runtime.pipeline import estimate_profile_for_shard
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path)
+    out = make_dataset(d, SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=700, min_overlap=300,
+                                    seed=47), name="mesh")
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    # reads (~700bp) still span many windows and shard across all 8 devices;
+    # two buckets keep the per-shape compile count down (parity is
+    # scale-invariant — the small config tests the same property)
+    cfg = PipelineConfig(batch_size=64, depth_buckets=(16,))
+    profile = estimate_profile_for_shard(db, las, cfg)
+
+    def run(solver):
+        return [(rid, [f.tobytes() for f in frags])
+                for rid, frags, _ in correct_shard(db, las, cfg, profile=profile,
+                                                   solver=solver)]
+
+    single = run(None)
+    ladder = TierLadder.from_config(profile, cfg.consensus)
+    mesh_out = run(make_sharded_solver(ladder, make_mesh(8)))
+    assert len(single) > 0
+    assert mesh_out == single
+
+
 def test_multihost_shard_model(tmp_path):
     """Per-shard run + manifest + merge (the -J array-job model)."""
     from daccord_tpu.parallel import merge_shards, run_shard
