@@ -56,6 +56,11 @@ class PipelineConfig:
                                  # row has waited this many reads — bounds the
                                  # in-order emission lag (and therefore the
                                  # pending/ready memory) under bucket skew
+    seg_len_buckets: tuple = ()  # optional second-level routing by max segment
+                                 # length (e.g. (48,)): windows whose segments
+                                 # all fit go to a narrower batch — exact, like
+                                 # depth buckets, but multiplies compile count;
+                                 # off by default until measured on hardware
     log_path: str | None = None  # jsonl event log ('-' = stderr)
     verbose: bool = False
 
@@ -237,10 +242,13 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     D, L = cfg.depth, cfg.seg_len
     adv = cfg.consensus.adv
     w = cfg.consensus.w
-    # depth buckets: windows route to the smallest bucket >= their segment
-    # count; each bucket is its own statically-shaped batch stream
-    buckets = sorted({b for b in cfg.depth_buckets if 0 < b < D} | {D})
-    shapes = [BatchShape(depth=b, seg_len=L, wlen=w) for b in buckets]
+    # depth (and optional seg-len) buckets: windows route to the smallest
+    # bucket holding their segment count / max segment length; each (D, L)
+    # bucket is its own statically-shaped batch stream
+    d_buckets = sorted({b for b in cfg.depth_buckets if 0 < b < D} | {D})
+    l_buckets = sorted({b for b in cfg.seg_len_buckets if 0 < b < L} | {L})
+    buckets = [(db, lb) for db in d_buckets for lb in l_buckets]
+    shapes = [BatchShape(depth=db, seg_len=lb, wlen=w) for db, lb in buckets]
 
     pending: dict[int, _PendingRead] = {}
     order: list[int] = []
@@ -248,7 +256,9 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     emit_idx = 0
     # per-bucket row buffers: parallel lists of blocks + (rid, widx) bookkeeping
     nb = len(buckets)
-    buckets_arr = np.asarray(buckets)
+    d_arr = np.asarray(d_buckets)
+    l_arr = np.asarray(l_buckets)
+    nl = len(l_buckets)
     blk_seqs: list[list[np.ndarray]] = [[] for _ in range(nb)]
     blk_lens: list[list[np.ndarray]] = [[] for _ in range(nb)]
     blk_nsegs: list[list[np.ndarray]] = [[] for _ in range(nb)]
@@ -361,13 +371,18 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 if first_seen[0] is None:
                     first_seen[0] = stats.n_reads
             else:
-                assign = np.searchsorted(buckets_arr, nsegs, side="left")
+                d_assign = np.searchsorted(d_arr, nsegs, side="left")
+                if nl > 1:
+                    maxlen = lens.max(axis=1)
+                    assign = d_assign * nl + np.searchsorted(l_arr, maxlen, side="left")
+                else:
+                    assign = d_assign
                 for bi in range(nb):
                     sel = np.nonzero(assign == bi)[0]
                     if len(sel) == 0:
                         continue
-                    Db = buckets[bi]
-                    blk_seqs[bi].append(seqs[sel, :Db])
+                    Db, Lb = buckets[bi]
+                    blk_seqs[bi].append(seqs[sel, :Db, :Lb])
                     blk_lens[bi].append(lens[sel, :Db])
                     blk_nsegs[bi].append(nsegs[sel])
                     blk_rid[bi].append(rid_arr[sel])

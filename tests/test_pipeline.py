@@ -80,6 +80,13 @@ def test_depth_buckets_match_single_bucket(dataset):
                      PipelineConfig(batch_size=256, depth_buckets=(8, 16),
                                     bucket_flush_reads=4))  # exercise partial flush
     assert open(f_one).read() == open(f_bkt).read()
+    # second-level seg-len bucketing is exact too (narrower trailing PAD
+    # columns are inert in the kernel)
+    f_lb = os.path.join(d, "lb.fasta")
+    correct_to_fasta(out["db"], out["las"], f_lb,
+                     PipelineConfig(batch_size=256, depth_buckets=(16,),
+                                    seg_len_buckets=(48,)))
+    assert open(f_one).read() == open(f_lb).read()
 
 
 def test_pipeline_byte_range_shard(dataset):
