@@ -38,6 +38,33 @@ class ErrorProfile:
     def p_err(self) -> float:
         return self.p_ins + self.p_del + self.p_sub
 
+    def save(self, path: str) -> None:
+        """Write the profile as JSON (the reference caches its error profile
+        in a sidecar file so repeat runs skip the estimation pass).
+
+        Atomic (write + rename): concurrent -J shards racing on the same path
+        each leave a complete file, never a torn one."""
+        import json
+        import os
+
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wt") as fh:
+            json.dump({"format": "daccord-tpu-eprof-v1", "p_ins": self.p_ins,
+                       "p_del": self.p_del, "p_sub": self.p_sub}, fh)
+            fh.write("\n")
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "ErrorProfile":
+        import json
+
+        with open(path, "rt") as fh:
+            d = json.load(fh)
+        if d.get("format") != "daccord-tpu-eprof-v1":
+            raise ValueError(f"{path}: not a daccord-tpu error-profile file")
+        return cls(p_ins=float(d["p_ins"]), p_del=float(d["p_del"]),
+                   p_sub=float(d["p_sub"]))
+
 
 def estimate_profile(refined: list[RefinedOverlap], a_len_total: int | None = None) -> ErrorProfile:
     """Estimate indel/sub rates from base-accurate refined overlaps.

@@ -247,7 +247,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     # bucket is its own statically-shaped batch stream
     d_buckets = sorted({b for b in cfg.depth_buckets if 0 < b < D} | {D})
     l_buckets = sorted({b for b in cfg.seg_len_buckets if 0 < b < L} | {L})
-    buckets = [(db, lb) for db in d_buckets for lb in l_buckets]
+    buckets = [(dv, lv) for dv in d_buckets for lv in l_buckets]
     shapes = [BatchShape(depth=db, seg_len=lb, wlen=w) for db, lb in buckets]
 
     pending: dict[int, _PendingRead] = {}
@@ -420,15 +420,18 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
 
 def correct_to_fasta(db_path: str, las_path: str, out_path, cfg: PipelineConfig | None = None,
-                     start: int | None = None, end: int | None = None) -> PipelineStats:
-    """Run the pipeline and write corrected fragments as FASTA (stdout with '-')."""
+                     start: int | None = None, end: int | None = None,
+                     profile: ErrorProfile | None = None) -> PipelineStats:
+    """Run the pipeline and write corrected fragments as FASTA (stdout with '-').
+
+    ``profile`` skips the estimation pass (reference: cached error profile)."""
     cfg = cfg or PipelineConfig()
     db = read_db(db_path)
     las = LasFile(las_path)
     t0 = time.time()
     stats: PipelineStats | None = None
     recs = []
-    for rid, frags, st in correct_shard(db, las, cfg, start, end):
+    for rid, frags, st in correct_shard(db, las, cfg, start, end, profile=profile):
         stats = st
         for fi, f in enumerate(frags):
             recs.append(FastaRecord(f"read{rid}/{fi}", ints_to_seq(f)))

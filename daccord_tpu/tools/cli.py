@@ -50,6 +50,14 @@ def daccord_main(argv=None) -> int:
     p.add_argument("--seg-len", type=int, default=64, help="max segment length")
     p.add_argument("--mode", choices=("split", "patch"), default="split",
                    help="unsolved windows split the read or get patched with raw bases")
+    p.add_argument("-E", "--eprof", default=None, metavar="PATH",
+                   help="error-profile file: load it if it exists, else estimate "
+                        "and save it there (reference: cached error profile). "
+                        "With -J array jobs, precompute it once via --eprof-only "
+                        "so every shard corrects with the same profile")
+    p.add_argument("--eprof-only", action="store_true",
+                   help="estimate the error profile, write it to -E, and exit "
+                        "(reference --eprofonly role)")
     p.add_argument("--stats", default=None, help="write run stats JSON here")
     p.add_argument("--log", default=None, help="jsonl event log path ('-' = stderr)")
     p.add_argument("--profile", default=None, metavar="DIR",
@@ -73,13 +81,37 @@ def daccord_main(argv=None) -> int:
                          depth=args.depth, seg_len=args.seg_len,
                          log_path=args.log, use_native=not args.no_native,
                          feeder_threads=args.threads)
+
+    import os
+
+    from ..oracle.profile import ErrorProfile
+
+    prof = None
+    if args.eprof and os.path.exists(args.eprof) and not args.eprof_only:
+        prof = ErrorProfile.load(args.eprof)
+    elif args.eprof or args.eprof_only:
+        if not args.eprof:
+            raise SystemExit("--eprof-only requires -E/--eprof PATH")
+        from ..runtime.pipeline import estimate_profile_for_shard
+
+        prof = estimate_profile_for_shard(read_db(args.db), LasFile(args.las),
+                                          cfg, start, end)
+        prof.save(args.eprof)
+        if args.eprof_only:
+            print(json.dumps({"eprof": args.eprof, "p_ins": prof.p_ins,
+                              "p_del": prof.p_del, "p_sub": prof.p_sub}),
+                  file=sys.stderr)
+            return 0
+
     if args.profile:
         import jax
 
         with jax.profiler.trace(args.profile):
-            stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start, end=end)
+            stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
+                                     end=end, profile=prof)
     else:
-        stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start, end=end)
+        stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
+                                 end=end, profile=prof)
     line = {
         "reads": stats.n_reads, "windows": stats.n_windows, "solved": stats.n_solved,
         "fragments": stats.n_fragments, "bases_in": stats.bases_in,
@@ -264,6 +296,104 @@ def merge_main(argv=None) -> int:
     return 0
 
 
+def fillfasta_main(argv=None) -> int:
+    """fill-fasta: replace non-ACGT symbols with (seeded) random bases so the
+    2-bit Dazzler DB can hold the reads (reference ``fillfasta`` role)."""
+    p = argparse.ArgumentParser(prog="fill-fasta", description=fillfasta_main.__doc__)
+    p.add_argument("fasta")
+    p.add_argument("out", help="output FASTA ('-' = stdout)")
+    p.add_argument("--seed", type=int, default=0, help="RNG seed for the fill bases")
+    args = p.parse_args(argv)
+    import numpy as np
+
+    from ..formats.fasta import FastaRecord, read_fasta, write_fasta
+
+    rng = np.random.default_rng(args.seed)
+    acgt = np.frombuffer(b"ACGT", dtype=np.uint8)
+    stats = {"reads": 0, "filled": 0}
+
+    def fill():  # streamed: O(one read) memory at CHM-scale inputs
+        for rec in read_fasta(args.fasta):
+            s = np.frombuffer(rec.seq.upper().encode(), dtype=np.uint8).copy()
+            bad = ~np.isin(s, acgt)
+            nb = int(bad.sum())
+            if nb:
+                s[bad] = acgt[rng.integers(0, 4, size=nb)]
+                stats["filled"] += nb
+            stats["reads"] += 1
+            yield FastaRecord(rec.name, s.tobytes().decode())
+
+    write_fasta(sys.stdout if args.out == "-" else args.out, fill())
+    print(f"filled {stats['filled']} non-ACGT symbols in {stats['reads']} reads",
+          file=sys.stderr)
+    return 0
+
+
+def qveval_main(argv=None) -> int:
+    """qv-eval: align corrected reads back to per-read truth and report the
+    consensus Q-score (the BASELINE.md protocol: 'consensus aligned back to
+    truth'; the paper's evaluation harness)."""
+    p = argparse.ArgumentParser(prog="qv-eval", description=qveval_main.__doc__)
+    p.add_argument("fasta", help="corrected FASTA (names 'read<ID>/<frag>')")
+    p.add_argument("truth", help="sim truth .npz (genome/starts/ends/strands)")
+    p.add_argument("--raw-db", default=None,
+                   help="also score the uncorrected reads of this DB (raw Q)")
+    p.add_argument("--json", default="-", help="write the JSON line here")
+    args = p.parse_args(argv)
+    import math
+
+    import numpy as np
+
+    from ..formats.fasta import read_fasta
+    from ..oracle.align import edit_distance, infix_distance
+    from ..utils.bases import revcomp_ints, seq_to_ints
+
+    t = np.load(args.truth)
+    genome, starts, ends, strands = t["genome"], t["starts"], t["ends"], t["strands"]
+
+    def truth_of(rid: int) -> np.ndarray:
+        tr = genome[starts[rid] : ends[rid]]
+        return revcomp_ints(tr) if strands[rid] == 1 else tr
+
+    tot_e = tot_l = 0
+    n_frags = 0
+    scored_rids = set()
+    for rec in read_fasta(args.fasta):
+        name = rec.name.split()[0]
+        if not name.startswith("read"):
+            continue
+        rid = int(name[4:].split("/")[0])
+        f = seq_to_ints(rec.seq)
+        tot_e += infix_distance(f, truth_of(rid))
+        tot_l += len(f)
+        n_frags += 1
+        scored_rids.add(rid)
+    err = tot_e / tot_l if tot_l else float("nan")
+    q = -10.0 * math.log10(max(err, 1e-9)) if tot_l else float("nan")
+    line = {"fragments": n_frags, "bases": tot_l, "errors": tot_e,
+            "error_rate": round(err, 6), "qscore": round(q, 2)}
+
+    if args.raw_db:
+        db = read_db(args.raw_db)
+        raw_e = raw_l = 0
+        for rid in sorted(scored_rids):
+            raw = db.read_bases(rid)
+            raw_e += edit_distance(raw, truth_of(rid))
+            raw_l += len(raw)  # same errors/len(sequence) convention as above
+        raw_err = raw_e / raw_l if raw_l else float("nan")
+        raw_q = -10.0 * math.log10(max(raw_err, 1e-9)) if raw_l else float("nan")
+        line.update(raw_error_rate=round(raw_err, 6), raw_qscore=round(raw_q, 2),
+                    delta_q=round(q - raw_q, 2))
+    out = json.dumps(line)
+    if args.json == "-":
+        print(out)
+    else:
+        with open(args.json, "wt") as fh:
+            fh.write(out + "\n")
+        print(out, file=sys.stderr)
+    return 0
+
+
 _TOOLS = {
     "daccord": daccord_main,
     "shard": shard_main,
@@ -276,6 +406,8 @@ _TOOLS = {
     "lasindex": lasindex_main,
     "fasta2db": fasta2db_main,
     "db2fasta": db2fasta_main,
+    "fillfasta": fillfasta_main,
+    "qveval": qveval_main,
 }
 
 

@@ -95,3 +95,60 @@ def test_cli_entrypoints(dataset, tmp_path, capsys):
     assert main(["lassort", filt, str(tmp_path / "sorted.las")]) == 0
     assert main(["nonsense"]) == 2
     assert main([]) == 0
+
+
+def test_fillfasta(tmp_path, capsys):
+    from daccord_tpu.formats import read_fasta, write_fasta
+    from daccord_tpu.formats.fasta import FastaRecord
+    from daccord_tpu.tools.cli import main
+
+    src = str(tmp_path / "in.fasta")
+    write_fasta(src, [FastaRecord("r0", "ACGTNNNRYacgt"), FastaRecord("r1", "NNNN")])
+    dst = str(tmp_path / "out.fasta")
+    assert main(["fillfasta", src, dst, "--seed", "7"]) == 0
+    recs = list(read_fasta(dst))
+    assert [r.name for r in recs] == ["r0", "r1"]
+    assert set(recs[0].seq) <= set("ACGT") and set(recs[1].seq) <= set("ACGT")
+    # ACGT symbols preserved (case-normalized), only the bad ones replaced
+    assert recs[0].seq[:4] == "ACGT" and recs[0].seq[-4:] == "ACGT"
+    # deterministic under the same seed
+    dst2 = str(tmp_path / "out2.fasta")
+    assert main(["fillfasta", src, dst2, "--seed", "7"]) == 0
+    assert open(dst).read() == open(dst2).read()
+
+
+def test_eprof_cache_and_qveval(dataset, tmp_path, capsys):
+    """-E estimates+saves on first run, loads on the second (identical output);
+    qv-eval reports a Q uplift vs the raw reads."""
+    import json
+
+    from daccord_tpu.oracle.profile import ErrorProfile
+    from daccord_tpu.tools.cli import main
+
+    out, d = dataset
+    ep = str(tmp_path / "prof.eprof")
+    f1 = str(tmp_path / "c1.fasta")
+    f2 = str(tmp_path / "c2.fasta")
+    args = [out["db"], out["las"], "--backend", "cpu", "-b", "256"]
+    assert main(["daccord", *args, "-o", f1, "-E", ep]) == 0
+    prof = ErrorProfile.load(ep)
+    assert 0 < prof.p_err < 0.5
+    assert main(["daccord", *args, "-o", f2, "-E", ep]) == 0
+    assert open(f1).read() == open(f2).read()
+
+    assert main(["qveval", f1, out["truth"], "--raw-db", out["db"]]) == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["bases"] > 0
+    assert line["qscore"] > line["raw_qscore"] + 5, line
+    assert line["delta_q"] > 5
+
+
+def test_eprof_only(dataset, tmp_path):
+    from daccord_tpu.tools.cli import main
+
+    out, d = dataset
+    ep = str(tmp_path / "only.eprof")
+    assert main(["daccord", out["db"], out["las"], "--backend", "cpu",
+                 "-E", ep, "--eprof-only"]) == 0
+    import os
+    assert os.path.exists(ep)
