@@ -94,12 +94,21 @@ def oracle_baseline(data: dict, n: int = 48) -> float:
     return bases / dt if dt > 0 else 0.0
 
 
-def device_throughput(data: dict, max_batches: int | None = None) -> tuple[float, dict]:
+def device_throughput(data: dict, max_batches: int | None = None,
+                      max_inflight: int = 8) -> tuple[float, dict]:
+    """Pipelined-dispatch throughput (the pipeline's own dispatch discipline).
+
+    A blocking fetch per batch would measure the axon tunnel's per-call
+    latency (~60-300 ms), not the chip: batches are dispatched with a bounded
+    in-flight window and results fetched as they complete, exactly like
+    runtime/pipeline.py does in production.
+    """
+    from collections import deque
+
     import jax
-    import jax.numpy as jnp
 
     from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch
-    from daccord_tpu.kernels.tiers import TierLadder, solve_ladder
+    from daccord_tpu.kernels.tiers import TierLadder, fetch, solve_ladder_async
     from daccord_tpu.oracle.consensus import ConsensusConfig
     from daccord_tpu.oracle.profile import ErrorProfile
 
@@ -121,13 +130,20 @@ def device_throughput(data: dict, max_batches: int | None = None) -> tuple[float
                            wstarts=np.zeros(BATCH, np.int64))
 
     # warmup / compile all tier shapes
-    solve_ladder(make_batch(0), ladder)
+    fetch(solve_ladder_async(make_batch(0), ladder))
 
     t0 = time.perf_counter()
     bases = 0
     solved = 0
+    inflight: deque = deque()
     for i in range(nb):
-        out = solve_ladder(make_batch(i), ladder)
+        inflight.append(solve_ladder_async(make_batch(i), ladder))
+        while len(inflight) >= max_inflight:
+            out = fetch(inflight.popleft())
+            bases += int(out["cons_len"].sum())
+            solved += int(out["solved"].sum())
+    while inflight:
+        out = fetch(inflight.popleft())
         bases += int(out["cons_len"].sum())
         solved += int(out["solved"].sum())
     dt = time.perf_counter() - t0
@@ -155,6 +171,9 @@ def _device_alive(timeout_s: int = 150) -> bool:
 
 
 def main() -> None:
+    from daccord_tpu.utils.obs import enable_compilation_cache
+
+    enable_compilation_cache()
     data = build_windows()
     fallback = None
     if not _device_alive():

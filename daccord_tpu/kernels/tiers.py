@@ -132,20 +132,81 @@ def _ladder_jit(seqs, lens, nsegs, tables, params, esc_cap):
     return ladder_core(seqs, lens, nsegs, tables, params, esc_cap)
 
 
+def pack_result(out: dict) -> jnp.ndarray:
+    """Pack a ladder result dict into ONE int32 array [B, words+3].
+
+    The tunneled TPU pays a large fixed cost per fetched *array* (measured
+    ~60-300 ms per device->host fetch on axon, vs ~1 GB/s once moving), so the
+    five result arrays are bit-packed on device into a single fetch:
+    ``cons`` int8 x4 per word, then cons_len, err (f32 bitcast), tier
+    (solved == tier >= 0), with esc_overflow folded into row 0's spare bits.
+    """
+    cons = out["cons"]
+    B, CL = cons.shape
+    words = (CL + 3) // 4
+    c = jnp.pad(cons, ((0, 0), (0, words * 4 - CL)), constant_values=4)
+    c = c.astype(jnp.uint8).astype(jnp.uint32).reshape(B, words, 4)
+    cw = c[:, :, 0] | (c[:, :, 1] << 8) | (c[:, :, 2] << 16) | (c[:, :, 3] << 24)
+    cw = jax.lax.bitcast_convert_type(cw, jnp.int32)
+    errw = jax.lax.bitcast_convert_type(out["err"].astype(jnp.float32), jnp.int32)
+    # tier is a small signed int; pack esc_overflow into the high bits of
+    # row 0's tier column (tier+1 in [0, 16) needs 5 low bits)
+    tier = out["tier"].astype(jnp.int32) + 1
+    ovf = jnp.zeros(B, jnp.int32).at[0].set(
+        jnp.asarray(out["esc_overflow"]).astype(jnp.int32))
+    tierw = tier | (ovf << 5)
+    return jnp.concatenate([cw, out["cons_len"].astype(jnp.int32)[:, None],
+                            errw[:, None], tierw[:, None]], axis=1)
+
+
+def unpack_result(arr: np.ndarray, cons_len_cl: int) -> dict:
+    """Host-side inverse of :func:`pack_result` (numpy, zero device work)."""
+    B = arr.shape[0]
+    CL = cons_len_cl
+    words = (CL + 3) // 4
+    cons = np.ascontiguousarray(arr[:, :words]).view(np.int8).reshape(B, words * 4)[:, :CL]
+    cons_len = arr[:, words]
+    err = np.ascontiguousarray(arr[:, words + 1]).view(np.float32)
+    tierw = arr[:, words + 2]
+    tier = (tierw & 31) - 1
+    overflow = int(tierw[0] >> 5) if B else 0
+    return dict(cons=cons, cons_len=cons_len, err=err, solved=tier >= 0,
+                tier=tier, esc_overflow=overflow)
+
+
+@functools.partial(jax.jit, static_argnames=("params", "esc_cap"))
+def _ladder_packed_jit(seqs, lens, nsegs, tables, params, esc_cap):
+    return pack_result(ladder_core(seqs, lens, nsegs, tables, params, esc_cap))
+
+
+class _PackedHandle:
+    """In-flight packed ladder result (device array + unpack metadata)."""
+
+    __slots__ = ("arr", "cl")
+
+    def __init__(self, arr, cl: int):
+        self.arr = arr
+        self.cl = cl
+
+
 def solve_ladder_async(batch: WindowBatch, ladder: TierLadder, esc_cap: int = 256):
-    """Dispatch the full ladder; returns device arrays without blocking.
+    """Dispatch the full ladder; returns a handle without blocking.
 
     Pair with :func:`fetch` — the pipeline keeps a couple of batches in flight
-    so host windowing, device compute, and the tunnel transfer overlap.
+    so host windowing, device compute, and the tunnel transfer overlap. The
+    result crosses the tunnel as ONE packed array (see :func:`pack_result`).
     """
     tables = tuple(ladder.tables[p.k] for p in ladder.params)
-    return _ladder_jit(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
-                       jnp.asarray(batch.nsegs), tables,
-                       tuple(ladder.params), esc_cap)
+    arr = _ladder_packed_jit(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
+                             jnp.asarray(batch.nsegs), tables,
+                             tuple(ladder.params), esc_cap)
+    return _PackedHandle(arr, ladder.params[0].cons_len)
 
 
 def fetch(out) -> dict:
     """Materialize a solver result on host (no-op for numpy dicts)."""
+    if isinstance(out, _PackedHandle):
+        return unpack_result(np.asarray(jax.device_get(out.arr)), out.cl)
     host = jax.device_get(out)
     return {k: np.asarray(v) for k, v in host.items()}
 

@@ -40,7 +40,9 @@ class PipelineConfig:
     profile_sample_piles: int = 4
     use_native: bool = True      # C++ host path when available
     depth_rank: bool = True      # best-alignments-first before depth capping
-    max_inflight: int = 2        # device batches in flight (double buffering)
+    max_inflight: int = 4        # device batches in flight; >2 hides the axon
+                                 # tunnel's per-fetch latency (~60-300 ms)
+                                 # behind the next dispatches
     feeder_threads: int = 0      # host windowing threads (0 = synchronous);
                                  # the reference's -t fan-out re-imagined as a
                                  # feeder pool ahead of the device queue — the

@@ -74,6 +74,9 @@ def daccord_main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    from ..utils.obs import enable_compilation_cache
+
+    enable_compilation_cache()
 
     start, end = _resolve_range(args, args.las)
     ccfg = ConsensusConfig(w=args.w, adv=args.a, mode=args.mode)
@@ -94,6 +97,8 @@ def daccord_main(argv=None) -> int:
             raise SystemExit("--eprof-only requires -E/--eprof PATH")
         from ..runtime.pipeline import estimate_profile_for_shard
 
+        # opens db/las a second time (correct_to_fasta reopens from paths);
+        # that is one extra index parse — noise next to the estimation pass
         prof = estimate_profile_for_shard(read_db(args.db), LasFile(args.las),
                                           cfg, start, end)
         prof.save(args.eprof)
@@ -270,6 +275,9 @@ def shard_main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    from ..utils.obs import enable_compilation_cache
+
+    enable_compilation_cache()
     i, n = (int(x) for x in args.J.split(","))
     if not (0 <= i < n):
         raise SystemExit(f"bad -J {args.J}")
@@ -356,22 +364,25 @@ def qveval_main(argv=None) -> int:
         return revcomp_ints(tr) if strands[rid] == 1 else tr
 
     tot_e = tot_l = 0
-    n_frags = 0
+    n_frags = n_skipped = 0
     scored_rids = set()
     for rec in read_fasta(args.fasta):
         name = rec.name.split()[0]
-        if not name.startswith("read"):
+        try:
+            rid = int(name.removeprefix("read").split("/")[0])
+            tr = truth_of(rid)  # IndexError if rid is not in the truth set
+        except (ValueError, IndexError):
+            n_skipped += 1
             continue
-        rid = int(name[4:].split("/")[0])
         f = seq_to_ints(rec.seq)
-        tot_e += infix_distance(f, truth_of(rid))
+        tot_e += infix_distance(f, tr)
         tot_l += len(f)
         n_frags += 1
         scored_rids.add(rid)
     err = tot_e / tot_l if tot_l else float("nan")
     q = -10.0 * math.log10(max(err, 1e-9)) if tot_l else float("nan")
-    line = {"fragments": n_frags, "bases": tot_l, "errors": tot_e,
-            "error_rate": round(err, 6), "qscore": round(q, 2)}
+    line = {"fragments": n_frags, "skipped": n_skipped, "bases": tot_l,
+            "errors": tot_e, "error_rate": round(err, 6), "qscore": round(q, 2)}
 
     if args.raw_db:
         db = read_db(args.raw_db)
@@ -421,6 +432,11 @@ def main(argv=None) -> int:
     if tool not in _TOOLS:
         print(f"unknown tool {tool!r}; tools: {', '.join(_TOOLS)}", file=sys.stderr)
         return 2
+    # every jit-compiling tool benefits; idempotent with the per-entry-point
+    # calls (console scripts invoke *_main directly, bypassing this dispatcher)
+    from ..utils.obs import enable_compilation_cache
+
+    enable_compilation_cache()
     return _TOOLS[tool](argv)
 
 

@@ -35,6 +35,9 @@ def main(argv=None) -> int:
 
     if args.backend == "cpu" or not round_bench._device_alive():
         jax.config.update("jax_platforms", "cpu")
+    from daccord_tpu.utils.obs import enable_compilation_cache
+
+    enable_compilation_cache()
     import jax.numpy as jnp
     import numpy as np
     from daccord_tpu.kernels.tiers import TierLadder, fetch, solve_ladder_async

@@ -37,3 +37,28 @@ class JsonlLogger:
 class NullLogger(JsonlLogger):
     def __init__(self):
         super().__init__(None)
+
+
+def enable_compilation_cache() -> str | None:
+    """Turn on JAX's persistent compilation cache (opt out:
+    DACCORD_NO_COMPCACHE=1; relocate: DACCORD_COMPCACHE=dir).
+
+    The ladder compiles one program per (depth, seg-len) bucket shape at
+    ~20-40s each on the tunneled TPU; caching them makes repeat CLI runs
+    start solving in seconds. Must run before the first jit compilation.
+    """
+    import os
+
+    if os.environ.get("DACCORD_NO_COMPCACHE"):
+        return None
+    path = os.environ.get("DACCORD_COMPCACHE") or os.path.expanduser(
+        "~/.cache/daccord_tpu/xla")
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        return path
+    except Exception:
+        return None

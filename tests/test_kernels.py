@@ -148,3 +148,30 @@ def test_tensorize_caps_and_padding(fixture):
     padded = pad_batch(batch, batch.size + 7)
     assert padded.size == batch.size + 7
     assert (padded.nsegs[-7:] == 0).all()
+
+
+def test_packed_ladder_matches_dict_ladder(fixture):
+    """The single-fetch packed result must decode bit-identically to the
+    dict-of-arrays ladder output (pack_result/unpack_result round trip)."""
+    import jax.numpy as jnp
+
+    from daccord_tpu.kernels.tiers import (
+        TierLadder, _ladder_jit, fetch, solve_ladder_async)
+
+    ccfg, windows, prof, ols, batch, shape = fixture
+    ladder = TierLadder.from_config(prof, ccfg)
+    tables = tuple(ladder.tables[p.k] for p in ladder.params)
+    ref = _ladder_jit(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
+                      jnp.asarray(batch.nsegs), tables,
+                      tuple(ladder.params), 256)
+    ref = {k: np.asarray(v) for k, v in ref.items()}
+    got = fetch(solve_ladder_async(batch, ladder, esc_cap=256))
+    assert np.array_equal(got["cons"], ref["cons"])
+    assert np.array_equal(got["cons_len"], ref["cons_len"])
+    assert np.array_equal(got["solved"], ref["solved"])
+    assert np.array_equal(got["tier"], ref["tier"])
+    # err: inf-preserving f32 bitcast
+    assert np.array_equal(np.isinf(got["err"]), np.isinf(ref["err"]))
+    fin = ~np.isinf(ref["err"])
+    assert np.array_equal(got["err"][fin], ref["err"][fin])
+    assert got["esc_overflow"] == int(ref["esc_overflow"])
