@@ -54,6 +54,8 @@ class TierLadder:
                          wlen=cfg.w)
             for k, mc, emc in cfg.tiers
         ]
+        # pack_result stores tier+1 in 5 bits next to the overflow counter
+        assert len(params) < 31, "ladder too deep for the packed-result layout"
         return cls(params=params, tables=tables)
 
 
@@ -150,7 +152,8 @@ def pack_result(out: dict) -> jnp.ndarray:
     cw = jax.lax.bitcast_convert_type(cw, jnp.int32)
     errw = jax.lax.bitcast_convert_type(out["err"].astype(jnp.float32), jnp.int32)
     # tier is a small signed int; pack esc_overflow into the high bits of
-    # row 0's tier column (tier+1 in [0, 16) needs 5 low bits)
+    # row 0's tier column. tier+1 gets the 5 low bits, so at most 31 tiers —
+    # far above any real ladder (default: 4)
     tier = out["tier"].astype(jnp.int32) + 1
     ovf = jnp.zeros(B, jnp.int32).at[0].set(
         jnp.asarray(out["esc_overflow"]).astype(jnp.int32))

@@ -57,27 +57,59 @@ def _ladder_sharded(seqs, lens, nsegs, tables, params, esc_cap, mesh):
     return fn(seqs, lens, nsegs, tables)
 
 
+@functools.partial(jax.jit, static_argnames=("params", "esc_cap", "mesh"))
+def _ladder_sharded_packed(seqs, lens, nsegs, tables, params, esc_cap, mesh):
+    from ..kernels.tiers import pack_result
+
+    # pack OUTSIDE shard_map, inside the same jit (nested jit inlines): the
+    # packing ops are elementwise along the sharded batch axis, so XLA keeps
+    # them local to each device and the result crosses as ONE array
+    return pack_result(_ladder_sharded(
+        seqs, lens, nsegs, tables, params, esc_cap, mesh))
+
+
+class ShardedLadderSolver:
+    """Async mesh solver: ``dispatch`` returns a non-blocking handle,
+    ``fetch`` materializes it (single packed-array transfer, like the
+    single-device path in ``kernels.tiers``). Calling the object directly is
+    the blocking convenience form used by tests and the dry run."""
+
+    def __init__(self, ladder: TierLadder, mesh: Mesh, esc_cap: int = 64):
+        self.mesh = mesh
+        self.nd = mesh.devices.size
+        self.sharding = NamedSharding(mesh, P("d"))
+        self.tables = tuple(ladder.tables[p.k] for p in ladder.params)
+        self.params = tuple(ladder.params)
+        self.esc_cap = esc_cap
+        self.cl = ladder.params[0].cons_len
+
+    def dispatch(self, batch: WindowBatch):
+        B0 = batch.size
+        target = ((B0 + self.nd - 1) // self.nd) * self.nd
+        batch = pad_batch(batch, target) if target != B0 else batch
+        arr = _ladder_sharded_packed(
+            jax.device_put(jnp.asarray(batch.seqs), self.sharding),
+            jax.device_put(jnp.asarray(batch.lens), self.sharding),
+            jax.device_put(jnp.asarray(batch.nsegs), self.sharding),
+            self.tables, params=self.params, esc_cap=self.esc_cap,
+            mesh=self.mesh)
+        return (arr, B0)
+
+    def fetch(self, handle) -> dict:
+        from ..kernels.tiers import unpack_result
+
+        arr, B0 = handle
+        out = unpack_result(np.asarray(jax.device_get(arr)), self.cl)
+        return {k: (v[:B0] if np.ndim(v) else v) for k, v in out.items()}
+
+    def __call__(self, batch: WindowBatch) -> dict:
+        return self.fetch(self.dispatch(batch))
+
+
 def make_sharded_solver(ladder: TierLadder, mesh: Mesh, esc_cap: int = 64):
     """WindowBatch -> results dict, the full ladder sharded over the mesh.
 
     ``esc_cap`` is the per-device escalation capacity. A drop-in ``solver``
-    for ``runtime.pipeline.correct_shard``.
-    """
-    nd = mesh.devices.size
-    sharding = NamedSharding(mesh, P("d"))
-    tables = tuple(ladder.tables[p.k] for p in ladder.params)
-    params = tuple(ladder.params)
-
-    def solver(batch: WindowBatch) -> dict:
-        B0 = batch.size
-        target = ((B0 + nd - 1) // nd) * nd
-        batch = pad_batch(batch, target) if target != B0 else batch
-        out = _ladder_sharded(
-            jax.device_put(jnp.asarray(batch.seqs), sharding),
-            jax.device_put(jnp.asarray(batch.lens), sharding),
-            jax.device_put(jnp.asarray(batch.nsegs), sharding),
-            tables, params=params, esc_cap=esc_cap, mesh=mesh)
-        host = jax.device_get(out)
-        return {k: np.asarray(v)[:B0] if np.ndim(v) else v for k, v in host.items()}
-
-    return solver
+    for ``runtime.pipeline.correct_shard`` (which detects the async
+    ``dispatch``/``fetch`` interface and pipelines batches through it)."""
+    return ShardedLadderSolver(ladder, mesh, esc_cap)

@@ -217,7 +217,12 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
     log = JsonlLogger(cfg.log_path)
     if solver is not None:
-        dispatch_fn, fetch_fn = solver, (lambda h: h)
+        if hasattr(solver, "dispatch") and hasattr(solver, "fetch"):
+            # async solver (e.g. the mesh-sharded ladder): pipeline batches
+            # through it exactly like the local single-device path
+            dispatch_fn, fetch_fn = solver.dispatch, solver.fetch
+        else:
+            dispatch_fn, fetch_fn = solver, (lambda h: h)
     else:
         import jax
 

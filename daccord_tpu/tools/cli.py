@@ -369,8 +369,12 @@ def qveval_main(argv=None) -> int:
     for rec in read_fasta(args.fasta):
         name = rec.name.split()[0]
         try:
+            if not name.startswith("read"):
+                raise ValueError(name)
             rid = int(name.removeprefix("read").split("/")[0])
-            tr = truth_of(rid)  # IndexError if rid is not in the truth set
+            if not (0 <= rid < len(starts)):  # also rejects negative-index rids
+                raise IndexError(rid)
+            tr = truth_of(rid)
         except (ValueError, IndexError):
             n_skipped += 1
             continue
