@@ -84,6 +84,8 @@ class ShardedLadderSolver:
         self.cl = ladder.params[0].cons_len
 
     def dispatch(self, batch: WindowBatch):
+        from ..kernels.tiers import _PackedHandle
+
         B0 = batch.size
         target = ((B0 + self.nd - 1) // self.nd) * self.nd
         batch = pad_batch(batch, target) if target != B0 else batch
@@ -93,13 +95,14 @@ class ShardedLadderSolver:
             jax.device_put(jnp.asarray(batch.nsegs), self.sharding),
             self.tables, params=self.params, esc_cap=self.esc_cap,
             mesh=self.mesh)
-        return (arr, B0)
+        return (_PackedHandle(arr, self.cl), B0)
 
     def fetch(self, handle) -> dict:
-        from ..kernels.tiers import unpack_result
+        # one wire format, one decoder: delegate to kernels.tiers.fetch
+        from ..kernels.tiers import fetch as fetch_packed
 
-        arr, B0 = handle
-        out = unpack_result(np.asarray(jax.device_get(arr)), self.cl)
+        ph, B0 = handle
+        out = fetch_packed(ph)
         return {k: (v[:B0] if np.ndim(v) else v) for k, v in out.items()}
 
     def __call__(self, batch: WindowBatch) -> dict:

@@ -44,6 +44,26 @@ class SimConfig:
     repeat_fraction: float = 0.0      # fraction of genome covered by a planted repeat
     seed: int = 0
 
+    @classmethod
+    def pacbio_clr(cls, **kw) -> "SimConfig":
+        """PacBio CLR-like: ~13.5% error, insertion-heavy (the defaults)."""
+        return cls(**kw)
+
+    @classmethod
+    def ont_r10(cls, **kw) -> "SimConfig":
+        """ONT R10-like: much longer reads at a few percent error,
+        deletion-leaning (BASELINE.md ladder config 5's regime). Read length
+        stresses windowing/stitching; window count per read grows ~25x over
+        the PacBio preset while the per-window kernel stays identical."""
+        kw.setdefault("read_len_mean", 20_000.0)
+        kw.setdefault("read_len_sigma", 0.5)
+        kw.setdefault("p_ins", 0.008)
+        kw.setdefault("p_del", 0.018)
+        kw.setdefault("p_sub", 0.01)
+        kw.setdefault("coverage", 30.0)
+        kw.setdefault("min_overlap", 2_000)
+        return cls(**kw)
+
 
 @dataclass
 class SimRead:

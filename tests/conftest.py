@@ -19,3 +19,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# persistent compilation cache: the suite is dominated by XLA compiles of the
+# ladder/kernel shapes, which are identical run to run
+from daccord_tpu.utils.obs import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()

@@ -106,3 +106,25 @@ def test_pipeline_byte_range_shard(dataset):
     assert set(rids0).isdisjoint(rids1)
     all_areads = sorted({o.aread for o in out["result"].overlaps})
     assert sorted(rids0 + rids1) == all_areads
+
+
+def test_ont_preset_end_to_end(tmp_path):
+    """ONT R10-like regime (long reads, low deletion-leaning error): the
+    pipeline must still deliver a strong Q uplift — the window unit makes
+    read length a non-axis (SURVEY.md §2.3 SP row), only window count grows."""
+    from daccord_tpu.sim import SimConfig, make_dataset
+    from daccord_tpu.tools.cli import qveval_main
+
+    cfg = SimConfig.ont_r10(genome_len=9000, coverage=10, read_len_mean=3000,
+                            min_overlap=800, seed=51)
+    assert cfg.p_del > cfg.p_ins  # deletion-leaning, unlike the PacBio default
+    out = make_dataset(str(tmp_path), cfg, name="ont")
+    fasta = str(tmp_path / "ont.corr.fasta")
+    stats = correct_to_fasta(out["db"], out["las"], fasta, PipelineConfig(batch_size=256))
+    assert stats.n_solved / stats.n_windows > 0.9
+
+    import json as _json
+    jout = str(tmp_path / "q.json")
+    assert qveval_main([fasta, out["truth"], "--raw-db", out["db"], "--json", jout]) == 0
+    line = _json.loads(open(jout).read())
+    assert line["qscore"] > line["raw_qscore"] + 6, line
