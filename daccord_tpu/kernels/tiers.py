@@ -192,13 +192,21 @@ class _PackedHandle:
         self.cl = cl
 
 
-def solve_ladder_async(batch: WindowBatch, ladder: TierLadder, esc_cap: int = 256):
+def solve_ladder_async(batch: WindowBatch, ladder: TierLadder,
+                       esc_cap: int | None = None):
     """Dispatch the full ladder; returns a handle without blocking.
 
     Pair with :func:`fetch` — the pipeline keeps a couple of batches in flight
     so host windowing, device compute, and the tunnel transfer overlap. The
     result crosses the tunnel as ONE packed array (see :func:`pack_result`).
+
+    ``esc_cap=None`` (default) sizes the escalation capacity to the full
+    batch, making overflow (windows silently left unsolved past the cap)
+    structurally impossible; the lax.cond still skips the rescue tiers at
+    runtime when nothing failed.
     """
+    if esc_cap is None:
+        esc_cap = int(batch.seqs.shape[0])
     tables = tuple(ladder.tables[p.k] for p in ladder.params)
     arr = _ladder_packed_jit(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
                              jnp.asarray(batch.nsegs), tables,
@@ -214,7 +222,8 @@ def fetch(out) -> dict:
     return {k: np.asarray(v) for k, v in host.items()}
 
 
-def solve_ladder(batch: WindowBatch, ladder: TierLadder, esc_cap: int = 256) -> dict:
+def solve_ladder(batch: WindowBatch, ladder: TierLadder,
+                 esc_cap: int | None = None) -> dict:
     """Single-dispatch full-ladder solve; host numpy results."""
     return fetch(solve_ladder_async(batch, ladder, esc_cap))
 

@@ -74,13 +74,13 @@ class ShardedLadderSolver:
     single-device path in ``kernels.tiers``). Calling the object directly is
     the blocking convenience form used by tests and the dry run."""
 
-    def __init__(self, ladder: TierLadder, mesh: Mesh, esc_cap: int = 64):
+    def __init__(self, ladder: TierLadder, mesh: Mesh, esc_cap: int | None = None):
         self.mesh = mesh
         self.nd = mesh.devices.size
         self.sharding = NamedSharding(mesh, P("d"))
         self.tables = tuple(ladder.tables[p.k] for p in ladder.params)
         self.params = tuple(ladder.params)
-        self.esc_cap = esc_cap
+        self.esc_cap = esc_cap   # None = full per-device slice (no overflow)
         self.cl = ladder.params[0].cons_len
 
     def dispatch(self, batch: WindowBatch):
@@ -89,11 +89,12 @@ class ShardedLadderSolver:
         B0 = batch.size
         target = ((B0 + self.nd - 1) // self.nd) * self.nd
         batch = pad_batch(batch, target) if target != B0 else batch
+        esc_cap = self.esc_cap if self.esc_cap is not None else target // self.nd
         arr = _ladder_sharded_packed(
             jax.device_put(jnp.asarray(batch.seqs), self.sharding),
             jax.device_put(jnp.asarray(batch.lens), self.sharding),
             jax.device_put(jnp.asarray(batch.nsegs), self.sharding),
-            self.tables, params=self.params, esc_cap=self.esc_cap,
+            self.tables, params=self.params, esc_cap=esc_cap,
             mesh=self.mesh)
         return (_PackedHandle(arr, self.cl), B0)
 
@@ -109,7 +110,7 @@ class ShardedLadderSolver:
         return self.fetch(self.dispatch(batch))
 
 
-def make_sharded_solver(ladder: TierLadder, mesh: Mesh, esc_cap: int = 64):
+def make_sharded_solver(ladder: TierLadder, mesh: Mesh, esc_cap: int | None = None):
     """WindowBatch -> results dict, the full ladder sharded over the mesh.
 
     ``esc_cap`` is the per-device escalation capacity. A drop-in ``solver``

@@ -235,6 +235,8 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         else:
             # async device ladder: one dispatch per batch, fetched a batch
             # later so host windowing overlaps device compute + tunnel RTT
+            # (default esc_cap sizes escalation to the full batch: overflow
+            # is structurally impossible)
             from ..kernels.tiers import fetch as _fetch, solve_ladder_async
 
             dispatch_fn, fetch_fn = (lambda b: solve_ladder_async(b, ladder)), _fetch
@@ -302,13 +304,17 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     def drain(to_depth: int):
         while len(inflight) > to_depth:
             handle, rid, widx, take, t0 = inflight.popleft()
+            t_f = time.time()
             out = fetch_fn(handle)
-            dt = time.time() - t0
-            stats.device_s += dt
+            now = time.time()
+            # device_s = time the host actually BLOCKED on the device/tunnel
+            # (in-flight batches overlap, so summing dispatch->fetch spans
+            # would double-count and can exceed wall time)
+            stats.device_s += now - t_f
             n_s = scatter(out, rid, widx, take)
             log.log("batch", windows=take, solved=n_s,
                     overflow=int(out.get("esc_overflow", 0)),
-                    inflight=len(inflight), t_turnaround=round(dt, 4))
+                    inflight=len(inflight), t_turnaround=round(now - t0, 4))
 
     def run_batches(final: bool):
         for bi in range(nb):

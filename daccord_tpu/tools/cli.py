@@ -214,6 +214,34 @@ def lassort_main(argv=None) -> int:
     return 0
 
 
+def lasmerge_main(argv=None) -> int:
+    """las-merge: merge sorted LAS files into one (reference LAmerge role —
+    DALIGNER emits one LAS per DB-block pair; downstream tools want one
+    aread-sorted file)."""
+    p = argparse.ArgumentParser(prog="las-merge", description=lasmerge_main.__doc__)
+    p.add_argument("out")
+    p.add_argument("las", nargs="+", help="input LAS files (aread-sorted)")
+    args = p.parse_args(argv)
+    import heapq
+    import os
+
+    from ..formats.las import write_las
+
+    if os.path.abspath(args.out) in {os.path.abspath(f) for f in args.las}:
+        raise SystemExit("las-merge: output path must not be one of the inputs "
+                         "(inputs are streamed lazily while the output is written)")
+    files = [LasFile(f) for f in args.las]
+    tspaces = {f.tspace for f in files}
+    if len(tspaces) != 1:
+        raise SystemExit(f"mismatched tspace across inputs: {sorted(tspaces)}")
+    # k-way merge of already-sorted streams, keyed like lassort
+    streams = [iter(f) for f in files]
+    merged = heapq.merge(*streams, key=lambda o: (o.aread, o.bread, o.abpos))
+    n = write_las(args.out, tspaces.pop(), merged)
+    print(f"merged {len(files)} files -> {n} overlaps", file=sys.stderr)
+    return 0
+
+
 def fasta2db_main(argv=None) -> int:
     """fasta2db: build a Dazzler DB triple from FASTA (DAZZ_DB fasta2DB role)."""
     p = argparse.ArgumentParser(prog="fasta2db", description=fasta2db_main.__doc__)
@@ -418,6 +446,7 @@ _TOOLS = {
     "filter": filteralignments_main,
     "filtersym": filtersym_main,
     "lassort": lassort_main,
+    "lasmerge": lasmerge_main,
     "lasindex": lasindex_main,
     "fasta2db": fasta2db_main,
     "db2fasta": db2fasta_main,
