@@ -43,6 +43,9 @@ def daccord_main(argv=None) -> int:
     p.add_argument("-o", "--out", default="-", help="output FASTA ('-' = stdout)")
     p.add_argument("-w", type=int, default=40, help="window size")
     p.add_argument("-a", type=int, default=10, help="window advance")
+    p.add_argument("-k", type=int, default=8,
+                   help="base k-mer size; the escalation ladder becomes "
+                        "(k,2,2),(k+2,2,2),(k+4,2,2),(k,1,1) (reference -k role)")
     p.add_argument("-b", "--batch", type=int, default=512, help="device batch size")
     p.add_argument("-t", "--threads", type=int, default=0,
                    help="host windowing threads (reference -t; 0 = synchronous)")
@@ -79,7 +82,16 @@ def daccord_main(argv=None) -> int:
     enable_compilation_cache()
 
     start, end = _resolve_range(args, args.las)
-    ccfg = ConsensusConfig(w=args.w, adv=args.a, mode=args.mode)
+    k = args.k
+    if not (4 <= k <= 11):  # k+4 must still pack into int32 k-mer codes
+        raise SystemExit(f"-k {k}: supported range is 4..11")
+    # kernel k-mer positions come from seg_len (npos = seg_len - k + 1 > 0);
+    # window size only needs to accommodate the base k
+    if k + 4 > min(args.w, args.seg_len - 1):
+        raise SystemExit(f"escalated k {k + 4} (from -k {k}) needs window size > "
+                         f"{k + 4} and --seg-len > {k + 5}")
+    tiers = ((k, 2, 2), (k + 2, 2, 2), (k + 4, 2, 2), (k, 1, 1))
+    ccfg = ConsensusConfig(w=args.w, adv=args.a, mode=args.mode, tiers=tiers)
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
                          depth=args.depth, seg_len=args.seg_len,
                          log_path=args.log, use_native=not args.no_native,

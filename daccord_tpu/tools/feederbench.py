@@ -1,0 +1,65 @@
+"""Host-feeder throughput bench: how fast can the host side cut windows?
+
+The reference's 64-thread CPU becomes this framework's *feeder* (SURVEY.md
+§7.3 item 5): LAS streaming + trace-point refinement + window cutting must
+outrun the device or the chip starves. This tool measures the feeder alone —
+no device work — in windows/sec and (input) bases/sec, for 1..N threads.
+
+Usage: ``python -m daccord_tpu.tools.feederbench [--threads 1,4,8] [--genome 60000]``
+Prints one JSON line per thread count.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--threads", default="1,4,8")
+    p.add_argument("--genome", type=int, default=60_000)
+    p.add_argument("--coverage", type=float, default=20.0)
+    args = p.parse_args(argv)
+
+    import os
+    import tempfile
+
+    from daccord_tpu.native import available as native_available
+    from daccord_tpu.formats.dazzdb import read_db
+    from daccord_tpu.formats.las import LasFile
+    from daccord_tpu.runtime.pipeline import (
+        PipelineConfig, _iter_pile_blocks, _iter_pile_blocks_threaded)
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    if not native_available():
+        print(json.dumps({"error": "native host path unavailable"}))
+        return 1
+
+    with tempfile.TemporaryDirectory() as d:
+        out = make_dataset(d, SimConfig(genome_len=args.genome,
+                                        coverage=args.coverage, seed=7), name="fb")
+        db = read_db(out["db"])
+        las = LasFile(out["las"])
+        for nt in (int(x) for x in args.threads.split(",")):
+            cfg = PipelineConfig(feeder_threads=nt)
+            t0 = time.perf_counter()
+            n_win = n_bases = n_reads = 0
+            it = (_iter_pile_blocks_threaded(db, las, cfg, None, None, nt)
+                  if nt > 0 else _iter_pile_blocks(db, las, cfg, None, None, True))
+            for aread, a, seqs, lens, nsegs in it:
+                n_reads += 1
+                n_win += len(nsegs)
+                n_bases += len(a)
+            dt = time.perf_counter() - t0
+            print(json.dumps({
+                "threads": nt, "reads": n_reads, "windows": n_win,
+                "wall_s": round(dt, 3),
+                "windows_per_s": round(n_win / dt, 1),
+                "bases_per_s": round(n_bases / dt, 1)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
