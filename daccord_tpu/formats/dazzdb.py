@@ -120,6 +120,26 @@ def _db_stems(path: str) -> tuple[str, str]:
     return d, b
 
 
+def _write_block_section(fh, bounds: list[int], block_bases: int, cutoff: int) -> None:
+    """The .db stub's ``blocks =`` section (single source for writer parity
+    with :func:`db_blocks`; fasta2DB layout)."""
+    fh.write(f"blocks = {len(bounds) - 1:>9}\n")
+    fh.write(f"size = {block_bases:>11} cutoff = {cutoff:>10} all = 1\n")
+    for b in bounds:
+        fh.write(f"{b:>11} {b:>11}\n")  # untrimmed == trimmed (all = 1)
+
+
+def read_lengths(path: str) -> np.ndarray:
+    """Per-read lengths from the .idx alone (no base-store load)."""
+    d, stem = _db_stems(path)
+    with open(os.path.join(d, f".{stem}.idx"), "rb") as fh:
+        hdr = fh.read(_HDR_SIZE)
+        ureads = struct.unpack_from("<i", hdr, 0)[0]
+        raw = fh.read(_READ_SIZE * ureads)
+    arr = np.frombuffer(raw, dtype=np.uint8).reshape(ureads, _READ_SIZE)
+    return arr[:, 4:8].copy().view("<i4").reshape(-1)
+
+
 def write_db(path: str, seqs: list[np.ndarray], names: list[str] | None = None, cutoff: int = 0) -> DazzDB:
     """Write reads (int8 arrays of 0..3) as a Dazzler DB triple (.db/.idx/.bps)."""
     d, stem = _db_stems(path)
@@ -168,10 +188,7 @@ def write_db(path: str, seqs: list[np.ndarray], names: list[str] | None = None, 
     with open(db_path, "wt") as fh:
         fh.write("files =         1\n")
         fh.write(f"{n:>11} {stem} {stem}\n")
-        fh.write("blocks =         1\n")
-        fh.write(f"size = {200000000:>11} cutoff = {cutoff:>10} all = 1\n")
-        fh.write(f"{0:>11} {0:>11}\n")
-        fh.write(f"{n:>11} {n:>11}\n")
+        _write_block_section(fh, [0, n], 200_000_000, cutoff)
 
     name_path = os.path.join(d, f".{stem}.names")
     with open(name_path, "wt") as fh:
@@ -251,3 +268,58 @@ def read_track(db_path: str, track: str) -> list[np.ndarray]:
         offsets = np.frombuffer(fh.read(8 * (nreads + 1)), dtype=np.int64)
     data = np.fromfile(data_path, dtype=np.uint8)
     return [data[offsets[i] : offsets[i + 1]] for i in range(nreads)]
+
+
+# ---------------------------------------------------------------------------
+# Block partition (DAZZ_DB DBsplit role)
+# ---------------------------------------------------------------------------
+
+def split_db(db_path: str, block_bases: int = 200_000_000) -> list[tuple[int, int]]:
+    """Recompute the .db stub's block partition (DAZZ_DB ``DBsplit -s`` role).
+
+    Blocks hold consecutive reads totalling at most ``block_bases`` bases
+    (boundaries at read edges; a single read longer than the limit gets its
+    own block). Returns the partition as [start_read, end_read) pairs and
+    rewrites the ``blocks =`` section of the .db text stub in fasta2DB layout.
+    """
+    # partition needs only the read lengths — never load the base store
+    # (real DBs are multi-GB; DBsplit must stay .idx-only)
+    rlens = read_lengths(db_path)
+    d, stem = _db_stems(db_path)
+    with open(os.path.join(d, f".{stem}.idx"), "rb") as fh:
+        cutoff = struct.unpack_from("<4i", fh.read(16), 0)[2]
+    bounds = [0]
+    acc = 0
+    for i, rlen in enumerate(rlens):
+        if acc > 0 and acc + int(rlen) > block_bases:
+            bounds.append(i)
+            acc = 0
+        acc += int(rlen)
+    bounds.append(len(rlens))
+
+    stub = os.path.join(d, f"{stem}.db")
+    with open(stub, "rt") as fh:
+        lines = fh.readlines()
+    # files section: "files = N" then N lines; blocks section replaces the rest
+    nfiles = int(lines[0].split("=")[1])
+    head = lines[: 1 + nfiles]
+    nb = len(bounds) - 1
+    tmp = f"{stub}.tmp.{os.getpid()}"
+    with open(tmp, "wt") as fh:  # atomic: a crash never corrupts the stub
+        fh.writelines(head)
+        _write_block_section(fh, bounds, block_bases, cutoff)
+    os.replace(tmp, stub)
+    return [(bounds[i], bounds[i + 1]) for i in range(nb)]
+
+
+def db_blocks(db_path: str) -> list[tuple[int, int]]:
+    """Read the block partition from the .db stub as [start, end) read pairs."""
+    d, stem = _db_stems(db_path)
+    with open(os.path.join(d, f"{stem}.db"), "rt") as fh:
+        lines = [ln.rstrip("\n") for ln in fh]
+    nfiles = int(lines[0].split("=")[1])
+    nb = int(lines[1 + nfiles].split("=")[1])
+    bounds = []
+    for ln in lines[3 + nfiles : 3 + nfiles + nb + 1]:
+        bounds.append(int(ln.split()[0]))
+    return [(bounds[i], bounds[i + 1]) for i in range(nb)]

@@ -226,6 +226,23 @@ def lassort_main(argv=None) -> int:
     return 0
 
 
+def dbsplit_main(argv=None) -> int:
+    """db-split: recompute the DB's block partition (DAZZ_DB ``DBsplit``
+    role). Blocks bound per-job work in daligner-style workflows; this
+    framework's own sharding is LAS-byte-range based (-J), so blocks exist
+    for workflow interop."""
+    p = argparse.ArgumentParser(prog="db-split", description=dbsplit_main.__doc__)
+    p.add_argument("db")
+    p.add_argument("-s", "--size", type=float, default=200.0,
+                   help="block size in megabases (DBsplit -s)")
+    args = p.parse_args(argv)
+    from ..formats.dazzdb import split_db
+
+    blocks = split_db(args.db, int(args.size * 1_000_000))
+    print(f"{len(blocks)} blocks", file=sys.stderr)
+    return 0
+
+
 def lasmerge_main(argv=None) -> int:
     """las-merge: merge sorted LAS files into one (reference LAmerge role —
     DALIGNER emits one LAS per DB-block pair; downstream tools want one
@@ -462,6 +479,7 @@ _TOOLS = {
     "lasindex": lasindex_main,
     "fasta2db": fasta2db_main,
     "db2fasta": db2fasta_main,
+    "dbsplit": dbsplit_main,
     "fillfasta": fillfasta_main,
     "qveval": qveval_main,
 }

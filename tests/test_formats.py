@@ -167,3 +167,30 @@ def test_las_trace_u16(tmp_path):
     tspace, back = read_las(p)
     assert tspace == 500
     np.testing.assert_array_equal(back[0].trace, o.trace)
+
+
+def test_dbsplit_blocks(tmp_path):
+    """DBsplit-role partition: boundaries at read edges, sizes bounded,
+    blocks cover all reads; stub round-trips through db_blocks."""
+    import numpy as np
+
+    from daccord_tpu.formats.dazzdb import db_blocks, read_db, split_db, write_db
+
+    rng = np.random.default_rng(3)
+    seqs = [rng.integers(0, 4, int(n), dtype=np.int8)
+            for n in rng.integers(200, 1200, size=40)]
+    db_path = str(tmp_path / "b.db")
+    write_db(db_path, seqs)
+
+    blocks = split_db(db_path, block_bases=5000)
+    assert blocks == db_blocks(db_path)
+    assert blocks[0][0] == 0 and blocks[-1][1] == len(seqs)
+    for (s, e), (s2, _) in zip(blocks, blocks[1:]):
+        assert e == s2
+    db = read_db(db_path)
+    for s, e in blocks:
+        tot = sum(db.reads[i].rlen for i in range(s, e))
+        # bounded unless a single long read forces a bigger block
+        assert tot <= 5000 or e - s == 1
+    # db still readable and bases intact after the stub rewrite
+    assert np.array_equal(db.read_bases(0), seqs[0])
