@@ -240,3 +240,22 @@ def shard_ranges(path: str, nshards: int) -> list[tuple[int, int]]:
     for i in range(1, len(cuts)):
         cuts[i] = max(cuts[i], cuts[i - 1])
     return [(cuts[i], cuts[i + 1]) for i in range(nshards)]
+
+
+def range_for_areads(path: str, lo: int, hi: int) -> tuple[int, int]:
+    """Byte range of the records whose aread is in [lo, hi).
+
+    The per-DB-block workflow primitive: block i of the DB (see
+    ``formats.dazzdb.db_blocks``) maps to the LAS byte range of its piles.
+    Requires an aread-sorted LAS (DALIGNER order); uses the sidecar index.
+    """
+    idx = index_las(path)
+    size = os.path.getsize(path)
+    if len(idx) == 0:
+        return size, size
+    areads = idx[:, 0]
+    i = int(np.searchsorted(areads, lo, side="left"))
+    j = int(np.searchsorted(areads, hi, side="left"))
+    start = int(idx[i, 1]) if i < len(idx) else size
+    end = int(idx[j, 1]) if j < len(idx) else size
+    return start, end

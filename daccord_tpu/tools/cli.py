@@ -70,6 +70,9 @@ def daccord_main(argv=None) -> int:
                    help="device backend (SURVEY.md §5 config row); 'cpu' forces the "
                         "host platform before any backend init — the only reliable "
                         "override under this image's axon plugin")
+    p.add_argument("--block", type=int, default=None, metavar="I",
+                   help="process only DB block I (1-based, after db-split; the "
+                        "reference's per-block workflow). Mutually exclusive with -J")
     _add_J(p)
     args = p.parse_args(argv)
 
@@ -81,7 +84,19 @@ def daccord_main(argv=None) -> int:
 
     enable_compilation_cache()
 
-    start, end = _resolve_range(args, args.las)
+    if args.block is not None and args.J is not None:
+        raise SystemExit("--block and -J are mutually exclusive")
+    if args.block is not None:
+        from ..formats.dazzdb import db_blocks
+        from ..formats.las import range_for_areads
+
+        blocks = db_blocks(args.db)
+        if not (1 <= args.block <= len(blocks)):
+            raise SystemExit(f"--block {args.block}: DB has {len(blocks)} blocks")
+        lo, hi = blocks[args.block - 1]
+        start, end = range_for_areads(args.las, lo, hi)
+    else:
+        start, end = _resolve_range(args, args.las)
     k = args.k
     if not (4 <= k <= 11):  # k+4 must still pack into int32 k-mer codes
         raise SystemExit(f"-k {k}: supported range is 4..11")

@@ -179,3 +179,39 @@ def test_lasmerge(dataset, tmp_path):
                and g.diffs == w.diffs and g.flags == w.flags
                and np.array_equal(g.trace, w.trace)
                for g, w in zip(got, want))
+
+
+def test_daccord_block_mode(dataset, tmp_path):
+    """--block i corrects exactly block i's piles; under a shared -E error
+    profile (the per-block workflow: profile once, correct per block) the
+    concatenation over all blocks equals the whole-file run byte-for-byte.
+    Without a shared profile each block would estimate its own."""
+    import shutil
+
+    from daccord_tpu.formats.dazzdb import db_blocks, split_db
+    from daccord_tpu.tools.cli import main
+
+    out, d = dataset
+    # work on a copy: split_db rewrites the stub, and the dataset fixture is
+    # shared module-wide
+    for f in ("t.db", ".t.idx", ".t.bps", ".t.names"):
+        shutil.copy(f"{d}/{f}", tmp_path / f)
+    db = str(tmp_path / "t.db")
+    split_db(db, block_bases=8000)
+    ep = str(tmp_path / "shared.eprof")
+    args = [db, out["las"], "--backend", "cpu", "-b", "256", "-E", ep]
+    assert main(["daccord", *args, "--eprof-only"]) == 0
+    whole = str(tmp_path / "whole.fasta")
+    assert main(["daccord", *args, "-o", whole]) == 0
+
+    nb = len(db_blocks(db))
+    assert nb >= 2
+    parts = []
+    for i in range(1, nb + 1):
+        p = str(tmp_path / f"b{i}.fasta")
+        assert main(["daccord", *args, "-o", p, "--block", str(i)]) == 0
+        parts.append(open(p).read())
+    assert "".join(parts) == open(whole).read()
+
+    with pytest.raises(SystemExit):
+        main(["daccord", *args, "--block", str(nb + 1)])
