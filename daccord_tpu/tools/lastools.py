@@ -33,6 +33,17 @@ QV_NOCOV = 255
 QV_SCALE = 200.0
 
 
+def _native_ok() -> bool:
+    """True when the C++ host library is importable and built. Only the
+    import is guarded — bugs inside the native-path math must propagate, not
+    silently degrade to the slow fallback."""
+    try:
+        from ..native import available
+    except Exception:
+        return False
+    return available()
+
+
 def _pile_tile_rates(db: DazzDB, aread: int, pile: list[Overlap], tspace: int):
     """Per-tile lists of alignment error rates for one A read."""
     rlen = db.read_length(aread)
@@ -51,8 +62,57 @@ def _pile_tile_rates(db: DazzDB, aread: int, pile: list[Overlap], tspace: int):
     return rates
 
 
+def _read_lengths(db: DazzDB) -> np.ndarray:
+    return np.fromiter((r.rlen for r in db.reads), np.int64, db.nreads)
+
+
+def _tile_table(db: DazzDB, tspace: int) -> np.ndarray:
+    """Global tile offsets: tile_base[i] .. tile_base[i+1] are read i's tiles."""
+    ntiles = (_read_lengths(db) + tspace - 1) // tspace
+    tile_base = np.zeros(db.nreads + 1, np.int64)
+    np.cumsum(ntiles, out=tile_base[1:])
+    return tile_base
+
+
+def _intrinsic_qv_native(db: DazzDB, las: LasFile, depth: int) -> list[np.ndarray]:
+    """Vectorized QV pass over the native columnar LAS load (SURVEY.md §2.4:
+    the streaming path rides C++ + numpy vector math, not per-record Python).
+    Bit-identical to the per-pile fallback below (parity-tested)."""
+    from ..native.api import ColumnarLas
+
+    col = ColumnarLas(las.path)
+    tspace = col.tspace
+    tile_base = _tile_table(db, tspace)
+    qv_flat = np.full(int(tile_base[-1]), QV_NOCOV, dtype=np.uint8)
+
+    if col.novl:
+        T = (np.diff(col.trace_off) // 2).astype(np.int64)   # tiles per overlap
+        n = col.novl
+        total = int(T.sum())
+        ov = np.repeat(np.arange(n), T)
+        starts = np.zeros(n + 1, np.int64)
+        np.cumsum(T, out=starts[1:])
+        tloc = np.arange(total, dtype=np.int64) - np.repeat(starts[:-1], T)
+        g = col.abpos.astype(np.int64)[ov] // tspace + tloc  # per-read tile id
+        lo = np.maximum(col.abpos[ov], g * tspace)
+        hi = np.minimum(col.aepos[ov], (g + 1) * tspace)
+        tl = hi - lo
+        dif = col.trace_flat[np.repeat(col.trace_off[:-1], T) + 2 * tloc]
+        ok = tl > 0
+        gid = (tile_base[col.aread.astype(np.int64)[ov]] + g)[ok]
+        # same expression shape as the fallback: (0.5 * diff) / tile_len
+        rate = 0.5 * dif[ok].astype(np.float64) / tl[ok]
+        order = np.lexsort((rate, gid))
+        gid_s, rate_s = gid[order], rate[order]
+        uniq, gstart, gcount = np.unique(gid_s, return_index=True, return_counts=True)
+        sel = gstart + np.minimum(max(depth // 2, 1), gcount) - 1
+        q = np.minimum(np.round(QV_SCALE * rate_s[sel]), 250).astype(np.uint8)
+        qv_flat[uniq] = q
+    return [qv_flat[tile_base[i] : tile_base[i + 1]] for i in range(db.nreads)]
+
+
 def compute_intrinsic_qv(db: DazzDB, las: LasFile, depth: int = 20,
-                         track: str = "inqual") -> list[np.ndarray]:
+                         track: str = "inqual", use_native: bool = True) -> list[np.ndarray]:
     """Per-read per-tile intrinsic QVs from pile error statistics.
 
     The depth-d quantile (d-th lowest rate) is robust to repeat-induced piles:
@@ -60,28 +120,53 @@ def compute_intrinsic_qv(db: DazzDB, las: LasFile, depth: int = 20,
     mostly intact (reference ``computeintrinsicqv -d``).
     """
     tspace = las.tspace
-    payloads: list[np.ndarray] = [np.zeros(0, dtype=np.uint8)] * db.nreads
-    for aread, pile in las.iter_piles():
-        rates = _pile_tile_rates(db, aread, pile, tspace)
-        qv = np.full(len(rates), QV_NOCOV, dtype=np.uint8)
-        for t, rl in enumerate(rates):
-            if not rl:
-                continue
-            rl = sorted(rl)
-            q = rl[min(max(depth // 2, 1), len(rl)) - 1]
-            qv[t] = min(int(round(QV_SCALE * q)), 250)
-        payloads[aread] = qv
-    # reads with no pile get all-NOCOV tracks of the right length
-    for i in range(db.nreads):
-        if len(payloads[i]) == 0:
-            nt = (db.read_length(i) + tspace - 1) // tspace
-            payloads[i] = np.full(nt, QV_NOCOV, dtype=np.uint8)
+    payloads: list[np.ndarray] | None = None
+    if use_native and _native_ok():
+        payloads = _intrinsic_qv_native(db, las, depth)
+    if payloads is None:
+        payloads = [np.zeros(0, dtype=np.uint8)] * db.nreads
+        for aread, pile in las.iter_piles():
+            rates = _pile_tile_rates(db, aread, pile, tspace)
+            qv = np.full(len(rates), QV_NOCOV, dtype=np.uint8)
+            for t, rl in enumerate(rates):
+                if not rl:
+                    continue
+                rl = sorted(rl)
+                q = rl[min(max(depth // 2, 1), len(rl)) - 1]
+                qv[t] = min(int(round(QV_SCALE * q)), 250)
+            payloads[aread] = qv
+        # reads with no pile get all-NOCOV tracks of the right length
+        for i in range(db.nreads):
+            if len(payloads[i]) == 0:
+                nt = (db.read_length(i) + tspace - 1) // tspace
+                payloads[i] = np.full(nt, QV_NOCOV, dtype=np.uint8)
     write_track(db.path, track, payloads)
     return payloads
 
 
+def _tile_coverage_native(db: DazzDB, las: LasFile) -> tuple[np.ndarray, np.ndarray]:
+    """(tile_base, cov_flat): per-tile alignment coverage over all reads via
+    the native columnar load + a difference-array sweep (no per-record
+    Python). Interval deltas cancel within each read, so one global cumsum
+    yields every read's coverage."""
+    from ..native.api import ColumnarLas
+
+    col = ColumnarLas(las.path)
+    tspace = col.tspace
+    tile_base = _tile_table(db, tspace)
+    delta = np.zeros(int(tile_base[-1]) + 1, dtype=np.int64)
+    if col.novl:
+        ar = col.aread.astype(np.int64)
+        g0 = col.abpos.astype(np.int64) // tspace
+        g1 = np.maximum(col.aepos.astype(np.int64) - 1, col.abpos) // tspace
+        np.add.at(delta, tile_base[ar] + g0, 1)
+        np.add.at(delta, tile_base[ar] + g1 + 1, -1)
+    return tile_base, np.cumsum(delta[:-1])
+
+
 def detect_repeats(db: DazzDB, las: LasFile, depth: int = 20,
-                   cov_factor: float = 2.0, track: str = "rep") -> list[np.ndarray]:
+                   cov_factor: float = 2.0, track: str = "rep",
+                   use_native: bool = True) -> list[np.ndarray]:
     """Detect simple-repeat intervals from pile over-coverage.
 
     A tile whose alignment coverage exceeds ``cov_factor * depth`` is repeat-
@@ -89,30 +174,51 @@ def detect_repeats(db: DazzDB, las: LasFile, depth: int = 20,
     pairs per read, written as track ``rep``).
     """
     tspace = las.tspace
-    payloads: list[np.ndarray] = [np.zeros(0, dtype=np.uint8)] * db.nreads
-    for aread, pile in las.iter_piles():
-        rlen = db.read_length(aread)
-        ntiles = (rlen + tspace - 1) // tspace
-        cov = np.zeros(ntiles, dtype=np.int64)
-        for o in pile:
-            g0 = o.abpos // tspace
-            g1 = (max(o.aepos - 1, o.abpos)) // tspace
-            cov[g0 : g1 + 1] += 1
-        hot = cov > cov_factor * depth
-        ivals: list[int] = []
-        t = 0
-        while t < ntiles:
-            if hot[t]:
-                t0 = t
-                while t < ntiles and hot[t]:
+    payloads: list[np.ndarray] | None = None
+    if use_native and _native_ok():
+        tile_base, cov_flat = _tile_coverage_native(db, las)
+        hot_flat = cov_flat > cov_factor * depth
+        # global run extraction: a zero separator at every read boundary
+        # keeps runs from merging across reads; one diff finds all runs
+        seps = tile_base[1:-1]
+        ext = np.insert(hot_flat.astype(np.int8), seps, 0)
+        d = np.diff(np.concatenate([[0], ext, [0]]))
+        p0 = np.nonzero(d == 1)[0]          # run starts, separator space
+        p1 = np.nonzero(d == -1)[0]         # run ends (exclusive)
+        # map back: subtract the number of separators inserted before p
+        sep_pos = seps + np.arange(len(seps))   # separator indices in ext
+        t0 = p0 - np.searchsorted(sep_pos, p0)
+        t1 = p1 - np.searchsorted(sep_pos, p1)
+        rid = np.searchsorted(tile_base, t0, side="right") - 1
+        rlens = _read_lengths(db)
+        iv = np.empty((len(t0), 2), dtype=np.int64)
+        iv[:, 0] = (t0 - tile_base[rid]) * tspace
+        iv[:, 1] = np.minimum((t1 - tile_base[rid]) * tspace, rlens[rid])
+        counts = np.bincount(rid, minlength=db.nreads)
+        splits = np.split(iv, np.cumsum(counts)[:-1])
+        payloads = [np.ascontiguousarray(s).reshape(-1).view(np.uint8) for s in splits]
+    if payloads is None:
+        payloads = [np.zeros(0, dtype=np.uint8)] * db.nreads
+        for aread, pile in las.iter_piles():
+            rlen = db.read_length(aread)
+            ntiles = (rlen + tspace - 1) // tspace
+            cov = np.zeros(ntiles, dtype=np.int64)
+            for o in pile:
+                g0 = o.abpos // tspace
+                g1 = (max(o.aepos - 1, o.abpos)) // tspace
+                cov[g0 : g1 + 1] += 1
+            hot = cov > cov_factor * depth
+            ivals: list[int] = []
+            t = 0
+            while t < ntiles:
+                if hot[t]:
+                    t0 = t
+                    while t < ntiles and hot[t]:
+                        t += 1
+                    ivals.extend([t0 * tspace, min(t * tspace, rlen)])
+                else:
                     t += 1
-                ivals.extend([t0 * tspace, min(t * tspace, rlen)])
-            else:
-                t += 1
-        payloads[aread] = np.asarray(ivals, dtype=np.int64).view(np.uint8)
-    for i in range(db.nreads):
-        if payloads[i] is None:
-            payloads[i] = np.zeros(0, dtype=np.uint8)
+            payloads[aread] = np.asarray(ivals, dtype=np.int64).view(np.uint8)
     write_track(db.path, track, payloads)
     return payloads
 

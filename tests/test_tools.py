@@ -215,3 +215,31 @@ def test_daccord_block_mode(dataset, tmp_path):
 
     with pytest.raises(SystemExit):
         main(["daccord", *args, "--block", str(nb + 1)])
+
+
+def test_native_lastools_bit_parity(dataset, tmp_path):
+    """The vectorized columnar-native QV and repeat passes must be
+    bit-identical to the per-pile Python fallback."""
+    from daccord_tpu.native import available
+
+    if not available():
+        pytest.skip("native host path unavailable")
+    out, d = dataset
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    qn = lastools.compute_intrinsic_qv(db, las, depth=14, use_native=True)
+    qp = lastools.compute_intrinsic_qv(db, las, depth=14, use_native=False)
+    assert len(qn) == len(qp)
+    for a, b in zip(qn, qp):
+        assert np.array_equal(a, b)
+
+    cfg2 = SimConfig(genome_len=4000, coverage=12, read_len_mean=900,
+                     repeat_fraction=0.4, seed=23)
+    out2 = make_dataset(str(tmp_path), cfg2, name="rp")
+    db2 = read_db(out2["db"])
+    las2 = LasFile(out2["las"])
+    rn = lastools.detect_repeats(db2, las2, depth=12, cov_factor=1.8, use_native=True)
+    rp = lastools.detect_repeats(db2, las2, depth=12, cov_factor=1.8, use_native=False)
+    assert len(rn) == len(rp)
+    for a, b in zip(rn, rp):
+        assert np.array_equal(np.asarray(a, dtype=np.uint8), np.asarray(b, dtype=np.uint8))
