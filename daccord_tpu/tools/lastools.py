@@ -258,17 +258,57 @@ def filter_alignments(db: DazzDB, las: LasFile, out_path: str,
             span -= max(0, min(o.aepos, e) - max(o.abpos, s))
         return span
 
-    kept: list[Overlap] = []
-    for aread, pile in las.iter_piles():
-        prates = []
-        for o in pile:
-            alen = max(o.aepos - o.abpos, 1)
-            prates.append(float(o.trace[:, 0].sum()) / alen)
-        med = float(np.median(prates)) if prates else 0.0
-        cut = max_err if max_err is not None else max(2.0 * med, med + 0.15)
-        for o, r in zip(pile, prates):
-            if r <= cut and unique_span(aread, o) >= min_unique_span:
-                kept.append(o)
+    if _native_ok():
+        # columnar pass: per-overlap rates and per-pile medians vectorized;
+        # only overlaps on repeat-annotated reads pay the interval check
+        from ..native.api import ColumnarLas
+
+        col = ColumnarLas(las.path)
+        n = col.novl
+        rate_keep = np.zeros(n, dtype=bool)
+        if n:
+            alen = np.maximum(col.aepos.astype(np.int64) - col.abpos, 1)
+            pairs = col.trace_flat[::2]
+            if len(pairs):
+                # a zero sentinel keeps trailing empty-trace groups in range
+                # without clipping into the previous group's last element;
+                # zero-length groups (which alias the next group's first
+                # element under reduceat) are masked after
+                pairs_s = np.concatenate([pairs, [0]])
+                dsum = np.add.reduceat(pairs_s, col.trace_off[:-1] // 2)
+                dsum = np.where(np.diff(col.trace_off) > 0, dsum, 0)
+            else:
+                dsum = np.zeros(n, np.int64)
+            prates = dsum / alen
+            for p in range(len(col.pile_starts) - 1):
+                s, e = int(col.pile_starts[p]), int(col.pile_starts[p + 1])
+                med = float(np.median(prates[s:e]))
+                cut = max_err if max_err is not None else max(2.0 * med, med + 0.15)
+                rate_keep[s:e] = prates[s:e] <= cut
+            # span test: on repeat-free reads unique_span == aepos - abpos,
+            # and repeat subtraction only shrinks it, so this cut is exact
+            rate_keep &= (col.aepos.astype(np.int64) - col.abpos) >= min_unique_span
+        kept = []
+        rep_reads = ({i for i in range(len(reps)) if len(reps[i])}
+                     if reps is not None else set())
+        for i, o in enumerate(las):
+            if not rate_keep[i]:
+                continue
+            if o.aread in rep_reads and unique_span(o.aread, o) < min_unique_span:
+                continue
+            kept.append(o)
+    else:
+        kept = []
+        for aread, pile in las.iter_piles():
+            prates = []
+            for o in pile:
+                alen = max(o.aepos - o.abpos, 1)
+                prates.append(float(o.trace[:, 0].sum()) / alen)
+            med = float(np.median(prates)) if prates else 0.0
+            cut = max_err if max_err is not None else max(2.0 * med, med + 0.15)
+            for o, r in zip(pile, prates):
+                if r <= cut and unique_span(aread, o) >= min_unique_span:
+                    kept.append(o)
     write_las(out_path, tspace, kept)
     return len(kept)
 

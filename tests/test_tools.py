@@ -243,3 +243,50 @@ def test_native_lastools_bit_parity(dataset, tmp_path):
     assert len(rn) == len(rp)
     for a, b in zip(rn, rp):
         assert np.array_equal(np.asarray(a, dtype=np.uint8), np.asarray(b, dtype=np.uint8))
+
+
+def test_filter_alignments_native_parity(dataset, tmp_path, monkeypatch):
+    """Columnar-native filter must keep exactly the overlaps the Python
+    per-pile fallback keeps (with and without a repeat track)."""
+    from daccord_tpu.native import available
+
+    if not available():
+        pytest.skip("native host path unavailable")
+    out, d = dataset
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+
+    def run(native: bool, tag: str, repeat_track):
+        if not native:
+            monkeypatch.setattr(lastools, "_native_ok", lambda: False)
+        else:
+            monkeypatch.setattr(lastools, "_native_ok", lambda: True)
+        p = str(tmp_path / f"{tag}.las")
+        n = lastools.filter_alignments(db, las, p, repeat_track=repeat_track)
+        return n, open(p, "rb").read()
+
+    lastools.detect_repeats(db, las, depth=14, cov_factor=1.5)
+    for rt in (None, "rep"):
+        n1, b1 = run(True, f"n{rt}", rt)
+        n2, b2 = run(False, f"p{rt}", rt)
+        assert n1 == n2 and b1 == b2, (rt, n1, n2)
+
+    # trailing empty-trace overlap: the reduceat edge case (a trailing
+    # zero-length trace group must not truncate the previous overlap's sum)
+    import dataclasses
+
+    from daccord_tpu.formats import write_las
+
+    ovls = list(las)
+    last = ovls[-1]
+    tail = dataclasses.replace(last, trace=np.zeros((0, 2), np.int64),
+                               abpos=last.abpos, aepos=last.abpos + 120)
+    et = str(tmp_path / "et.las")
+    write_las(et, las.tspace, ovls + [tail])
+    las2 = LasFile(et)
+    monkeypatch.setattr(lastools, "_native_ok", lambda: True)
+    na = lastools.filter_alignments(db, las2, str(tmp_path / "etn.las"), repeat_track=None)
+    monkeypatch.setattr(lastools, "_native_ok", lambda: False)
+    pa = lastools.filter_alignments(db, las2, str(tmp_path / "etp.las"), repeat_track=None)
+    assert na == pa
+    assert open(str(tmp_path / "etn.las"), "rb").read() == open(str(tmp_path / "etp.las"), "rb").read()
