@@ -26,7 +26,10 @@ import numpy as np
 
 CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
 N_BENCH_WINDOWS = 32768
-BATCH = 1024
+# 2048 measured ~2x the 1024-batch throughput on the tunneled v5e (batch-size
+# sweep 2026-07-30: 1024 -> 330-459k bases/s, 2048 -> 652k): per-dispatch
+# overhead dominates single-digit-ms compute, so bigger batches amortize it
+BATCH = 2048
 DEPTH, SEG_LEN, WLEN = 32, 64, 40
 
 

@@ -42,7 +42,10 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
 
 @functools.partial(jax.jit, static_argnames=("params", "esc_cap", "mesh"))
 def _ladder_sharded(seqs, lens, nsegs, tables, params, esc_cap, mesh):
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
 
     def local(seqs, lens, nsegs, tables):
         out = ladder_core(seqs, lens, nsegs, tables, params, esc_cap)
@@ -117,3 +120,19 @@ def make_sharded_solver(ladder: TierLadder, mesh: Mesh, esc_cap: int | None = No
     for ``runtime.pipeline.correct_shard`` (which detects the async
     ``dispatch``/``fetch`` interface and pipelines batches through it)."""
     return ShardedLadderSolver(ladder, mesh, esc_cap)
+
+
+def build_sharded_solver(n_devices: int, profile, consensus_cfg,
+                         esc_cap: int | None = None) -> ShardedLadderSolver:
+    """Device-count-checked mesh solver from an error profile.
+
+    The one construction path shared by the ``daccord --mesh`` CLI and the
+    ladder bench; raises ``SystemExit`` with the off-pod recipe when fewer
+    than ``n_devices`` devices are visible."""
+    if len(jax.devices()) < n_devices:
+        raise SystemExit(
+            f"mesh {n_devices}: only {len(jax.devices())} devices visible "
+            "(off-pod: set JAX_PLATFORMS=cpu and "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    ladder = TierLadder.from_config(profile, consensus_cfg)
+    return make_sharded_solver(ladder, make_mesh(n_devices), esc_cap)

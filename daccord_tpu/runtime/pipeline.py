@@ -434,17 +434,20 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
 def correct_to_fasta(db_path: str, las_path: str, out_path, cfg: PipelineConfig | None = None,
                      start: int | None = None, end: int | None = None,
-                     profile: ErrorProfile | None = None) -> PipelineStats:
+                     profile: ErrorProfile | None = None,
+                     solver=None) -> PipelineStats:
     """Run the pipeline and write corrected fragments as FASTA (stdout with '-').
 
-    ``profile`` skips the estimation pass (reference: cached error profile)."""
+    ``profile`` skips the estimation pass (reference: cached error profile).
+    ``solver`` overrides the window solver (e.g. the mesh-sharded ladder)."""
     cfg = cfg or PipelineConfig()
     db = read_db(db_path)
     las = LasFile(las_path)
     t0 = time.time()
     stats: PipelineStats | None = None
     recs = []
-    for rid, frags, st in correct_shard(db, las, cfg, start, end, profile=profile):
+    for rid, frags, st in correct_shard(db, las, cfg, start, end, profile=profile,
+                                        solver=solver):
         stats = st
         for fi, f in enumerate(frags):
             recs.append(FastaRecord(f"read{rid}/{fi}", ints_to_seq(f)))

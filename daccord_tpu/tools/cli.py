@@ -70,6 +70,9 @@ def daccord_main(argv=None) -> int:
                    help="device backend (SURVEY.md §5 config row); 'cpu' forces the "
                         "host platform before any backend init — the only reliable "
                         "override under this image's axon plugin")
+    p.add_argument("--mesh", type=int, default=0, metavar="N",
+                   help="shard window batches over the first N local devices "
+                        "(shard_map data parallelism; 0/1 = single device)")
     p.add_argument("--block", type=int, default=None, metavar="I",
                    help="process only DB block I (1-based, after db-split; the "
                         "reference's per-block workflow). Mutually exclusive with -J")
@@ -135,15 +138,25 @@ def daccord_main(argv=None) -> int:
                   file=sys.stderr)
             return 0
 
+    solver = None
+    if args.mesh > 1:
+        from ..parallel.mesh import build_sharded_solver
+        from ..runtime.pipeline import estimate_profile_for_shard
+
+        if prof is None:
+            prof = estimate_profile_for_shard(read_db(args.db), LasFile(args.las),
+                                              cfg, start, end)
+        solver = build_sharded_solver(args.mesh, prof, cfg.consensus)
+
     if args.profile:
         import jax
 
         with jax.profiler.trace(args.profile):
             stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
-                                     end=end, profile=prof)
+                                     end=end, profile=prof, solver=solver)
     else:
         stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
-                                 end=end, profile=prof)
+                                 end=end, profile=prof, solver=solver)
     line = {
         "reads": stats.n_reads, "windows": stats.n_windows, "solved": stats.n_solved,
         "fragments": stats.n_fragments, "bases_in": stats.bases_in,

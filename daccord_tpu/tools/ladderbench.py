@@ -1,0 +1,183 @@
+"""Measurement-ladder bench: end-to-end runs shaped like BASELINE.md's configs.
+
+BASELINE.md defines a five-config measurement ladder (E. coli 25x parity ->
+CHM13 WGS multi-host). The real datasets need DALIGNER + genome downloads that
+this sealed environment cannot reach, so each rung is represented by a
+synthetic dataset with the same *shape* (coverage, read length regime, error
+profile), scaled to what the host can feed in minutes. Every run goes through
+the production CLI path (``correct_to_fasta``) and is scored with the qv-eval
+harness; one JSON line per rung.
+
+Rungs:
+  cfg1  25x PacBio-like, oracle-vs-kernel parity regime (small, CPU ok)
+  cfg2  100x PacBio-like single chip (the "first bases/sec/chip" rung)
+  cfg3  80x multi-contig over an 8-device mesh (virtual CPU mesh when only
+        one real chip is visible; exercises the sharded solver end to end)
+
+Usage: ``python -m daccord_tpu.tools.ladderbench [--configs cfg1,cfg2,cfg3]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+CACHE = os.path.join(REPO, ".bench_cache")
+
+
+def _dataset(name: str, **kw) -> dict:
+    """Build (or reuse) a cached synthetic dataset; returns its file paths.
+
+    The cache is keyed on the sim parameters (config.json comparison), so
+    editing a rung's sim_kw invalidates the old dataset instead of silently
+    reusing it."""
+    from dataclasses import asdict
+
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    cfg = SimConfig(**kw)
+    d = os.path.join(CACHE, f"ladder_{name}")
+    paths = {k: os.path.join(d, f"{name}.{ext}")
+             for k, ext in (("db", "db"), ("las", "las"), ("truth", "truth.npz"))}
+    cfg_json = os.path.join(d, f"{name}.config.json")
+    if all(os.path.exists(p) for p in paths.values()) and os.path.exists(cfg_json):
+        with open(cfg_json) as fh:
+            if json.load(fh) == asdict(cfg):
+                return paths
+        import shutil
+
+        shutil.rmtree(d)
+    out = make_dataset(d, cfg, name=name)
+    return {k: out[k] for k in ("db", "las", "truth")}
+
+
+def _qveval(fasta: str, truth: str, raw_db: str) -> dict:
+    from daccord_tpu.tools.cli import qveval_main
+
+    with tempfile.NamedTemporaryFile("rt", suffix=".json", delete=False) as fh:
+        path = fh.name
+    try:
+        rc = qveval_main([fasta, truth, "--raw-db", raw_db, "--json", path])
+        assert rc == 0
+        with open(path) as fh2:
+            return json.load(fh2)
+    finally:
+        os.unlink(path)
+
+
+def run_rung(name: str, sim_kw: dict, feeder_threads: int = 0,
+             mesh: int = 0) -> dict:
+    """One ladder rung through the production pipeline; returns the JSON row."""
+    import jax
+
+    from daccord_tpu.runtime.pipeline import PipelineConfig, correct_to_fasta
+    from daccord_tpu.utils.obs import enable_compilation_cache
+
+    enable_compilation_cache()
+    paths = _dataset(name, **sim_kw)
+    cfg = PipelineConfig(feeder_threads=feeder_threads)
+    out_fa = os.path.join(CACHE, f"ladder_{name}", "corrected.fasta")
+
+    # profile estimation runs OUTSIDE the timed window for every rung, so
+    # bases_out_per_s measures the correction pipeline symmetrically
+    from daccord_tpu.formats.dazzdb import read_db
+    from daccord_tpu.formats.las import LasFile
+    from daccord_tpu.runtime.pipeline import estimate_profile_for_shard
+
+    prof = estimate_profile_for_shard(read_db(paths["db"]),
+                                      LasFile(paths["las"]), cfg)
+    solver = None
+    if mesh > 1:
+        from daccord_tpu.parallel.mesh import build_sharded_solver
+
+        solver = build_sharded_solver(mesh, prof, cfg.consensus)
+    t0 = time.perf_counter()
+    stats = correct_to_fasta(paths["db"], paths["las"], out_fa, cfg,
+                             profile=prof, solver=solver)
+    wall = time.perf_counter() - t0
+
+    q = _qveval(out_fa, paths["truth"], paths["db"])
+    return {
+        "rung": name, "devices": mesh if mesh > 1 else 1,
+        "backend": jax.default_backend(),
+        "device0": str(jax.devices()[0]).replace(" ", ""),
+        "reads": stats.n_reads, "windows": stats.n_windows,
+        "solve_rate": round(stats.n_solved / max(stats.n_windows, 1), 4),
+        "bases_in": stats.bases_in, "bases_out": stats.bases_out,
+        "wall_s": round(wall, 2), "device_s": round(stats.device_s, 3),
+        "bases_out_per_s": round(stats.bases_out / wall, 1),
+        "pad_waste": round(stats.pad_waste, 4),
+        "q_raw": q.get("raw_qscore"), "q_corrected": q.get("qscore"),
+        "delta_q": q.get("delta_q"),
+    }
+
+
+RUNGS = {
+    # 25x PacBio-like: the oracle-parity regime (BASELINE ladder config 1)
+    "cfg1": dict(sim_kw=dict(genome_len=20_000, coverage=25, read_len_mean=4_000,
+                             seed=11)),
+    # 100x PacBio-like: single-chip throughput rung (config 2)
+    "cfg2": dict(sim_kw=dict(genome_len=50_000, coverage=100, read_len_mean=8_000,
+                             seed=12)),
+    # 80x over an 8-device mesh (config 3; virtual CPU mesh off-pod)
+    "cfg3": dict(sim_kw=dict(genome_len=30_000, coverage=80, read_len_mean=6_000,
+                             repeat_fraction=0.05, seed=13), mesh=8),
+}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--configs", default="cfg1,cfg2,cfg3")
+    p.add_argument("--threads", type=int, default=0, help="feeder threads")
+    p.add_argument("--inner", default=None, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.inner:  # subprocess re-entry with the virtual device count set
+        r = RUNGS[args.inner]
+        row = run_rung(args.inner, r["sim_kw"], feeder_threads=args.threads,
+                       mesh=r.get("mesh", 0))
+        print(json.dumps(row))
+        return 0
+
+    names = args.configs.split(",")
+    unknown = [n for n in names if n not in RUNGS]
+    if unknown:
+        p.error(f"unknown configs {unknown}; valid: {', '.join(RUNGS)}")
+
+    import jax
+
+    for name in names:
+        r = RUNGS[name]
+        mesh = r.get("mesh", 0)
+        if mesh > 1 and len(jax.devices()) < mesh:
+            # not enough real devices: force a virtual CPU platform of the
+            # right size in a fresh interpreter (device counts are sticky
+            # once any backend has initialized)
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                                  f" --xla_force_host_platform_device_count={mesh}"))
+            proc = subprocess.run([sys.executable, "-m",
+                                   "daccord_tpu.tools.ladderbench",
+                                   "--inner", name, "--threads", str(args.threads)],
+                                  env=env, cwd=REPO, capture_output=True, text=True)
+            out = (proc.stdout or "").strip().splitlines()
+            if proc.returncode != 0 or not out:
+                print(json.dumps({"rung": name, "error": proc.returncode,
+                                  "stderr": proc.stderr[-400:]}))
+                continue
+            print(out[-1])
+        else:
+            row = run_rung(name, r["sim_kw"], feeder_threads=args.threads,
+                           mesh=mesh)
+            print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

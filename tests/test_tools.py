@@ -290,3 +290,19 @@ def test_filter_alignments_native_parity(dataset, tmp_path, monkeypatch):
     pa = lastools.filter_alignments(db, las2, str(tmp_path / "etp.las"), repeat_track=None)
     assert na == pa
     assert open(str(tmp_path / "etn.las"), "rb").read() == open(str(tmp_path / "etp.las"), "rb").read()
+
+
+def test_daccord_mesh_cli(dataset, tmp_path):
+    """--mesh 8 (shard_map over the virtual CPU mesh) is byte-identical to the
+    single-device run under a shared error profile."""
+    from daccord_tpu.tools.cli import main
+
+    out, d = dataset
+    ep = str(tmp_path / "m.eprof")
+    args = [out["db"], out["las"], "--backend", "cpu", "-b", "64", "-E", ep]
+    assert main(["daccord", *args, "--eprof-only"]) == 0
+    single = str(tmp_path / "single.fasta")
+    meshed = str(tmp_path / "meshed.fasta")
+    assert main(["daccord", *args, "-o", single]) == 0
+    assert main(["daccord", *args, "-o", meshed, "--mesh", "8"]) == 0
+    assert open(meshed).read() == open(single).read()
