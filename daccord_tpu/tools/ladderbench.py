@@ -138,10 +138,20 @@ def main(argv=None) -> int:
     p.add_argument("--inner", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
-    if args.inner:  # subprocess re-entry with the virtual device count set
+    if args.inner:  # subprocess re-entry: force a virtual CPU mesh pre-init
         r = RUNGS[args.inner]
+        mesh = r.get("mesh", 0)
+        if mesh > 1:
+            # this image's TPU plugin overrides JAX_PLATFORMS from
+            # sitecustomize, so env vars are NOT enough: the config update
+            # must land before any backend init (same dance as
+            # __graft_entry__.dryrun_multichip)
+            import jax
+
+            jax.config.update("jax_num_cpu_devices", mesh)
+            jax.config.update("jax_platforms", "cpu")
         row = run_rung(args.inner, r["sim_kw"], feeder_threads=args.threads,
-                       mesh=r.get("mesh", 0))
+                       mesh=mesh)
         print(json.dumps(row))
         return 0
 
@@ -156,16 +166,15 @@ def main(argv=None) -> int:
         r = RUNGS[name]
         mesh = r.get("mesh", 0)
         if mesh > 1 and len(jax.devices()) < mesh:
-            # not enough real devices: force a virtual CPU platform of the
-            # right size in a fresh interpreter (device counts are sticky
-            # once any backend has initialized)
-            env = dict(os.environ, JAX_PLATFORMS="cpu",
-                       XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
-                                  f" --xla_force_host_platform_device_count={mesh}"))
+            # not enough real devices: re-enter in a fresh interpreter, where
+            # the --inner path forces a virtual CPU platform of the right
+            # size via jax.config.update BEFORE backend init (env vars are
+            # overridden by this image's TPU plugin; device counts are
+            # sticky once any backend has initialized)
             proc = subprocess.run([sys.executable, "-m",
                                    "daccord_tpu.tools.ladderbench",
                                    "--inner", name, "--threads", str(args.threads)],
-                                  env=env, cwd=REPO, capture_output=True, text=True)
+                                  cwd=REPO, capture_output=True, text=True)
             out = (proc.stdout or "").strip().splitlines()
             if proc.returncode != 0 or not out:
                 print(json.dumps({"rung": name, "error": proc.returncode,
