@@ -22,7 +22,8 @@ import numpy as np
 from ..oracle.consensus import ConsensusConfig
 from ..oracle.profile import ErrorProfile, OffsetLikely
 from .tensorize import WindowBatch
-from .window_kernel import KernelParams, _solve_one, solve_window_batch
+from .window_kernel import (KernelParams, _solve_one, solve_batch_pallas_core,
+                            solve_window_batch)
 
 
 @dataclass
@@ -59,8 +60,22 @@ class TierLadder:
         return cls(params=params, tables=tables)
 
 
+def _solve_batch(seqs, lens, nsegs, table, p: KernelParams, use_pallas: bool,
+                 interpret: bool = False):
+    """One tier over a batch: vmap/scan formulation or the Pallas-DP path.
+
+    ``interpret`` runs the Pallas kernel in interpret mode so the full ladder
+    (escalation tiers included) is parity-testable off-TPU."""
+    if use_pallas:
+        return solve_batch_pallas_core(seqs, lens, nsegs, table, p,
+                                       interpret=interpret)
+    return jax.vmap(functools.partial(_solve_one, p=p),
+                    in_axes=(0, 0, 0, None))(seqs, lens, nsegs, table)
+
+
 def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ...],
-                esc_cap: int):
+                esc_cap: int, use_pallas: bool = False,
+                pallas_interpret: bool = False):
     """Full escalation ladder as one traceable program.
 
     ``tables[i]`` is the OffsetLikely table for ``params[i]``. Failures of
@@ -68,10 +83,13 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
     through the remaining tiers with already-solved slots depth-masked; results
     scatter back. Failures beyond ``esc_cap`` stay unsolved (reported via
     ``esc_overflow``; cap generously — tier-0 failure rate is <10%).
+
+    ``use_pallas`` routes every tier's heaviest-path DP through the Pallas
+    kernel (TPU only; semantics bit-identical, tests/test_pallas.py).
     """
     p0 = params[0]
-    out0 = jax.vmap(functools.partial(_solve_one, p=p0),
-                    in_axes=(0, 0, 0, None))(seqs, lens, nsegs, tables[0])
+    out0 = _solve_batch(seqs, lens, nsegs, tables[0], p0, use_pallas,
+                        pallas_interpret)
     solved = out0["solved"]
     cons = out0["cons"]
     cons_len = out0["cons_len"]
@@ -100,9 +118,8 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
             e_tier = jnp.full(E, -1, dtype=jnp.int32)
             for ti in range(1, len(params)):
                 p = params[ti]
-                out_t = jax.vmap(functools.partial(_solve_one, p=p),
-                                 in_axes=(0, 0, 0, None))(
-                    sseqs, slens, jnp.where(e_solved, 0, snsegs), tables[ti])
+                out_t = _solve_batch(sseqs, slens, jnp.where(e_solved, 0, snsegs),
+                                     tables[ti], p, use_pallas, pallas_interpret)
                 take = live & out_t["solved"] & ~e_solved
                 e_cons = jnp.where(take[:, None], out_t["cons"], e_cons)
                 e_len = jnp.where(take, out_t["cons_len"], e_len)
@@ -129,9 +146,13 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
                 esc_overflow=overflow)
 
 
-@functools.partial(jax.jit, static_argnames=("params", "esc_cap"))
-def _ladder_jit(seqs, lens, nsegs, tables, params, esc_cap):
-    return ladder_core(seqs, lens, nsegs, tables, params, esc_cap)
+@functools.partial(jax.jit,
+                   static_argnames=("params", "esc_cap", "use_pallas",
+                                    "pallas_interpret"))
+def _ladder_jit(seqs, lens, nsegs, tables, params, esc_cap, use_pallas=False,
+                pallas_interpret=False):
+    return ladder_core(seqs, lens, nsegs, tables, params, esc_cap, use_pallas,
+                       pallas_interpret)
 
 
 def pack_result(out: dict) -> jnp.ndarray:
@@ -177,9 +198,13 @@ def unpack_result(arr: np.ndarray, cons_len_cl: int) -> dict:
                 tier=tier, esc_overflow=overflow)
 
 
-@functools.partial(jax.jit, static_argnames=("params", "esc_cap"))
-def _ladder_packed_jit(seqs, lens, nsegs, tables, params, esc_cap):
-    return pack_result(ladder_core(seqs, lens, nsegs, tables, params, esc_cap))
+@functools.partial(jax.jit,
+                   static_argnames=("params", "esc_cap", "use_pallas",
+                                    "pallas_interpret"))
+def _ladder_packed_jit(seqs, lens, nsegs, tables, params, esc_cap,
+                       use_pallas=False, pallas_interpret=False):
+    return pack_result(ladder_core(seqs, lens, nsegs, tables, params, esc_cap,
+                                   use_pallas, pallas_interpret))
 
 
 class _PackedHandle:
@@ -193,7 +218,8 @@ class _PackedHandle:
 
 
 def solve_ladder_async(batch: WindowBatch, ladder: TierLadder,
-                       esc_cap: int | None = None):
+                       esc_cap: int | None = None, use_pallas: bool = False,
+                       pallas_interpret: bool = False):
     """Dispatch the full ladder; returns a handle without blocking.
 
     Pair with :func:`fetch` — the pipeline keeps a couple of batches in flight
@@ -210,7 +236,8 @@ def solve_ladder_async(batch: WindowBatch, ladder: TierLadder,
     tables = tuple(ladder.tables[p.k] for p in ladder.params)
     arr = _ladder_packed_jit(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
                              jnp.asarray(batch.nsegs), tables,
-                             tuple(ladder.params), esc_cap)
+                             tuple(ladder.params), esc_cap, use_pallas,
+                             pallas_interpret)
     return _PackedHandle(arr, ladder.params[0].cons_len)
 
 
@@ -223,9 +250,11 @@ def fetch(out) -> dict:
 
 
 def solve_ladder(batch: WindowBatch, ladder: TierLadder,
-                 esc_cap: int | None = None) -> dict:
+                 esc_cap: int | None = None, use_pallas: bool = False,
+                 pallas_interpret: bool = False) -> dict:
     """Single-dispatch full-ladder solve; host numpy results."""
-    return fetch(solve_ladder_async(batch, ladder, esc_cap))
+    return fetch(solve_ladder_async(batch, ladder, esc_cap, use_pallas,
+                                    pallas_interpret))
 
 
 def solve_tiered(batch: WindowBatch, ladder: TierLadder,

@@ -221,9 +221,13 @@ def _edit_distance_myers(cand: jnp.ndarray, cand_len: jnp.ndarray,
     return jnp.where(cand_len == 0, seg_len, outs[seg_len])
 
 
-def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
-               ol: jnp.ndarray, p: KernelParams):
-    """Solve one window. seqs [D, L] int8, lens [D] i32, ol [P, O] f32."""
+def _prep_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
+              ol: jnp.ndarray, p: KernelParams) -> dict:
+    """Graph construction for one window: k-mer counting/compaction, (k,k+1)
+    edge support, OffsetLikely position weights, source/sink anchors.
+
+    Split from the path DP + candidate stages so the DP can run either as the
+    in-vmap lax.scan or as the batch-wide Pallas kernel (pallas_dp)."""
     k, M = p.k, p.max_kmers
     D, L = seqs.shape
     npos = L - k + 1
@@ -278,10 +282,20 @@ def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
     adj = (compat & (support >= p.edge_min_count)
            & sel_valid[:, None] & sel_valid[None, :])
 
-    # ---- position weights + heaviest-path DP ---------------------------
+    # ---- position weights ----------------------------------------------
     W = occ @ ol.T                                        # [M, P]
     adjW = jnp.where(adj, jnp.float32(0), NEG)
     score0 = jnp.where(src_ok & sel_valid, W[:, 0], NEG)
+    return dict(sel=sel, adjW=adjW, W=W, score0=score0, snk_ok=snk_ok)
+
+
+def _dp_scan_one(adjW: jnp.ndarray, W: jnp.ndarray, score0: jnp.ndarray):
+    """Heaviest-path max-plus DP for one window (lax.scan formulation).
+
+    Semantically identical to ``pallas_dp.heaviest_path_batch`` (bit-parity
+    enforced in tests/test_pallas.py); W is [M, P]."""
+    P = W.shape[1]
+    M = adjW.shape[0]
 
     def step(s_prev, t):
         cand = s_prev[:, None] + adjW                     # [u, v]
@@ -293,6 +307,15 @@ def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
     _, (scores_rest, ptrs_rest) = jax.lax.scan(step, score0, jnp.arange(1, P))
     scores = jnp.concatenate([score0[None], scores_rest])  # [P, M]
     ptrs = jnp.concatenate([jnp.zeros((1, M), jnp.int32), ptrs_rest])
+    return scores, ptrs
+
+
+def _finish_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
+                scores: jnp.ndarray, ptrs: jnp.ndarray, sel: jnp.ndarray,
+                snk_ok: jnp.ndarray, p: KernelParams):
+    """Candidate extraction + rescore for one window, given the DP result."""
+    k, M = p.k, p.max_kmers
+    P = scores.shape[0]
 
     t_lo = max(0, p.wlen - k - p.len_slack)
     t_hi = min(P - 1, p.wlen - k + p.len_slack)
@@ -371,10 +394,44 @@ def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
                 solved=solved)
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
+def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
+               ol: jnp.ndarray, p: KernelParams):
+    """Solve one window. seqs [D, L] int8, lens [D] i32, ol [P, O] f32."""
+    g = _prep_one(seqs, lens, nsegs, ol, p)
+    scores, ptrs = _dp_scan_one(g["adjW"], g["W"], g["score0"])
+    return _finish_one(seqs, lens, nsegs, scores, ptrs, g["sel"], g["snk_ok"], p)
+
+
+def solve_batch_pallas_core(seqs, lens, nsegs, ol, p: KernelParams,
+                            interpret: bool = False):
+    """Batch solve with the heaviest-path DP as the Pallas TPU kernel.
+
+    Same contract (and bitwise the same results, enforced by
+    tests/test_pallas.py) as ``vmap(_solve_one)``: graph construction and
+    candidate stages run vmapped, the P-step max-plus recurrence runs as one
+    ``pallas_dp.heaviest_path_batch`` call with all DP state in VMEM."""
+    from .pallas_dp import heaviest_path_batch
+
+    g = jax.vmap(functools.partial(_prep_one, p=p),
+                 in_axes=(0, 0, 0, None))(seqs, lens, nsegs, ol)
+    wt = jnp.transpose(g["W"], (0, 2, 1))                 # [B, P, M]
+    scores, ptrs = heaviest_path_batch(g["adjW"], wt, g["score0"],
+                                       interpret=interpret)
+    return jax.vmap(functools.partial(_finish_one, p=p))(
+        seqs, lens, nsegs, scores, ptrs, g["sel"], g["snk_ok"])
+
+
+@functools.partial(jax.jit, static_argnames=("params", "use_pallas", "interpret"))
 def solve_window_batch(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
-                       ol: jnp.ndarray, params: KernelParams):
+                       ol: jnp.ndarray, params: KernelParams,
+                       use_pallas: bool = False, interpret: bool = False):
     """Solve a batch: seqs [B,D,L] int8, lens [B,D] i32, nsegs [B] i32,
-    ol [P,O] f32 (the OffsetLikely table for params.k)."""
+    ol [P,O] f32 (the OffsetLikely table for params.k).
+
+    ``use_pallas`` routes the heaviest-path DP through the Pallas kernel
+    (``interpret=True`` for off-TPU parity runs)."""
+    if use_pallas:
+        return solve_batch_pallas_core(seqs, lens, nsegs, ol, params,
+                                       interpret=interpret)
     fn = functools.partial(_solve_one, p=params)
     return jax.vmap(fn, in_axes=(0, 0, 0, None))(seqs, lens, nsegs, ol)

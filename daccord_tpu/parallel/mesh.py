@@ -40,15 +40,24 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), axis_names=("d",))
 
 
-@functools.partial(jax.jit, static_argnames=("params", "esc_cap", "mesh"))
-def _ladder_sharded(seqs, lens, nsegs, tables, params, esc_cap, mesh):
+@functools.partial(jax.jit,
+                   static_argnames=("params", "esc_cap", "mesh", "use_pallas",
+                                    "pallas_interpret"))
+def _ladder_sharded(seqs, lens, nsegs, tables, params, esc_cap, mesh,
+                    use_pallas=False, pallas_interpret=False):
+    # pallas_call's out_shape carries no varying-axes info, so the vma check
+    # must be off when the ladder routes its DP through the Pallas kernel
+    # (the pre-0.8 fallback spells the same knob check_rep)
     try:
         from jax import shard_map  # jax >= 0.8
+        vma_kw = {"check_vma": not use_pallas}
     except ImportError:  # pragma: no cover - older jax
         from jax.experimental.shard_map import shard_map
+        vma_kw = {"check_rep": not use_pallas}
 
     def local(seqs, lens, nsegs, tables):
-        out = ladder_core(seqs, lens, nsegs, tables, params, esc_cap)
+        out = ladder_core(seqs, lens, nsegs, tables, params, esc_cap,
+                          use_pallas, pallas_interpret)
         out["esc_overflow"] = jax.lax.psum(out["esc_overflow"], "d")
         return out
 
@@ -56,19 +65,24 @@ def _ladder_sharded(seqs, lens, nsegs, tables, params, esc_cap, mesh):
                    in_specs=(P("d"), P("d"), P("d"), P()),
                    out_specs={"cons": P("d"), "cons_len": P("d"), "err": P("d"),
                               "solved": P("d"), "tier": P("d"),
-                              "esc_overflow": P()})
+                              "esc_overflow": P()},
+                   **vma_kw)
     return fn(seqs, lens, nsegs, tables)
 
 
-@functools.partial(jax.jit, static_argnames=("params", "esc_cap", "mesh"))
-def _ladder_sharded_packed(seqs, lens, nsegs, tables, params, esc_cap, mesh):
+@functools.partial(jax.jit,
+                   static_argnames=("params", "esc_cap", "mesh", "use_pallas",
+                                    "pallas_interpret"))
+def _ladder_sharded_packed(seqs, lens, nsegs, tables, params, esc_cap, mesh,
+                           use_pallas=False, pallas_interpret=False):
     from ..kernels.tiers import pack_result
 
     # pack OUTSIDE shard_map, inside the same jit (nested jit inlines): the
     # packing ops are elementwise along the sharded batch axis, so XLA keeps
     # them local to each device and the result crosses as ONE array
     return pack_result(_ladder_sharded(
-        seqs, lens, nsegs, tables, params, esc_cap, mesh))
+        seqs, lens, nsegs, tables, params, esc_cap, mesh, use_pallas,
+        pallas_interpret))
 
 
 class ShardedLadderSolver:
@@ -77,13 +91,16 @@ class ShardedLadderSolver:
     single-device path in ``kernels.tiers``). Calling the object directly is
     the blocking convenience form used by tests and the dry run."""
 
-    def __init__(self, ladder: TierLadder, mesh: Mesh, esc_cap: int | None = None):
+    def __init__(self, ladder: TierLadder, mesh: Mesh, esc_cap: int | None = None,
+                 use_pallas: bool = False, pallas_interpret: bool = False):
         self.mesh = mesh
         self.nd = mesh.devices.size
         self.sharding = NamedSharding(mesh, P("d"))
         self.tables = tuple(ladder.tables[p.k] for p in ladder.params)
         self.params = tuple(ladder.params)
         self.esc_cap = esc_cap   # None = full per-device slice (no overflow)
+        self.use_pallas = use_pallas
+        self.pallas_interpret = pallas_interpret
         self.cl = ladder.params[0].cons_len
 
     def dispatch(self, batch: WindowBatch):
@@ -98,7 +115,8 @@ class ShardedLadderSolver:
             jax.device_put(jnp.asarray(batch.lens), self.sharding),
             jax.device_put(jnp.asarray(batch.nsegs), self.sharding),
             self.tables, params=self.params, esc_cap=esc_cap,
-            mesh=self.mesh)
+            mesh=self.mesh, use_pallas=self.use_pallas,
+            pallas_interpret=self.pallas_interpret)
         return (_PackedHandle(arr, self.cl), B0)
 
     def fetch(self, handle) -> dict:
@@ -113,17 +131,19 @@ class ShardedLadderSolver:
         return self.fetch(self.dispatch(batch))
 
 
-def make_sharded_solver(ladder: TierLadder, mesh: Mesh, esc_cap: int | None = None):
+def make_sharded_solver(ladder: TierLadder, mesh: Mesh, esc_cap: int | None = None,
+                        use_pallas: bool = False, pallas_interpret: bool = False):
     """WindowBatch -> results dict, the full ladder sharded over the mesh.
 
     ``esc_cap`` is the per-device escalation capacity. A drop-in ``solver``
     for ``runtime.pipeline.correct_shard`` (which detects the async
     ``dispatch``/``fetch`` interface and pipelines batches through it)."""
-    return ShardedLadderSolver(ladder, mesh, esc_cap)
+    return ShardedLadderSolver(ladder, mesh, esc_cap, use_pallas, pallas_interpret)
 
 
 def build_sharded_solver(n_devices: int, profile, consensus_cfg,
-                         esc_cap: int | None = None) -> ShardedLadderSolver:
+                         esc_cap: int | None = None,
+                         use_pallas: bool = False) -> ShardedLadderSolver:
     """Device-count-checked mesh solver from an error profile.
 
     The one construction path shared by the ``daccord --mesh`` CLI and the
@@ -135,4 +155,8 @@ def build_sharded_solver(n_devices: int, profile, consensus_cfg,
             "(off-pod: set JAX_PLATFORMS=cpu and "
             "XLA_FLAGS=--xla_force_host_platform_device_count=N)")
     ladder = TierLadder.from_config(profile, consensus_cfg)
-    return make_sharded_solver(ladder, make_mesh(n_devices), esc_cap)
+    # off-TPU backends can't Mosaic-lower the kernel; run it in interpret mode
+    # (bit-identical, slow — fine for the virtual-mesh validation path)
+    interpret = use_pallas and jax.default_backend() != "tpu"
+    return make_sharded_solver(ladder, make_mesh(n_devices), esc_cap,
+                               use_pallas=use_pallas, pallas_interpret=interpret)

@@ -63,6 +63,10 @@ class PipelineConfig:
                                  # all fit go to a narrower batch — exact, like
                                  # depth buckets, but multiplies compile count;
                                  # off by default until measured on hardware
+    use_pallas: bool = False     # route the heaviest-path DP through the
+                                 # Pallas TPU kernel (pallas_dp); bit-identical
+                                 # results (tests/test_pallas.py), TPU only —
+                                 # ignored on the CPU solve_tiered path
     log_path: str | None = None  # jsonl event log ('-' = stderr)
     verbose: bool = False
 
@@ -231,6 +235,10 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             # (cheap syncs; right trade-off for local CPU execution)
             from ..kernels.tiers import solve_tiered
 
+            if cfg.use_pallas:
+                print("daccord: --pallas has no effect on the CPU host-routed "
+                      "ladder (scan path used); use the tpu backend or --mesh",
+                      file=sys.stderr)
             dispatch_fn, fetch_fn = (lambda b: solve_tiered(b, ladder)), (lambda h: h)
         else:
             # async device ladder: one dispatch per batch, fetched a batch
@@ -239,7 +247,12 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             # is structurally impossible)
             from ..kernels.tiers import fetch as _fetch, solve_ladder_async
 
-            dispatch_fn, fetch_fn = (lambda b: solve_ladder_async(b, ladder)), _fetch
+            # non-TPU device backends can't Mosaic-lower the Pallas kernel;
+            # interpret mode keeps the flag honest (bit-identical, slow)
+            interp = cfg.use_pallas and jax.default_backend() != "tpu"
+            dispatch_fn = (lambda b: solve_ladder_async(
+                b, ladder, use_pallas=cfg.use_pallas, pallas_interpret=interp))
+            fetch_fn = _fetch
 
     try:
         from ..native import available as native_available

@@ -70,6 +70,9 @@ def daccord_main(argv=None) -> int:
                    help="device backend (SURVEY.md §5 config row); 'cpu' forces the "
                         "host platform before any backend init — the only reliable "
                         "override under this image's axon plugin")
+    p.add_argument("--pallas", action="store_true",
+                   help="run the heaviest-path DP as the Pallas TPU kernel "
+                        "(bit-identical results; TPU backend only)")
     p.add_argument("--mesh", type=int, default=0, metavar="N",
                    help="shard window batches over the first N local devices "
                         "(shard_map data parallelism; 0/1 = single device)")
@@ -113,7 +116,7 @@ def daccord_main(argv=None) -> int:
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
                          depth=args.depth, seg_len=args.seg_len,
                          log_path=args.log, use_native=not args.no_native,
-                         feeder_threads=args.threads)
+                         feeder_threads=args.threads, use_pallas=args.pallas)
 
     import os
 
@@ -146,7 +149,8 @@ def daccord_main(argv=None) -> int:
         if prof is None:
             prof = estimate_profile_for_shard(read_db(args.db), LasFile(args.las),
                                               cfg, start, end)
-        solver = build_sharded_solver(args.mesh, prof, cfg.consensus)
+        solver = build_sharded_solver(args.mesh, prof, cfg.consensus,
+                                      use_pallas=args.pallas)
 
     if args.profile:
         import jax

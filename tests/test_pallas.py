@@ -48,3 +48,85 @@ def test_pallas_dp_matches_scan():
                                        jnp.asarray(s0), interpret=True)
     np.testing.assert_array_equal(np.asarray(ref_s), np.asarray(pal_s))
     np.testing.assert_array_equal(np.asarray(ref_p), np.asarray(pal_p))
+
+
+def test_pallas_full_solver_parity():
+    """The full batched solver with the DP routed through the Pallas kernel
+    (interpret mode off-TPU) is bitwise identical to the vmap/scan path."""
+    import jax.numpy as jnp
+
+    from daccord_tpu.kernels.window_kernel import KernelParams, solve_window_batch
+    from daccord_tpu.oracle.profile import ErrorProfile, OffsetLikely
+
+    rng = np.random.default_rng(3)
+    p = KernelParams(k=8, wlen=40, max_kmers=32)
+    prof = ErrorProfile(p_ins=0.08, p_del=0.04, p_sub=0.015)
+    ol = jnp.asarray(OffsetLikely(prof, positions=p.positions, max_offset=56).table)
+
+    B, D, L, wlen = 16, 12, 64, 40
+    true = rng.integers(0, 4, (B, wlen)).astype(np.int8)
+    seqs = np.full((B, D, L), 4, dtype=np.int8)
+    lens = np.zeros((B, D), dtype=np.int32)
+    for b in range(B):
+        for d in range(D):
+            s = true[b].copy()
+            for _ in range(3):
+                s[rng.integers(0, wlen)] = rng.integers(0, 4)
+            seqs[b, d, :wlen] = s
+            lens[b, d] = wlen
+    nsegs = np.full(B, D, dtype=np.int32)
+    args = (jnp.asarray(seqs), jnp.asarray(lens), jnp.asarray(nsegs), ol)
+
+    ref = solve_window_batch(*args, params=p)
+    pal = solve_window_batch(*args, params=p, use_pallas=True, interpret=True)
+    assert bool(np.asarray(ref["solved"]).any())
+    for key in ("cons", "cons_len", "err", "solved"):
+        np.testing.assert_array_equal(np.asarray(ref[key]), np.asarray(pal[key]))
+
+
+def test_pallas_ladder_and_mesh_parity():
+    """The full escalation ladder — and the mesh-sharded ladder — with the
+    Pallas DP (interpret mode off-TPU) match the scan-path ladder bitwise,
+    including rescue tiers driven by depth-masked compacted sub-batches."""
+    import jax.numpy as jnp
+
+    from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch
+    from daccord_tpu.kernels.tiers import TierLadder, solve_ladder
+    from daccord_tpu.oracle.consensus import ConsensusConfig
+    from daccord_tpu.parallel.mesh import make_mesh, make_sharded_solver
+    from daccord_tpu.oracle.profile import ErrorProfile
+
+    rng = np.random.default_rng(5)
+    ccfg = ConsensusConfig()
+    prof = ErrorProfile(p_ins=0.08, p_del=0.04, p_sub=0.015)
+    ladder = TierLadder.from_config(prof, ccfg, max_kmers=32, rescue_max_kmers=64)
+
+    B, D, L, wlen = 16, 8, 64, ccfg.w
+    seqs = np.full((B, D, L), 4, dtype=np.int8)
+    lens = np.zeros((B, D), dtype=np.int32)
+    for b in range(B):
+        true = rng.integers(0, 4, wlen).astype(np.int8)
+        # a couple of low-depth windows force tier escalation
+        depth = 3 if b % 5 == 0 else D
+        for d in range(depth):
+            s = true.copy()
+            for _ in range(4):
+                s[rng.integers(0, wlen)] = rng.integers(0, 4)
+            seqs[b, d, :wlen] = s
+            lens[b, d] = wlen
+    nsegs = (lens > 0).sum(axis=1).astype(np.int32)
+    batch = WindowBatch(seqs=seqs, lens=lens, nsegs=nsegs,
+                        shape=BatchShape(depth=D, seg_len=L, wlen=wlen),
+                        read_ids=np.zeros(B, np.int64),
+                        wstarts=np.zeros(B, np.int64))
+
+    ref = solve_ladder(batch, ladder)
+    pal = solve_ladder(batch, ladder, use_pallas=True, pallas_interpret=True)
+    for key in ("cons", "cons_len", "err", "solved", "tier"):
+        np.testing.assert_array_equal(np.asarray(ref[key]), np.asarray(pal[key]))
+
+    mesh_pal = make_sharded_solver(ladder, make_mesh(8), use_pallas=True,
+                                   pallas_interpret=True)(batch)
+    for key in ("cons", "cons_len", "err", "solved", "tier"):
+        np.testing.assert_array_equal(np.asarray(ref[key]),
+                                      np.asarray(mesh_pal[key]))
