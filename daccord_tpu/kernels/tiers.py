@@ -22,8 +22,7 @@ import numpy as np
 from ..oracle.consensus import ConsensusConfig
 from ..oracle.profile import ErrorProfile, OffsetLikely
 from .tensorize import WindowBatch
-from .window_kernel import (KernelParams, _solve_one, solve_batch_pallas_core,
-                            solve_window_batch)
+from .window_kernel import KernelParams, solve_batch_core, solve_window_batch
 
 
 @dataclass
@@ -60,19 +59,6 @@ class TierLadder:
         return cls(params=params, tables=tables)
 
 
-def _solve_batch(seqs, lens, nsegs, table, p: KernelParams, use_pallas: bool,
-                 interpret: bool = False):
-    """One tier over a batch: vmap/scan formulation or the Pallas-DP path.
-
-    ``interpret`` runs the Pallas kernel in interpret mode so the full ladder
-    (escalation tiers included) is parity-testable off-TPU."""
-    if use_pallas:
-        return solve_batch_pallas_core(seqs, lens, nsegs, table, p,
-                                       interpret=interpret)
-    return jax.vmap(functools.partial(_solve_one, p=p),
-                    in_axes=(0, 0, 0, None))(seqs, lens, nsegs, table)
-
-
 def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ...],
                 esc_cap: int, use_pallas: bool = False,
                 pallas_interpret: bool = False):
@@ -88,8 +74,8 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
     kernel (TPU only; semantics bit-identical, tests/test_pallas.py).
     """
     p0 = params[0]
-    out0 = _solve_batch(seqs, lens, nsegs, tables[0], p0, use_pallas,
-                        pallas_interpret)
+    out0 = solve_batch_core(seqs, lens, nsegs, tables[0], p0, use_pallas,
+                            pallas_interpret)
     solved = out0["solved"]
     cons = out0["cons"]
     cons_len = out0["cons_len"]
@@ -118,8 +104,10 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
             e_tier = jnp.full(E, -1, dtype=jnp.int32)
             for ti in range(1, len(params)):
                 p = params[ti]
-                out_t = _solve_batch(sseqs, slens, jnp.where(e_solved, 0, snsegs),
-                                     tables[ti], p, use_pallas, pallas_interpret)
+                out_t = solve_batch_core(sseqs, slens,
+                                         jnp.where(e_solved, 0, snsegs),
+                                         tables[ti], p, use_pallas,
+                                         pallas_interpret)
                 take = live & out_t["solved"] & ~e_solved
                 e_cons = jnp.where(take[:, None], out_t["cons"], e_cons)
                 e_len = jnp.where(take, out_t["cons_len"], e_len)

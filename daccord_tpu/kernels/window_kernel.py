@@ -421,6 +421,25 @@ def solve_batch_pallas_core(seqs, lens, nsegs, ol, p: KernelParams,
         seqs, lens, nsegs, scores, ptrs, g["sel"], g["snk_ok"])
 
 
+def pallas_needs_interpret() -> bool:
+    """Mosaic lowering of the Pallas kernel exists only on TPU; every other
+    backend must run it in interpret mode (bit-identical, slow). The one
+    policy point shared by the pipeline and the mesh solver."""
+    return jax.default_backend() != "tpu"
+
+
+def solve_batch_core(seqs, lens, nsegs, ol, p: KernelParams,
+                     use_pallas: bool = False, interpret: bool = False):
+    """Unjitted batch solve: the single dispatch point between the vmap/scan
+    formulation and the Pallas-DP path (used by both ``solve_window_batch``
+    and the escalation ladder in ``kernels.tiers``)."""
+    if use_pallas:
+        return solve_batch_pallas_core(seqs, lens, nsegs, ol, p,
+                                       interpret=interpret)
+    fn = functools.partial(_solve_one, p=p)
+    return jax.vmap(fn, in_axes=(0, 0, 0, None))(seqs, lens, nsegs, ol)
+
+
 @functools.partial(jax.jit, static_argnames=("params", "use_pallas", "interpret"))
 def solve_window_batch(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
                        ol: jnp.ndarray, params: KernelParams,
@@ -430,8 +449,4 @@ def solve_window_batch(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
 
     ``use_pallas`` routes the heaviest-path DP through the Pallas kernel
     (``interpret=True`` for off-TPU parity runs)."""
-    if use_pallas:
-        return solve_batch_pallas_core(seqs, lens, nsegs, ol, params,
-                                       interpret=interpret)
-    fn = functools.partial(_solve_one, p=params)
-    return jax.vmap(fn, in_axes=(0, 0, 0, None))(seqs, lens, nsegs, ol)
+    return solve_batch_core(seqs, lens, nsegs, ol, params, use_pallas, interpret)

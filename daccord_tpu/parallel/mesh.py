@@ -154,9 +154,9 @@ def build_sharded_solver(n_devices: int, profile, consensus_cfg,
             f"mesh {n_devices}: only {len(jax.devices())} devices visible "
             "(off-pod: set JAX_PLATFORMS=cpu and "
             "XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    from ..kernels.window_kernel import pallas_needs_interpret
+
     ladder = TierLadder.from_config(profile, consensus_cfg)
-    # off-TPU backends can't Mosaic-lower the kernel; run it in interpret mode
-    # (bit-identical, slow — fine for the virtual-mesh validation path)
-    interpret = use_pallas and jax.default_backend() != "tpu"
+    interpret = use_pallas and pallas_needs_interpret()
     return make_sharded_solver(ladder, make_mesh(n_devices), esc_cap,
                                use_pallas=use_pallas, pallas_interpret=interpret)

@@ -247,9 +247,9 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             # is structurally impossible)
             from ..kernels.tiers import fetch as _fetch, solve_ladder_async
 
-            # non-TPU device backends can't Mosaic-lower the Pallas kernel;
-            # interpret mode keeps the flag honest (bit-identical, slow)
-            interp = cfg.use_pallas and jax.default_backend() != "tpu"
+            from ..kernels.window_kernel import pallas_needs_interpret
+
+            interp = cfg.use_pallas and pallas_needs_interpret()
             dispatch_fn = (lambda b: solve_ladder_async(
                 b, ladder, use_pallas=cfg.use_pallas, pallas_interpret=interp))
             fetch_fn = _fetch

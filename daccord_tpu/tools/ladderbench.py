@@ -13,8 +13,13 @@ Rungs:
   cfg2  100x PacBio-like single chip (the "first bases/sec/chip" rung)
   cfg3  80x multi-contig over an 8-device mesh (virtual CPU mesh when only
         one real chip is visible; exercises the sharded solver end to end)
+  cfg4  60x streamed as 4 sequential LAS byte-range shards with mid-shard
+        checkpoints + manifest merge (the streaming-shards rung)
+  cfg5  ONT R10-like regime corrected by two concurrent OS processes, each
+        owning one LAS shard, outputs merged (the multi-host scale-out
+        model: zero cross-process communication, shared FS)
 
-Usage: ``python -m daccord_tpu.tools.ladderbench [--configs cfg1,cfg2,cfg3]``
+Usage: ``python -m daccord_tpu.tools.ladderbench [--configs cfg1,...,cfg5]``
 """
 
 from __future__ import annotations
@@ -128,7 +133,80 @@ RUNGS = {
     # 80x over an 8-device mesh (config 3; virtual CPU mesh off-pod)
     "cfg3": dict(sim_kw=dict(genome_len=30_000, coverage=80, read_len_mean=6_000,
                              repeat_fraction=0.05, seed=13), mesh=8),
+    # 60x streamed as 4 byte-range shards with checkpoints (config 4's shape)
+    "cfg4": dict(sim_kw=dict(genome_len=40_000, coverage=60, read_len_mean=7_000,
+                             seed=14), shards=4),
+    # ONT R10-like, two concurrent shard processes (config 5's regime)
+    "cfg5": dict(sim_kw=dict(genome_len=30_000, coverage=15, read_len_mean=8_000,
+                             read_len_sigma=0.5, p_ins=0.008, p_del=0.018,
+                             p_sub=0.01, min_overlap=2_000, seed=15), procs=2),
 }
+
+
+def run_rung_shards(name: str, sim_kw: dict, shards: int) -> dict:
+    """Sequential byte-range shards with mid-shard checkpoints + merge."""
+    import jax
+
+    from daccord_tpu.parallel.launch import merge_shards, run_shard
+    from daccord_tpu.runtime.pipeline import PipelineConfig
+    from daccord_tpu.utils.obs import enable_compilation_cache
+
+    enable_compilation_cache()
+    paths = _dataset(name, **sim_kw)
+    outdir = os.path.join(CACHE, f"ladder_{name}", "shards")
+    out_fa = os.path.join(CACHE, f"ladder_{name}", "corrected.fasta")
+    t0 = time.perf_counter()
+    manifests = [run_shard(paths["db"], paths["las"], outdir, s, shards,
+                           PipelineConfig(), force=True, checkpoint_every=64)
+                 for s in range(shards)]
+    n_frags = merge_shards(outdir, shards, out_fa)
+    wall = time.perf_counter() - t0
+    q = _qveval(out_fa, paths["truth"], paths["db"])
+    bases_out = sum(m.get("bases_out", 0) for m in manifests)
+    # no bases_out_per_s here: the timed window covers the whole shard
+    # workflow (incl. one profile-estimation pass PER shard, by design of the
+    # resumable shard machinery), so the number would not be comparable with
+    # the other rungs' correction-only throughput
+    return {
+        "rung": name, "shards": shards, "devices": 1,
+        "backend": jax.default_backend(),
+        "reads": sum(m.get("reads", 0) for m in manifests),
+        "fragments": n_frags, "bases_out": bases_out,
+        "wall_s": round(wall, 2),
+        "q_raw": q.get("raw_qscore"), "q_corrected": q.get("qscore"),
+        "delta_q": q.get("delta_q"),
+    }
+
+
+def run_rung_procs(name: str, sim_kw: dict, procs: int) -> dict:
+    """Concurrent shard OS processes (multi-host model: shared FS, zero
+    cross-process communication), merged afterwards. The subprocesses run the
+    CPU backend: two clients cannot share the single tunneled TPU chip."""
+    paths = _dataset(name, **sim_kw)
+    outdir = os.path.join(CACHE, f"ladder_{name}", "shards")
+    out_fa = os.path.join(CACHE, f"ladder_{name}", "corrected.fasta")
+    t0 = time.perf_counter()
+    running = [subprocess.Popen(
+        [sys.executable, "-m", "daccord_tpu.tools.cli", "shard",
+         paths["db"], paths["las"], outdir, "-J", f"{s},{procs}",
+         "--force", "--backend", "cpu"],
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+        for s in range(procs)]
+    errs = [p.communicate()[1] for p in running]
+    if any(p.returncode != 0 for p in running):
+        return {"rung": name, "error": [p.returncode for p in running],
+                "stderr": " | ".join(e[-200:] for e in errs)}
+    from daccord_tpu.parallel.launch import merge_shards
+
+    n_frags = merge_shards(outdir, procs, out_fa)
+    wall = time.perf_counter() - t0
+    q = _qveval(out_fa, paths["truth"], paths["db"])
+    return {
+        "rung": name, "processes": procs, "backend": "cpu",
+        "fragments": n_frags, "wall_s": round(wall, 2),
+        "q_raw": q.get("raw_qscore"), "q_corrected": q.get("qscore"),
+        "delta_q": q.get("delta_q"),
+    }
 
 
 def main(argv=None) -> int:
@@ -165,6 +243,12 @@ def main(argv=None) -> int:
     for name in names:
         r = RUNGS[name]
         mesh = r.get("mesh", 0)
+        if "shards" in r:
+            print(json.dumps(run_rung_shards(name, r["sim_kw"], r["shards"])))
+            continue
+        if "procs" in r:
+            print(json.dumps(run_rung_procs(name, r["sim_kw"], r["procs"])))
+            continue
         if mesh > 1 and len(jax.devices()) < mesh:
             # not enough real devices: re-enter in a fresh interpreter, where
             # the --inner path forces a virtual CPU platform of the right
