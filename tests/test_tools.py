@@ -306,3 +306,22 @@ def test_daccord_mesh_cli(dataset, tmp_path):
     assert main(["daccord", *args, "-o", single]) == 0
     assert main(["daccord", *args, "-o", meshed, "--mesh", "8"]) == 0
     assert open(meshed).read() == open(single).read()
+
+
+def test_ladderbench_rungs_smoke(tmp_path, monkeypatch):
+    """The ladder-bench rung drivers work end to end on a micro dataset:
+    the plain rung and the shard-workflow rung (checkpoints + merge).
+    run_rung_procs is NOT covered here — each subprocess pays a full jax
+    import + compile, too slow for CI; it is exercised by the cfg5 hardware
+    runs (BASELINE.md)."""
+    from daccord_tpu.tools import ladderbench as lb
+
+    monkeypatch.setattr(lb, "CACHE", str(tmp_path))
+    kw = dict(genome_len=2500, coverage=10, read_len_mean=700, seed=9)
+
+    row = lb.run_rung("smoke", kw)
+    assert row["reads"] > 0 and row["delta_q"] is not None
+
+    row = lb.run_rung_shards("smoke2", kw, shards=2)
+    assert row["shards"] == 2 and row["fragments"] > 0
+    assert row["q_corrected"] > row["q_raw"]
