@@ -46,7 +46,7 @@ def daccord_main(argv=None) -> int:
     p.add_argument("-k", type=int, default=8,
                    help="base k-mer size; the escalation ladder becomes "
                         "(k,2,2),(k+2,2,2),(k+4,2,2),(k,1,1) (reference -k role)")
-    p.add_argument("-b", "--batch", type=int, default=512, help="device batch size")
+    p.add_argument("-b", "--batch", type=int, default=None, help="device batch size (default auto: 2048 on tpu, 512 otherwise)")
     p.add_argument("-t", "--threads", type=int, default=0,
                    help="host windowing threads (reference -t; 0 = synchronous)")
     p.add_argument("--depth", type=int, default=32, help="max segments per window")
@@ -354,7 +354,7 @@ def shard_main(argv=None) -> int:
     p.add_argument("las")
     p.add_argument("outdir")
     p.add_argument("-J", required=True, metavar="i,n", help="shard i of n")
-    p.add_argument("-b", "--batch", type=int, default=512)
+    p.add_argument("-b", "--batch", type=int, default=None)
     p.add_argument("--checkpoint-every", type=int, default=64,
                    help="checkpoint progress every N emitted reads (0 = off)")
     p.add_argument("--force", action="store_true", help="recompute even if manifest exists")
