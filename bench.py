@@ -111,7 +111,8 @@ def device_throughput(data: dict, max_batches: int | None = None,
     import jax
 
     from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch
-    from daccord_tpu.kernels.tiers import TierLadder, fetch, solve_ladder_async
+    from daccord_tpu.kernels.tiers import (TierLadder, fetch, fetch_many,
+                                           solve_ladder_async)
     from daccord_tpu.oracle.consensus import ConsensusConfig
     from daccord_tpu.oracle.profile import ErrorProfile
 
@@ -139,16 +140,23 @@ def device_throughput(data: dict, max_batches: int | None = None,
     bases = 0
     solved = 0
     inflight: deque = deque()
-    for i in range(nb):
-        inflight.append(solve_ladder_async(make_batch(i), ladder))
-        while len(inflight) >= max_inflight:
-            out = fetch(inflight.popleft())
+
+    def drain(to_depth: int):
+        nonlocal bases, solved
+        n_pop = len(inflight) - to_depth
+        if n_pop <= 0:
+            return
+        # ONE grouped fetch per drain: the tunnel charges its ~100 ms RTT per
+        # device_get call, not per array (same discipline as the pipeline)
+        for out in fetch_many([inflight.popleft() for _ in range(n_pop)]):
             bases += int(out["cons_len"].sum())
             solved += int(out["solved"].sum())
-    while inflight:
-        out = fetch(inflight.popleft())
-        bases += int(out["cons_len"].sum())
-        solved += int(out["solved"].sum())
+
+    for i in range(nb):
+        inflight.append(solve_ladder_async(make_batch(i), ladder))
+        if len(inflight) >= max_inflight:
+            drain(max_inflight // 2)
+    drain(0)
     dt = time.perf_counter() - t0
     info = dict(windows=nb * BATCH, solved=solved, wall_s=round(dt, 3),
                 device=str(jax.devices()[0]).replace(" ", ""),

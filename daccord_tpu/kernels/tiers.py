@@ -237,6 +237,20 @@ def fetch(out) -> dict:
     return {k: np.asarray(v) for k, v in host.items()}
 
 
+def fetch_many(handles: list) -> list[dict]:
+    """Materialize several in-flight results in ONE device->host transfer.
+
+    The tunneled TPU pays its fixed ~100 ms RTT per ``device_get`` CALL, not
+    per array (measured 2026-07-30: 8 sequential fetches 988 ms vs the same
+    8 arrays grouped 91 ms), so draining the in-flight window in groups
+    divides the per-batch fetch floor by the group size."""
+    if all(isinstance(h, _PackedHandle) for h in handles):
+        arrs = jax.device_get([h.arr for h in handles])
+        return [unpack_result(np.asarray(a), h.cl)
+                for a, h in zip(arrs, handles)]
+    return [fetch(h) for h in handles]
+
+
 def solve_ladder(batch: WindowBatch, ladder: TierLadder,
                  esc_cap: int | None = None, use_pallas: bool = False,
                  pallas_interpret: bool = False) -> dict:

@@ -119,13 +119,23 @@ class ShardedLadderSolver:
             pallas_interpret=self.pallas_interpret)
         return (_PackedHandle(arr, self.cl), B0)
 
+    @staticmethod
+    def _trim(out: dict, B0: int) -> dict:
+        """Drop the rows added by the pad-to-mesh-multiple in dispatch."""
+        return {k: (v[:B0] if np.ndim(v) else v) for k, v in out.items()}
+
     def fetch(self, handle) -> dict:
         # one wire format, one decoder: delegate to kernels.tiers.fetch
         from ..kernels.tiers import fetch as fetch_packed
 
         ph, B0 = handle
-        out = fetch_packed(ph)
-        return {k: (v[:B0] if np.ndim(v) else v) for k, v in out.items()}
+        return self._trim(fetch_packed(ph), B0)
+
+    def fetch_many(self, handles) -> list[dict]:
+        from ..kernels.tiers import fetch_many as fetch_many_packed
+
+        outs = fetch_many_packed([ph for ph, _ in handles])
+        return [self._trim(out, B0) for out, (_, B0) in zip(outs, handles)]
 
     def __call__(self, batch: WindowBatch) -> dict:
         return self.fetch(self.dispatch(batch))

@@ -46,9 +46,11 @@ class PipelineConfig:
     profile_sample_piles: int = 4
     use_native: bool = True      # C++ host path when available
     depth_rank: bool = True      # best-alignments-first before depth capping
-    max_inflight: int = 4        # device batches in flight; >2 hides the axon
-                                 # tunnel's per-fetch latency (~60-300 ms)
-                                 # behind the next dispatches
+    max_inflight: int = 8        # device batches in flight. The deque fills
+                                 # to this depth, then HALF is drained in one
+                                 # grouped fetch: the tunnel charges ~100 ms
+                                 # per fetch call (not per array), so the
+                                 # per-batch fetch floor is RTT/(max_inflight/2)
     feeder_threads: int = 0      # host windowing threads (0 = synchronous);
                                  # the reference's -t fan-out re-imagined as a
                                  # feeder pool ahead of the device queue — the
@@ -233,11 +235,13 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     from ..utils.obs import JsonlLogger
 
     log = JsonlLogger(cfg.log_path)
+    fetch_many_fn = None
     if solver is not None:
         if hasattr(solver, "dispatch") and hasattr(solver, "fetch"):
             # async solver (e.g. the mesh-sharded ladder): pipeline batches
             # through it exactly like the local single-device path
             dispatch_fn, fetch_fn = solver.dispatch, solver.fetch
+            fetch_many_fn = getattr(solver, "fetch_many", None)
         else:
             dispatch_fn, fetch_fn = solver, (lambda h: h)
     else:
@@ -260,12 +264,14 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             # is structurally impossible)
             from ..kernels.tiers import fetch as _fetch, solve_ladder_async
 
+            from ..kernels.tiers import fetch_many as _fetch_many
             from ..kernels.window_kernel import pallas_needs_interpret
 
             interp = cfg.use_pallas and pallas_needs_interpret()
             dispatch_fn = (lambda b: solve_ladder_async(
                 b, ladder, use_pallas=cfg.use_pallas, pallas_interpret=interp))
             fetch_fn = _fetch
+            fetch_many_fn = _fetch_many
 
     try:
         from ..native import available as native_available
@@ -328,15 +334,24 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         return n_batch_solved
 
     def drain(to_depth: int):
-        while len(inflight) > to_depth:
-            handle, rid, widx, take, t0 = inflight.popleft()
-            t_f = time.time()
-            out = fetch_fn(handle)
-            now = time.time()
-            # device_s = time the host actually BLOCKED on the device/tunnel
-            # (in-flight batches overlap, so summing dispatch->fetch spans
-            # would double-count and can exceed wall time)
-            stats.device_s += now - t_f
+        # drain in ONE grouped fetch: the tunnel charges its ~100 ms RTT per
+        # device_get CALL, not per array, so fetching k batches together
+        # divides the per-batch fetch floor by k (see kernels.tiers.fetch_many)
+        n_pop = len(inflight) - to_depth
+        if n_pop <= 0:
+            return
+        entries = [inflight.popleft() for _ in range(n_pop)]
+        t_f = time.time()
+        if fetch_many_fn is not None and len(entries) > 1:
+            outs = fetch_many_fn([e[0] for e in entries])
+        else:
+            outs = [fetch_fn(e[0]) for e in entries]
+        now = time.time()
+        # device_s = time the host actually BLOCKED on the device/tunnel
+        # (in-flight batches overlap, so summing dispatch->fetch spans
+        # would double-count and can exceed wall time)
+        stats.device_s += now - t_f
+        for (handle, rid, widx, take, t0), out in zip(entries, outs):
             n_s = scatter(out, rid, widx, take)
             log.log("batch", windows=take, solved=n_s,
                     overflow=int(out.get("esc_overflow", 0)),
@@ -376,7 +391,11 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 stats.used_cells += int(batch.lens.sum())
                 handle = dispatch_fn(batch)
                 inflight.append((handle, rid, widx, take, time.time()))
-                drain(cfg.max_inflight - 1)
+                # let the in-flight window FILL, then drain half of it in one
+                # grouped fetch — steady state pays one tunnel RTT per
+                # max_inflight/2 batches instead of one per batch
+                if len(inflight) >= cfg.max_inflight:
+                    drain(cfg.max_inflight // 2)
         if final:
             drain(0)
 
