@@ -236,11 +236,22 @@ def read_db(path: str) -> DazzDB:
 # Tracks (variable-length per-read byte payloads; e.g. daccord's `inqual`)
 # ---------------------------------------------------------------------------
 
-def write_track(db_path: str, track: str, payloads: list[bytes | np.ndarray]) -> None:
-    """Write a variable-length Dazzler track (.anno = offsets, .data = bytes)."""
+def _track_paths(db_path: str, track: str, block: int | None) -> tuple[str, str]:
+    """(.anno, .data) paths; block tracks use the Dazzler ``.<stem>.<block>.
+    <track>`` naming so per-block jobs never collide (Catrack convention)."""
     d, stem = _db_stems(db_path)
-    anno_path = os.path.join(d, f".{stem}.{track}.anno")
-    data_path = os.path.join(d, f".{stem}.{track}.data")
+    mid = f"{block}.{track}" if block is not None else track
+    return (os.path.join(d, f".{stem}.{mid}.anno"),
+            os.path.join(d, f".{stem}.{mid}.data"))
+
+
+def write_track(db_path: str, track: str, payloads: list[bytes | np.ndarray],
+                block: int | None = None) -> None:
+    """Write a variable-length Dazzler track (.anno = offsets, .data = bytes).
+
+    With ``block``, writes a per-block track covering only that block's reads
+    (merge into the whole-DB track with :func:`catrack`)."""
+    anno_path, data_path = _track_paths(db_path, track, block)
 
     blobs = [bytes(np.asarray(p, dtype=np.uint8).tobytes()) if isinstance(p, np.ndarray) else bytes(p)
              for p in payloads]
@@ -255,11 +266,9 @@ def write_track(db_path: str, track: str, payloads: list[bytes | np.ndarray]) ->
             fh.write(b)
 
 
-def read_track(db_path: str, track: str) -> list[np.ndarray]:
+def read_track(db_path: str, track: str, block: int | None = None) -> list[np.ndarray]:
     """Read a variable-length track back as per-read uint8 arrays."""
-    d, stem = _db_stems(db_path)
-    anno_path = os.path.join(d, f".{stem}.{track}.anno")
-    data_path = os.path.join(d, f".{stem}.{track}.data")
+    anno_path, data_path = _track_paths(db_path, track, block)
 
     with open(anno_path, "rb") as fh:
         nreads, size = struct.unpack("<2i", fh.read(8))
@@ -310,6 +319,30 @@ def split_db(db_path: str, block_bases: int = 200_000_000) -> list[tuple[int, in
         _write_block_section(fh, bounds, block_bases, cutoff)
     os.replace(tmp, stub)
     return [(bounds[i], bounds[i + 1]) for i in range(nb)]
+
+
+def catrack(db_path: str, track: str, delete: bool = False) -> int:
+    """Merge per-block tracks into the whole-DB track (DAZZ_DB ``Catrack``
+    role). Every block 1..N of the .db stub's partition must have its
+    ``.<stem>.<i>.<track>`` pair present, and block i's track must cover
+    exactly block i's reads. Returns the merged read count.
+
+    With ``delete``, the block-track files are removed after a successful
+    merge (Catrack ``-d``)."""
+    blocks = db_blocks(db_path)
+    payloads: list[np.ndarray] = []
+    for i, (lo, hi) in enumerate(blocks, start=1):
+        p = read_track(db_path, track, block=i)
+        if len(p) != hi - lo:
+            raise ValueError(
+                f"block {i} track '{track}' covers {len(p)} reads, expected {hi - lo}")
+        payloads.extend(p)
+    write_track(db_path, track, payloads)
+    if delete:
+        for i in range(1, len(blocks) + 1):
+            for path in _track_paths(db_path, track, i):
+                os.remove(path)
+    return len(payloads)
 
 
 def db_blocks(db_path: str) -> list[tuple[int, int]]:

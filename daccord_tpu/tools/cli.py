@@ -183,10 +183,13 @@ def intrinsicqv_main(argv=None) -> int:
     p.add_argument("db")
     p.add_argument("las")
     p.add_argument("-d", type=int, default=20, help="expected coverage depth")
+    p.add_argument("--block", type=int, default=None, metavar="I",
+                   help="process only DB block I (1-based); writes a per-block "
+                        "track to merge with `catrack`")
     args = p.parse_args(argv)
     db = read_db(args.db)
     las = LasFile(args.las)
-    lastools.compute_intrinsic_qv(db, las, depth=args.d)
+    lastools.compute_intrinsic_qv(db, las, depth=args.d, block=args.block)
     return 0
 
 
@@ -197,10 +200,14 @@ def detectrepeats_main(argv=None) -> int:
     p.add_argument("las")
     p.add_argument("-d", type=int, default=20, help="expected coverage depth")
     p.add_argument("--factor", type=float, default=2.0, help="over-coverage factor")
+    p.add_argument("--block", type=int, default=None, metavar="I",
+                   help="process only DB block I (1-based); writes a per-block "
+                        "track to merge with `catrack`")
     args = p.parse_args(argv)
     db = read_db(args.db)
     las = LasFile(args.las)
-    lastools.detect_repeats(db, las, depth=args.d, cov_factor=args.factor)
+    lastools.detect_repeats(db, las, depth=args.d, cov_factor=args.factor,
+                            block=args.block)
     return 0
 
 
@@ -272,6 +279,23 @@ def dbsplit_main(argv=None) -> int:
 
     blocks = split_db(args.db, int(args.size * 1_000_000))
     print(f"{len(blocks)} blocks", file=sys.stderr)
+    return 0
+
+
+def catrack_main(argv=None) -> int:
+    """catrack: merge per-block tracks into the whole-DB track (DAZZ_DB
+    ``Catrack`` role; completes the per-block cluster workflow for the
+    track-writing tools `inqual --block` / `repeats --block`)."""
+    p = argparse.ArgumentParser(prog="catrack", description=catrack_main.__doc__)
+    p.add_argument("db")
+    p.add_argument("track", help="track name (e.g. inqual, rep)")
+    p.add_argument("-d", "--delete", action="store_true",
+                   help="remove the per-block track files after merging")
+    args = p.parse_args(argv)
+    from ..formats.dazzdb import catrack
+
+    n = catrack(args.db, args.track, delete=args.delete)
+    print(f"merged track '{args.track}' over {n} reads", file=sys.stderr)
     return 0
 
 
@@ -508,6 +532,7 @@ _TOOLS = {
     "filtersym": filtersym_main,
     "lassort": lassort_main,
     "lasmerge": lasmerge_main,
+    "catrack": catrack_main,
     "lasindex": lasindex_main,
     "fasta2db": fasta2db_main,
     "db2fasta": db2fasta_main,

@@ -62,27 +62,50 @@ def _pile_tile_rates(db: DazzDB, aread: int, pile: list[Overlap], tspace: int):
     return rates
 
 
-def _read_lengths(db: DazzDB) -> np.ndarray:
-    return np.fromiter((r.rlen for r in db.reads), np.int64, db.nreads)
+def _read_lengths(db: DazzDB, lo: int = 0, hi: int | None = None) -> np.ndarray:
+    hi = db.nreads if hi is None else hi
+    return np.fromiter((db.reads[i].rlen for i in range(lo, hi)), np.int64, hi - lo)
 
 
-def _tile_table(db: DazzDB, tspace: int) -> np.ndarray:
-    """Global tile offsets: tile_base[i] .. tile_base[i+1] are read i's tiles."""
-    ntiles = (_read_lengths(db) + tspace - 1) // tspace
-    tile_base = np.zeros(db.nreads + 1, np.int64)
+def _tile_table(db: DazzDB, tspace: int, lo: int = 0, hi: int | None = None) -> np.ndarray:
+    """Tile offsets over reads [lo, hi): tile_base[i] .. tile_base[i+1] are
+    read lo+i's tiles. Block jobs pass their read range so every flat array
+    downstream is O(block), not O(whole DB)."""
+    ntiles = (_read_lengths(db, lo, hi) + tspace - 1) // tspace
+    tile_base = np.zeros(len(ntiles) + 1, np.int64)
     np.cumsum(ntiles, out=tile_base[1:])
     return tile_base
 
 
-def _intrinsic_qv_native(db: DazzDB, las: LasFile, depth: int) -> list[np.ndarray]:
+def _block_range(db: DazzDB, las: LasFile, block: int | None) -> tuple[int, int, int | None, int | None]:
+    """(lo, hi, byte_start, byte_end) for DB block ``block`` (1-based);
+    ``block=None`` means the whole run (all reads, full file)."""
+    if block is None:
+        return 0, db.nreads, None, None
+    from ..formats.dazzdb import db_blocks
+    from ..formats.las import range_for_areads
+
+    blocks = db_blocks(db.path)
+    if not (1 <= block <= len(blocks)):
+        raise ValueError(f"block {block}: DB has {len(blocks)} blocks")
+    lo, hi = blocks[block - 1]
+    start, end = range_for_areads(las.path, lo, hi)
+    return lo, hi, start, end
+
+
+def _intrinsic_qv_native(db: DazzDB, las: LasFile, depth: int,
+                         rlo: int = 0, rhi: int | None = None,
+                         byte_range=(None, None)) -> list[np.ndarray]:
     """Vectorized QV pass over the native columnar LAS load (SURVEY.md §2.4:
     the streaming path rides C++ + numpy vector math, not per-record Python).
-    Bit-identical to the per-pile fallback below (parity-tested)."""
+    Bit-identical to the per-pile fallback below (parity-tested). All flat
+    arrays cover only reads [rlo, rhi) so block jobs stay O(block)."""
     from ..native.api import ColumnarLas
 
-    col = ColumnarLas(las.path)
+    rhi = db.nreads if rhi is None else rhi
+    col = ColumnarLas(las.path, *byte_range)
     tspace = col.tspace
-    tile_base = _tile_table(db, tspace)
+    tile_base = _tile_table(db, tspace, rlo, rhi)
     qv_flat = np.full(int(tile_base[-1]), QV_NOCOV, dtype=np.uint8)
 
     if col.novl:
@@ -99,7 +122,7 @@ def _intrinsic_qv_native(db: DazzDB, las: LasFile, depth: int) -> list[np.ndarra
         tl = hi - lo
         dif = col.trace_flat[np.repeat(col.trace_off[:-1], T) + 2 * tloc]
         ok = tl > 0
-        gid = (tile_base[col.aread.astype(np.int64)[ov]] + g)[ok]
+        gid = (tile_base[col.aread.astype(np.int64)[ov] - rlo] + g)[ok]
         # same expression shape as the fallback: (0.5 * diff) / tile_len
         rate = 0.5 * dif[ok].astype(np.float64) / tl[ok]
         order = np.lexsort((rate, gid))
@@ -108,24 +131,30 @@ def _intrinsic_qv_native(db: DazzDB, las: LasFile, depth: int) -> list[np.ndarra
         sel = gstart + np.minimum(max(depth // 2, 1), gcount) - 1
         q = np.minimum(np.round(QV_SCALE * rate_s[sel]), 250).astype(np.uint8)
         qv_flat[uniq] = q
-    return [qv_flat[tile_base[i] : tile_base[i + 1]] for i in range(db.nreads)]
+    return [qv_flat[tile_base[i] : tile_base[i + 1]] for i in range(rhi - rlo)]
 
 
 def compute_intrinsic_qv(db: DazzDB, las: LasFile, depth: int = 20,
-                         track: str = "inqual", use_native: bool = True) -> list[np.ndarray]:
+                         track: str = "inqual", use_native: bool = True,
+                         block: int | None = None) -> list[np.ndarray]:
     """Per-read per-tile intrinsic QVs from pile error statistics.
 
     The depth-d quantile (d-th lowest rate) is robust to repeat-induced piles:
     repeats inflate coverage with *worse* alignments, leaving the best d
     mostly intact (reference ``computeintrinsicqv -d``).
+
+    With ``block``, only that DB block's reads are processed (via the LAS
+    aread-range byte index) and a per-block track is written; merge the block
+    tracks with :func:`daccord_tpu.formats.dazzdb.catrack`.
     """
     tspace = las.tspace
+    lo, hi, start, end = _block_range(db, las, block)
     payloads: list[np.ndarray] | None = None
     if use_native and _native_ok():
-        payloads = _intrinsic_qv_native(db, las, depth)
+        payloads = _intrinsic_qv_native(db, las, depth, lo, hi, byte_range=(start, end))
     if payloads is None:
-        payloads = [np.zeros(0, dtype=np.uint8)] * db.nreads
-        for aread, pile in las.iter_piles():
+        payloads = [np.zeros(0, dtype=np.uint8)] * (hi - lo)
+        for aread, pile in las.iter_piles(start, end):
             rates = _pile_tile_rates(db, aread, pile, tspace)
             qv = np.full(len(rates), QV_NOCOV, dtype=np.uint8)
             for t, rl in enumerate(rates):
@@ -134,29 +163,31 @@ def compute_intrinsic_qv(db: DazzDB, las: LasFile, depth: int = 20,
                 rl = sorted(rl)
                 q = rl[min(max(depth // 2, 1), len(rl)) - 1]
                 qv[t] = min(int(round(QV_SCALE * q)), 250)
-            payloads[aread] = qv
+            payloads[aread - lo] = qv
         # reads with no pile get all-NOCOV tracks of the right length
-        for i in range(db.nreads):
+        for i in range(hi - lo):
             if len(payloads[i]) == 0:
-                nt = (db.read_length(i) + tspace - 1) // tspace
+                nt = (db.read_length(lo + i) + tspace - 1) // tspace
                 payloads[i] = np.full(nt, QV_NOCOV, dtype=np.uint8)
-    write_track(db.path, track, payloads)
+    write_track(db.path, track, payloads, block=block)
     return payloads
 
 
-def _tile_coverage_native(db: DazzDB, las: LasFile) -> tuple[np.ndarray, np.ndarray]:
-    """(tile_base, cov_flat): per-tile alignment coverage over all reads via
-    the native columnar load + a difference-array sweep (no per-record
-    Python). Interval deltas cancel within each read, so one global cumsum
-    yields every read's coverage."""
+def _tile_coverage_native(db: DazzDB, las: LasFile, rlo: int = 0, rhi: int | None = None,
+                          byte_range=(None, None)) -> tuple[np.ndarray, np.ndarray]:
+    """(tile_base, cov_flat): per-tile alignment coverage over reads
+    [rlo, rhi) via the native columnar load + a difference-array sweep (no
+    per-record Python). Interval deltas cancel within each read, so one
+    global cumsum yields every read's coverage."""
     from ..native.api import ColumnarLas
 
-    col = ColumnarLas(las.path)
+    rhi = db.nreads if rhi is None else rhi
+    col = ColumnarLas(las.path, *byte_range)
     tspace = col.tspace
-    tile_base = _tile_table(db, tspace)
+    tile_base = _tile_table(db, tspace, rlo, rhi)
     delta = np.zeros(int(tile_base[-1]) + 1, dtype=np.int64)
     if col.novl:
-        ar = col.aread.astype(np.int64)
+        ar = col.aread.astype(np.int64) - rlo
         g0 = col.abpos.astype(np.int64) // tspace
         g1 = np.maximum(col.aepos.astype(np.int64) - 1, col.abpos) // tspace
         np.add.at(delta, tile_base[ar] + g0, 1)
@@ -166,17 +197,22 @@ def _tile_coverage_native(db: DazzDB, las: LasFile) -> tuple[np.ndarray, np.ndar
 
 def detect_repeats(db: DazzDB, las: LasFile, depth: int = 20,
                    cov_factor: float = 2.0, track: str = "rep",
-                   use_native: bool = True) -> list[np.ndarray]:
+                   use_native: bool = True, block: int | None = None) -> list[np.ndarray]:
     """Detect simple-repeat intervals from pile over-coverage.
 
     A tile whose alignment coverage exceeds ``cov_factor * depth`` is repeat-
     annotated; adjacent repeat tiles merge into intervals (int64 start/end
     pairs per read, written as track ``rep``).
+
+    With ``block``, processes only that DB block (per-block track; merge with
+    ``catrack``) — the reference's per-block cluster workflow.
     """
     tspace = las.tspace
+    lo, hi, start, end = _block_range(db, las, block)
     payloads: list[np.ndarray] | None = None
     if use_native and _native_ok():
-        tile_base, cov_flat = _tile_coverage_native(db, las)
+        tile_base, cov_flat = _tile_coverage_native(db, las, lo, hi,
+                                                    byte_range=(start, end))
         hot_flat = cov_flat > cov_factor * depth
         # global run extraction: a zero separator at every read boundary
         # keeps runs from merging across reads; one diff finds all runs
@@ -189,17 +225,18 @@ def detect_repeats(db: DazzDB, las: LasFile, depth: int = 20,
         sep_pos = seps + np.arange(len(seps))   # separator indices in ext
         t0 = p0 - np.searchsorted(sep_pos, p0)
         t1 = p1 - np.searchsorted(sep_pos, p1)
-        rid = np.searchsorted(tile_base, t0, side="right") - 1
-        rlens = _read_lengths(db)
+        rid = np.searchsorted(tile_base, t0, side="right") - 1  # block-local ids
+        rlens = _read_lengths(db, lo, hi)
         iv = np.empty((len(t0), 2), dtype=np.int64)
         iv[:, 0] = (t0 - tile_base[rid]) * tspace
         iv[:, 1] = np.minimum((t1 - tile_base[rid]) * tspace, rlens[rid])
-        counts = np.bincount(rid, minlength=db.nreads)
+        counts = np.bincount(rid, minlength=hi - lo)
         splits = np.split(iv, np.cumsum(counts)[:-1])
-        payloads = [np.ascontiguousarray(s).reshape(-1).view(np.uint8) for s in splits]
+        payloads = [np.ascontiguousarray(s).reshape(-1).view(np.uint8)
+                    for s in splits]
     if payloads is None:
-        payloads = [np.zeros(0, dtype=np.uint8)] * db.nreads
-        for aread, pile in las.iter_piles():
+        payloads = [np.zeros(0, dtype=np.uint8)] * (hi - lo)
+        for aread, pile in las.iter_piles(start, end):
             rlen = db.read_length(aread)
             ntiles = (rlen + tspace - 1) // tspace
             cov = np.zeros(ntiles, dtype=np.int64)
@@ -218,8 +255,8 @@ def detect_repeats(db: DazzDB, las: LasFile, depth: int = 20,
                     ivals.extend([t0 * tspace, min(t * tspace, rlen)])
                 else:
                     t += 1
-            payloads[aread] = np.asarray(ivals, dtype=np.int64).view(np.uint8)
-    write_track(db.path, track, payloads)
+            payloads[aread - lo] = np.asarray(ivals, dtype=np.int64).view(np.uint8)
+    write_track(db.path, track, payloads, block=block)
     return payloads
 
 

@@ -325,3 +325,54 @@ def test_ladderbench_rungs_smoke(tmp_path, monkeypatch):
     row = lb.run_rung_shards("smoke2", kw, shards=2)
     assert row["shards"] == 2 and row["fragments"] > 0
     assert row["q_corrected"] > row["q_raw"]
+
+
+def test_block_tracks_catrack(dataset, tmp_path):
+    """inqual/repeats --block write per-block tracks; catrack merges them
+    byte-identically to the whole-DB run (the reference's per-block cluster
+    workflow: computeintrinsicqv per block + Catrack), native and fallback."""
+    import shutil
+
+    from daccord_tpu.formats.dazzdb import db_blocks, split_db
+    from daccord_tpu.tools.cli import main
+
+    out, d = dataset
+    for f in ("t.db", ".t.idx", ".t.bps", ".t.names"):
+        shutil.copy(f"{d}/{f}", tmp_path / f)
+    db_path = str(tmp_path / "t.db")
+    split_db(db_path, block_bases=8000)
+    nb = len(db_blocks(db_path))
+    assert nb >= 2
+
+    db = read_db(db_path)
+    las = LasFile(out["las"])
+    for use_native in (True, False):
+        whole_q = lastools.compute_intrinsic_qv(db, las, depth=14, use_native=use_native)
+        whole_r = lastools.detect_repeats(db, las, depth=14, cov_factor=1.8,
+                                          use_native=use_native)
+        block_q: list = []
+        block_r: list = []
+        for i in range(1, nb + 1):
+            block_q.extend(lastools.compute_intrinsic_qv(
+                db, las, depth=14, use_native=use_native, block=i))
+            block_r.extend(lastools.detect_repeats(
+                db, las, depth=14, cov_factor=1.8, use_native=use_native, block=i))
+        assert len(block_q) == len(whole_q) and len(block_r) == len(whole_r)
+        for a, b in zip(block_q, whole_q):
+            assert np.array_equal(a, b)
+        for a, b in zip(block_r, whole_r):
+            assert np.array_equal(np.asarray(a, np.uint8), np.asarray(b, np.uint8))
+
+    # CLI: per-block runs + catrack == whole-run track files, byte for byte
+    whole_anno = (tmp_path / ".t.inqual.anno").read_bytes()
+    whole_data = (tmp_path / ".t.inqual.data").read_bytes()
+    for i in range(1, nb + 1):
+        assert main(["inqual", db_path, out["las"], "-d", "14", "--block", str(i)]) == 0
+        assert (tmp_path / f".t.{i}.inqual.anno").exists()
+    assert main(["catrack", db_path, "inqual", "-d"]) == 0
+    assert (tmp_path / ".t.inqual.anno").read_bytes() == whole_anno
+    assert (tmp_path / ".t.inqual.data").read_bytes() == whole_data
+    assert not (tmp_path / ".t.1.inqual.anno").exists()  # -d removed block files
+
+    with pytest.raises(ValueError):
+        lastools.compute_intrinsic_qv(db, las, depth=14, block=nb + 1)
