@@ -201,8 +201,12 @@ def write_db(path: str, seqs: list[np.ndarray], names: list[str] | None = None, 
                   names=names)
 
 
-def read_db(path: str) -> DazzDB:
-    """Load a DB triple written by :func:`write_db` (or DAZZ_DB-compatible)."""
+def read_db(path: str, load_bases: bool = True) -> DazzDB:
+    """Load a DB triple written by :func:`write_db` (or DAZZ_DB-compatible).
+
+    ``load_bases=False`` skips the .bps base store (multi-GB on real DBs) for
+    consumers that only need read lengths/metadata — e.g. the track tools'
+    per-block jobs, which must stay O(block) in memory."""
     d, stem = _db_stems(path)
     idx_path = os.path.join(d, f".{stem}.idx")
     bps_path = os.path.join(d, f".{stem}.bps")
@@ -220,7 +224,7 @@ def read_db(path: str) -> DazzDB:
             origin, rlen, fpulse, boff, coff, flags = struct.unpack_from(_READ_FMT, raw, i * _READ_SIZE)
             reads.append(DazzRead(origin, rlen, fpulse, boff, coff, flags))
 
-    bps = np.fromfile(bps_path, dtype=np.uint8)
+    bps = np.fromfile(bps_path, dtype=np.uint8) if load_bases else np.zeros(0, np.uint8)
 
     names: list[str] = []
     name_path = os.path.join(d, f".{stem}.names")

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import struct
 import sys
 
 from ..formats.dazzdb import read_db
@@ -187,7 +188,7 @@ def intrinsicqv_main(argv=None) -> int:
                    help="process only DB block I (1-based); writes a per-block "
                         "track to merge with `catrack`")
     args = p.parse_args(argv)
-    db = read_db(args.db)
+    db = read_db(args.db, load_bases=False)  # lengths only: block jobs stay O(block)
     las = LasFile(args.las)
     lastools.compute_intrinsic_qv(db, las, depth=args.d, block=args.block)
     return 0
@@ -204,7 +205,7 @@ def detectrepeats_main(argv=None) -> int:
                    help="process only DB block I (1-based); writes a per-block "
                         "track to merge with `catrack`")
     args = p.parse_args(argv)
-    db = read_db(args.db)
+    db = read_db(args.db, load_bases=False)
     las = LasFile(args.las)
     lastools.detect_repeats(db, las, depth=args.d, cov_factor=args.factor,
                             block=args.block)
@@ -219,7 +220,7 @@ def filteralignments_main(argv=None) -> int:
     p.add_argument("out")
     p.add_argument("--max-err", type=float, default=None)
     args = p.parse_args(argv)
-    db = read_db(args.db)
+    db = read_db(args.db, load_bases=False)
     las = LasFile(args.las)
     n = lastools.filter_alignments(db, las, args.out, max_err=args.max_err)
     print(f"kept {n} of {las.novl}", file=sys.stderr)
@@ -233,7 +234,7 @@ def filtersym_main(argv=None) -> int:
     p.add_argument("out")
     p.add_argument("--db", default=None, help="DB for exact complement mirroring")
     args = p.parse_args(argv)
-    db = read_db(args.db) if args.db else None
+    db = read_db(args.db, load_bases=False) if args.db else None
     n = lastools.filter_symmetric(args.las, args.out, db=db)
     print(f"kept {n}", file=sys.stderr)
     return 0
@@ -367,6 +368,175 @@ def db2fasta_main(argv=None) -> int:
                         ints_to_seq(db.read_bases(i)))
             for i in range(db.nreads)]
     write_fasta(sys.stdout if args.out == "-" else args.out, recs)
+    return 0
+
+
+def dbstats_main(argv=None) -> int:
+    """db-stats: read/base counts, length distribution, N50, block partition
+    (DAZZ_DB ``DBstats`` role)."""
+    p = argparse.ArgumentParser(prog="db-stats", description=dbstats_main.__doc__)
+    p.add_argument("db")
+    args = p.parse_args(argv)
+    import numpy as np
+
+    from ..formats.dazzdb import db_blocks, read_lengths
+
+    rlens = np.sort(read_lengths(args.db))[::-1]
+    tot = int(rlens.sum())
+    n50 = 0
+    if tot:
+        n50 = int(rlens[np.searchsorted(np.cumsum(rlens), tot / 2)])
+    try:
+        nblocks = len(db_blocks(args.db))
+    except (OSError, ValueError, IndexError):
+        nblocks = 1
+    print(f"{len(rlens):>12,} reads  {tot:>15,} bases  in {nblocks} block(s)")
+    if len(rlens):
+        print(f"{'min':>12} {int(rlens[-1]):>11,}\n"
+              f"{'median':>12} {int(np.median(rlens)):>11,}\n"
+              f"{'mean':>12} {int(rlens.mean()):>11,}\n"
+              f"{'N50':>12} {n50:>11,}\n"
+              f"{'max':>12} {int(rlens[0]):>11,}")
+    return 0
+
+
+def dbshow_main(argv=None) -> int:
+    """db-show: print selected reads as FASTA (DAZZ_DB ``DBshow`` role).
+    Read selectors are 0-based ids or i-j ranges (end exclusive); no selector
+    dumps the whole DB."""
+    p = argparse.ArgumentParser(prog="db-show", description=dbshow_main.__doc__)
+    p.add_argument("db")
+    p.add_argument("reads", nargs="*", help="read ids: '7' or '3-12' (0-based, end-exclusive)")
+    p.add_argument("-o", "--out", default="-", help="output FASTA ('-' = stdout)")
+    args = p.parse_args(argv)
+    from ..formats.fasta import FastaRecord, write_fasta
+    from ..utils.bases import ints_to_seq
+
+    db = read_db(args.db)
+    ids: list[int] = []
+    for sel in args.reads:
+        try:
+            if "-" in sel:
+                i, j = (int(x) for x in sel.split("-", 1))
+                ids.extend(range(i, j))
+            else:
+                ids.append(int(sel))
+        except ValueError:
+            raise SystemExit(f"db-show: bad read selector {sel!r} (use 'i' or 'i-j')")
+    if not args.reads:
+        ids = list(range(db.nreads))
+    bad = [i for i in ids if not (0 <= i < db.nreads)]
+    if bad:
+        raise SystemExit(f"db-show: read id(s) out of range (DB has {db.nreads} reads): {bad[:5]}")
+    recs = (FastaRecord(db.names[i] if i < len(db.names) else f"read{i}",
+                        ints_to_seq(db.read_bases(i))) for i in ids)
+    write_fasta(sys.stdout if args.out == "-" else args.out, recs)
+    return 0
+
+
+def lasshow_main(argv=None) -> int:
+    """las-show: human-readable LAS dump (DALIGNER ``LAshow`` role)."""
+    p = argparse.ArgumentParser(prog="las-show", description=lasshow_main.__doc__)
+    p.add_argument("las")
+    p.add_argument("-n", type=int, default=None, help="print at most N records")
+    p.add_argument("--trace", action="store_true", help="also print per-tile (diffs, b-bases)")
+    args = p.parse_args(argv)
+    las = LasFile(args.las)
+    print(f"{las.novl} records, tspace {las.tspace}")
+    for i, o in enumerate(las):
+        if args.n is not None and i >= args.n:
+            break
+        strand = "c" if o.is_comp else "n"
+        print(f"{o.aread:>9} {o.bread:>9} {strand} "
+              f"[{o.abpos:>9}..{o.aepos:>9}] x [{o.bbpos:>9}..{o.bepos:>9}] "
+              f"diffs {o.diffs}")
+        if args.trace:
+            for d, b in o.trace:
+                print(f"          ({d:>4}, {b:>4})")
+    return 0
+
+
+def lascheck_main(argv=None) -> int:
+    """las-check: validate LAS structure (DALIGNER ``LAcheck`` role): header
+    count vs records, aread sort order, coordinate sanity, per-record trace
+    tile counts, and (with a DB) coordinate bounds against read lengths.
+    Exit status 1 on any violation."""
+    p = argparse.ArgumentParser(prog="las-check", description=lascheck_main.__doc__)
+    p.add_argument("las")
+    p.add_argument("--db", default=None, help="DB to bounds-check coordinates against")
+    p.add_argument("--max-report", type=int, default=10)
+    args = p.parse_args(argv)
+    rlens = None
+    if args.db:
+        from ..formats.dazzdb import read_lengths
+
+        rlens = read_lengths(args.db)
+    las = LasFile(args.las)
+    errs: list[str] = []
+
+    def report(msg: str):
+        if len(errs) < args.max_report:
+            errs.append(msg)
+
+    n = 0
+    prev = (-1, -1, -1)
+    try:
+        for o in las:
+            key = (o.aread, o.bread, o.abpos)
+            if key < prev:
+                report(f"record {n}: sort order violated {prev} > {key}")
+            prev = key
+            if not (0 <= o.abpos < o.aepos) or not (0 <= o.bbpos < o.bepos):
+                report(f"record {n}: degenerate span a[{o.abpos},{o.aepos}) b[{o.bbpos},{o.bepos})")
+            elif len(o.trace) != o.ntiles(las.tspace):
+                report(f"record {n}: {len(o.trace)} trace tiles, expected {o.ntiles(las.tspace)}")
+            elif int(o.trace[:, 1].sum()) != o.bepos - o.bbpos:
+                report(f"record {n}: trace b-bases {int(o.trace[:, 1].sum())} != span {o.bepos - o.bbpos}")
+            if rlens is not None:
+                if not (0 <= o.aread < len(rlens)) or not (0 <= o.bread < len(rlens)):
+                    report(f"record {n}: read id out of range ({o.aread}, {o.bread})")
+                elif o.aepos > rlens[o.aread] or o.bepos > rlens[o.bread]:
+                    report(f"record {n}: span exceeds read length")
+            n += 1
+    except (ValueError, struct.error) as ex:
+        # a file truncated mid-record/mid-trace is exactly what this tool
+        # exists to detect — report it, don't traceback
+        report(f"record {n}: file truncated or corrupt mid-record ({ex})")
+    if n != las.novl:
+        report(f"header novl {las.novl} != {n} records")
+    for e in errs:
+        print(e, file=sys.stderr)
+    print(f"{args.las}: {n} records {'OK' if not errs else 'BAD'}", file=sys.stderr)
+    return 1 if errs else 0
+
+
+def lassplit_main(argv=None) -> int:
+    """las-split: split an aread-sorted LAS into per-DB-block files (DALIGNER
+    ``LAsplit`` role — the inverse of las-merge; block jobs then read only
+    their own file). Output template must contain '#' (block number)."""
+    p = argparse.ArgumentParser(prog="las-split", description=lassplit_main.__doc__)
+    p.add_argument("las")
+    p.add_argument("db", help="DB whose block partition drives the split")
+    p.add_argument("template", help="output path template, e.g. out.#.las")
+    args = p.parse_args(argv)
+    if "#" not in args.template:
+        raise SystemExit("las-split: template must contain '#'")
+    from ..formats.dazzdb import db_blocks
+    from ..formats.las import range_for_areads, write_las
+
+    las = LasFile(args.las)
+    total = 0
+    for i, (lo, hi) in enumerate(db_blocks(args.db), start=1):
+        start, end = range_for_areads(args.las, lo, hi)
+        n = write_las(args.template.replace("#", str(i)), las.tspace,
+                      las.iter_range(start, end))
+        total += n
+        print(f"block {i}: reads [{lo},{hi}) -> {n} overlaps", file=sys.stderr)
+    if total != las.novl:
+        # e.g. a LAS built against a different (larger) DB: records whose
+        # aread lies outside the block partition would vanish silently
+        raise SystemExit(f"las-split: {las.novl - total} of {las.novl} overlaps "
+                         f"fall outside {args.db}'s block partition")
     return 0
 
 
@@ -534,6 +704,11 @@ _TOOLS = {
     "lasmerge": lasmerge_main,
     "catrack": catrack_main,
     "lasindex": lasindex_main,
+    "lasshow": lasshow_main,
+    "lascheck": lascheck_main,
+    "lassplit": lassplit_main,
+    "dbstats": dbstats_main,
+    "dbshow": dbshow_main,
     "fasta2db": fasta2db_main,
     "db2fasta": db2fasta_main,
     "dbsplit": dbsplit_main,

@@ -376,3 +376,84 @@ def test_block_tracks_catrack(dataset, tmp_path):
 
     with pytest.raises(ValueError):
         lastools.compute_intrinsic_qv(db, las, depth=14, block=nb + 1)
+
+
+def test_inspection_tools(dataset, tmp_path, capsys):
+    """dbstats/dbshow/lasshow/lascheck/lassplit (DAZZ_DB DBstats/DBshow and
+    DALIGNER LAshow/LAcheck/LAsplit roles)."""
+    import shutil
+
+    from daccord_tpu.formats.dazzdb import db_blocks, split_db
+    from daccord_tpu.formats.las import write_las
+    from daccord_tpu.tools.cli import main
+
+    out, d = dataset
+    db = read_db(out["db"])
+
+    assert main(["dbstats", out["db"]]) == 0
+    stats_out = capsys.readouterr().out
+    assert f"{db.nreads:,} reads" in stats_out and "N50" in stats_out
+
+    assert main(["dbshow", out["db"], "0", "2-4", "-o", str(tmp_path / "sel.fasta")]) == 0
+    from daccord_tpu.formats.fasta import read_fasta
+    recs = list(read_fasta(str(tmp_path / "sel.fasta")))
+    assert len(recs) == 3
+    assert recs[0].seq == "".join("ACGT"[b] for b in db.read_bases(0))
+    with pytest.raises(SystemExit):
+        main(["dbshow", out["db"], str(db.nreads)])
+
+    assert main(["lasshow", out["las"], "-n", "5", "--trace"]) == 0
+    las = LasFile(out["las"])
+    show = capsys.readouterr().out
+    assert f"{las.novl} records, tspace {las.tspace}" in show
+
+    # the simulator's LAS is structurally valid, with and without DB bounds
+    assert main(["lascheck", out["las"], "--db", out["db"]]) == 0
+    # corrupt: drop aepos below abpos in one record
+    bad = [o for o in las]
+    bad[3].aepos = bad[3].abpos
+    badp = str(tmp_path / "bad.las")
+    write_las(badp, las.tspace, bad)
+    assert main(["lascheck", badp]) == 1
+    # truncated header count
+    trunc = str(tmp_path / "trunc.las")
+    shutil.copy(out["las"], trunc)
+    with open(trunc, "r+b") as fh:
+        import struct
+        fh.write(struct.pack("<q", las.novl + 7))
+    assert main(["lascheck", trunc]) == 1
+    # file cut mid-trace: must report BAD, not traceback
+    cut = str(tmp_path / "cut.las")
+    raw = open(out["las"], "rb").read()
+    with open(cut, "wb") as fh:
+        fh.write(raw[: len(raw) - 3])
+    assert main(["lascheck", cut]) == 1
+    with pytest.raises(SystemExit):
+        main(["dbshow", out["db"], "3-"])
+
+    # lassplit: per-block files concat (in block order) == whole file's records
+    for f in ("t.db", ".t.idx", ".t.bps", ".t.names"):
+        shutil.copy(f"{d}/{f}", tmp_path / f)
+    db_path = str(tmp_path / "t.db")
+    split_db(db_path, block_bases=8000)
+    nb = len(db_blocks(db_path))
+    tmpl = str(tmp_path / "part.#.las")
+    assert main(["lassplit", out["las"], db_path, tmpl]) == 0
+    tot = 0
+    parts = [tmpl.replace("#", str(i)) for i in range(1, nb + 1)]
+    for p in parts:
+        assert main(["lascheck", p]) == 0
+        tot += LasFile(p).novl
+    assert tot == las.novl
+    merged = str(tmp_path / "merged.las")
+    assert main(["lasmerge", merged, *parts]) == 0
+    assert open(merged, "rb").read() == open(out["las"], "rb").read()
+
+    # an overlap whose aread is outside the DB's partition must not vanish
+    # silently: lassplit exits nonzero instead of dropping it
+    stray = [o for o in las][:2]
+    stray[1].aread = db.nreads + 5
+    strayp = str(tmp_path / "stray.las")
+    write_las(strayp, las.tspace, stray)
+    with pytest.raises(SystemExit):
+        main(["lassplit", strayp, db_path, str(tmp_path / "s.#.las")])
