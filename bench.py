@@ -28,8 +28,11 @@ CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
 N_BENCH_WINDOWS = 32768
 # 2048 measured ~2x the 1024-batch throughput on the tunneled v5e (batch-size
 # sweep 2026-07-30: 1024 -> 330-459k bases/s, 2048 -> 652k): per-dispatch
-# overhead dominates single-digit-ms compute, so bigger batches amortize it
-BATCH = 2048
+# overhead dominates single-digit-ms compute, so bigger batches amortize it.
+# DACCORD_BENCH_BATCH overrides for sweeps (must divide N_BENCH_WINDOWS).
+BATCH = int(os.environ.get("DACCORD_BENCH_BATCH", "2048"))
+assert 0 < BATCH <= N_BENCH_WINDOWS and N_BENCH_WINDOWS % BATCH == 0, \
+    f"DACCORD_BENCH_BATCH={BATCH} must divide N_BENCH_WINDOWS={N_BENCH_WINDOWS}"
 DEPTH, SEG_LEN, WLEN = 32, 64, 40
 
 

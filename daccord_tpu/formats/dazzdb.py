@@ -86,6 +86,9 @@ class DazzDB:
     def read_bases(self, i: int) -> np.ndarray:
         """Decode read ``i`` to an int8 array of 0..3."""
         r = self.reads[i]
+        if len(self.bps) == 0 and r.rlen > 0:
+            raise ValueError("DB was opened with load_bases=False (no base store); "
+                             "use read_db(path) or decode_reads_from_bps for bases")
         nbytes = (r.rlen + 3) // 4
         return unpack_2bit(self.bps[r.boff : r.boff + nbytes], r.rlen)
 
@@ -93,6 +96,9 @@ class DazzDB:
         """Decode many reads at once (native 2-bit batch decode when built —
         SURVEY.md §2.4; bit-identical Python fallback otherwise)."""
         ids = list(ids)
+        if len(self.bps) == 0 and any(self.reads[i].rlen > 0 for i in ids):
+            raise ValueError("DB was opened with load_bases=False (no base store); "
+                             "use read_db(path) or decode_reads_from_bps for bases")
         try:
             from ..native import available
             from ..native.api import decode_reads_batch
@@ -234,6 +240,22 @@ def read_db(path: str, load_bases: bool = True) -> DazzDB:
 
     return DazzDB(path=os.path.join(d, f"{stem}.db"), nreads=nreads, totlen=totlen,
                   maxlen=maxlen, cutoff=cutoff, reads=reads, bps=bps, names=names)
+
+
+def decode_reads_from_bps(db: DazzDB, ids) -> list[np.ndarray]:
+    """Decode selected reads by seeking the .bps on disk — O(selected bytes)
+    memory, for lengths-only DB handles (``read_db(load_bases=False)``).
+    The DAZZ_DB ``DBshow`` access pattern."""
+    d, stem = _db_stems(db.path)
+    out: list[np.ndarray] = []
+    with open(os.path.join(d, f".{stem}.bps"), "rb") as fh:
+        for i in ids:
+            r = db.reads[i]
+            nbytes = (r.rlen + 3) // 4
+            fh.seek(r.boff)
+            buf = np.frombuffer(fh.read(nbytes), dtype=np.uint8)
+            out.append(unpack_2bit(buf, r.rlen))
+    return out
 
 
 # ---------------------------------------------------------------------------

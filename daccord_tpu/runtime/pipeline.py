@@ -75,6 +75,15 @@ class PipelineConfig:
                                  # Pallas TPU kernel (pallas_dp); bit-identical
                                  # results (tests/test_pallas.py), TPU only —
                                  # ignored on the CPU solve_tiered path
+    end_trim: bool = True        # treat prefix/suffix runs of windows solved
+                                 # only by a low-confidence rescue tier
+                                 # (min_count<=1) as unsolved: read ends have
+                                 # thin piles, and rescue-solved end windows
+                                 # carry near-raw error rates (measured ~10x
+                                 # the interior rate). Trimming them costs ~2%
+                                 # of output bases and no extra fragments;
+                                 # interior rescue windows keep the read
+                                 # contiguous and are left alone
     log_path: str | None = None  # jsonl event log ('-' = stderr)
     verbose: bool = False
 
@@ -84,6 +93,7 @@ class PipelineStats:
     n_reads: int = 0
     n_windows: int = 0
     n_solved: int = 0
+    n_end_trimmed: int = 0
     n_fragments: int = 0
     bases_in: int = 0
     bases_out: int = 0
@@ -104,7 +114,7 @@ class PipelineStats:
 
 
 class _PendingRead:
-    __slots__ = ("aread", "a_bases", "n_windows", "results", "n_done")
+    __slots__ = ("aread", "a_bases", "n_windows", "results", "n_done", "tiers")
 
     def __init__(self, aread: int, a_bases: np.ndarray, n_windows: int):
         self.aread = aread
@@ -112,6 +122,31 @@ class _PendingRead:
         self.n_windows = n_windows
         self.results: list = [None] * n_windows
         self.n_done = 0
+        self.tiers = np.full(n_windows, -1, dtype=np.int32)
+
+
+def _trim_rescue_ends(pr: _PendingRead, rescue_tiers: set, stats: PipelineStats) -> None:
+    """Null out prefix/suffix runs of rescue-tier-solved windows (see
+    PipelineConfig.end_trim). Scanning skips over already-unsolved windows
+    (they are split points either way) and stops at the first window solved
+    by a confident tier."""
+    res = pr.results
+
+    def sweep(idxs) -> None:
+        for j in idxs:
+            ws, wl, seq = res[j]
+            if seq is None:
+                continue
+            t = int(pr.tiers[j])
+            if t not in rescue_tiers:
+                return
+            res[j] = (ws, wl, None)
+            stats.n_solved -= 1
+            stats.n_end_trimmed += 1
+            stats.tier_histogram[t] = stats.tier_histogram.get(t, 0) - 1
+
+    sweep(range(pr.n_windows))
+    sweep(range(pr.n_windows - 1, -1, -1))
 
 
 def estimate_profile_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
@@ -312,6 +347,13 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
     inflight: deque = deque()    # (handle, rid, widx, take, t_dispatch)
 
+    # rescue tiers = frequency filter effectively off (min_count <= 1);
+    # their end-of-read solutions get trimmed (see PipelineConfig.end_trim).
+    # In patch mode unsolved windows are refilled with RAW bases — strictly
+    # worse than any rescue consensus — so trimming only applies to split mode
+    rescue_tiers = ({i for i, t in enumerate(cfg.consensus.tiers) if t[1] <= 1}
+                    if cfg.end_trim and cfg.consensus.mode != "patch" else set())
+
     def scatter(out, rid, widx, take):
         n_batch_solved = 0
         for i in range(take):
@@ -326,8 +368,11 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 stats.n_solved += 1
                 n_batch_solved += 1
                 t = int(out["tier"][i])
+                pr.tiers[wj] = t
                 stats.tier_histogram[t] = stats.tier_histogram.get(t, 0) + 1
             if pr.n_done == pr.n_windows:
+                if rescue_tiers:
+                    _trim_rescue_ends(pr, rescue_tiers, stats)
                 rows = [x for x in pr.results if x is not None]
                 ready[r] = stitch_results(pr.a_bases, rows, cfg.consensus)
                 del pending[r]

@@ -67,6 +67,10 @@ def daccord_main(argv=None) -> int:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler device trace into DIR")
     p.add_argument("--no-native", action="store_true", help="disable C++ host path")
+    p.add_argument("--no-end-trim", action="store_true",
+                   help="keep rescue-tier solutions at read ends (default: "
+                        "trim them — thin end-of-read piles solved with the "
+                        "frequency filter off carry ~10x the interior error rate)")
     p.add_argument("--backend", choices=("auto", "cpu", "tpu"), default="auto",
                    help="device backend (SURVEY.md §5 config row); 'cpu' forces the "
                         "host platform before any backend init — the only reliable "
@@ -117,7 +121,8 @@ def daccord_main(argv=None) -> int:
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
                          depth=args.depth, seg_len=args.seg_len,
                          log_path=args.log, use_native=not args.no_native,
-                         feeder_threads=args.threads, use_pallas=args.pallas)
+                         feeder_threads=args.threads, use_pallas=args.pallas,
+                         end_trim=not args.no_end_trim)
 
     import os
 
@@ -164,6 +169,7 @@ def daccord_main(argv=None) -> int:
                                  end=end, profile=prof, solver=solver)
     line = {
         "reads": stats.n_reads, "windows": stats.n_windows, "solved": stats.n_solved,
+        "end_trimmed": stats.n_end_trimmed,
         "fragments": stats.n_fragments, "bases_in": stats.bases_in,
         "bases_out": stats.bases_out, "wall_s": round(stats.wall_s, 3),
         "device_s": round(stats.device_s, 3),
@@ -409,10 +415,11 @@ def dbshow_main(argv=None) -> int:
     p.add_argument("reads", nargs="*", help="read ids: '7' or '3-12' (0-based, end-exclusive)")
     p.add_argument("-o", "--out", default="-", help="output FASTA ('-' = stdout)")
     args = p.parse_args(argv)
+    from ..formats.dazzdb import decode_reads_from_bps
     from ..formats.fasta import FastaRecord, write_fasta
     from ..utils.bases import ints_to_seq
 
-    db = read_db(args.db)
+    db = read_db(args.db, load_bases=False)  # bases seeked per selected read
     ids: list[int] = []
     for sel in args.reads:
         try:
@@ -429,7 +436,8 @@ def dbshow_main(argv=None) -> int:
     if bad:
         raise SystemExit(f"db-show: read id(s) out of range (DB has {db.nreads} reads): {bad[:5]}")
     recs = (FastaRecord(db.names[i] if i < len(db.names) else f"read{i}",
-                        ints_to_seq(db.read_bases(i))) for i in ids)
+                        ints_to_seq(bases))
+            for i, bases in zip(ids, decode_reads_from_bps(db, ids)))
     write_fasta(sys.stdout if args.out == "-" else args.out, recs)
     return 0
 

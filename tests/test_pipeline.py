@@ -128,3 +128,64 @@ def test_ont_preset_end_to_end(tmp_path):
     assert qveval_main([fasta, out["truth"], "--raw-db", out["db"], "--json", jout]) == 0
     line = _json.loads(open(jout).read())
     assert line["qscore"] > line["raw_qscore"] + 6, line
+
+
+def test_trim_rescue_ends_unit():
+    """Prefix/suffix rescue-tier runs are nulled; interior ones and confident
+    tiers survive; unsolved gaps are scanned over."""
+    from daccord_tpu.runtime.pipeline import PipelineStats, _PendingRead, _trim_rescue_ends
+
+    def mk(tiers_seq):
+        pr = _PendingRead(0, np.zeros(100, np.int8), len(tiers_seq))
+        st = PipelineStats()
+        for j, t in enumerate(tiers_seq):
+            seq = None if t is None else np.zeros(40, np.int8)
+            pr.results[j] = (j * 10, 40, seq)
+            if t is not None:
+                pr.tiers[j] = t
+                st.n_solved += 1
+                st.tier_histogram[t] = st.tier_histogram.get(t, 0) + 1
+        return pr, st
+
+    pr, st = mk([3, 0, 3, 1, 3, 3])
+    _trim_rescue_ends(pr, {3}, st)
+    kept = [pr.results[j][2] is not None for j in range(6)]
+    assert kept == [False, True, True, True, False, False]
+    assert st.n_end_trimmed == 3 and st.tier_histogram[3] == 1
+
+    # unsolved gaps do not stop the sweep
+    pr, st = mk([3, None, 3, 0])
+    _trim_rescue_ends(pr, {3}, st)
+    assert [pr.results[j][2] is not None for j in range(4)] == [False, False, False, True]
+    assert st.n_end_trimmed == 2
+
+    # an all-rescue read trims away entirely
+    pr, st = mk([3, 3])
+    _trim_rescue_ends(pr, {3}, st)
+    assert st.n_end_trimmed == 2 and st.n_solved == 0
+
+
+def test_end_trim_pipeline(dataset):
+    """end_trim drops low-confidence end windows: fewer output bases, solved
+    count reduced by exactly the trimmed count, and no fragmentation blow-up."""
+    out, d = dataset
+    f_on = os.path.join(d, "trim_on.fasta")
+    f_off = os.path.join(d, "trim_off.fasta")
+    s_on = correct_to_fasta(out["db"], out["las"], f_on,
+                            PipelineConfig(batch_size=256, end_trim=True))
+    s_off = correct_to_fasta(out["db"], out["las"], f_off,
+                             PipelineConfig(batch_size=256, end_trim=False))
+    assert s_off.n_end_trimmed == 0
+    assert s_on.n_end_trimmed > 0
+    assert s_on.n_solved == s_off.n_solved - s_on.n_end_trimmed
+    assert s_on.bases_out < s_off.bases_out
+    assert s_on.n_fragments <= s_off.n_fragments + s_on.n_end_trimmed
+
+    # patch mode refills unsolved windows with raw bases, which would be
+    # strictly worse than the rescue consensus — end_trim must not engage
+    from daccord_tpu.oracle.consensus import ConsensusConfig
+
+    s_patch = correct_to_fasta(out["db"], out["las"], os.path.join(d, "patch.fasta"),
+                               PipelineConfig(batch_size=256, end_trim=True,
+                                              consensus=ConsensusConfig(mode="patch")))
+    assert s_patch.n_end_trimmed == 0
