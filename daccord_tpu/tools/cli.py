@@ -67,6 +67,15 @@ def daccord_main(argv=None) -> int:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler device trace into DIR")
     p.add_argument("--no-native", action="store_true", help="disable C++ host path")
+    p.add_argument("--candidates", type=int, default=3, metavar="N",
+                   help="DBG paths rescored per window (measured on synthetic "
+                        "25x PacBio-like: 5 -> +0.5 Q and slightly fewer "
+                        "fragments vs 3, at extra per-window backtrack/rescore "
+                        "device cost)")
+    p.add_argument("--max-err", type=float, default=0.3,
+                   help="reject window consensus above this mean edit rate vs "
+                        "its segments (0.2 -> +0.7 Q but +11%% fragments on the "
+                        "same measurement)")
     p.add_argument("--no-end-trim", action="store_true",
                    help="keep rescue-tier solutions at read ends (default: "
                         "trim them — thin end-of-read piles solved with the "
@@ -117,7 +126,11 @@ def daccord_main(argv=None) -> int:
         raise SystemExit(f"escalated k {k + 4} (from -k {k}) needs window size > "
                          f"{k + 4} and --seg-len > {k + 5}")
     tiers = ((k, 2, 2), (k + 2, 2, 2), (k + 4, 2, 2), (k, 1, 1))
-    ccfg = ConsensusConfig(w=args.w, adv=args.a, mode=args.mode, tiers=tiers)
+    from ..oracle.dbg import DBGParams
+
+    ccfg = ConsensusConfig(w=args.w, adv=args.a, mode=args.mode, tiers=tiers,
+                           dbg=DBGParams(n_candidates=args.candidates,
+                                         max_err=args.max_err))
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
                          depth=args.depth, seg_len=args.seg_len,
                          log_path=args.log, use_native=not args.no_native,
