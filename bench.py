@@ -168,20 +168,9 @@ def device_throughput(data: dict, max_batches: int | None = None,
 
 
 def _device_alive(timeout_s: int = 150) -> bool:
-    """Probe device init in a subprocess: a dead axon tunnel hangs forever
-    inside make_c_api_client, which would wedge the whole bench run."""
-    import subprocess
-    import sys
+    from daccord_tpu.utils.obs import device_alive
 
-    code = ("import jax, jax.numpy as jnp;"
-            "jax.block_until_ready(jnp.ones((8,8)) @ jnp.ones((8,8)));"
-            "print('ok')")
-    try:
-        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                           timeout=timeout_s)
-        return b"ok" in r.stdout
-    except Exception:
-        return False
+    return device_alive(timeout_s)
 
 
 def main() -> None:

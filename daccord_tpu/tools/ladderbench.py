@@ -238,16 +238,29 @@ def main(argv=None) -> int:
     if unknown:
         p.error(f"unknown configs {unknown}; valid: {', '.join(RUNGS)}")
 
+    from daccord_tpu.utils.obs import device_alive
+
+    fallback = False
+    if not device_alive():
+        # dead axon tunnel hangs default-backend init forever; run the ladder
+        # on CPU with a machine-detectable marker (same policy as bench.py)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        fallback = True
+
     import jax
 
     for name in names:
         r = RUNGS[name]
         mesh = r.get("mesh", 0)
         if "shards" in r:
-            print(json.dumps(run_rung_shards(name, r["sim_kw"], r["shards"])))
+            print(json.dumps({**run_rung_shards(name, r["sim_kw"], r["shards"]),
+                              "fallback": fallback}))
             continue
         if "procs" in r:
-            print(json.dumps(run_rung_procs(name, r["sim_kw"], r["procs"])))
+            print(json.dumps({**run_rung_procs(name, r["sim_kw"], r["procs"]),
+                              "fallback": fallback}))
             continue
         if mesh > 1 and len(jax.devices()) < mesh:
             # not enough real devices: re-enter in a fresh interpreter, where
@@ -268,7 +281,7 @@ def main(argv=None) -> int:
         else:
             row = run_rung(name, r["sim_kw"], feeder_threads=args.threads,
                            mesh=mesh)
-            print(json.dumps(row))
+            print(json.dumps({**row, "fallback": fallback}))
     return 0
 
 

@@ -39,6 +39,25 @@ class NullLogger(JsonlLogger):
         super().__init__(None)
 
 
+def device_alive(timeout_s: int = 150) -> bool:
+    """Probe default-backend device init in a subprocess: a dead axon tunnel
+    HANGS forever inside make_c_api_client (it does not error), which would
+    wedge any tool that touches the default backend. Shared by bench.py and
+    ladderbench."""
+    import subprocess
+    import sys
+
+    code = ("import jax, jax.numpy as jnp;"
+            "jax.block_until_ready(jnp.ones((8,8)) @ jnp.ones((8,8)));"
+            "print('ok')")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           timeout=timeout_s)
+        return b"ok" in r.stdout
+    except Exception:
+        return False
+
+
 def enable_compilation_cache() -> str | None:
     """Turn on JAX's persistent compilation cache (opt out:
     DACCORD_NO_COMPCACHE=1; relocate: DACCORD_COMPCACHE=dir).
