@@ -153,3 +153,19 @@ def test_stitch_long_read_linear_time():
     assert len(frags) == 1
     assert abs(len(frags[0]) - rlen) < 100
     assert dt < 30, f"stitching 20k windows took {dt:.1f}s"
+
+
+def test_profile_decollapse_accuracy(pile_fixture):
+    """The de-collapse correction in profile_vs_consensus recovers the
+    generative rates to ~20% relative error; the uncorrected unit-cost op
+    counts misattribute ~half the deletions as substitutions (a deletion
+    with an insertion within ~2 positions aligns as one substitution)."""
+    cfg, _, _, a, refined = pile_fixture
+    ccfg = ConsensusConfig()
+    windows = cut_windows(a, refined, w=ccfg.w, adv=ccfg.adv)
+    prof = estimate_profile_two_pass(refined, windows, ccfg, sample=32)
+    assert abs(prof.p_ins - cfg.p_ins) / cfg.p_ins < 0.25
+    assert abs(prof.p_del - cfg.p_del) / cfg.p_del < 0.35
+    # residual sub inflation comes from consensus errors; it must at least
+    # be far below the uncorrected ~2.3x over-estimate
+    assert prof.p_sub < 2.0 * cfg.p_sub
