@@ -74,6 +74,12 @@ def estimate_profile(refined: list[RefinedOverlap], a_len_total: int | None = No
     pair error rate is the sum of both reads' error rates, per-read rates are
     half the pair rates (both reads drawn from the same noise process — the
     reference's estimator likewise works on pair alignments).
+
+    NOTE: raw op counts from optimal unit-cost paths carry the del+ins ->
+    sub collapse bias quantified (and corrected) in
+    :func:`profile_vs_consensus`; the production pipeline uses the two-pass
+    estimator (``estimate_profile_two_pass``), which routes through that
+    corrected counter. This single-pass variant is kept for diagnostics.
     """
     n_adv0 = 0       # pair deletions
     n_ins = 0        # pair inserted bases
