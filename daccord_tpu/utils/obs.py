@@ -39,23 +39,33 @@ class NullLogger(JsonlLogger):
         super().__init__(None)
 
 
-def device_alive(timeout_s: int = 150) -> bool:
-    """Probe default-backend device init in a subprocess: a dead axon tunnel
-    HANGS forever inside make_c_api_client (it does not error), which would
-    wedge any tool that touches the default backend. Shared by bench.py and
-    ladderbench."""
+def probe_default_backend(timeout_s: int = 150) -> int:
+    """Device count of the default backend, probed from a throwaway
+    subprocess: a dead axon tunnel HANGS forever inside make_c_api_client
+    (it does not error), which would wedge any process that touches the
+    default backend — the subprocess bounds the hang to ``timeout_s``.
+    Returns 0 when the backend is dead/unreachable. The one probe (and one
+    timeout policy) shared by bench.py, ladderbench and __graft_entry__."""
     import subprocess
     import sys
 
     code = ("import jax, jax.numpy as jnp;"
             "jax.block_until_ready(jnp.ones((8,8)) @ jnp.ones((8,8)));"
-            "print('ok')")
+            "print('ndev=%d' % len(jax.devices()))")
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            timeout=timeout_s)
-        return b"ok" in r.stdout
+        for line in r.stdout.decode(errors="replace").splitlines():
+            if line.startswith("ndev="):
+                return int(line.split("=", 1)[1])
     except Exception:
-        return False
+        pass
+    return 0
+
+
+def device_alive(timeout_s: int = 150) -> bool:
+    """True iff default-backend init + one matmul succeeds (see probe)."""
+    return probe_default_backend(timeout_s) > 0
 
 
 def enable_compilation_cache() -> str | None:
