@@ -31,8 +31,10 @@ N_BENCH_WINDOWS = 32768
 # overhead dominates single-digit-ms compute, so bigger batches amortize it.
 # DACCORD_BENCH_BATCH overrides for sweeps (must divide N_BENCH_WINDOWS).
 BATCH = int(os.environ.get("DACCORD_BENCH_BATCH", "2048"))
-assert 0 < BATCH <= N_BENCH_WINDOWS and N_BENCH_WINDOWS % BATCH == 0, \
-    f"DACCORD_BENCH_BATCH={BATCH} must divide N_BENCH_WINDOWS={N_BENCH_WINDOWS}"
+if not (0 < BATCH <= N_BENCH_WINDOWS and N_BENCH_WINDOWS % BATCH == 0):
+    raise SystemExit(   # not assert: stripped under python -O, and a
+        # non-dividing batch silently drops the trailing partial batch
+        f"DACCORD_BENCH_BATCH={BATCH} must divide N_BENCH_WINDOWS={N_BENCH_WINDOWS}")
 DEPTH, SEG_LEN, WLEN = 32, 64, 40
 
 
@@ -78,6 +80,18 @@ def build_windows() -> dict:
     return out
 
 
+def _make_batch(data: dict, i: int, batch_size: int, shape):
+    """Slice windows [i*batch_size, (i+1)*batch_size) into a WindowBatch —
+    the one batch constructor shared by all three throughput paths."""
+    from daccord_tpu.kernels.tensorize import WindowBatch
+
+    sl = slice(i * batch_size, (i + 1) * batch_size)
+    return WindowBatch(seqs=data["seqs"][sl], lens=data["lens"][sl],
+                       nsegs=data["nsegs"][sl], shape=shape,
+                       read_ids=np.zeros(batch_size, np.int64),
+                       wstarts=np.zeros(batch_size, np.int64))
+
+
 def oracle_baseline(data: dict, n: int = 48) -> float:
     """Single-core numpy oracle throughput (consensus bases/sec)."""
     from daccord_tpu.oracle.consensus import ConsensusConfig, make_offset_likely, solve_window
@@ -113,7 +127,7 @@ def device_throughput(data: dict, max_batches: int | None = None,
 
     import jax
 
-    from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch
+    from daccord_tpu.kernels.tensorize import BatchShape
     from daccord_tpu.kernels.tiers import (TierLadder, fetch, fetch_many,
                                            solve_ladder_async)
     from daccord_tpu.oracle.consensus import ConsensusConfig
@@ -130,11 +144,7 @@ def device_throughput(data: dict, max_batches: int | None = None,
         nb = min(nb, max_batches)
 
     def make_batch(i):
-        sl = slice(i * BATCH, (i + 1) * BATCH)
-        return WindowBatch(seqs=data["seqs"][sl], lens=data["lens"][sl],
-                           nsegs=data["nsegs"][sl], shape=shape,
-                           read_ids=np.zeros(BATCH, np.int64),
-                           wstarts=np.zeros(BATCH, np.int64))
+        return _make_batch(data, i, BATCH, shape)
 
     # warmup / compile all tier shapes
     fetch(solve_ladder_async(make_batch(0), ladder))
@@ -167,6 +177,125 @@ def device_throughput(data: dict, max_batches: int | None = None,
     return bases / dt, info
 
 
+def device_compute_throughput(data: dict, max_batches: int | None = None
+                              ) -> tuple[float, dict]:
+    """Compute-bound ceiling: all batches pre-staged on device, every ladder
+    program enqueued back-to-back, ONE terminal block — no per-batch fetch,
+    no H2D inside the timed region. The gap between this number and the
+    pipelined one is pure dispatch/tunnel overhead (VERDICT r1 weak #3: the
+    chip was ~90% idle behind ~100 ms fetch RTTs and nobody had recorded the
+    ceiling). Per-stage wall times (h2d, dispatch, compute, fetch) come back
+    in the info dict so the overhead has a breakdown, not just a total.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from daccord_tpu.kernels.tiers import TierLadder, _ladder_packed_jit, unpack_result
+    from daccord_tpu.oracle.consensus import ConsensusConfig
+    from daccord_tpu.oracle.profile import ErrorProfile
+
+    prof = ErrorProfile(float(data["p_ins"]), float(data["p_del"]), float(data["p_sub"]))
+    ladder = TierLadder.from_config(prof, ConsensusConfig())
+    tables = tuple(ladder.tables[p.k] for p in ladder.params)
+    params = tuple(ladder.params)
+    cl = ladder.params[0].cons_len
+
+    N = len(data["nsegs"])
+    nb = N // BATCH
+    if max_batches is not None:
+        nb = min(nb, max_batches)
+
+    def run(staged):
+        return _ladder_packed_jit(*staged, tables, params, esc_cap=BATCH)
+
+    # H2D: stage every batch's inputs as committed device arrays
+    t0 = time.perf_counter()
+    staged = []
+    for i in range(nb):
+        sl = slice(i * BATCH, (i + 1) * BATCH)
+        staged.append((jax.device_put(jnp.asarray(data["seqs"][sl])),
+                       jax.device_put(jnp.asarray(data["lens"][sl])),
+                       jax.device_put(jnp.asarray(data["nsegs"][sl]))))
+    jax.block_until_ready(staged)
+    t_h2d = time.perf_counter() - t0
+
+    # warmup / compile (first staged batch), excluded from the timed region
+    jax.block_until_ready(run(staged[0]))
+
+    t0 = time.perf_counter()
+    outs = [run(s) for s in staged]
+    t_dispatch = time.perf_counter() - t0
+    jax.block_until_ready(outs)
+    t_total = time.perf_counter() - t0
+    t_compute = t_total - t_dispatch
+
+    t0 = time.perf_counter()
+    arrs = jax.device_get(outs)   # one grouped transfer
+    t_fetch = time.perf_counter() - t0
+
+    bases = 0
+    solved = 0
+    for a in arrs:
+        out = unpack_result(np.asarray(a), cl)
+        bases += int(out["cons_len"].sum())
+        solved += int(out["solved"].sum())
+    info = dict(compute_windows=nb * BATCH, compute_solved=solved,
+                compute_wall_s=round(t_total, 3),
+                stage_h2d_s=round(t_h2d, 3),
+                stage_dispatch_s=round(t_dispatch, 3),
+                stage_compute_s=round(t_compute, 3),
+                stage_fetch_s=round(t_fetch, 3),
+                dispatch_ms_per_batch=round(1e3 * t_dispatch / nb, 2))
+    return bases / t_total if t_total > 0 else 0.0, info
+
+
+def cpu_fallback_throughput(data: dict, n_windows: int = 2048,
+                            batch: int = 256) -> tuple[float, dict]:
+    """Honest CPU number for tunnel-outage runs: the CPU-appropriate tiered
+    path (small jitted batches + compacted rescue), not the TPU-shaped B=2048
+    program that is pessimal on host (VERDICT r1 weak #2)."""
+    import jax
+    import jax.numpy as jnp
+
+    from daccord_tpu.kernels.tensorize import BatchShape
+    from daccord_tpu.kernels.tiers import TierLadder, solve_tiered
+    from daccord_tpu.kernels.window_kernel import solve_window_batch
+    from daccord_tpu.oracle.consensus import ConsensusConfig
+    from daccord_tpu.oracle.profile import ErrorProfile
+
+    prof = ErrorProfile(float(data["p_ins"]), float(data["p_del"]), float(data["p_sub"]))
+    ladder = TierLadder.from_config(prof, ConsensusConfig())
+    shape = BatchShape(depth=DEPTH, seg_len=SEG_LEN, wlen=WLEN)
+
+    def make_batch(i):
+        return _make_batch(data, i, batch, shape)
+
+    nb = max(1, min(len(data["nsegs"]), n_windows) // batch)
+    # warmup: tier 0 at the full batch shape via solve_tiered, PLUS every
+    # rescue tier at its compact shape explicitly — solve_tiered stops at the
+    # deepest tier batch 0 happens to need, and a first-time XLA compile of a
+    # deeper tier inside the timed loop would deflate the reported number
+    cs = 64
+    solve_tiered(make_batch(0), ladder, compact_size=cs)
+    zs = jnp.asarray(np.full((cs, DEPTH, SEG_LEN), 4, np.int8))
+    zl = jnp.asarray(np.zeros((cs, DEPTH), np.int32))
+    zn = jnp.asarray(np.zeros(cs, np.int32))
+    for p in ladder.params[1:]:
+        solve_window_batch(zs, zl, zn, ladder.tables[p.k], p)
+    t0 = time.perf_counter()
+    bases = 0
+    solved = 0
+    for i in range(nb):
+        out = solve_tiered(make_batch(i), ladder, compact_size=cs)
+        bases += int(out["cons_len"][out["solved"]].sum())
+        solved += int(out["solved"].sum())
+    dt = time.perf_counter() - t0
+    info = dict(windows=nb * batch, solved=solved, wall_s=round(dt, 3),
+                device=str(jax.devices()[0]).replace(" ", ""),
+                solve_rate=round(solved / (nb * batch), 4))
+    return bases / dt if dt > 0 else 0.0, info
+
+
 def _device_alive(timeout_s: int = 150) -> bool:
     from daccord_tpu.utils.obs import device_alive
 
@@ -184,10 +313,18 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
         fallback = "cpu-fallback (device init unreachable at bench time)"
-    dev_bps, info = device_throughput(data, max_batches=2 if fallback else None)
-    info["fallback"] = bool(fallback)   # machine-detectable degraded run
     if fallback:
+        dev_bps, info = cpu_fallback_throughput(data)
         info["device"] = fallback
+    else:
+        dev_bps, info = device_throughput(data)
+        # the compute-bound ceiling + stage breakdown next to the pipelined
+        # number: their ratio is the dispatch-overhead gap being attacked
+        comp_bps, comp_info = device_compute_throughput(data)
+        info["device_compute_bases_per_sec"] = round(comp_bps, 1)
+        info.update(comp_info)
+        info["pipeline_efficiency"] = round(dev_bps / comp_bps, 3) if comp_bps else None
+    info["fallback"] = bool(fallback)   # machine-detectable degraded run
     orc_bps = oracle_baseline(data)
     line = {
         "metric": "consensus_bases_per_sec_per_chip",
@@ -203,26 +340,36 @@ def main() -> None:
     # context. Two sidecars: the machine-local cache copy, and a TRACKED
     # repo-root copy (BENCH_TPU_LAST.json) that survives fresh checkouts —
     # a fallback run on a machine that never saw the TPU still reports the
-    # last real measurement
+    # last real measurement. Payloads are timestamped and the NEWER of the
+    # two wins, so a stale local cache can't shadow a fresher committed
+    # measurement pulled from another machine (or vice versa).
     last_tpu = os.path.join(CACHE, "last_tpu.json")
     tracked = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_TPU_LAST.json")
     if not fallback:
         payload = {"value": line["value"], "wall_s": info["wall_s"],
-                   "windows": info["windows"], "device": info["device"]}
+                   "windows": info["windows"], "device": info["device"],
+                   "ts": round(time.time(), 1)}
+        if "device_compute_bases_per_sec" in info:
+            payload["device_compute_bases_per_sec"] = \
+                info["device_compute_bases_per_sec"]
         for dst in (last_tpu, tracked):
             tmp = f"{dst}.tmp.{os.getpid()}"
             with open(tmp, "wt") as fh:  # atomic: a killed bench never corrupts it
                 json.dump(payload, fh)
             os.replace(tmp, dst)
     else:
+        best = None
         for src in (last_tpu, tracked):
             try:
                 with open(src) as fh:
-                    line["last_tpu_measurement"] = json.load(fh)
-                break
+                    cand = json.load(fh)
             except (OSError, json.JSONDecodeError):
                 continue  # a broken sidecar must never cost the round its bench line
+            if best is None or cand.get("ts", 0) > best.get("ts", 0):
+                best = cand
+        if best is not None:
+            line["last_tpu_measurement"] = best
     print(json.dumps(line))
 
 
