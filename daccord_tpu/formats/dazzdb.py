@@ -276,7 +276,15 @@ def write_track(db_path: str, track: str, payloads: list[bytes | np.ndarray],
     """Write a variable-length Dazzler track (.anno = offsets, .data = bytes).
 
     With ``block``, writes a per-block track covering only that block's reads
-    (merge into the whole-DB track with :func:`catrack`)."""
+    (merge into the whole-DB track with :func:`catrack`).
+
+    Both files go through tmp-name + ``os.replace`` so a crash mid-WRITE (the
+    long window) never leaves a truncated file; each file is individually
+    atomic. A crash exactly between the two renames can still pair the new
+    .data with the old .anno — a much narrower window than the old in-place
+    writes, closable only with a directory-level commit this format doesn't
+    have. .data goes first so the common mismatch direction is old-data +
+    old-anno (fully consistent)."""
     anno_path, data_path = _track_paths(db_path, track, block)
 
     blobs = [bytes(np.asarray(p, dtype=np.uint8).tobytes()) if isinstance(p, np.ndarray) else bytes(p)
@@ -284,12 +292,17 @@ def write_track(db_path: str, track: str, payloads: list[bytes | np.ndarray],
     offsets = np.zeros(len(blobs) + 1, dtype=np.int64)
     np.cumsum([len(b) for b in blobs], out=offsets[1:])
 
-    with open(anno_path, "wb") as fh:
+    anno_tmp = f"{anno_path}.tmp.{os.getpid()}"
+    data_tmp = f"{data_path}.tmp.{os.getpid()}"
+    with open(anno_tmp, "wb") as fh:
         fh.write(struct.pack("<2i", len(blobs), 0))
         fh.write(offsets.tobytes())
-    with open(data_path, "wb") as fh:
+    with open(data_tmp, "wb") as fh:
         for b in blobs:
             fh.write(b)
+    # .data first: a reader must never see the new .anno without its .data
+    os.replace(data_tmp, data_path)
+    os.replace(anno_tmp, anno_path)
 
 
 def read_track(db_path: str, track: str, block: int | None = None) -> list[np.ndarray]:

@@ -1,0 +1,189 @@
+"""External-memory LAS sort and symmetric filtering.
+
+The reference's LAsort/LAmerge are block-memory external sorts and its
+``filtersym`` streams with bounded state (SURVEY.md §2.2 LAS layer row);
+the in-memory ``lassort``/``filter_symmetric`` paths fail at CHM-scale
+inputs (measurement ladder configs 4-5, SURVEY.md §6). This module holds
+the scale-capable equivalents:
+
+- :func:`sort_las_external` — chunked sorted runs on disk + k-way streaming
+  merge. Peak memory is ``mem_records`` Overlap objects regardless of file
+  size; byte-identical to the in-memory sort (stable on equal keys).
+- :func:`filter_symmetric_external` — the A->B iff B->A semi-join, hash-
+  partitioned on the match key so each partition's key set fits in memory;
+  byte-identical output to ``lastools.filter_symmetric`` with a DB.
+
+Both write temp files next to the output (same filesystem => atomic-rename
+friendly, and big-input temp space lives where the output goes, not /tmp).
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import tempfile
+
+import numpy as np
+
+from .las import LasFile, Overlap, write_las
+
+#: sort key shared by las-sort, las-merge and the external runs
+def _sort_key(o: Overlap):
+    return (o.aread, o.bread, o.abpos)
+
+
+def sort_las_external(in_path: str, out_path: str,
+                      mem_records: int = 2_000_000) -> int:
+    """Sort a LAS by (aread, bread, abpos) with bounded memory.
+
+    Records stream in; every ``mem_records`` of them become one sorted temp
+    run (a valid LAS file); runs k-way merge straight into ``out_path``.
+    Returns the record count.
+    """
+    las = LasFile(in_path)
+    with tempfile.TemporaryDirectory(
+            dir=os.path.dirname(os.path.abspath(out_path)),
+            prefix=".lassort.") as td:
+        runs: list[str] = []
+        chunk: list[Overlap] = []
+
+        def flush():
+            if not chunk:
+                return
+            chunk.sort(key=_sort_key)
+            rp = os.path.join(td, f"run{len(runs)}.las")
+            write_las(rp, las.tspace, chunk)
+            runs.append(rp)
+            chunk.clear()
+
+        for o in las:
+            chunk.append(o)
+            if len(chunk) >= mem_records:
+                flush()
+        if not runs:
+            # whole input fit in one chunk (the common block-level case):
+            # sort and write directly, no spill + re-merge I/O
+            chunk.sort(key=_sort_key)
+            return write_las(out_path, las.tspace, chunk)
+        flush()
+        streams = [iter(LasFile(r)) for r in runs]
+        return write_las(out_path, las.tspace,
+                         heapq.merge(*streams, key=_sort_key))
+
+
+# --------------------------------------------------------------------------
+# Symmetric filter, hash-partitioned
+# --------------------------------------------------------------------------
+
+# exact 3-word packing of the 7-field match key (unsigned: aread<<33 must
+# not overflow for read ids up to 2^31):
+#   k0 = aread<<33 | bread<<1 | comp
+#   k1 = abpos<<32 | aepos                (positions < 2^31, non-negative)
+#   k2 = bbpos<<32 | bepos
+_KEY_DT = np.dtype([("k0", "<u8"), ("k1", "<u8"), ("k2", "<u8")])
+_IDX_DT = np.dtype([("k0", "<u8"), ("k1", "<u8"), ("k2", "<u8"), ("i", "<i8")])
+
+
+def _pack(a, b, comp, ab, ae, bb, be) -> np.ndarray:
+    out = np.empty(len(a), dtype=_KEY_DT)
+    out["k0"] = ((a.astype(np.uint64) << np.uint64(33))
+                 | (b.astype(np.uint64) << np.uint64(1))
+                 | comp.astype(np.uint64))
+    out["k1"] = (ab.astype(np.uint64) << np.uint64(32)) | ae.astype(np.uint64)
+    out["k2"] = (bb.astype(np.uint64) << np.uint64(32)) | be.astype(np.uint64)
+    return out
+
+
+def _batch_arrays(batch: list[Overlap], db):
+    """(own_keys, mirror_keys) for one record batch (exact mirror through
+    read lengths for complemented overlaps — same rule as
+    ``lastools.filter_symmetric``)."""
+    n = len(batch)
+    a = np.fromiter((o.aread for o in batch), np.int64, n)
+    b = np.fromiter((o.bread for o in batch), np.int64, n)
+    comp = np.fromiter((o.is_comp for o in batch), np.int64, n)
+    ab = np.fromiter((o.abpos for o in batch), np.int64, n)
+    ae = np.fromiter((o.aepos for o in batch), np.int64, n)
+    bb = np.fromiter((o.bbpos for o in batch), np.int64, n)
+    be = np.fromiter((o.bepos for o in batch), np.int64, n)
+    own = _pack(a, b, comp, ab, ae, bb, be)
+    alen = np.fromiter((db.read_length(o.aread) for o in batch), np.int64, n)
+    blen = np.fromiter((db.read_length(o.bread) for o in batch), np.int64, n)
+    # mirror of (a,b,[ab,ae),[bb,be)): plain overlaps swap the intervals;
+    # complemented overlaps also flip both through their read length
+    m_ab = np.where(comp == 1, blen - be, bb)
+    m_ae = np.where(comp == 1, blen - bb, be)
+    m_bb = np.where(comp == 1, alen - ae, ab)
+    m_be = np.where(comp == 1, alen - ab, ae)
+    mirror = _pack(b, a, comp, m_ab, m_ae, m_bb, m_be)
+    return own, mirror
+
+
+def filter_symmetric_external(las_path: str, out_path: str, db,
+                              mem_records: int = 2_000_000,
+                              batch: int = 65536) -> int:
+    """Keep A->B overlaps iff the matching B->A record exists, with bounded
+    memory: keys hash-partition onto disk, each partition joins in memory,
+    matches set bits in a novl-bit bitmap, and a final streaming pass writes
+    the kept records. ``db`` supplies read lengths for the complement-space
+    mirror (required — the exact semantics of the in-memory path)."""
+    las = LasFile(las_path)
+    novl = las.novl
+    nparts = min(256, max(1, (novl + mem_records - 1) // mem_records))
+    keep = np.zeros(novl, dtype=bool)
+
+    with tempfile.TemporaryDirectory(
+            dir=os.path.dirname(os.path.abspath(out_path)),
+            prefix=".filtersym.") as td:
+        kf = [open(os.path.join(td, f"k{p}.bin"), "wb") for p in range(nparts)]
+        mf = [open(os.path.join(td, f"m{p}.bin"), "wb") for p in range(nparts)]
+        try:
+            idx0 = 0
+            buf: list[Overlap] = []
+
+            def emit():
+                nonlocal idx0
+                if not buf:
+                    return
+                own, mirror = _batch_arrays(buf, db)
+                # partition by the key the join runs on: a record's OWN key
+                # and another record's MIRROR key land in the same partition
+                po = (own["k0"] ^ own["k1"] ^ own["k2"]) % nparts
+                pm = (mirror["k0"] ^ mirror["k1"] ^ mirror["k2"]) % nparts
+                rows = np.empty(len(buf), dtype=_IDX_DT)
+                rows["k0"], rows["k1"], rows["k2"] = (
+                    mirror["k0"], mirror["k1"], mirror["k2"])
+                rows["i"] = np.arange(idx0, idx0 + len(buf))
+                for p in range(nparts):
+                    sel = po == p
+                    if sel.any():
+                        kf[p].write(own[sel].tobytes())
+                    sel = pm == p
+                    if sel.any():
+                        mf[p].write(rows[sel].tobytes())
+                idx0 += len(buf)
+                buf.clear()
+
+            for o in las:
+                buf.append(o)
+                if len(buf) >= batch:
+                    emit()
+            emit()
+        finally:
+            for fh in kf + mf:
+                fh.close()
+
+        for p in range(nparts):
+            keys = np.sort(np.fromfile(os.path.join(td, f"k{p}.bin"),
+                                       dtype=_KEY_DT))
+            rows = np.fromfile(os.path.join(td, f"m{p}.bin"), dtype=_IDX_DT)
+            if len(keys) == 0 or len(rows) == 0:
+                continue
+            mk = rows[["k0", "k1", "k2"]].astype(_KEY_DT)
+            pos = np.searchsorted(keys, mk)
+            pos = np.minimum(pos, len(keys) - 1)
+            hit = keys[pos] == mk
+            keep[rows["i"][hit]] = True
+
+    return write_las(out_path, las.tspace,
+                     (o for i, o in enumerate(las) if keep[i]))

@@ -32,12 +32,18 @@ class TierLadder:
 
     @classmethod
     def from_config(cls, profile: ErrorProfile, cfg: ConsensusConfig,
-                    max_kmers: int = 64, rescue_max_kmers: int = 256) -> "TierLadder":
+                    max_kmers: int = 64, rescue_max_kmers: int = 256,
+                    offset_counts=None) -> "TierLadder":
+        """``offset_counts``: empirical [P, O] offset samples from the
+        estimation pass; blended into every tier's OL table (see
+        ``oracle.profile.OffsetLikely``)."""
         tables = {}
         for k in cfg.k_values:
             P = cfg.w - k + 1 + cfg.dbg.len_slack
             O = cfg.w + 16
-            tables[k] = jnp.asarray(OffsetLikely(profile, positions=P, max_offset=O).table)
+            tables[k] = jnp.asarray(OffsetLikely(
+                profile, positions=P, max_offset=O,
+                counts=offset_counts).table)
         params = [
             KernelParams(k=k, min_count=mc, edge_min_count=emc,
                          count_frac=cfg.dbg.count_frac,
@@ -81,6 +87,8 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
     cons_len = out0["cons_len"]
     err = out0["err"]
     tier = jnp.where(solved, 0, -1).astype(jnp.int32)
+    # tier 0's top-M-cap flag: the one place kernel and oracle can disagree
+    m_ovf = out0["m_overflow"]
 
     overflow = jnp.int32(0)
     if len(params) > 1 and esc_cap > 0:
@@ -131,7 +139,7 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
             (cons, cons_len, err, solved, tier))
 
     return dict(cons=cons, cons_len=cons_len, err=err, solved=solved, tier=tier,
-                esc_overflow=overflow)
+                m_ovf=m_ovf, esc_overflow=overflow)
 
 
 @functools.partial(jax.jit,
@@ -160,13 +168,17 @@ def pack_result(out: dict) -> jnp.ndarray:
     cw = c[:, :, 0] | (c[:, :, 1] << 8) | (c[:, :, 2] << 16) | (c[:, :, 3] << 24)
     cw = jax.lax.bitcast_convert_type(cw, jnp.int32)
     errw = jax.lax.bitcast_convert_type(out["err"].astype(jnp.float32), jnp.int32)
-    # tier is a small signed int; pack esc_overflow into the high bits of
-    # row 0's tier column. tier+1 gets the 5 low bits, so at most 31 tiers —
-    # far above any real ladder (default: 4)
+    # tier is a small signed int; bit 5 carries the per-window top-M-cap
+    # flag, and esc_overflow rides the high bits of row 0's tier column.
+    # tier+1 gets the 5 low bits, so at most 31 tiers — far above any real
+    # ladder (default: 4)
     tier = out["tier"].astype(jnp.int32) + 1
+    movf = out.get("m_ovf")
+    if movf is None:
+        movf = jnp.zeros(B, jnp.int32)
     ovf = jnp.zeros(B, jnp.int32).at[0].set(
         jnp.asarray(out["esc_overflow"]).astype(jnp.int32))
-    tierw = tier | (ovf << 5)
+    tierw = tier | (movf.astype(jnp.int32) << 5) | (ovf << 6)
     return jnp.concatenate([cw, out["cons_len"].astype(jnp.int32)[:, None],
                             errw[:, None], tierw[:, None]], axis=1)
 
@@ -181,9 +193,10 @@ def unpack_result(arr: np.ndarray, cons_len_cl: int) -> dict:
     err = np.ascontiguousarray(arr[:, words + 1]).view(np.float32)
     tierw = arr[:, words + 2]
     tier = (tierw & 31) - 1
-    overflow = int(tierw[0] >> 5) if B else 0
+    m_ovf = ((tierw >> 5) & 1).astype(bool)
+    overflow = int(tierw[0] >> 6) if B else 0
     return dict(cons=cons, cons_len=cons_len, err=err, solved=tier >= 0,
-                tier=tier, esc_overflow=overflow)
+                tier=tier, m_ovf=m_ovf, esc_overflow=overflow)
 
 
 @functools.partial(jax.jit,
@@ -278,11 +291,13 @@ def solve_tiered(batch: WindowBatch, ladder: TierLadder,
     err = np.full(B, np.inf, dtype=np.float32)
     solved = np.zeros(B, dtype=bool)
     tier_of = np.full(B, -1, dtype=np.int32)
+    m_ovf = np.zeros(B, dtype=bool)
 
     if not skip_tier0:
         p0 = ladder.params[0]
         out = solve_window_batch(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
                                  jnp.asarray(batch.nsegs), ladder.tables[p0.k], p0)
+        m_ovf = np.asarray(out["m_overflow"])
         o_solved = np.asarray(out["solved"])
         if o_solved.any():
             cons[o_solved] = np.asarray(out["cons"])[o_solved]
@@ -314,4 +329,5 @@ def solve_tiered(batch: WindowBatch, ladder: TierLadder,
                 err[take] = np.asarray(out["err"])[:n][s_solved]
                 solved[take] = True
                 tier_of[take] = ti
-    return dict(cons=cons, cons_len=cons_len, err=err, solved=solved, tier=tier_of)
+    return dict(cons=cons, cons_len=cons_len, err=err, solved=solved, tier=tier_of,
+                m_ovf=m_ovf)

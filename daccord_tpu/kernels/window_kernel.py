@@ -286,7 +286,12 @@ def _prep_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
     W = occ @ ol.T                                        # [M, P]
     adjW = jnp.where(adj, jnp.float32(0), NEG)
     score0 = jnp.where(src_ok & sel_valid, W[:, 0], NEG)
-    return dict(sel=sel, adjW=adjW, W=W, score0=score0, snk_ok=snk_ok)
+    # top-M cap diagnostics: did more k-mers survive the frequency filter
+    # than the compacted active set holds? (the only source of kernel-vs-
+    # oracle disagreement; counted per window in pipeline stats)
+    m_overflow = jnp.sum((start_counts > 0).astype(jnp.int32)) > M
+    return dict(sel=sel, adjW=adjW, W=W, score0=score0, snk_ok=snk_ok,
+                m_overflow=m_overflow)
 
 
 def _dp_scan_one(adjW: jnp.ndarray, W: jnp.ndarray, score0: jnp.ndarray):
@@ -399,7 +404,9 @@ def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
     """Solve one window. seqs [D, L] int8, lens [D] i32, ol [P, O] f32."""
     g = _prep_one(seqs, lens, nsegs, ol, p)
     scores, ptrs = _dp_scan_one(g["adjW"], g["W"], g["score0"])
-    return _finish_one(seqs, lens, nsegs, scores, ptrs, g["sel"], g["snk_ok"], p)
+    out = _finish_one(seqs, lens, nsegs, scores, ptrs, g["sel"], g["snk_ok"], p)
+    out["m_overflow"] = g["m_overflow"]
+    return out
 
 
 def solve_batch_pallas_core(seqs, lens, nsegs, ol, p: KernelParams,
@@ -417,8 +424,10 @@ def solve_batch_pallas_core(seqs, lens, nsegs, ol, p: KernelParams,
     wt = jnp.transpose(g["W"], (0, 2, 1))                 # [B, P, M]
     scores, ptrs = heaviest_path_batch(g["adjW"], wt, g["score0"],
                                        interpret=interpret)
-    return jax.vmap(functools.partial(_finish_one, p=p))(
+    out = jax.vmap(functools.partial(_finish_one, p=p))(
         seqs, lens, nsegs, scores, ptrs, g["sel"], g["snk_ok"])
+    out["m_overflow"] = g["m_overflow"]
+    return out
 
 
 def pallas_needs_interpret() -> bool:

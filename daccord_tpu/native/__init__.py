@@ -14,20 +14,31 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "dazz_native.cpp")
-_SO = os.path.join(_DIR, "libdazz_native.so")
 _lock = threading.Lock()
 _lib = None
 _tried = False
 
 
-def _build() -> bool:
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           _SRC, "-o", _SO]
-    if os.environ.get("DACCORD_NATIVE_TSAN"):
+def _tsan() -> bool:
+    return bool(os.environ.get("DACCORD_NATIVE_TSAN"))
+
+
+def _so_path() -> str:
+    # the TSAN build gets its own artifact so a race-detection run never
+    # shadows the optimized library for later normal runs
+    name = "libdazz_native_tsan.so" if _tsan() else "libdazz_native.so"
+    return os.path.join(_DIR, name)
+
+
+def _build(so: str) -> bool:
+    if _tsan():
         # race-detection build (SURVEY.md §5 race row): the library is called
         # concurrently by the feeder thread pool
         cmd = ["g++", "-O1", "-g", "-fsanitize=thread", "-shared", "-fPIC",
-               "-std=c++17", _SRC, "-o", _SO]
+               "-std=c++17", _SRC, "-o", so]
+    else:
+        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+               _SRC, "-o", so]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
         return True
@@ -42,11 +53,12 @@ def load():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-            if not _build():
+        so = _so_path()
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(_SRC):
+            if not _build(so):
                 return None
         try:
-            lib = ctypes.CDLL(_SO)
+            lib = ctypes.CDLL(so)
         except OSError:
             return None
         c = ctypes

@@ -64,7 +64,7 @@ def _ladder_sharded(seqs, lens, nsegs, tables, params, esc_cap, mesh,
     fn = shard_map(local, mesh=mesh,
                    in_specs=(P("d"), P("d"), P("d"), P()),
                    out_specs={"cons": P("d"), "cons_len": P("d"), "err": P("d"),
-                              "solved": P("d"), "tier": P("d"),
+                              "solved": P("d"), "tier": P("d"), "m_ovf": P("d"),
                               "esc_overflow": P()},
                    **vma_kw)
     return fn(seqs, lens, nsegs, tables)
@@ -153,8 +153,11 @@ def make_sharded_solver(ladder: TierLadder, mesh: Mesh, esc_cap: int | None = No
 
 def build_sharded_solver(n_devices: int, profile, consensus_cfg,
                          esc_cap: int | None = None,
-                         use_pallas: bool = False) -> ShardedLadderSolver:
-    """Device-count-checked mesh solver from an error profile.
+                         use_pallas: bool = False,
+                         offset_counts=None) -> ShardedLadderSolver:
+    """Device-count-checked mesh solver from an error profile (plus the
+    estimation pass's empirical OL counts, when collected — the mesh path
+    must blend the same tables as the single-device path).
 
     The one construction path shared by the ``daccord --mesh`` CLI and the
     ladder bench; raises ``SystemExit`` with the off-pod recipe when fewer
@@ -166,7 +169,8 @@ def build_sharded_solver(n_devices: int, profile, consensus_cfg,
             "XLA_FLAGS=--xla_force_host_platform_device_count=N)")
     from ..kernels.window_kernel import pallas_needs_interpret
 
-    ladder = TierLadder.from_config(profile, consensus_cfg)
+    ladder = TierLadder.from_config(profile, consensus_cfg,
+                                    offset_counts=offset_counts)
     interpret = use_pallas and pallas_needs_interpret()
     return make_sharded_solver(ladder, make_mesh(n_devices), esc_cap,
                                use_pallas=use_pallas, pallas_interpret=interpret)

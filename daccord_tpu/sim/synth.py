@@ -42,6 +42,13 @@ class SimConfig:
     min_overlap: int = 500
     tspace: int = 100
     repeat_fraction: float = 0.0      # fraction of genome covered by a planted repeat
+    repeat_divergence: float = 0.0    # substitution rate between the two repeat
+                                      # copies (0 = exact copies). Diverged
+                                      # copies are what make repeat-induced
+                                      # piles damaging: cross-copy B segments
+                                      # pull window consensus toward the OTHER
+                                      # copy, the failure mode the paper's
+                                      # local-consistency filtering targets
     seed: int = 0
 
     @classmethod
@@ -138,17 +145,26 @@ def _sample_noisy(genome: np.ndarray, start: int, end: int, cfg: SimConfig,
 
 
 def _make_genome(cfg: SimConfig, rng: np.random.Generator) -> tuple[np.ndarray, tuple | None]:
-    """Returns (genome, repeat) where repeat = (src, dst, rep_len) or None."""
+    """Returns (genome, repeat) where repeat = (src, dst, rep_len, div_off)
+    or None; ``div_off`` holds the copy-local offsets where the two copies
+    differ (empty for an exact repeat)."""
     g = rng.integers(0, 4, size=cfg.genome_len, dtype=np.int8)
     rep = None
     if cfg.repeat_fraction > 0:
-        # plant a two-copy exact repeat: copy one segment to another location
+        # plant a two-copy repeat: copy one segment to another location,
+        # then diverge the second copy by repeat_divergence substitutions
         rep_len = int(cfg.genome_len * cfg.repeat_fraction / 2)
         if rep_len > 100:
             src = int(rng.integers(0, cfg.genome_len // 2 - rep_len))
             dst = int(rng.integers(cfg.genome_len // 2, cfg.genome_len - rep_len))
             g[dst : dst + rep_len] = g[src : src + rep_len]
-            rep = (src, dst, rep_len)
+            ndiv = int(round(rep_len * cfg.repeat_divergence))
+            div_off = np.sort(rng.choice(rep_len, size=ndiv, replace=False)) \
+                if ndiv else np.zeros(0, np.int64)
+            if ndiv:
+                g[dst + div_off] = (g[dst + div_off]
+                                    + rng.integers(1, 4, ndiv, dtype=np.int8)) % 4
+            rep = (src, dst, rep_len, div_off.astype(np.int64))
     return g, rep
 
 
@@ -173,13 +189,16 @@ def _positions_in(g_of_r: np.ndarray, glo: int, ghi: int, ascending: bool) -> tu
 
 
 def _true_overlap(a: SimRead, b: SimRead, ai: int, bi: int, cfg: SimConfig,
-                  shift: int = 0, clamp: tuple[int, int] | None = None) -> Overlap | None:
+                  shift: int = 0, clamp: tuple[int, int] | None = None,
+                  div_sites: np.ndarray | None = None) -> Overlap | None:
     """Construct the true overlap record (A as stored; B possibly complemented).
 
     ``shift`` maps B's genome coordinates into A's frame (used for overlaps
-    induced by an exact planted repeat copy: B positions g map to A positions
+    induced by a planted repeat copy: B positions g map to A positions
     g - shift). ``clamp`` restricts the overlap to an A-frame interval (the
-    repeat body — flanks beyond the copy do not match).
+    repeat body — flanks beyond the copy do not match). ``div_sites`` are
+    A-frame genome positions where the two copies differ; each one inside a
+    tile adds a pair diff (cross-copy alignments really see that mismatch).
     """
     glo = max(a.start, b.start - shift)
     ghi = min(a.end, b.end - shift)
@@ -232,7 +251,10 @@ def _true_overlap(a: SimRead, b: SimRead, ai: int, bi: int, cfg: SimConfig,
         g0, g1 = min(gb[t], gb[t + 1]), max(gb[t], gb[t + 1])
         a_dl = int(np.searchsorted(a.dels, g1) - np.searchsorted(a.dels, g0))
         b_dl = int(np.searchsorted(b.dels, g1 + shift) - np.searchsorted(b.dels, g0 + shift))
-        trace[t, 0] = min(a_ed + a_dl + b_ed + b_dl, 255 if cfg.tspace <= 125 else 65535)
+        dv = (int(np.searchsorted(div_sites, g1) - np.searchsorted(div_sites, g0))
+              if div_sites is not None else 0)
+        trace[t, 0] = min(a_ed + a_dl + b_ed + b_dl + dv,
+                          255 if cfg.tspace <= 125 else 65535)
         trace[t, 1] = b1 - b0
     ovl.trace = trace
     ovl.diffs = int(trace[:, 0].sum())
@@ -283,10 +305,12 @@ def simulate(cfg: SimConfig) -> SimResult:
             if ovl is not None:
                 overlaps.append(ovl)
 
-    # repeat-induced overlaps: reads over the two exact copies align to each
-    # other within the copy body (what daligner would report on a repeat)
+    # repeat-induced overlaps: reads over the two copies align to each other
+    # within the copy body (what daligner would report on a repeat); with
+    # repeat_divergence > 0 every divergent site inside the overlap adds a
+    # real pair diff
     if rep is not None:
-        src, dst, rep_len = rep
+        src, dst, rep_len, div_off = rep
         shift = dst - src
         in_src = [i for i, r in enumerate(reads) if r.start < src + rep_len and r.end > src]
         in_dst = [i for i, r in enumerate(reads) if r.start < dst + rep_len and r.end > dst]
@@ -298,7 +322,8 @@ def simulate(cfg: SimConfig) -> SimResult:
                     if bi == ai:
                         continue
                     ovl = _true_overlap(a, reads[bi], ai, bi, cfg, shift=shift,
-                                        clamp=(src, src + rep_len))
+                                        clamp=(src, src + rep_len),
+                                        div_sites=src + div_off)
                     if ovl is not None:
                         overlaps.append(ovl)
             if a.start < dst + rep_len and a.end > dst:
@@ -307,7 +332,8 @@ def simulate(cfg: SimConfig) -> SimResult:
                     if bi == ai:
                         continue
                     ovl = _true_overlap(a, reads[bi], ai, bi, cfg, shift=-shift,
-                                        clamp=(dst, dst + rep_len))
+                                        clamp=(dst, dst + rep_len),
+                                        div_sites=dst + div_off)
                     if ovl is not None:
                         overlaps.append(ovl)
 

@@ -76,6 +76,16 @@ def daccord_main(argv=None) -> int:
                    help="reject window consensus above this mean edit rate vs "
                         "its segments (0.2 -> +0.7 Q but +11%% fragments on the "
                         "same measurement)")
+    p.add_argument("--qv-track", default="inqual", metavar="NAME",
+                   help="intrinsic-QV track joined into the depth-ranking "
+                        "score (written by the inqual tool; reference: "
+                        "daccord loads the computeintrinsicqv track). "
+                        "Missing track falls back to trace-diff ranking; "
+                        "'' disables")
+    p.add_argument("--no-empirical-ol", action="store_true",
+                   help="use the pure analytic OffsetLikely tables instead of "
+                        "blending in the estimation pass's measured offset "
+                        "distributions")
     p.add_argument("--no-end-trim", action="store_true",
                    help="keep rescue-tier solutions at read ends (default: "
                         "trim them — thin end-of-read piles solved with the "
@@ -135,15 +145,21 @@ def daccord_main(argv=None) -> int:
                          depth=args.depth, seg_len=args.seg_len,
                          log_path=args.log, use_native=not args.no_native,
                          feeder_threads=args.threads, use_pallas=args.pallas,
-                         end_trim=not args.no_end_trim)
+                         end_trim=not args.no_end_trim,
+                         qv_track=args.qv_track or None,
+                         empirical_ol=not args.no_empirical_ol)
 
     import os
 
-    from ..oracle.profile import ErrorProfile
+    from ..oracle.profile import load_eprof, save_eprof
 
     prof = None
+    ol_counts = None
     if args.eprof and os.path.exists(args.eprof) and not args.eprof_only:
-        prof = ErrorProfile.load(args.eprof)
+        # v2 eprof files carry the empirical OL counts, so cached runs (and
+        # every -J shard sharing the file) blend the same tables the
+        # estimating run did; v1 files load as analytic
+        prof, ol_counts = load_eprof(args.eprof)
     elif args.eprof or args.eprof_only:
         if not args.eprof:
             raise SystemExit("--eprof-only requires -E/--eprof PATH")
@@ -151,9 +167,10 @@ def daccord_main(argv=None) -> int:
 
         # opens db/las a second time (correct_to_fasta reopens from paths);
         # that is one extra index parse — noise next to the estimation pass
-        prof = estimate_profile_for_shard(read_db(args.db), LasFile(args.las),
-                                          cfg, start, end)
-        prof.save(args.eprof)
+        prof, ol_counts = estimate_profile_for_shard(
+            read_db(args.db), LasFile(args.las), cfg, start, end,
+            collect_offsets=True)
+        save_eprof(args.eprof, prof, ol_counts)
         if args.eprof_only:
             print(json.dumps({"eprof": args.eprof, "p_ins": prof.p_ins,
                               "p_del": prof.p_del, "p_sub": prof.p_sub}),
@@ -166,22 +183,36 @@ def daccord_main(argv=None) -> int:
         from ..runtime.pipeline import estimate_profile_for_shard
 
         if prof is None:
-            prof = estimate_profile_for_shard(read_db(args.db), LasFile(args.las),
-                                              cfg, start, end)
+            # collect the empirical OL counts here too, or the mesh path
+            # would silently solve with analytic-only tables while the
+            # single-device path blends (same flags, different quality)
+            if cfg.empirical_ol:
+                prof, ol_counts = estimate_profile_for_shard(
+                    read_db(args.db), LasFile(args.las), cfg, start, end,
+                    collect_offsets=True)
+            else:
+                prof = estimate_profile_for_shard(read_db(args.db),
+                                                  LasFile(args.las), cfg,
+                                                  start, end)
         solver = build_sharded_solver(args.mesh, prof, cfg.consensus,
-                                      use_pallas=args.pallas)
+                                      use_pallas=args.pallas,
+                                      offset_counts=ol_counts)
 
     if args.profile:
         import jax
 
         with jax.profiler.trace(args.profile):
             stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
-                                     end=end, profile=prof, solver=solver)
+                                     end=end, profile=prof,
+                                     offset_counts=ol_counts, solver=solver)
     else:
         stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
-                                 end=end, profile=prof, solver=solver)
+                                 end=end, profile=prof,
+                                 offset_counts=ol_counts, solver=solver)
     line = {
         "reads": stats.n_reads, "windows": stats.n_windows, "solved": stats.n_solved,
+        "skipped_shallow": stats.n_skipped_shallow, "qv_ranked": stats.qv_ranked,
+        "topm_overflow": stats.n_topm_overflow,
         "end_trimmed": stats.n_end_trimmed,
         "fragments": stats.n_fragments, "bases_in": stats.bases_in,
         "bases_out": stats.bases_out, "wall_s": round(stats.wall_s, 3),
@@ -220,6 +251,17 @@ def detectrepeats_main(argv=None) -> int:
     p.add_argument("las")
     p.add_argument("-d", type=int, default=20, help="expected coverage depth")
     p.add_argument("--factor", type=float, default=2.0, help="over-coverage factor")
+    p.add_argument("--qv-track", default="inqual", metavar="NAME",
+                   help="intrinsic-QV track gating which tiles may be repeat-"
+                        "annotated (reference: the tool consumes "
+                        "computeintrinsicqv output); '' disables")
+    p.add_argument("--qv-max", type=int, default=100,
+                   help="tiles with QV above this are too low-quality to "
+                        "repeat-annotate (255 = no coverage always excluded)")
+    p.add_argument("--grow", type=int, default=2,
+                   help="dilate detected intervals by this many tiles per "
+                        "side (tile-granular thresholding under-calls repeat "
+                        "edges where coverage decays)")
     p.add_argument("--block", type=int, default=None, metavar="I",
                    help="process only DB block I (1-based); writes a per-block "
                         "track to merge with `catrack`")
@@ -227,7 +269,8 @@ def detectrepeats_main(argv=None) -> int:
     db = read_db(args.db, load_bases=False)
     las = LasFile(args.las)
     lastools.detect_repeats(db, las, depth=args.d, cov_factor=args.factor,
-                            block=args.block)
+                            block=args.block, qv_track=args.qv_track or None,
+                            qv_max=args.qv_max, grow=args.grow)
     return 0
 
 
@@ -238,10 +281,16 @@ def filteralignments_main(argv=None) -> int:
     p.add_argument("las")
     p.add_argument("out")
     p.add_argument("--max-err", type=float, default=None)
+    p.add_argument("--rep-margin", type=float, default=0.015,
+                   help="repeat-confined alignments survive while their error "
+                        "rate is within this of the unique-region profile "
+                        "(cross-repeat-copy alignments carry the copies' "
+                        "divergence on top of it)")
     args = p.parse_args(argv)
     db = read_db(args.db, load_bases=False)
     las = LasFile(args.las)
-    n = lastools.filter_alignments(db, las, args.out, max_err=args.max_err)
+    n = lastools.filter_alignments(db, las, args.out, max_err=args.max_err,
+                                   rep_margin=args.rep_margin)
     print(f"kept {n} of {las.novl}", file=sys.stderr)
     return 0
 
@@ -252,9 +301,19 @@ def filtersym_main(argv=None) -> int:
     p.add_argument("las")
     p.add_argument("out")
     p.add_argument("--db", default=None, help="DB for exact complement mirroring")
+    p.add_argument("--mem-records", type=int, default=2_000_000,
+                   help="above this record count (with --db) the symmetric "
+                        "join hash-partitions its key sets onto disk so "
+                        "memory stays bounded; output is byte-identical")
     args = p.parse_args(argv)
     db = read_db(args.db, load_bases=False) if args.db else None
-    n = lastools.filter_symmetric(args.las, args.out, db=db)
+    if db is not None and LasFile(args.las).novl > args.mem_records:
+        from ..formats.extsort import filter_symmetric_external
+
+        n = filter_symmetric_external(args.las, args.out, db,
+                                      mem_records=args.mem_records)
+    else:
+        n = lastools.filter_symmetric(args.las, args.out, db=db)
     print(f"kept {n}", file=sys.stderr)
     return 0
 
@@ -273,15 +332,20 @@ def lasindex_main(argv=None) -> int:
 
 
 def lassort_main(argv=None) -> int:
-    """las-sort: sort a LAS by (aread, bread) (reference LAS sort/merge role)."""
+    """las-sort: sort a LAS by (aread, bread) (reference LAsort role — a
+    block-memory external sort, so inputs far larger than RAM still sort)."""
     p = argparse.ArgumentParser(prog="las-sort", description=lassort_main.__doc__)
     p.add_argument("las")
     p.add_argument("out")
+    p.add_argument("--mem-records", type=int, default=2_000_000,
+                   help="records held in memory per sorted run; files with "
+                        "more records than this go through on-disk runs + "
+                        "k-way merge (byte-identical to the in-memory sort)")
     args = p.parse_args(argv)
-    las = LasFile(args.las)
-    ovls = sorted(las, key=lambda o: (o.aread, o.bread, o.abpos))
-    from ..formats.las import write_las
-    write_las(args.out, las.tspace, ovls)
+    from ..formats.extsort import sort_las_external
+
+    n = sort_las_external(args.las, args.out, mem_records=args.mem_records)
+    print(f"sorted {n} overlaps", file=sys.stderr)
     return 0
 
 

@@ -95,16 +95,20 @@ def run_rung(name: str, sim_kw: dict, feeder_threads: int = 0,
     from daccord_tpu.formats.las import LasFile
     from daccord_tpu.runtime.pipeline import estimate_profile_for_shard
 
-    prof = estimate_profile_for_shard(read_db(paths["db"]),
-                                      LasFile(paths["las"]), cfg)
+    prof, counts = estimate_profile_for_shard(read_db(paths["db"]),
+                                              LasFile(paths["las"]), cfg,
+                                              collect_offsets=True)
+    if not cfg.empirical_ol:
+        counts = None
     solver = None
     if mesh > 1:
         from daccord_tpu.parallel.mesh import build_sharded_solver
 
-        solver = build_sharded_solver(mesh, prof, cfg.consensus)
+        solver = build_sharded_solver(mesh, prof, cfg.consensus,
+                                      offset_counts=counts)
     t0 = time.perf_counter()
     stats = correct_to_fasta(paths["db"], paths["las"], out_fa, cfg,
-                             profile=prof, solver=solver)
+                             profile=prof, offset_counts=counts, solver=solver)
     wall = time.perf_counter() - t0
 
     q = _qveval(out_fa, paths["truth"], paths["db"])
@@ -277,7 +281,11 @@ def main(argv=None) -> int:
                 print(json.dumps({"rung": name, "error": proc.returncode,
                                   "stderr": proc.stderr[-400:]}))
                 continue
-            print(out[-1])
+            # re-emit with the degradation marker all other rungs carry
+            try:
+                print(json.dumps({**json.loads(out[-1]), "fallback": fallback}))
+            except json.JSONDecodeError:
+                print(out[-1])
         else:
             row = run_rung(name, r["sim_kw"], feeder_threads=args.threads,
                            mesh=mesh)

@@ -26,6 +26,9 @@ from daccord_tpu.oracle import (
 from daccord_tpu.oracle.dbg import DBGParams, window_consensus
 from daccord_tpu.sim import SimConfig, simulate
 
+# XLA-compile-heavy e2e tier: excluded from `pytest -m 'not slow'` (fast tier)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def fixture():
@@ -55,6 +58,7 @@ def test_kernel_oracle_parity_tier0(fixture):
                              jnp.asarray(batch.nsegs), jnp.asarray(ols[8].table), kp)
     out = {k: np.asarray(v) for k, v in out.items()}
     p = DBGParams(k=8, min_count=2, edge_min_count=2)
+    m_ovf = np.asarray(out["m_overflow"])
     agree = total = 0
     mismatches = []
     for i, ws in enumerate(windows):
@@ -66,7 +70,12 @@ def test_kernel_oracle_parity_tier0(fixture):
             agree += 1
         else:
             mismatches.append(i)
-    # the kernel's top-M cap may cost isolated windows; >=97% exact agreement
+    # every disagreement must be EXPLAINED: the kernel's top-M active-set cap
+    # is the only divergence source vs the unbounded oracle, and the kernel
+    # flags exactly the windows where the cap bound (m_overflow). Windows
+    # with the full k-mer set must agree bit-for-bit.
+    unexplained = [i for i in mismatches if not m_ovf[i]]
+    assert not unexplained, (unexplained[:10], agree, total)
     assert agree / total >= 0.97, (agree, total, mismatches[:10])
 
 

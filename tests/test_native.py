@@ -107,6 +107,7 @@ def test_process_pile_with_order(dataset):
     np.testing.assert_array_equal(batch.lens, lens)
 
 
+@pytest.mark.slow   # full-pipeline run -> ladder-shape XLA compiles (~2 min)
 def test_wide_tspace_native_pipeline_parity(tmp_path):
     """tspace > 125 (uint16 trace points on disk) through the FULL pipeline:
     the native columnar loader's 2-byte trace branch and the banded

@@ -7,6 +7,9 @@ through Mosaic (exercised by bench/driver runs).
 import numpy as np
 import pytest
 
+# XLA-compile-heavy e2e tier: excluded from `pytest -m 'not slow'` (fast tier)
+pytestmark = pytest.mark.slow
+
 
 def _scan_ref(adjW, wt, s0):
     import jax

@@ -5,6 +5,9 @@ import os
 import numpy as np
 import pytest
 
+# XLA-compile-heavy e2e tier: excluded from `pytest -m 'not slow'` (fast tier)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def ladder_and_batch():
@@ -214,6 +217,7 @@ import jax, sys
 jax.config.update("jax_platforms", "cpu")
 from daccord_tpu.parallel.launch import init_distributed, run_shard
 from daccord_tpu.runtime.pipeline import PipelineConfig
+
 pid, np_ = init_distributed("127.0.0.1:{port}", num_processes=2,
                             process_id=int(sys.argv[1]))
 assert np_ == 2, np_

@@ -11,6 +11,9 @@ from daccord_tpu.runtime import PipelineConfig, correct_to_fasta
 from daccord_tpu.sim import SimConfig, make_dataset
 from daccord_tpu.utils import revcomp_ints, seq_to_ints
 
+# XLA-compile-heavy e2e tier: excluded from `pytest -m 'not slow'` (fast tier)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def dataset(tmp_path_factory):
@@ -189,3 +192,114 @@ def test_end_trim_pipeline(dataset):
                                PipelineConfig(batch_size=256, end_trim=True,
                                               consensus=ConsensusConfig(mode="patch")))
     assert s_patch.n_end_trimmed == 0
+
+
+def test_skip_shallow_is_exact(dataset):
+    """Host-side skip of sub-min_depth windows must be byte-identical to
+    letting the kernel mark them unsolved (window_kernel.py:389) — it only
+    saves device batch slots."""
+    out, d = dataset
+    f_on = os.path.join(d, "skip_on.fasta")
+    f_off = os.path.join(d, "skip_off.fasta")
+    s_on = correct_to_fasta(out["db"], out["las"], f_on,
+                            PipelineConfig(batch_size=256, skip_shallow=True))
+    s_off = correct_to_fasta(out["db"], out["las"], f_off,
+                             PipelineConfig(batch_size=256, skip_shallow=False))
+    assert open(f_on).read() == open(f_off).read()
+    assert s_off.n_skipped_shallow == 0
+    assert s_on.n_skipped_shallow > 0   # thin read ends exist at 15x
+    assert s_on.n_solved == s_off.n_solved
+
+
+def test_qv_ranker_unit():
+    """B-interval QV averaging: tile selection, NOCOV exclusion, complement
+    coordinate flip, and the median fill for unknown-quality overlaps."""
+    from types import SimpleNamespace
+
+    from daccord_tpu.runtime.pipeline import QvRanker, _rank_scores
+    from daccord_tpu.tools.lastools import QV_NOCOV, QV_SCALE
+
+    tspace = 100
+    # read 0: tiles [40, 80, NOCOV], len 250
+    payloads = [np.asarray([40, 80, QV_NOCOV], dtype=np.uint8)]
+    db = SimpleNamespace(read_length=lambda r: 250)
+    qvr = QvRanker(payloads, tspace, db)
+    # forward, tiles 0-1
+    assert qvr.rate(0, 0, 200, False) == pytest.approx(60 / QV_SCALE)
+    # forward, tile 1 only
+    assert qvr.rate(0, 150, 180, False) == pytest.approx(80 / QV_SCALE)
+    # NOCOV-only interval -> NaN
+    assert np.isnan(qvr.rate(0, 210, 240, False))
+    # complement: comp range [0, 100) maps to forward [150, 250) = tiles 1-2;
+    # tile 2 is NOCOV so only tile 1 counts
+    assert qvr.rate(0, 0, 100, True) == pytest.approx(80 / QV_SCALE)
+    # unknown read -> NaN
+    assert np.isnan(qvr.rate(7, 0, 100, False))
+
+    # median fill: NaN entries rank neutral, not best
+    from daccord_tpu.runtime.pipeline import QV_RANK_WEIGHT
+
+    diffs = np.asarray([10, 10, 10])
+    spans = np.asarray([100, 100, 100])
+    bq = np.asarray([0.1, np.nan, 0.4])
+    s = _rank_scores(diffs, spans, bq)
+    assert s[0] < s[1] < s[2]
+    # NaN takes the median of known rates, scaled by the ranking weight
+    assert s[1] == pytest.approx(0.1 + QV_RANK_WEIGHT * 0.25)
+
+
+def test_qv_ranked_pipeline_native_parity(dataset):
+    """With an inqual track present, the QV-augmented depth ranking must
+    produce byte-identical FASTA through the native and oracle host paths
+    (one _rank_scores, two feeders)."""
+    from daccord_tpu.formats import LasFile, read_db
+    from daccord_tpu.tools.lastools import compute_intrinsic_qv
+
+    out, d = dataset
+    compute_intrinsic_qv(read_db(out["db"]), LasFile(out["las"]), depth=15)
+    f_nat = os.path.join(d, "qv_nat.fasta")
+    f_orc = os.path.join(d, "qv_orc.fasta")
+    s_nat = correct_to_fasta(out["db"], out["las"], f_nat,
+                             PipelineConfig(batch_size=256, use_native=True))
+    s_orc = correct_to_fasta(out["db"], out["las"], f_orc,
+                             PipelineConfig(batch_size=256, use_native=False))
+    assert s_nat.qv_ranked and s_orc.qv_ranked
+    assert open(f_nat).read() == open(f_orc).read()
+
+    # disabled track -> ranking reverts to trace-diff only, still works
+    f_off = os.path.join(d, "qv_off.fasta")
+    s_off = correct_to_fasta(out["db"], out["las"], f_off,
+                             PipelineConfig(batch_size=256, qv_track=None))
+    assert not s_off.qv_ranked
+    assert s_off.n_solved > 0
+
+
+def test_empirical_ol_ab(dataset):
+    """Empirical OffsetLikely blending must not degrade correction quality
+    (it should match or beat the analytic tables on well-sampled data)."""
+    out, d = dataset
+    res = out["result"]
+    f_emp = os.path.join(d, "emp.fasta")
+    f_ana = os.path.join(d, "ana.fasta")
+    correct_to_fasta(out["db"], out["las"], f_emp,
+                     PipelineConfig(batch_size=256, empirical_ol=True))
+    correct_to_fasta(out["db"], out["las"], f_ana,
+                     PipelineConfig(batch_size=256, empirical_ol=False))
+
+    def err_rate(path):
+        tot_e = tot_l = 0
+        for rec in read_fasta(path):
+            rid = int(rec.name[4:].split("/")[0])
+            r = res.reads[rid]
+            truth = res.genome[r.start : r.end]
+            if r.strand == 1:
+                truth = revcomp_ints(truth)
+            f = seq_to_ints(rec.seq)
+            tot_e += infix_distance(f, truth)
+            tot_l += len(f)
+        return tot_e / max(tot_l, 1)
+
+    e_emp, e_ana = err_rate(f_emp), err_rate(f_ana)
+    # both are strong corrections; empirical must not be meaningfully worse
+    assert e_emp < 0.02 and e_ana < 0.02
+    assert e_emp <= e_ana * 1.5 + 1e-4, (e_emp, e_ana)

@@ -7,6 +7,9 @@ from daccord_tpu.formats import LasFile, read_db, read_track
 from daccord_tpu.sim import SimConfig, make_dataset
 from daccord_tpu.tools import lastools
 
+# XLA-compile-heavy e2e tier: excluded from `pytest -m 'not slow'` (fast tier)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def dataset(tmp_path_factory):
@@ -457,3 +460,43 @@ def test_inspection_tools(dataset, tmp_path, capsys):
     write_las(strayp, las.tspace, stray)
     with pytest.raises(SystemExit):
         main(["lassplit", strayp, db_path, str(tmp_path / "s.#.las")])
+
+
+def test_detect_repeats_qv_gate(tmp_path):
+    """The intrinsic-QV gate masks untrustworthy tiles from repeat
+    annotation: an all-NOCOV track suppresses every interval, an all-good
+    track changes nothing vs ungated detection."""
+    from daccord_tpu.formats.dazzdb import write_track
+
+    cfg = SimConfig(genome_len=4000, coverage=12, read_len_mean=900,
+                    repeat_fraction=0.4, seed=23)
+    out = make_dataset(str(tmp_path), cfg, name="rq")
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    tspace = las.tspace
+
+    def uniform_track(value):
+        return [np.full((db.read_length(i) + tspace - 1) // tspace, value,
+                        dtype=np.uint8) for i in range(db.nreads)]
+
+    # baseline: no track on disk -> graceful coverage-only detection
+    lastools.detect_repeats(db, las, depth=12, cov_factor=1.8)
+    base = lastools.read_repeat_track(db)
+    assert sum(len(r) for r in base) > 0
+
+    # all-good track: gate passes every tile, intervals unchanged
+    write_track(out["db"], "inqual", uniform_track(10))
+    lastools.detect_repeats(db, las, depth=12, cov_factor=1.8)
+    gated = lastools.read_repeat_track(db)
+    assert all(np.array_equal(a, b) for a, b in zip(base, gated))
+
+    # all-NOCOV track: no tile is trustworthy, nothing gets annotated
+    write_track(out["db"], "inqual", uniform_track(lastools.QV_NOCOV))
+    lastools.detect_repeats(db, las, depth=12, cov_factor=1.8)
+    none = lastools.read_repeat_track(db)
+    assert sum(len(r) for r in none) == 0
+
+    # explicit opt-out restores coverage-only behavior
+    lastools.detect_repeats(db, las, depth=12, cov_factor=1.8, qv_track=None)
+    off = lastools.read_repeat_track(db)
+    assert all(np.array_equal(a, b) for a, b in zip(base, off))
