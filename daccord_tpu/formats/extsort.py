@@ -66,6 +66,24 @@ def sort_las_external(in_path: str, out_path: str,
             chunk.sort(key=_sort_key)
             return write_las(out_path, las.tspace, chunk)
         flush()
+        # multi-level merge: each open run holds a file descriptor for the
+        # whole merge, so fan-in is capped well under the process fd limit
+        # (at the 2M default, 64^2 runs already cover 8G records)
+        FANIN = 64
+        gen = len(runs)
+        while len(runs) > FANIN:
+            merged: list[str] = []
+            for g0 in range(0, len(runs), FANIN):
+                group = runs[g0 : g0 + FANIN]
+                gen += 1
+                rp = os.path.join(td, f"run{gen}.las")
+                write_las(rp, las.tspace,
+                          heapq.merge(*(iter(LasFile(r)) for r in group),
+                                      key=_sort_key))
+                for r in group:
+                    os.remove(r)
+                merged.append(rp)
+            runs = merged
         streams = [iter(LasFile(r)) for r in runs]
         return write_las(out_path, las.tspace,
                          heapq.merge(*streams, key=_sort_key))

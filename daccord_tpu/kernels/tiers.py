@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..oracle.consensus import ConsensusConfig
-from ..oracle.profile import ErrorProfile, OffsetLikely
+from ..oracle.profile import ErrorProfile
 from .tensorize import WindowBatch
 from .window_kernel import KernelParams, solve_batch_core, solve_window_batch
 
@@ -36,14 +36,14 @@ class TierLadder:
                     offset_counts=None) -> "TierLadder":
         """``offset_counts``: empirical [P, O] offset samples from the
         estimation pass; blended into every tier's OL table (see
-        ``oracle.profile.OffsetLikely``)."""
-        tables = {}
-        for k in cfg.k_values:
-            P = cfg.w - k + 1 + cfg.dbg.len_slack
-            O = cfg.w + 16
-            tables[k] = jnp.asarray(OffsetLikely(
-                profile, positions=P, max_offset=O,
-                counts=offset_counts).table)
+        ``oracle.profile.OffsetLikely``). Table construction delegates to the
+        oracle's ``make_offset_likely`` so kernel and oracle tables cannot
+        desynchronize (the bit-parity tests depend on identical tables)."""
+        from ..oracle.consensus import make_offset_likely
+
+        tables = {k: jnp.asarray(t.table)
+                  for k, t in make_offset_likely(
+                      profile, cfg, offset_counts=offset_counts).items()}
         params = [
             KernelParams(k=k, min_count=mc, edge_min_count=emc,
                          count_frac=cfg.dbg.count_frac,
@@ -87,7 +87,9 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
     cons_len = out0["cons_len"]
     err = out0["err"]
     tier = jnp.where(solved, 0, -1).astype(jnp.int32)
-    # tier 0's top-M-cap flag: the one place kernel and oracle can disagree
+    # top-M-cap flag: the one place kernel and oracle can disagree. Seeded
+    # from tier 0; escalation tiers OR in their own caps below so every
+    # window that ANY processing tier truncated carries the flag
     m_ovf = out0["m_overflow"]
 
     overflow = jnp.int32(0)
@@ -98,7 +100,7 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
         overflow = jnp.maximum(count - E, 0)
 
         def run_esc(args):
-            cons, cons_len, err, solved, tier = args
+            cons, cons_len, err, solved, tier, m_ovf = args
             idx = jnp.nonzero(fail, size=E, fill_value=0)[0]
             live = jnp.arange(E) < count
             sseqs = seqs[idx]
@@ -110,12 +112,15 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
             e_len = jnp.zeros(E, dtype=jnp.int32)
             e_err = jnp.full(E, jnp.inf, dtype=jnp.float32)
             e_tier = jnp.full(E, -1, dtype=jnp.int32)
+            e_movf = jnp.zeros(E, dtype=bool)
             for ti in range(1, len(params)):
                 p = params[ti]
+                processed = live & ~e_solved
                 out_t = solve_batch_core(sseqs, slens,
                                          jnp.where(e_solved, 0, snsegs),
                                          tables[ti], p, use_pallas,
                                          pallas_interpret)
+                e_movf = e_movf | (processed & out_t["m_overflow"])
                 take = live & out_t["solved"] & ~e_solved
                 e_cons = jnp.where(take[:, None], out_t["cons"], e_cons)
                 e_len = jnp.where(take, out_t["cons_len"], e_len)
@@ -126,17 +131,21 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
             # out of bounds and drop, or their stale writes clobber window 0
             B = seqs.shape[0]
             idx_w = jnp.where(live & e_solved, idx, B)
+            # the overflow flag scatters for ALL live escaped windows (an
+            # unsolved-but-truncated window is still unexplained vs oracle)
+            idx_all = jnp.where(live, idx, B)
             return (cons.at[idx_w].set(e_cons, mode="drop"),
                     cons_len.at[idx_w].set(e_len, mode="drop"),
                     err.at[idx_w].set(e_err, mode="drop"),
                     solved.at[idx_w].set(True, mode="drop"),
-                    tier.at[idx_w].set(e_tier, mode="drop"))
+                    tier.at[idx_w].set(e_tier, mode="drop"),
+                    m_ovf.at[idx_all].set(m_ovf[idx] | e_movf, mode="drop"))
 
         # batches with zero tier-0 failures (the common case at >99% solve
         # rate) skip the rescue tiers entirely at runtime
-        cons, cons_len, err, solved, tier = jax.lax.cond(
+        cons, cons_len, err, solved, tier, m_ovf = jax.lax.cond(
             count > 0, run_esc, lambda args: args,
-            (cons, cons_len, err, solved, tier))
+            (cons, cons_len, err, solved, tier, m_ovf))
 
     return dict(cons=cons, cons_len=cons_len, err=err, solved=solved, tier=tier,
                 m_ovf=m_ovf, esc_overflow=overflow)
@@ -297,7 +306,7 @@ def solve_tiered(batch: WindowBatch, ladder: TierLadder,
         p0 = ladder.params[0]
         out = solve_window_batch(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
                                  jnp.asarray(batch.nsegs), ladder.tables[p0.k], p0)
-        m_ovf = np.asarray(out["m_overflow"])
+        m_ovf = np.array(out["m_overflow"])   # writable copy: rescue tiers OR in
         o_solved = np.asarray(out["solved"])
         if o_solved.any():
             cons[o_solved] = np.asarray(out["cons"])[o_solved]
@@ -321,6 +330,7 @@ def solve_tiered(batch: WindowBatch, ladder: TierLadder,
             snsegs[:n] = batch.nsegs[sub]
             out = solve_window_batch(jnp.asarray(sseqs), jnp.asarray(slens),
                                      jnp.asarray(snsegs), ladder.tables[p.k], p)
+            m_ovf[sub] |= np.asarray(out["m_overflow"])[:n]
             s_solved = np.asarray(out["solved"])[:n]
             take = sub[s_solved]
             if len(take):

@@ -177,6 +177,10 @@ def daccord_main(argv=None) -> int:
                   file=sys.stderr)
             return 0
 
+    if not cfg.empirical_ol:
+        # opt-out must bind every consumer (mesh solver included), not just
+        # correct_shard's internal gate
+        ol_counts = None
     solver = None
     if args.mesh > 1:
         from ..parallel.mesh import build_sharded_solver

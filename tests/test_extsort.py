@@ -70,3 +70,18 @@ def test_filter_symmetric_external_matches_inmemory(dataset):
     n_ext = filter_symmetric_external(asym, ext, db, mem_records=64, batch=50)
     assert n_ext == n_mem > 0
     assert open(ext, "rb").read() == open(ref, "rb").read()
+
+
+def test_external_sort_multilevel_merge(dataset):
+    """>64 runs trigger the multi-level merge (fd-limit cap); output stays
+    byte-identical to the in-memory sort."""
+    out, d = dataset
+    las = LasFile(out["las"])
+    n_rec = las.novl
+    mem = max(1, n_rec // 70)   # ~70 runs > FANIN=64
+    ref = os.path.join(d, "ml_ref.las")
+    write_las(ref, las.tspace,
+              sorted(las, key=lambda o: (o.aread, o.bread, o.abpos)))
+    ext = os.path.join(d, "ml_ext.las")
+    assert sort_las_external(out["las"], ext, mem_records=mem) == n_rec
+    assert open(ext, "rb").read() == open(ref, "rb").read()

@@ -303,3 +303,42 @@ def test_empirical_ol_ab(dataset):
     # both are strong corrections; empirical must not be meaningfully worse
     assert e_emp < 0.02 and e_ana < 0.02
     assert e_emp <= e_ana * 1.5 + 1e-4, (e_emp, e_ana)
+
+
+def test_depth_cap_excludes_cross_copy_segments():
+    """In-pile repeat handling: when a repeat-inflated pile is deeper than
+    the depth cap, quality-ranked capping (trace-diff rate, which carries
+    the copies' divergence) fills the slots predominantly with same-copy
+    alignments — the windows never see most cross-copy segments."""
+    from daccord_tpu.sim import SimConfig, simulate
+
+    cfg = SimConfig(genome_len=6000, coverage=24, read_len_mean=800,
+                    repeat_fraction=0.35, repeat_divergence=0.08, seed=43)
+    res = simulate(cfg)
+    reads = res.reads
+
+    def is_cross(o):
+        a, b = reads[o.aread], reads[o.bread]
+        return min(a.end, b.end) <= max(a.start, b.start)
+
+    # the read with the most cross-copy overlaps = deepest repeat pile
+    from collections import Counter
+
+    cross_per_read = Counter(o.aread for o in res.overlaps if is_cross(o))
+    aread = cross_per_read.most_common(1)[0][0]
+    pile = [o for o in res.overlaps if o.aread == aread]
+    n_cross = sum(1 for o in pile if is_cross(o))
+    D = 16
+    assert len(pile) > D and n_cross >= D // 2   # cap binds, repeat is real
+
+    diffs = np.asarray([o.diffs for o in pile])
+    spans = np.maximum(np.asarray([o.aepos - o.abpos for o in pile]), 1)
+    from daccord_tpu.runtime.pipeline import _rank_scores
+
+    order = np.argsort(_rank_scores(diffs, spans, None), kind="stable")
+    top = [pile[i] for i in order[:D]]
+    frac_cross_pile = n_cross / len(pile)
+    frac_cross_top = sum(1 for o in top if is_cross(o)) / D
+    # capping must at least halve the cross-copy fraction vs the raw pile
+    assert frac_cross_top <= 0.5 * frac_cross_pile, \
+        (frac_cross_top, frac_cross_pile)
