@@ -11,6 +11,8 @@ import io
 from dataclasses import dataclass
 from typing import Iterable, Iterator
 
+from ..utils import aio
+
 
 @dataclass
 class FastaRecord:
@@ -19,9 +21,10 @@ class FastaRecord:
 
 
 def read_fasta(path_or_file) -> Iterator[FastaRecord]:
-    """Stream records from a FASTA file path or text file object."""
+    """Stream records from a FASTA path/URL (``mem:`` supported — the aio
+    stream factory, SURVEY.md §2.2) or an open text file object."""
     if isinstance(path_or_file, (str, bytes)):
-        fh = open(path_or_file, "rt")
+        fh = aio.open_input(path_or_file, "rt")
         own = True
     else:
         fh = path_or_file
@@ -49,7 +52,7 @@ def read_fasta(path_or_file) -> Iterator[FastaRecord]:
 
 def write_fasta(path_or_file, records: Iterable[FastaRecord | tuple], width: int = 80) -> None:
     if isinstance(path_or_file, (str, bytes)):
-        fh: io.TextIOBase = open(path_or_file, "wt")
+        fh: io.TextIOBase = aio.open_output(path_or_file, "wt")
         own = True
     else:
         fh = path_or_file

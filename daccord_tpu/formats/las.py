@@ -36,6 +36,8 @@ from typing import Iterable, Iterator
 
 import numpy as np
 
+from ..utils import aio
+
 TRACE_XOVR = 125
 OVL_COMP = 0x1  # flags bit: B read is complemented
 
@@ -85,10 +87,11 @@ def _trace_dtype(tspace: int):
 
 
 def write_las(path: str, tspace: int, overlaps: Iterable[Overlap]) -> int:
-    """Write overlaps to a .las file; returns record count."""
+    """Write overlaps to a .las path/URL (``mem:`` supported); returns record
+    count."""
     tdt = _trace_dtype(tspace)
     novl = 0
-    with open(path, "wb") as fh:
+    with aio.open_output(path, "wb") as fh:
         fh.write(struct.pack("<qi4x", 0, tspace))  # novl patched at the end
         for ovl in overlaps:
             trace = np.asarray(ovl.trace, dtype=np.int64).reshape(-1)
@@ -100,10 +103,11 @@ def write_las(path: str, tspace: int, overlaps: Iterable[Overlap]) -> int:
         fh.seek(0)
         fh.write(struct.pack("<q", novl))
     # a rewritten LAS invalidates any index sidecar regardless of mtime skew
-    try:
-        os.remove(path + ".idx")
-    except OSError:
-        pass
+    if not aio.is_mem(path):
+        try:
+            os.remove(aio.local_path(path) + ".idx")
+        except OSError:
+            pass
     return novl
 
 
@@ -112,11 +116,15 @@ _HDR_SIZE = struct.calcsize(_HDR_FMT)
 
 
 class LasFile:
-    """Streaming .las reader with optional byte-range restriction."""
+    """Streaming .las reader with optional byte-range restriction.
+
+    Accepts paths or aio URLs (``mem:`` in-memory files, SURVEY.md §2.2 aio
+    row) everywhere; the persistent index sidecar only applies to real files.
+    """
 
     def __init__(self, path: str):
         self.path = path
-        with open(path, "rb") as fh:
+        with aio.open_input(path, "rb") as fh:
             self.novl, self.tspace = struct.unpack(_HDR_FMT, fh.read(_HDR_SIZE))
         self._tdt = _trace_dtype(self.tspace)
         self._tsize = np.dtype(self._tdt).itemsize
@@ -126,9 +134,9 @@ class LasFile:
 
     def iter_range(self, start: int | None = None, end: int | None = None) -> Iterator[Overlap]:
         """Iterate records in byte range [start, end) (defaults: whole file)."""
-        with open(self.path, "rb") as fh:
+        with aio.open_input(self.path, "rb") as fh:
             fh.seek(start if start is not None else _HDR_SIZE)
-            limit = end if end is not None else os.path.getsize(self.path)
+            limit = end if end is not None else aio.getsize(self.path)
             while fh.tell() < limit:
                 raw = fh.read(_REC_SIZE)
                 if len(raw) < _REC_SIZE:
@@ -170,9 +178,14 @@ def index_las(path: str, use_sidecar: bool = True) -> np.ndarray:
     8-byte magic+count header) so N array jobs sharing one LAS pay one scan
     total, not one each; a sidecar older than the LAS is rebuilt.
     """
-    sidecar = path + ".idx"
+    if aio.is_mem(path):
+        use_sidecar = False   # the sidecar cache is for durable files
+    # sidecar lives next to the REAL file: a file: scheme must strip to the
+    # same .idx path the plain-path form manages
+    fs_path = aio.local_path(path)
+    sidecar = fs_path + ".idx"
     if use_sidecar and os.path.exists(sidecar) \
-            and os.path.getmtime(sidecar) >= os.path.getmtime(path):
+            and os.path.getmtime(sidecar) >= os.path.getmtime(fs_path):
         # any malformed sidecar (truncated header/payload, concurrent-writer
         # corruption) falls through to a fresh scan instead of erroring
         try:
@@ -187,9 +200,9 @@ def index_las(path: str, use_sidecar: bool = True) -> np.ndarray:
             pass
     f = LasFile(path)
     rows: list[tuple[int, int]] = []
-    with open(path, "rb") as fh:
+    with aio.open_input(path, "rb") as fh:
         fh.seek(_HDR_SIZE)
-        size = os.path.getsize(path)
+        size = aio.getsize(path)
         last = None
         while fh.tell() < size:
             off = fh.tell()
@@ -224,7 +237,7 @@ def shard_ranges(path: str, nshards: int) -> list[tuple[int, int]]:
     ``-J i,n`` CLI sharding re-imagined as byte ranges over one file.
     """
     idx = index_las(path)
-    size = os.path.getsize(path)
+    size = aio.getsize(path)
     if len(idx) == 0:
         return [(_HDR_SIZE, size)] * 1 if nshards <= 1 else [(_HDR_SIZE, size)] + [(size, size)] * (nshards - 1)
     starts = idx[:, 1]
@@ -250,7 +263,7 @@ def range_for_areads(path: str, lo: int, hi: int) -> tuple[int, int]:
     Requires an aread-sorted LAS (DALIGNER order); uses the sidecar index.
     """
     idx = index_las(path)
-    size = os.path.getsize(path)
+    size = aio.getsize(path)
     if len(idx) == 0:
         return size, size
     areads = idx[:, 0]

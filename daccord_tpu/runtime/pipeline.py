@@ -285,13 +285,12 @@ def _strided_pile_ranges(las: LasFile, n: int, start: int | None,
     """Byte ranges of ``n`` piles spread evenly across the shard (via the
     aread index sidecar). The reference samples across the input; round 1
     took the FIRST n piles — a start-of-file bias (VERDICT r1 weak #5)."""
-    import os
-
     from ..formats.las import _HDR_SIZE, index_las
+    from ..utils.aio import getsize
 
     idx = index_las(las.path)
     lo = start if start is not None else _HDR_SIZE
-    hi = end if end is not None else os.path.getsize(las.path)
+    hi = end if end is not None else getsize(las.path)
     if len(idx) == 0:
         return [(lo, hi)]
     sel = np.nonzero((idx[:, 1] >= lo) & (idx[:, 1] < hi))[0]

@@ -1,0 +1,114 @@
+"""URL-scheme stream factory (libmaus2 ``aio`` role, SURVEY.md §2.2).
+
+The reference's abstract I/O layer opens streams by URL, and its ``mem:``
+scheme — process-local in-memory files — is the closest thing it has to a
+test-fixture infrastructure (SURVEY.md §4). This is the TPU framework's
+equivalent:
+
+- plain paths and ``file:PATH`` map to the filesystem;
+- ``mem:NAME`` maps to a process-local byte store: writes become visible at
+  close (atomic, like the repo's tmp+rename discipline on disk), reads get
+  an independent seekable view.
+
+Binary formats (DB/LAS) open their inputs through :func:`open_input` /
+:func:`getsize`, so tests can parse in-memory files without touching disk;
+multi-file stores (the DB's .idx/.bps/track sidecars) and the persistent
+LAS index sidecar stay file-backed by design — they are the durable
+resume/data plane of the shard model, not stream consumers.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+
+_MEM: dict[str, bytes] = {}
+_LOCK = threading.Lock()
+
+MEM_SCHEME = "mem:"
+FILE_SCHEME = "file:"
+
+
+def is_mem(url: str) -> bool:
+    return isinstance(url, str) and url.startswith(MEM_SCHEME)
+
+
+def local_path(url: str) -> str:
+    """Filesystem path of a non-mem URL (strips a ``file:`` scheme)."""
+    return url[len(FILE_SCHEME):] if isinstance(url, str) and \
+        url.startswith(FILE_SCHEME) else url
+
+
+_path = local_path
+
+
+def _is_text(mode: str) -> bool:
+    # builtin open() treats modes without 'b' as text ('r', 'rt', 'w', ...);
+    # the mem: branch must agree or the same code yields str on disk and
+    # bytes in memory
+    return "b" not in mode
+
+
+class _MemWriter(io.BytesIO):
+    """Seekable write buffer committed to the store on close."""
+
+    def __init__(self, name: str):
+        super().__init__()
+        self._name = name
+
+    def close(self) -> None:
+        if not self.closed:
+            with _LOCK:
+                _MEM[self._name] = self.getvalue()
+        super().close()
+
+
+def open_input(url: str, mode: str = "rb"):
+    """Readable stream for a URL (text unless mode contains 'b', exactly
+    like builtin ``open``)."""
+    if is_mem(url):
+        with _LOCK:
+            if url not in _MEM:
+                raise FileNotFoundError(url)
+            data = _MEM[url]
+        buf = io.BytesIO(data)
+        return io.TextIOWrapper(buf) if _is_text(mode) else buf
+    return open(local_path(url), mode)
+
+
+def open_output(url: str, mode: str = "wb"):
+    """Writable stream for a URL (text unless mode contains 'b'). mem:
+    content becomes visible at close."""
+    if is_mem(url):
+        buf = _MemWriter(url)
+        return io.TextIOWrapper(buf) if _is_text(mode) else buf
+    return open(local_path(url), mode)
+
+
+def exists(url: str) -> bool:
+    if is_mem(url):
+        with _LOCK:
+            return url in _MEM
+    return os.path.exists(local_path(url))
+
+
+def getsize(url: str) -> int:
+    if is_mem(url):
+        with _LOCK:
+            if url not in _MEM:
+                raise FileNotFoundError(url)
+            return len(_MEM[url])
+    return os.path.getsize(local_path(url))
+
+
+def remove(url: str) -> None:
+    """Delete a URL; raises FileNotFoundError when absent (both schemes —
+    callers' double-delete handling must not depend on the backend)."""
+    if is_mem(url):
+        with _LOCK:
+            if url not in _MEM:
+                raise FileNotFoundError(url)
+            del _MEM[url]
+        return
+    os.remove(local_path(url))

@@ -194,3 +194,63 @@ def test_dbsplit_blocks(tmp_path):
         assert tot <= 5000 or e - s == 1
     # db still readable and bases intact after the stub rewrite
     assert np.array_equal(db.read_bases(0), seqs[0])
+
+
+def test_aio_mem_streams():
+    """aio URL streams (libmaus2 aio role, SURVEY.md §2.2): FASTA and LAS
+    round-trip through mem: in-memory files — the reference's test-fixture
+    infrastructure — byte-identically to the disk path."""
+    from daccord_tpu.formats.fasta import FastaRecord, read_fasta, write_fasta
+    from daccord_tpu.formats.las import LasFile, Overlap, write_las
+    from daccord_tpu.utils import aio
+
+    # fasta round trip
+    recs = [FastaRecord("r0", "ACGT" * 30), FastaRecord("r1", "TTAA")]
+    write_fasta("mem:t.fasta", recs)
+    back = list(read_fasta("mem:t.fasta"))
+    assert [(r.name, r.seq) for r in back] == [(r.name, r.seq) for r in recs]
+
+    # las round trip incl. byte-range iteration and index
+    ovls = [Overlap(aread=a, bread=a + 1, abpos=0, aepos=100, bbpos=5,
+                    bepos=105, diffs=3,
+                    trace=np.asarray([[3, 105]], dtype=np.int32))
+            for a in range(5)]
+    n = write_las("mem:t.las", 100, ovls)
+    assert n == 5
+    las = LasFile("mem:t.las")
+    assert las.novl == 5 and las.tspace == 100
+    assert [o.aread for o in las] == [0, 1, 2, 3, 4]
+
+    from daccord_tpu.formats.las import range_for_areads, shard_ranges
+
+    r = shard_ranges("mem:t.las", 2)
+    assert len(r) == 2
+    s, e = range_for_areads("mem:t.las", 2, 4)
+    assert [o.aread for o in las.iter_range(s, e)] == [2, 3]
+
+    aio.remove("mem:t.las")
+    assert not aio.exists("mem:t.las")
+    with pytest.raises(FileNotFoundError):
+        aio.open_input("mem:t.las")
+
+
+def test_aio_file_scheme_sidecar(tmp_path):
+    """file: URLs strip to the same sidecar the plain path manages, so the
+    index cache is shared across both spellings."""
+    from daccord_tpu.formats.las import Overlap, index_las, write_las
+    from daccord_tpu.utils import aio
+
+    p = str(tmp_path / "f.las")
+    ovls = [Overlap(aread=a, bread=a + 1, abpos=0, aepos=50, bbpos=0, bepos=50,
+                    trace=np.asarray([[1, 50]], dtype=np.int32))
+            for a in range(3)]
+    write_las(p, 100, ovls)
+    idx1 = index_las(p)                       # builds sidecar f.las.idx
+    assert (tmp_path / "f.las.idx").exists()
+    idx2 = index_las("file:" + p)             # must REUSE it, not rescan/fail
+    np.testing.assert_array_equal(idx1, idx2)
+    assert aio.getsize("file:" + p) == aio.getsize(p)
+
+    import pytest as _pytest
+    with _pytest.raises(FileNotFoundError):
+        aio.remove("mem:never-existed")
