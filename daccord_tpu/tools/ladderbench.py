@@ -18,8 +18,11 @@ Rungs:
   cfg5  ONT R10-like regime corrected by two concurrent OS processes, each
         owning one LAS shard, outputs merged (the multi-host scale-out
         model: zero cross-process communication, shared FS)
+  cfg6  8%-diverged two-copy repeat, TWO ARMS: plain daccord vs the full
+        track pipeline (inqual -> repeats -> filter -> filtersym ->
+        QV-ranked daccord); reports both arms' Q in one row
 
-Usage: ``python -m daccord_tpu.tools.ladderbench [--configs cfg1,...,cfg5]``
+Usage: ``python -m daccord_tpu.tools.ladderbench [--configs cfg1,...,cfg6]``
 """
 
 from __future__ import annotations
@@ -62,13 +65,16 @@ def _dataset(name: str, **kw) -> dict:
     return {k: out[k] for k in ("db", "las", "truth")}
 
 
-def _qveval(fasta: str, truth: str, raw_db: str) -> dict:
+def _qveval(fasta: str, truth: str, raw_db: str | None) -> dict:
     from daccord_tpu.tools.cli import qveval_main
 
     with tempfile.NamedTemporaryFile("rt", suffix=".json", delete=False) as fh:
         path = fh.name
     try:
-        rc = qveval_main([fasta, truth, "--raw-db", raw_db, "--json", path])
+        args = [fasta, truth, "--json", path]
+        if raw_db is not None:   # raw-read scoring is a full DP pass; skip
+            args += ["--raw-db", raw_db]   # it when the caller discards it
+        rc = qveval_main(args)
         assert rc == 0
         with open(path) as fh2:
             return json.load(fh2)
@@ -144,7 +150,55 @@ RUNGS = {
     "cfg5": dict(sim_kw=dict(genome_len=30_000, coverage=15, read_len_mean=8_000,
                              read_len_sigma=0.5, p_ins=0.008, p_del=0.018,
                              p_sub=0.01, min_overlap=2_000, seed=15), procs=2),
+    # diverged two-copy repeat: the full track pipeline (inqual -> QV-gated
+    # repeats -> consistency filter -> filtersym -> QV-ranked daccord) vs the
+    # trackless run — the reference's preprocessing chain exercised end to
+    # end with a measured Q delta (BASELINE.md "Track-pipeline measurement")
+    "cfg6": dict(sim_kw=dict(genome_len=6_000, coverage=24, read_len_mean=800,
+                             repeat_fraction=0.35, repeat_divergence=0.08,
+                             seed=43), tracks=True),
 }
+
+
+def run_rung_tracks(name: str, sim_kw: dict) -> dict:
+    """Two-arm rung: plain daccord vs the full track pipeline, one JSON row.
+
+    Runs every stage through the production CLI in subprocesses (CPU backend:
+    the arms must be backend-identical, and track tools are host-only)."""
+    paths = _dataset(name, **sim_kw)
+    d = os.path.dirname(paths["db"])
+
+    def cli(*a):
+        r = subprocess.run([sys.executable, "-m", "daccord_tpu.tools.cli", *a],
+                           cwd=REPO, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(f"{a[0]} failed: {r.stderr[-300:]}")
+
+    t0 = time.perf_counter()
+    plain_fa = os.path.join(d, "plain.fasta")
+    cli("daccord", paths["db"], paths["las"], "-o", plain_fa,
+        "--backend", "cpu", "--qv-track", "")
+    filt = os.path.join(d, "filt.las")
+    sym = os.path.join(d, "sym.las")
+    depth = str(int(sim_kw.get("coverage", 20)))
+    cli("inqual", paths["db"], paths["las"], "-d", depth)
+    cli("repeats", paths["db"], paths["las"], "-d", depth, "--factor", "1.5")
+    cli("filter", paths["db"], paths["las"], filt)
+    cli("filtersym", filt, sym, "--db", paths["db"])
+    tracks_fa = os.path.join(d, "tracks.fasta")
+    cli("daccord", paths["db"], sym, "-o", tracks_fa, "--backend", "cpu")
+    wall = time.perf_counter() - t0
+
+    qp = _qveval(plain_fa, paths["truth"], paths["db"])
+    qt = _qveval(tracks_fa, paths["truth"], None)   # q_raw comes from qp
+    return {
+        "rung": name, "backend": "cpu", "wall_s": round(wall, 2),
+        "q_raw": qp.get("raw_qscore"),
+        "q_plain": qp.get("qscore"), "q_tracks": qt.get("qscore"),
+        "errors_plain": qp.get("errors"), "errors_tracks": qt.get("errors"),
+        "delta_q_tracks": round((qt.get("qscore") or 0)
+                                - (qp.get("qscore") or 0), 2),
+    }
 
 
 def run_rung_shards(name: str, sim_kw: dict, shards: int) -> dict:
@@ -258,6 +312,13 @@ def main(argv=None) -> int:
     for name in names:
         r = RUNGS[name]
         mesh = r.get("mesh", 0)
+        if r.get("tracks"):
+            try:
+                row = run_rung_tracks(name, r["sim_kw"])
+            except Exception as exc:   # a failed stage must not kill the
+                row = {"rung": name, "error": str(exc)[-400:]}   # whole ladder
+            print(json.dumps({**row, "fallback": fallback}))
+            continue
         if "shards" in r:
             print(json.dumps({**run_rung_shards(name, r["sim_kw"], r["shards"]),
                               "fallback": fallback}))

@@ -330,6 +330,22 @@ def test_ladderbench_rungs_smoke(tmp_path, monkeypatch):
     assert row["q_corrected"] > row["q_raw"]
 
 
+def test_ladderbench_tracks_rung_smoke(tmp_path, monkeypatch):
+    """The two-arm track-pipeline rung (cfg6 shape) runs every CLI stage and
+    reports both arms' Q. Subprocess CLI stages pay jax imports, so the
+    dataset is tiny; the real measurement is the cfg6 hardware run
+    (BASELINE.md 'Track-pipeline measurement')."""
+    from daccord_tpu.tools import ladderbench as lb
+
+    monkeypatch.setattr(lb, "CACHE", str(tmp_path))
+    row = lb.run_rung_tracks("tsmoke", dict(genome_len=2500, coverage=10,
+                                            read_len_mean=700,
+                                            repeat_fraction=0.3,
+                                            repeat_divergence=0.08, seed=9))
+    assert row["q_plain"] > row["q_raw"]
+    assert row["q_tracks"] is not None and row["errors_tracks"] is not None
+
+
 def test_block_tracks_catrack(dataset, tmp_path):
     """inqual/repeats --block write per-block tracks; catrack merges them
     byte-identically to the whole-DB run (the reference's per-block cluster
