@@ -154,7 +154,9 @@ def make_sharded_solver(ladder: TierLadder, mesh: Mesh, esc_cap: int | None = No
 def build_sharded_solver(n_devices: int, profile, consensus_cfg,
                          esc_cap: int | None = None,
                          use_pallas: bool = False,
-                         offset_counts=None) -> ShardedLadderSolver:
+                         offset_counts=None,
+                         max_kmers: int = 64,
+                         rescue_max_kmers: int = 256) -> ShardedLadderSolver:
     """Device-count-checked mesh solver from an error profile (plus the
     estimation pass's empirical OL counts, when collected — the mesh path
     must blend the same tables as the single-device path).
@@ -170,6 +172,8 @@ def build_sharded_solver(n_devices: int, profile, consensus_cfg,
     from ..kernels.window_kernel import pallas_needs_interpret
 
     ladder = TierLadder.from_config(profile, consensus_cfg,
+                                    max_kmers=max_kmers,
+                                    rescue_max_kmers=rescue_max_kmers,
                                     offset_counts=offset_counts)
     interpret = use_pallas and pallas_needs_interpret()
     return make_sharded_solver(ladder, make_mesh(n_devices), esc_cap,

@@ -43,6 +43,15 @@ class PipelineConfig:
                                  # grows with the static batch shape)
     depth: int = 32
     seg_len: int = 64
+    max_kmers: int = 64          # tier-0 compacted active-set size (top-M
+                                 # k-mers per window); the cap binds on
+                                 # 60-70% of windows at 24-30x depth
+                                 # (topm_overflow stat) though truncations
+                                 # are usually harmless — larger M trades
+                                 # quadratic DP cost for fidelity
+    rescue_max_kmers: int = 256  # active-set size of the min_count<=1
+                                 # rescue tiers (they keep every k-mer, so
+                                 # they need the headroom)
     profile_sample_piles: int = 4
     use_native: bool = True      # C++ host path when available
     depth_rank: bool = True      # best-alignments-first before depth capping
@@ -461,6 +470,8 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     if not cfg.empirical_ol:
         offset_counts = None
     ladder = TierLadder.from_config(profile, cfg.consensus,
+                                    max_kmers=cfg.max_kmers,
+                                    rescue_max_kmers=cfg.rescue_max_kmers,
                                     offset_counts=offset_counts)
     from ..utils.obs import JsonlLogger
 

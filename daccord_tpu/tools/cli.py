@@ -52,6 +52,11 @@ def daccord_main(argv=None) -> int:
                    help="host windowing threads (reference -t; 0 = synchronous)")
     p.add_argument("--depth", type=int, default=32, help="max segments per window")
     p.add_argument("--seg-len", type=int, default=64, help="max segment length")
+    p.add_argument("-M", "--max-kmers", type=int, default=64,
+                   help="tier-0 compacted active-set size (top-M k-mers per "
+                        "window); the cap binds on most windows at >24x depth "
+                        "(topm_overflow stat) — raising it trades quadratic "
+                        "path-DP cost for graph fidelity")
     p.add_argument("--mode", choices=("split", "patch"), default="split",
                    help="unsolved windows split the read or get patched with raw bases")
     p.add_argument("-E", "--eprof", default=None, metavar="PATH",
@@ -143,6 +148,7 @@ def daccord_main(argv=None) -> int:
                                          max_err=args.max_err))
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
                          depth=args.depth, seg_len=args.seg_len,
+                         max_kmers=args.max_kmers,
                          log_path=args.log, use_native=not args.no_native,
                          feeder_threads=args.threads, use_pallas=args.pallas,
                          end_trim=not args.no_end_trim,
@@ -200,7 +206,9 @@ def daccord_main(argv=None) -> int:
                                                   start, end)
         solver = build_sharded_solver(args.mesh, prof, cfg.consensus,
                                       use_pallas=args.pallas,
-                                      offset_counts=ol_counts)
+                                      offset_counts=ol_counts,
+                                      max_kmers=cfg.max_kmers,
+                                      rescue_max_kmers=cfg.rescue_max_kmers)
 
     if args.profile:
         import jax
