@@ -184,3 +184,32 @@ def test_packed_ladder_matches_dict_ladder(fixture):
     fin = ~np.isinf(ref["err"])
     assert np.array_equal(got["err"][fin], ref["err"][fin])
     assert got["esc_overflow"] == int(ref["esc_overflow"])
+
+
+def test_packed_result_roundtrip_unit():
+    """pack_result/unpack_result wire format: four int8 cons bytes per word, f32 err
+    bitcast, tier+1 in 5 bits, per-window m_ovf at bit 5, esc_overflow in
+    row 0's high bits — exact round trip."""
+    import jax.numpy as jnp
+
+    from daccord_tpu.kernels.tiers import pack_result, unpack_result
+
+    rng = np.random.default_rng(3)
+    B, CL = 7, 50
+    cons = rng.integers(0, 5, (B, CL)).astype(np.int8)
+    cons_len = rng.integers(0, CL + 1, B).astype(np.int32)
+    err = rng.random(B).astype(np.float32)
+    err[2] = np.inf
+    tier = np.asarray([0, 1, 2, 3, -1, 0, 30], np.int32)   # 30 = max (tier+1 in 5 bits)
+    m_ovf = np.asarray([1, 0, 1, 0, 1, 0, 1], bool)
+    out = dict(cons=jnp.asarray(cons), cons_len=jnp.asarray(cons_len),
+               err=jnp.asarray(err), tier=jnp.asarray(tier),
+               m_ovf=jnp.asarray(m_ovf), esc_overflow=jnp.int32(12345))
+    back = unpack_result(np.asarray(pack_result(out)), CL)
+    np.testing.assert_array_equal(back["cons"], cons)
+    np.testing.assert_array_equal(back["cons_len"], cons_len)
+    np.testing.assert_array_equal(back["err"], err)
+    np.testing.assert_array_equal(back["tier"], tier)
+    np.testing.assert_array_equal(back["m_ovf"], m_ovf)
+    np.testing.assert_array_equal(back["solved"], tier >= 0)
+    assert back["esc_overflow"] == 12345
