@@ -33,13 +33,42 @@ def _sort_key(o: Overlap):
 
 
 def sort_las_external(in_path: str, out_path: str,
-                      mem_records: int = 2_000_000) -> int:
+                      mem_records: int = 2_000_000,
+                      use_native: bool = True) -> int:
     """Sort a LAS by (aread, bread, abpos) with bounded memory.
 
-    Records stream in; every ``mem_records`` of them become one sorted temp
-    run (a valid LAS file); runs k-way merge straight into ``out_path``.
-    Returns the record count.
+    The hot path is the native C++ external sort (``las_sort`` — the
+    reference's LAsort is native too; ~30x the Python record stream). The
+    Python path below is the executable spec and the fallback; both produce
+    byte-identical output for the same ``mem_records`` (same run
+    partitioning, stable chunk sort, earliest-run-wins fan-in-64 merge;
+    parity-tested). Records stream in; every ``mem_records`` of them become
+    one sorted temp run; runs merge straight into ``out_path``. Returns the
+    record count.
     """
+    from ..utils.aio import is_mem
+
+    if use_native and not (is_mem(in_path) or is_mem(out_path)):
+        try:
+            from ..native import available
+            native_ok = available()
+        except Exception:
+            native_ok = False
+        if native_ok:
+            from ..native.api import las_sort_native
+
+            with tempfile.TemporaryDirectory(
+                    dir=os.path.dirname(os.path.abspath(out_path)),
+                    prefix=".lassort.") as td:
+                n = las_sort_native(in_path, out_path, td, mem_records)
+            # a rewritten LAS invalidates any index sidecar (the Python path
+            # does this inside write_las)
+            try:
+                os.remove(out_path + ".idx")
+            except OSError:
+                pass
+            return n
+
     las = LasFile(in_path)
     with tempfile.TemporaryDirectory(
             dir=os.path.dirname(os.path.abspath(out_path)),

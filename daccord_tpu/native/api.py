@@ -146,3 +146,20 @@ def process_pile_native(a_bases: np.ndarray, col: ColumnarLas, s: int, e: int,
     if rc != 0:
         raise RuntimeError(f"process_pile failed: {rc}")
     return seqs, lens, nsegs
+
+
+def las_sort_native(in_path: str, out_path: str, tmp_dir: str,
+                    mem_records: int) -> int:
+    """Native external LAS sort (LAsort role); returns the record count.
+
+    Byte-identical to ``formats.extsort.sort_las_external``'s Python path for
+    the same ``mem_records`` (same run partitioning, stable sort, earliest-
+    run-wins merge — parity-tested)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = lib.las_sort(in_path.encode(), out_path.encode(), tmp_dir.encode(),
+                     int(mem_records))
+    if n < 0:
+        raise IOError(f"las_sort({in_path}) failed: {n}")
+    return int(n)
