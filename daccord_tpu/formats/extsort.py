@@ -46,7 +46,7 @@ def sort_las_external(in_path: str, out_path: str,
     one sorted temp run; runs merge straight into ``out_path``. Returns the
     record count.
     """
-    from ..utils.aio import is_mem
+    from ..utils.aio import is_mem, local_path
 
     if use_native and not (is_mem(in_path) or is_mem(out_path)):
         try:
@@ -56,17 +56,14 @@ def sort_las_external(in_path: str, out_path: str,
             native_ok = False
         if native_ok:
             from ..native.api import las_sort_native
+            from .las import invalidate_index
 
+            in_fs, out_fs = local_path(in_path), local_path(out_path)
             with tempfile.TemporaryDirectory(
-                    dir=os.path.dirname(os.path.abspath(out_path)),
+                    dir=os.path.dirname(os.path.abspath(out_fs)),
                     prefix=".lassort.") as td:
-                n = las_sort_native(in_path, out_path, td, mem_records)
-            # a rewritten LAS invalidates any index sidecar (the Python path
-            # does this inside write_las)
-            try:
-                os.remove(out_path + ".idx")
-            except OSError:
-                pass
+                n = las_sort_native(in_fs, out_fs, td, mem_records)
+            invalidate_index(out_path)
             return n
 
     las = LasFile(in_path)

@@ -102,13 +102,20 @@ def write_las(path: str, tspace: int, overlaps: Iterable[Overlap]) -> int:
             novl += 1
         fh.seek(0)
         fh.write(struct.pack("<q", novl))
-    # a rewritten LAS invalidates any index sidecar regardless of mtime skew
-    if not aio.is_mem(path):
-        try:
-            os.remove(aio.local_path(path) + ".idx")
-        except OSError:
-            pass
+    invalidate_index(path)
     return novl
+
+
+def invalidate_index(path: str) -> None:
+    """Drop the aread-index sidecar of a (re)written LAS — one owner for
+    the sidecar lifecycle rule, shared by every writer path (write_las, the
+    native sort/merge dispatchers)."""
+    if aio.is_mem(path):
+        return
+    try:
+        os.remove(aio.local_path(path) + ".idx")
+    except OSError:
+        pass
 
 
 _HDR_FMT = "<qi4x"

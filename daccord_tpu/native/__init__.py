@@ -70,6 +70,8 @@ def load():
         lib.las_load.argtypes = [c.c_char_p, c.c_int64, c.c_int64, c.c_int64] + [c.c_void_p] * 10
         lib.las_sort.restype = c.c_int64
         lib.las_sort.argtypes = [c.c_char_p, c.c_char_p, c.c_char_p, c.c_int64]
+        lib.las_merge.restype = c.c_int64
+        lib.las_merge.argtypes = [c.c_char_p, c.c_char_p, c.c_int32]
         lib.suffix_prefix.restype = c.c_int
         lib.suffix_prefix.argtypes = [c.c_void_p, c.c_int32, c.c_void_p, c.c_int32,
                                       c.POINTER(c.c_int32), c.POINTER(c.c_int32),

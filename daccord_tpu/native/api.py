@@ -163,3 +163,17 @@ def las_sort_native(in_path: str, out_path: str, tmp_dir: str,
     if n < 0:
         raise IOError(f"las_sort({in_path}) failed: {n}")
     return int(n)
+
+
+def las_merge_native(in_paths: list[str], out_path: str, tspace: int) -> int:
+    """Native k-way merge of sorted headered LAS files (LAmerge role);
+    returns the record count. Same ordering semantics as the Python
+    heapq.merge path (parity-tested)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    blob = b"\x00".join(p.encode() for p in in_paths) + b"\x00\x00"
+    n = lib.las_merge(blob, out_path.encode(), int(tspace))
+    if n < 0:
+        raise IOError(f"las_merge failed: {n}")
+    return int(n)

@@ -391,24 +391,34 @@ struct LasRec40 {
 };
 static_assert(sizeof(LasRec40) == 40, "record layout");
 
-// buffered reader over a headerless run file of raw (Rec40 + trace) records
+// buffered reader over a run file of raw (Rec40 + trace) records.
+// corrupt != exhausted: a truncated record or garbage tlen sets `err`
+// (silently dropping a foreign file's tail would hand downstream consensus
+// an incomplete overlap set while reporting success)
 struct RunReader {
   FILE* f = nullptr;
   int tsize = 1;
   std::vector<uint8_t> rec;   // current raw record bytes
   SortKey key{};
   bool ok = false;
+  bool err = false;
 
   bool next() {
     LasRec40 h;
-    if (fread(&h, sizeof(h), 1, f) != 1) { ok = false; return false; }
-    if (h.tlen < 0 || h.tlen > (1 << 28)) { ok = false; return false; }
+    size_t got = fread(&h, 1, sizeof(h), f);
+    if (got != sizeof(h)) {
+      ok = false;
+      err = got != 0;          // partial header = corruption, 0 = clean EOF
+      return false;
+    }
+    if (h.tlen < 0 || h.tlen > (1 << 28)) { ok = false; err = true; return false; }
     h.pad = 0;   // normalize struct tail padding like the Python writer
     rec.resize(sizeof(h) + (size_t)h.tlen * tsize);
     std::memcpy(rec.data(), &h, sizeof(h));
     if (h.tlen &&
         fread(rec.data() + sizeof(h), tsize, h.tlen, f) != (size_t)h.tlen) {
       ok = false;
+      err = true;              // truncated trace
       return false;
     }
     key = SortKey{h.aread, h.bread, h.abpos};
@@ -418,9 +428,14 @@ struct RunReader {
 };
 
 // merge `paths` (already individually sorted) into `out`; `hdr16` non-null
-// writes the 16-byte LAS header (novl patched at the end) for the final file
+// writes the 16-byte LAS header (novl patched at the end) for the final
+// file. `in_hdr_tspace >= 0` means each input starts with a 16-byte LAS
+// header that must carry that tspace (the las_merge foreign-input mode);
+// -1 means headerless run files. `count_out` (optional) receives novl.
 static int merge_runs(const std::vector<std::string>& paths, int tsize,
-                      const char* out, const uint8_t* hdr16) {
+                      const char* out, const uint8_t* hdr16,
+                      int32_t in_hdr_tspace = -1,
+                      int64_t* count_out = nullptr) {
   std::vector<RunReader> rs(paths.size());
   auto close_runs = [&]() {
     for (auto& r : rs)
@@ -429,6 +444,13 @@ static int merge_runs(const std::vector<std::string>& paths, int tsize,
   for (size_t i = 0; i < paths.size(); ++i) {
     rs[i].f = fopen(paths[i].c_str(), "rb");
     if (!rs[i].f) { close_runs(); return -1; }
+    if (in_hdr_tspace >= 0) {
+      struct { int64_t novl; int32_t tspace; int32_t pad; } h;
+      if (fread(&h, 16, 1, rs[i].f) != 1 || h.tspace != in_hdr_tspace) {
+        close_runs();
+        return -6;
+      }
+    }
     rs[i].tsize = tsize;
     rs[i].next();
   }
@@ -456,6 +478,8 @@ static int merge_runs(const std::vector<std::string>& paths, int tsize,
     ++novl;
     if (rs[i].next()) heap.push({rs[i].key, i});
   }
+  for (const auto& r : rs)
+    if (r.err) { fclose(fo); close_runs(); return -7; }   // corrupt input
   if (hdr16) {
     struct { int64_t novl; int32_t tspace; int32_t pad; } hdr;
     std::memcpy(&hdr, hdr16, 16);
@@ -464,6 +488,7 @@ static int merge_runs(const std::vector<std::string>& paths, int tsize,
     if (fwrite(&hdr, 16, 1, fo) != 1) { fclose(fo); close_runs(); return -2; }
   }
   close_runs();
+  if (count_out) *count_out = novl;
   // fclose flushes the tail of the stdio buffer: a full disk surfaces HERE,
   // not at the buffered fwrites — an unchecked close would report a
   // truncated file as success
@@ -473,6 +498,29 @@ static int merge_runs(const std::vector<std::string>& paths, int tsize,
 }  // namespace
 
 extern "C" {
+
+// k-way merge of ALREADY-SORTED headered LAS files (LAmerge role; DALIGNER
+// emits one LAS per block pair). Same key and earliest-input-wins tie break
+// as las_sort / the Python heapq.merge path. in_paths is a
+// NUL-separated, double-NUL-terminated list. Returns the record count or a
+// negative error; all inputs must share out-file tspace `tspace_expect`.
+int64_t las_merge(const char* in_paths, const char* out_path,
+                  int32_t tspace_expect) {
+  std::vector<std::string> paths;
+  for (const char* p = in_paths; *p;) {
+    paths.emplace_back(p);
+    p += paths.back().size() + 1;
+  }
+  if (paths.empty()) return -1;
+  const int tsize = tspace_expect <= 125 ? 1 : 2;
+  struct { int64_t novl; int32_t tspace; int32_t pad; } oh{0, tspace_expect, 0};
+  uint8_t hdr16[16];
+  std::memcpy(hdr16, &oh, 16);
+  int64_t novl = 0;
+  const int rc = merge_runs(paths, tsize, out_path, hdr16,
+                            /*in_hdr_tspace=*/tspace_expect, &novl);
+  return rc == 0 ? novl : rc;
+}
 
 // sorts in_path -> out_path by (aread, bread, abpos) holding at most
 // mem_records records in memory; temp runs live in tmp_dir. Returns the
@@ -518,7 +566,8 @@ int64_t las_sort(const char* in_path, const char* out_path,
 
   LasRec40 rec;
   int64_t total = 0;
-  while (fread(&rec, sizeof(rec), 1, f) == 1) {
+  size_t got;
+  while ((got = fread(&rec, 1, sizeof(rec), f)) == sizeof(rec)) {
     if (rec.tlen < 0 || rec.tlen > (1 << 28)) { fclose(f); return -3; }
     rec.pad = 0;   // normalize struct tail padding like the Python writer
     const size_t sz = sizeof(rec) + (size_t)rec.tlen * tsize;
@@ -535,6 +584,7 @@ int64_t las_sort(const char* in_path, const char* out_path,
     if ((int64_t)ents.size() >= mem_records)
       if (flush() != 0) { fclose(f); return -4; }
   }
+  if (got != 0) { fclose(f); return -3; }   // partial record = truncated input
   fclose(f);
 
   if (runs.empty()) {

@@ -415,10 +415,31 @@ def lasmerge_main(argv=None) -> int:
     tspaces = {f.tspace for f in files}
     if len(tspaces) != 1:
         raise SystemExit(f"mismatched tspace across inputs: {sorted(tspaces)}")
-    # k-way merge of already-sorted streams, keyed like lassort
-    streams = [iter(f) for f in files]
-    merged = heapq.merge(*streams, key=lambda o: (o.aread, o.bread, o.abpos))
-    n = write_las(args.out, tspaces.pop(), merged)
+    tspace = tspaces.pop()
+    from ..utils.aio import is_mem
+
+    native_ok = not any(is_mem(p) for p in [args.out, *args.las])
+    if native_ok:
+        try:
+            from ..native import available
+            native_ok = available()
+        except Exception:
+            native_ok = False
+    if native_ok:
+        # native heap merge (LAmerge is native in the reference too); same
+        # ordering as the Python path below (parity-tested)
+        from ..formats.las import invalidate_index
+        from ..native.api import las_merge_native
+        from ..utils.aio import local_path
+
+        n = las_merge_native([local_path(p) for p in args.las],
+                             local_path(args.out), tspace)
+        invalidate_index(args.out)
+    else:
+        # k-way merge of already-sorted streams, keyed like lassort
+        streams = [iter(f) for f in files]
+        merged = heapq.merge(*streams, key=lambda o: (o.aread, o.bread, o.abpos))
+        n = write_las(args.out, tspace, merged)
     print(f"merged {len(files)} files -> {n} overlaps", file=sys.stderr)
     return 0
 

@@ -136,3 +136,76 @@ def test_native_sort_normalizes_foreign_pad_bytes(tmp_path):
     sort_las_external(p, py, mem_records=2, use_native=False)
     sort_las_external(p, nat, mem_records=2, use_native=True)
     assert open(py, "rb").read() == open(nat, "rb").read()
+
+
+def test_native_merge_matches_python(dataset, tmp_path):
+    """las-merge's native heap merge is byte-identical to the Python
+    heapq.merge path (including pad normalization on foreign inputs)."""
+    from daccord_tpu.native import available
+
+    if not available():
+        pytest.skip("native host path unavailable")
+    import heapq
+
+    from daccord_tpu.native.api import las_merge_native
+
+    out, d = dataset
+    las = LasFile(out["las"])
+    ovls = list(las)
+    p1, p2, p3 = (str(tmp_path / f"{n}.las") for n in "abc")
+    write_las(p1, las.tspace, [o for o in ovls if o.aread % 3 == 0])
+    write_las(p2, las.tspace, [o for o in ovls if o.aread % 3 == 1])
+    write_las(p3, las.tspace, [o for o in ovls if o.aread % 3 == 2])
+
+    ref = str(tmp_path / "ref.las")
+    write_las(ref, las.tspace,
+              heapq.merge(*(iter(LasFile(p)) for p in (p1, p2, p3)),
+                          key=lambda o: (o.aread, o.bread, o.abpos)))
+    nat = str(tmp_path / "nat.las")
+    n = las_merge_native([p1, p2, p3], nat, las.tspace)
+    assert n == las.novl
+    assert open(nat, "rb").read() == open(ref, "rb").read()
+
+
+def test_native_sort_wide_tspace_parity(tmp_path):
+    """tspace > 125 (2-byte trace values on disk): the native tsize=2 read
+    path must stay byte-identical to the Python path."""
+    from daccord_tpu.formats.las import Overlap
+    from daccord_tpu.native import available
+
+    if not available():
+        pytest.skip("native host path unavailable")
+    rng = np.random.default_rng(13)
+    ovls = [Overlap(aread=int(rng.integers(0, 50)), bread=int(rng.integers(0, 50)),
+                    abpos=0, aepos=300, bbpos=0, bepos=300,
+                    trace=np.asarray([[int(rng.integers(0, 400)), 150],
+                                      [int(rng.integers(0, 400)), 150]], np.int32))
+            for _ in range(200)]
+    p = str(tmp_path / "wide.las")
+    write_las(p, 150, ovls)   # tspace 150 -> uint16 traces
+    py = str(tmp_path / "wide_py.las")
+    nat = str(tmp_path / "wide_nat.las")
+    n1 = sort_las_external(p, py, mem_records=50, use_native=False)
+    n2 = sort_las_external(p, nat, mem_records=50, use_native=True)
+    assert n1 == n2 == 200
+    assert open(py, "rb").read() == open(nat, "rb").read()
+
+
+def test_native_merge_rejects_truncated_input(dataset, tmp_path):
+    """A foreign LAS truncated mid-record must fail the native merge loudly
+    (the Python path raises on the same input); silently dropping the tail
+    would hand consensus an incomplete overlap set."""
+    from daccord_tpu.native import available
+    from daccord_tpu.native.api import las_merge_native
+
+    if not available():
+        pytest.skip("native host path unavailable")
+    out, d = dataset
+    las = LasFile(out["las"])
+    good = str(tmp_path / "good.las")
+    write_las(good, las.tspace, list(las)[:20])
+    raw = open(good, "rb").read()
+    bad = str(tmp_path / "bad.las")
+    open(bad, "wb").write(raw[:-7])   # chop mid-trace
+    with pytest.raises(IOError):
+        las_merge_native([bad], str(tmp_path / "m.las"), las.tspace)
