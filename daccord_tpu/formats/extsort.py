@@ -170,10 +170,25 @@ def filter_symmetric_external(las_path: str, out_path: str, db,
     memory: keys hash-partition onto disk, each partition joins in memory,
     matches set bits in a novl-bit bitmap, and a final streaming pass writes
     the kept records. ``db`` supplies read lengths for the complement-space
-    mirror (required — the exact semantics of the in-memory path)."""
+    mirror (required — the exact semantics of the in-memory path).
+
+    Memory bound: the per-partition join holds ~max(mem_records, novl/nparts)
+    keys at once, plus the always-resident novl-byte keep bitmap. nparts is
+    sized so the first term stays at mem_records, capped only by the process
+    fd limit (the scatter phase keeps 2 files per partition open at once);
+    at the default ulimit of 1024 that caps nparts near 480, i.e. ~1e9
+    records before partitions start exceeding mem_records."""
     las = LasFile(las_path)
     novl = las.novl
-    nparts = min(256, max(1, (novl + mem_records - 1) // mem_records))
+    try:
+        import resource
+        soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+        if soft < 0 or soft == resource.RLIM_INFINITY:
+            soft = 4096   # unlimited: RLIM_INFINITY is -1 on Linux
+        fd_cap = max(16, (soft - 64) // 2)
+    except Exception:
+        fd_cap = 256
+    nparts = min(fd_cap, max(1, (novl + mem_records - 1) // mem_records))
     keep = np.zeros(novl, dtype=bool)
 
     with tempfile.TemporaryDirectory(

@@ -54,7 +54,10 @@ def load():
             return _lib
         _tried = True
         so = _so_path()
-        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(_SRC):
+        # <= not <: a fresh checkout stamps .so and .cpp with identical mtimes,
+        # and a stale -march=native build from another host can SIGILL at call
+        # time even though CDLL load succeeds — rebuild on any tie
+        if not os.path.exists(so) or os.path.getmtime(so) <= os.path.getmtime(_SRC):
             if not _build(so):
                 return None
         try:

@@ -279,8 +279,9 @@ def detect_repeats(db: DazzDB, las: LasFile, depth: int = 20,
         tile_base, cov_flat = _tile_coverage_native(db, las, lo, hi,
                                                     byte_range=(start, end))
         hot_flat = cov_flat > cov_factor * depth
-        if qv_gate is not None:
-            hot_flat &= np.concatenate(qv_gate) if qv_gate else hot_flat[:0]
+        if qv_gate:
+            # empty gate list == no reads in range: nothing to mask
+            hot_flat &= np.concatenate(qv_gate)
         # global run extraction: a zero separator at every read boundary
         # keeps runs from merging across reads; one diff finds all runs
         seps = tile_base[1:-1]
@@ -408,11 +409,39 @@ def filter_alignments(db: DazzDB, las: LasFile, out_path: str,
                          if reps is not None else set())
             uspan = (col.aepos.astype(np.int64) - col.abpos).copy()
             if rep_reads:
-                for i in range(n):
-                    a = int(col.aread[i])
-                    if a in rep_reads:
-                        uspan[i] = unique_span(a, int(col.abpos[i]),
-                                               int(col.aepos[i]))
+                # repeat-bearing reads dominate exactly the piles this tool
+                # targets, so the subtraction is grouped by read and done with
+                # searchsorted against the read's interval boundaries instead
+                # of a per-record Python loop
+                sel = np.nonzero(np.isin(
+                    col.aread, np.fromiter(rep_reads, np.int64)))[0]
+                sel = sel[np.argsort(col.aread[sel], kind="stable")]
+                grp = np.split(sel, np.nonzero(np.diff(col.aread[sel]))[0] + 1)
+                for g in grp:
+                    if not len(g):
+                        continue
+                    a = int(col.aread[g[0]])
+                    iv = np.asarray(reps[a], dtype=np.int64).reshape(-1, 2)
+                    st, en = iv[:, 0], iv[:, 1]
+                    ab = col.abpos[g].astype(np.int64)
+                    ae = col.aepos[g].astype(np.int64)
+                    if len(iv) and np.all(st[1:] >= en[:-1]):
+                        # sorted disjoint intervals (the track writer's
+                        # invariant): covered length via prefix sums minus
+                        # the two end overhangs
+                        cum = np.concatenate([[0], np.cumsum(en - st)])
+                        i0 = np.searchsorted(en, ab, side="right")
+                        i1 = np.searchsorted(st, ae, side="left")
+                        has = i1 > i0
+                        cov = cum[i1] - cum[i0]
+                        cov -= np.where(has, np.maximum(
+                            0, ab - st[np.minimum(i0, len(iv) - 1)]), 0)
+                        cov -= np.where(has, np.maximum(
+                            0, en[np.maximum(i1, 1) - 1] - ae), 0)
+                        uspan[g] = (ae - ab) - cov
+                    else:
+                        for j, i in enumerate(g):
+                            uspan[i] = unique_span(a, int(ab[j]), int(ae[j]))
             is_uniq = uspan >= min_unique_span
             span_ok = alen >= min_unique_span
             gmed = float(np.median(prates[is_uniq])) if is_uniq.any() \
