@@ -29,11 +29,17 @@ from .window_kernel import KernelParams, solve_batch_core, solve_window_batch
 class TierLadder:
     params: list[KernelParams]
     tables: dict[int, jnp.ndarray]   # k -> OL table [P, O] f32
+    wide_p0: KernelParams | None = None   # overflow-rescue tier: tier 0 at
+                                 # the rescue active-set size; windows whose
+                                 # top-M cap bound are re-solved uncapped
+                                 # (reference full-graph semantics,
+                                 # SURVEY.md:65; BASELINE.md top-M table)
 
     @classmethod
     def from_config(cls, profile: ErrorProfile, cfg: ConsensusConfig,
                     max_kmers: int = 64, rescue_max_kmers: int = 256,
-                    offset_counts=None) -> "TierLadder":
+                    offset_counts=None, overflow_rescue: bool = False
+                    ) -> "TierLadder":
         """``offset_counts``: empirical [P, O] offset samples from the
         estimation pass; blended into every tier's OL table (see
         ``oracle.profile.OffsetLikely``). Table construction delegates to the
@@ -62,12 +68,19 @@ class TierLadder:
         ]
         # pack_result stores tier+1 in 5 bits next to the overflow counter
         assert len(params) < 31, "ladder too deep for the packed-result layout"
-        return cls(params=params, tables=tables)
+        wide_p0 = None
+        if overflow_rescue and params[0].max_kmers < rescue_max_kmers:
+            import dataclasses
+
+            wide_p0 = dataclasses.replace(params[0],
+                                          max_kmers=rescue_max_kmers)
+        return cls(params=params, tables=tables, wide_p0=wide_p0)
 
 
 def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ...],
                 esc_cap: int, use_pallas: bool = False,
-                pallas_interpret: bool = False):
+                pallas_interpret: bool = False,
+                wide_p0: KernelParams | None = None):
     """Full escalation ladder as one traceable program.
 
     ``tables[i]`` is the OffsetLikely table for ``params[i]``. Failures of
@@ -75,6 +88,12 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
     through the remaining tiers with already-solved slots depth-masked; results
     scatter back. Failures beyond ``esc_cap`` stay unsolved (reported via
     ``esc_overflow``; cap generously — tier-0 failure rate is <10%).
+
+    ``wide_p0`` (overflow rescue) re-solves every window whose tier-0 top-M
+    cap bound at the rescue active-set size, replacing the capped result when
+    the wide solve succeeds — the reference's full-graph semantics restored
+    for exactly the windows where truncation could matter. Runs before the
+    failure escalation so wide-solved windows skip the rescue tiers.
 
     ``use_pallas`` routes every tier's heaviest-path DP through the Pallas
     kernel (TPU only; semantics bit-identical, tests/test_pallas.py).
@@ -91,6 +110,43 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
     # from tier 0; escalation tiers OR in their own caps below so every
     # window that ANY processing tier truncated carries the flag
     m_ovf = out0["m_overflow"]
+
+    if wide_p0 is not None:
+        # rescue capacity = the FULL batch, independent of esc_cap: the top-M
+        # cap binds on most windows at production depth (unlike tier-0
+        # failures, which esc_cap is sized for), so truncating the rescue
+        # would silently skip exactly the windows it exists for. The host
+        # path (solve_tiered) rescues every overflowed window; parity
+        # requires the same here. lax.cond skips the solve when none bind.
+        EW = seqs.shape[0]
+        ovf = m_ovf & (nsegs >= p0.min_depth)
+        wcount = jnp.sum(ovf.astype(jnp.int32))
+
+        def run_wide(args):
+            cons, cons_len, err, solved, tier, m_ovf = args
+            idx = jnp.nonzero(ovf, size=EW, fill_value=0)[0]
+            live = jnp.arange(EW) < wcount
+            out_w = solve_batch_core(seqs[idx], lens[idx],
+                                     jnp.where(live, nsegs[idx], 0),
+                                     tables[0], wide_p0, use_pallas,
+                                     pallas_interpret)
+            take = live & out_w["solved"]
+            B = seqs.shape[0]
+            idx_w = jnp.where(take, idx, B)   # non-taken -> out of bounds, drop
+            # the uncapped result replaces the capped one even when both
+            # solved; the flag clears only where the wide set didn't cap too
+            clear = take & ~out_w["m_overflow"]
+            idx_c = jnp.where(clear, idx, B)
+            return (cons.at[idx_w].set(out_w["cons"], mode="drop"),
+                    cons_len.at[idx_w].set(out_w["cons_len"], mode="drop"),
+                    err.at[idx_w].set(out_w["err"], mode="drop"),
+                    solved.at[idx_w].set(True, mode="drop"),
+                    tier.at[idx_w].set(0, mode="drop"),
+                    m_ovf.at[idx_c].set(False, mode="drop"))
+
+        cons, cons_len, err, solved, tier, m_ovf = jax.lax.cond(
+            wcount > 0, run_wide, lambda args: args,
+            (cons, cons_len, err, solved, tier, m_ovf))
 
     overflow = jnp.int32(0)
     if len(params) > 1 and esc_cap > 0:
@@ -153,11 +209,11 @@ def ladder_core(seqs, lens, nsegs, tables: tuple, params: tuple[KernelParams, ..
 
 @functools.partial(jax.jit,
                    static_argnames=("params", "esc_cap", "use_pallas",
-                                    "pallas_interpret"))
+                                    "pallas_interpret", "wide_p0"))
 def _ladder_jit(seqs, lens, nsegs, tables, params, esc_cap, use_pallas=False,
-                pallas_interpret=False):
+                pallas_interpret=False, wide_p0=None):
     return ladder_core(seqs, lens, nsegs, tables, params, esc_cap, use_pallas,
-                       pallas_interpret)
+                       pallas_interpret, wide_p0)
 
 
 def pack_result(out: dict) -> jnp.ndarray:
@@ -210,11 +266,11 @@ def unpack_result(arr: np.ndarray, cons_len_cl: int) -> dict:
 
 @functools.partial(jax.jit,
                    static_argnames=("params", "esc_cap", "use_pallas",
-                                    "pallas_interpret"))
+                                    "pallas_interpret", "wide_p0"))
 def _ladder_packed_jit(seqs, lens, nsegs, tables, params, esc_cap,
-                       use_pallas=False, pallas_interpret=False):
+                       use_pallas=False, pallas_interpret=False, wide_p0=None):
     return pack_result(ladder_core(seqs, lens, nsegs, tables, params, esc_cap,
-                                   use_pallas, pallas_interpret))
+                                   use_pallas, pallas_interpret, wide_p0))
 
 
 class _PackedHandle:
@@ -247,7 +303,7 @@ def solve_ladder_async(batch: WindowBatch, ladder: TierLadder,
     arr = _ladder_packed_jit(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
                              jnp.asarray(batch.nsegs), tables,
                              tuple(ladder.params), esc_cap, use_pallas,
-                             pallas_interpret)
+                             pallas_interpret, ladder.wide_p0)
     return _PackedHandle(arr, ladder.params[0].cons_len)
 
 
@@ -279,6 +335,25 @@ def solve_ladder(batch: WindowBatch, ladder: TierLadder,
     """Single-dispatch full-ladder solve; host numpy results."""
     return fetch(solve_ladder_async(batch, ladder, esc_cap, use_pallas,
                                     pallas_interpret))
+
+
+def _solve_compact(batch: WindowBatch, idx: np.ndarray, table, p: KernelParams,
+                   compact_size: int):
+    """Chunked masked solve over batch rows ``idx``: pad each chunk to
+    ``compact_size`` (one static shape per tier), solve, yield the chunk's
+    row indices and its outputs trimmed to the live rows."""
+    for c0 in range(0, len(idx), compact_size):
+        sub = idx[c0 : c0 + compact_size]
+        n = len(sub)
+        sseqs = np.full((compact_size,) + batch.seqs.shape[1:], 4, dtype=np.int8)
+        slens = np.zeros((compact_size, batch.lens.shape[1]), dtype=np.int32)
+        snsegs = np.zeros(compact_size, dtype=np.int32)
+        sseqs[:n] = batch.seqs[sub]
+        slens[:n] = batch.lens[sub]
+        snsegs[:n] = batch.nsegs[sub]
+        out = solve_window_batch(jnp.asarray(sseqs), jnp.asarray(slens),
+                                 jnp.asarray(snsegs), table, p)
+        yield sub, {k: np.asarray(v)[:n] for k, v in out.items()}
 
 
 def solve_tiered(batch: WindowBatch, ladder: TierLadder,
@@ -314,29 +389,37 @@ def solve_tiered(batch: WindowBatch, ladder: TierLadder,
             err[o_solved] = np.asarray(out["err"])[o_solved]
             solved[o_solved] = True
             tier_of[o_solved] = 0
+        if ladder.wide_p0 is not None:
+            # overflow rescue, host-routed: same semantics as ladder_core's
+            # wide block — capped windows re-solve at the rescue set size and
+            # the wide result replaces the capped one wherever it solves
+            wp = ladder.wide_p0
+            widx = np.nonzero(m_ovf & (batch.nsegs >= p0.min_depth))[0]
+            for sub, out_w in _solve_compact(batch, widx, ladder.tables[wp.k],
+                                             wp, compact_size):
+                w_solved = out_w["solved"]
+                take = sub[w_solved]
+                if len(take):
+                    cons[take] = out_w["cons"][w_solved]
+                    cons_len[take] = out_w["cons_len"][w_solved]
+                    err[take] = out_w["err"][w_solved]
+                    solved[take] = True
+                    tier_of[take] = 0
+                m_ovf[sub[w_solved & ~out_w["m_overflow"]]] = False
 
     for ti, p in enumerate(ladder.params[1:], start=1):
         idx = np.nonzero(~solved & (batch.nsegs >= p.min_depth))[0]
         if len(idx) == 0:
             break
-        for c0 in range(0, len(idx), compact_size):
-            sub = idx[c0 : c0 + compact_size]
-            n = len(sub)
-            sseqs = np.full((compact_size,) + batch.seqs.shape[1:], 4, dtype=np.int8)
-            slens = np.zeros((compact_size, batch.lens.shape[1]), dtype=np.int32)
-            snsegs = np.zeros(compact_size, dtype=np.int32)
-            sseqs[:n] = batch.seqs[sub]
-            slens[:n] = batch.lens[sub]
-            snsegs[:n] = batch.nsegs[sub]
-            out = solve_window_batch(jnp.asarray(sseqs), jnp.asarray(slens),
-                                     jnp.asarray(snsegs), ladder.tables[p.k], p)
-            m_ovf[sub] |= np.asarray(out["m_overflow"])[:n]
-            s_solved = np.asarray(out["solved"])[:n]
+        for sub, out in _solve_compact(batch, idx, ladder.tables[p.k], p,
+                                       compact_size):
+            m_ovf[sub] |= out["m_overflow"]
+            s_solved = out["solved"]
             take = sub[s_solved]
             if len(take):
-                cons[take] = np.asarray(out["cons"])[:n][s_solved]
-                cons_len[take] = np.asarray(out["cons_len"])[:n][s_solved]
-                err[take] = np.asarray(out["err"])[:n][s_solved]
+                cons[take] = out["cons"][s_solved]
+                cons_len[take] = out["cons_len"][s_solved]
+                err[take] = out["err"][s_solved]
                 solved[take] = True
                 tier_of[take] = ti
     return dict(cons=cons, cons_len=cons_len, err=err, solved=solved, tier=tier_of,

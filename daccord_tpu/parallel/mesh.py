@@ -42,9 +42,9 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
 
 @functools.partial(jax.jit,
                    static_argnames=("params", "esc_cap", "mesh", "use_pallas",
-                                    "pallas_interpret"))
+                                    "pallas_interpret", "wide_p0"))
 def _ladder_sharded(seqs, lens, nsegs, tables, params, esc_cap, mesh,
-                    use_pallas=False, pallas_interpret=False):
+                    use_pallas=False, pallas_interpret=False, wide_p0=None):
     # pallas_call's out_shape carries no varying-axes info, so the vma check
     # must be off when the ladder routes its DP through the Pallas kernel
     # (the pre-0.8 fallback spells the same knob check_rep)
@@ -57,7 +57,7 @@ def _ladder_sharded(seqs, lens, nsegs, tables, params, esc_cap, mesh,
 
     def local(seqs, lens, nsegs, tables):
         out = ladder_core(seqs, lens, nsegs, tables, params, esc_cap,
-                          use_pallas, pallas_interpret)
+                          use_pallas, pallas_interpret, wide_p0)
         out["esc_overflow"] = jax.lax.psum(out["esc_overflow"], "d")
         return out
 
@@ -72,9 +72,10 @@ def _ladder_sharded(seqs, lens, nsegs, tables, params, esc_cap, mesh,
 
 @functools.partial(jax.jit,
                    static_argnames=("params", "esc_cap", "mesh", "use_pallas",
-                                    "pallas_interpret"))
+                                    "pallas_interpret", "wide_p0"))
 def _ladder_sharded_packed(seqs, lens, nsegs, tables, params, esc_cap, mesh,
-                           use_pallas=False, pallas_interpret=False):
+                           use_pallas=False, pallas_interpret=False,
+                           wide_p0=None):
     from ..kernels.tiers import pack_result
 
     # pack OUTSIDE shard_map, inside the same jit (nested jit inlines): the
@@ -82,7 +83,7 @@ def _ladder_sharded_packed(seqs, lens, nsegs, tables, params, esc_cap, mesh,
     # them local to each device and the result crosses as ONE array
     return pack_result(_ladder_sharded(
         seqs, lens, nsegs, tables, params, esc_cap, mesh, use_pallas,
-        pallas_interpret))
+        pallas_interpret, wide_p0))
 
 
 class ShardedLadderSolver:
@@ -98,6 +99,7 @@ class ShardedLadderSolver:
         self.sharding = NamedSharding(mesh, P("d"))
         self.tables = tuple(ladder.tables[p.k] for p in ladder.params)
         self.params = tuple(ladder.params)
+        self.wide_p0 = ladder.wide_p0
         self.esc_cap = esc_cap   # None = full per-device slice (no overflow)
         self.use_pallas = use_pallas
         self.pallas_interpret = pallas_interpret
@@ -116,7 +118,7 @@ class ShardedLadderSolver:
             jax.device_put(jnp.asarray(batch.nsegs), self.sharding),
             self.tables, params=self.params, esc_cap=esc_cap,
             mesh=self.mesh, use_pallas=self.use_pallas,
-            pallas_interpret=self.pallas_interpret)
+            pallas_interpret=self.pallas_interpret, wide_p0=self.wide_p0)
         return (_PackedHandle(arr, self.cl), B0)
 
     @staticmethod
@@ -156,7 +158,8 @@ def build_sharded_solver(n_devices: int, profile, consensus_cfg,
                          use_pallas: bool = False,
                          offset_counts=None,
                          max_kmers: int = 64,
-                         rescue_max_kmers: int = 256) -> ShardedLadderSolver:
+                         rescue_max_kmers: int = 256,
+                         overflow_rescue: bool = False) -> ShardedLadderSolver:
     """Device-count-checked mesh solver from an error profile (plus the
     estimation pass's empirical OL counts, when collected — the mesh path
     must blend the same tables as the single-device path).
@@ -174,7 +177,8 @@ def build_sharded_solver(n_devices: int, profile, consensus_cfg,
     ladder = TierLadder.from_config(profile, consensus_cfg,
                                     max_kmers=max_kmers,
                                     rescue_max_kmers=rescue_max_kmers,
-                                    offset_counts=offset_counts)
+                                    offset_counts=offset_counts,
+                                    overflow_rescue=overflow_rescue)
     interpret = use_pallas and pallas_needs_interpret()
     return make_sharded_solver(ladder, make_mesh(n_devices), esc_cap,
                                use_pallas=use_pallas, pallas_interpret=interpret)

@@ -52,7 +52,17 @@ class PipelineConfig:
     rescue_max_kmers: int = 256  # active-set size of the min_count<=1
                                  # rescue tiers (they keep every k-mer, so
                                  # they need the headroom)
+    overflow_rescue: bool = False  # re-solve top-M-capped windows at
+                                 # rescue_max_kmers (reference full-graph
+                                 # semantics for exactly the windows where
+                                 # truncation binds; measured in the
+                                 # BASELINE.md top-M table before choosing
+                                 # the default)
     profile_sample_piles: int = 4
+    profile_sample_offset: int = 0   # pile-index shift of the strided profile
+                                 # sample; distinct offsets draw disjoint
+                                 # samples (estimator-variance probe,
+                                 # tools/profilevar.py)
     use_native: bool = True      # C++ host path when available
     depth_rank: bool = True      # best-alignments-first before depth capping
     qv_track: str | None = "inqual"  # intrinsic-QV track consumed by the
@@ -290,7 +300,7 @@ def load_qv_ranker(db: DazzDB, las: LasFile, cfg: PipelineConfig) -> QvRanker | 
 
 
 def _strided_pile_ranges(las: LasFile, n: int, start: int | None,
-                         end: int | None) -> list[tuple[int, int]]:
+                         end: int | None, offset: int = 0) -> list[tuple[int, int]]:
     """Byte ranges of ``n`` piles spread evenly across the shard (via the
     aread index sidecar). The reference samples across the input; round 1
     took the FIRST n piles — a start-of-file bias (VERDICT r1 weak #5)."""
@@ -305,8 +315,9 @@ def _strided_pile_ranges(las: LasFile, n: int, start: int | None,
     sel = np.nonzero((idx[:, 1] >= lo) & (idx[:, 1] < hi))[0]
     if len(sel) == 0:
         return [(lo, hi)]
-    take = np.unique(np.linspace(0, len(sel) - 1,
-                                 min(n, len(sel))).astype(int))
+    take = np.unique((np.linspace(0, len(sel) - 1,
+                                  min(n, len(sel))).astype(int)
+                      + offset) % len(sel))
     out = []
     for t in take:
         j = int(sel[t])
@@ -327,7 +338,8 @@ def estimate_profile_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
     refined_all = []
     windows_all: list[WindowSegments] = []
-    for s, e in _strided_pile_ranges(las, cfg.profile_sample_piles, start, end):
+    for s, e in _strided_pile_ranges(las, cfg.profile_sample_piles, start, end,
+                                     offset=cfg.profile_sample_offset):
         for aread, pile in las.iter_piles(s, e):
             a_bases = db.read_bases(aread)
             refined = [refine_overlap(o, a_bases, db.read_bases(o.bread), las.tspace)
@@ -472,7 +484,8 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     ladder = TierLadder.from_config(profile, cfg.consensus,
                                     max_kmers=cfg.max_kmers,
                                     rescue_max_kmers=cfg.rescue_max_kmers,
-                                    offset_counts=offset_counts)
+                                    offset_counts=offset_counts,
+                                    overflow_rescue=cfg.overflow_rescue)
     from ..utils.obs import JsonlLogger
 
     log = JsonlLogger(cfg.log_path)

@@ -50,6 +50,26 @@ class SimConfig:
                                       # copy, the failure mode the paper's
                                       # local-consistency filtering targets
     seed: int = 0
+    # --- model-mismatch stress knobs (all default OFF; BASELINE.md round-3
+    # mismatch table). The base model above is the iid ins/del/sub family the
+    # error-profile estimator and OffsetLikely assume; these knobs generate
+    # error processes the estimator does NOT model, as the sealed-environment
+    # substitute for real sequencer data. All extra errors flow through the
+    # same err/dels bookkeeping, so trace-point diffs stay truthful.
+    hp_indel_slope: float = 0.0   # indel prob scaled by 1+slope*(runlen-1) in
+                                  # homopolymer runs; insertions duplicate the
+                                  # run base instead of being uniform random
+    hp_run_cap: int = 8           # runlen-1 capped here (prob clip at 0.45)
+    burst_rate: float = 0.0       # expected error bursts per base (e.g. 2e-4)
+    burst_len_mean: float = 30.0  # geometric mean burst length (bases)
+    burst_mult: float = 6.0       # ins/del/sub multiplier inside a burst
+    read_rate_sigma: float = 0.0  # lognormal sigma of a per-read error-rate
+                                  # multiplier (mean 1): rate dispersion
+    p_chimera: float = 0.0        # per-read prob of a foreign insert replacing
+                                  # an interior span (bridged chimera junction)
+    chimera_frac: float = 0.2     # replaced span, as a fraction of read length
+    dropout_frac: float = 0.0     # genome fraction with thinned coverage
+    dropout_factor: float = 4.0   # coverage divisor inside the dropout region
 
     @classmethod
     def pacbio_clr(cls, **kw) -> "SimConfig":
@@ -70,6 +90,26 @@ class SimConfig:
         kw.setdefault("coverage", 30.0)
         kw.setdefault("min_overlap", 2_000)
         return cls(**kw)
+
+    @classmethod
+    def pacbio_mismatch(cls, **kw) -> "SimConfig":
+        """PacBio CLR shape with every mismatch process switched on — the
+        'everything the estimator does not model at once' stress preset."""
+        kw.setdefault("hp_indel_slope", 0.5)
+        kw.setdefault("burst_rate", 2e-4)
+        kw.setdefault("read_rate_sigma", 0.4)
+        kw.setdefault("p_chimera", 0.03)
+        kw.setdefault("dropout_frac", 0.15)
+        return cls(**kw)
+
+    @classmethod
+    def ont_r10_mismatch(cls, **kw) -> "SimConfig":
+        """ONT R10 shape + homopolymer-dominated indels and rate dispersion —
+        the characteristic ONT failure modes."""
+        kw.setdefault("hp_indel_slope", 1.0)
+        kw.setdefault("read_rate_sigma", 0.5)
+        kw.setdefault("burst_rate", 1e-4)
+        return cls.ont_r10(**kw)
 
 
 @dataclass
@@ -101,18 +141,54 @@ class SimResult:
 
 
 def _sample_noisy(genome: np.ndarray, start: int, end: int, cfg: SimConfig,
-                  rng: np.random.Generator) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+                  rng: np.random.Generator, rmult: float = 1.0
+                  ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Apply sub/ins/del noise to genome[start:end] (forward orientation).
 
     Returns (read_fwd, g_of_r_fwd, err_fwd, dels) where g_of_r is monotone
-    non-decreasing over genome positions start..end-1.
+    non-decreasing over genome positions start..end-1. ``rmult`` is the
+    per-read rate multiplier (rate dispersion); the mismatch knobs
+    (homopolymer slope, bursts) modulate the per-position probabilities.
+    The knobs-off scalar path is kept verbatim so existing seeds reproduce
+    their datasets bit-for-bit (cached fixtures, parity tests).
     """
     seg = genome[start:end]
     n = len(seg)
-    u = rng.random(n)
-    is_del = u < cfg.p_del
-    is_sub = (~is_del) & (u < cfg.p_del + cfg.p_sub)
-    n_ins = rng.geometric(1.0 - cfg.p_ins, size=n) - 1  # insertions after each base
+    mismatch = (cfg.hp_indel_slope > 0 or cfg.burst_rate > 0 or rmult != 1.0)
+    in_run = None
+    if not mismatch:
+        u = rng.random(n)
+        is_del = u < cfg.p_del
+        is_sub = (~is_del) & (u < cfg.p_del + cfg.p_sub)
+        n_ins = rng.geometric(1.0 - cfg.p_ins, size=n) - 1  # insertions after each base
+    else:
+        m = np.full(n, float(rmult))
+        if cfg.burst_rate > 0 and n:
+            # error bursts: Poisson-placed starts, geometric lengths, all
+            # three channels multiplied inside — the polymerase-stall /
+            # signal-dropout process the iid estimator does not model
+            nb = int(rng.poisson(cfg.burst_rate * n))
+            if nb:
+                bs = rng.integers(0, n, size=nb)
+                bl = rng.geometric(1.0 / max(cfg.burst_len_mean, 1.0), size=nb)
+                for s, ln_ in zip(bs, bl):
+                    m[s:s + ln_] *= cfg.burst_mult
+        hp = np.ones(n)
+        if cfg.hp_indel_slope > 0 and n:
+            change = np.nonzero(np.diff(seg))[0] + 1
+            bounds = np.concatenate([[0], change, [n]])
+            rl = np.diff(bounds)
+            runlen = np.repeat(rl, rl)
+            hp = 1.0 + cfg.hp_indel_slope * np.minimum(runlen - 1,
+                                                       cfg.hp_run_cap)
+            in_run = runlen > 1
+        pd = np.clip(cfg.p_del * m * hp, 0.0, 0.45)
+        ps = np.clip(cfg.p_sub * m, 0.0, 0.45)
+        pi = np.clip(cfg.p_ins * m * hp, 0.0, 0.45)
+        u = rng.random(n)
+        is_del = u < pd
+        is_sub = (~is_del) & (u < pd + ps)
+        n_ins = rng.geometric(1.0 - pi) - 1 if n else np.zeros(0, np.int64)
 
     out: list[np.ndarray] = []
     gpos: list[np.ndarray] = []
@@ -129,7 +205,13 @@ def _sample_noisy(genome: np.ndarray, start: int, end: int, cfg: SimConfig,
             errm.append(np.array([1 if is_sub[i] else 0], dtype=np.int8))
         k = int(n_ins[i])
         if k:
-            out.append(rng.integers(0, 4, size=k, dtype=np.int8))
+            if in_run is not None and in_run[i]:
+                # homopolymer expansion: inserted bases duplicate the run
+                # base (the characteristic ONT indel), still errors vs truth
+                ins = np.full(k, seg[i], dtype=np.int8)
+            else:
+                ins = rng.integers(0, 4, size=k, dtype=np.int8)
+            out.append(ins)
             gpos.append(np.full(k, start + i, dtype=np.int64))
             errm.append(np.ones(k, dtype=np.int8))
     if out:
@@ -142,6 +224,35 @@ def _sample_noisy(genome: np.ndarray, start: int, end: int, cfg: SimConfig,
         err = np.zeros(0, dtype=np.int8)
     dels = (start + np.nonzero(is_del)[0]).astype(np.int64)
     return read, g_of_r, err, dels
+
+
+def _chimerize(fwd: np.ndarray, g_of_r: np.ndarray, err: np.ndarray,
+               dels: np.ndarray, cfg: SimConfig, rng: np.random.Generator
+               ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Replace an interior span of a (forward-orientation) read with foreign
+    sequence — a bridged chimera junction. The replaced genome positions
+    become deletions and the foreign bases insertion-like errors pinned at
+    the junction, so per-tile trace diffs remain truthful: an overlap tile
+    crossing the junction really carries that much divergence."""
+    n = len(fwd)
+    lf = max(50, int(n * cfg.chimera_frac))
+    lf = min(lf, n - n // 4 - 2)
+    if lf <= 0:
+        return fwd, g_of_r, err, dels
+    j = int(rng.integers(n // 4, n - lf - 1))
+    g_prev = int(g_of_r[j - 1]) if j else int(g_of_r[0])
+    g_next = int(g_of_r[j + lf])
+    span = np.arange(g_prev + 1, g_next, dtype=np.int64)
+    if len(span):
+        span = span[~np.isin(span, dels)]
+        dels = np.sort(np.concatenate([dels, span]))
+    fwd = fwd.copy()
+    fwd[j:j + lf] = rng.integers(0, 4, size=lf, dtype=np.int8)
+    g_of_r = g_of_r.copy()
+    g_of_r[j:j + lf] = g_prev
+    err = err.copy()
+    err[j:j + lf] = 1
+    return fwd, g_of_r, err, dels
 
 
 def _make_genome(cfg: SimConfig, rng: np.random.Generator) -> tuple[np.ndarray, tuple | None]:
@@ -268,14 +379,35 @@ def simulate(cfg: SimConfig) -> SimResult:
     nbases_target = cfg.genome_len * cfg.coverage
     reads: list[SimRead] = []
     total = 0
+    drop = None
+    if cfg.dropout_frac > 0:
+        dlen = int(cfg.genome_len * cfg.dropout_frac)
+        if dlen:
+            d0 = int(rng.integers(0, cfg.genome_len - dlen + 1))
+            drop = (d0, d0 + dlen)
     while total < nbases_target:
         ln = int(rng.lognormal(np.log(cfg.read_len_mean), cfg.read_len_sigma))
         ln = max(300, min(ln, cfg.genome_len))
         start = int(rng.integers(0, cfg.genome_len - ln + 1))
+        if drop is not None:
+            # thin reads proportionally to their overlap with the dropout
+            # region: coverage inside tends to depth/dropout_factor
+            ov = min(start + ln, drop[1]) - max(start, drop[0])
+            if ov > 0 and rng.random() < (ov / ln) * (1.0 - 1.0 / cfg.dropout_factor):
+                continue
         strand = int(rng.integers(0, 2))
-        fwd, g_of_r, err, dels = _sample_noisy(genome, start, start + ln, cfg, rng)
+        rmult = 1.0
+        if cfg.read_rate_sigma > 0:
+            # mean-1 lognormal: a fat right tail of junk reads, the per-read
+            # dispersion real instruments show
+            s = cfg.read_rate_sigma
+            rmult = float(rng.lognormal(-0.5 * s * s, s))
+        fwd, g_of_r, err, dels = _sample_noisy(genome, start, start + ln, cfg,
+                                               rng, rmult)
         if len(fwd) < 100:
             continue
+        if cfg.p_chimera > 0 and len(fwd) > 600 and rng.random() < cfg.p_chimera:
+            fwd, g_of_r, err, dels = _chimerize(fwd, g_of_r, err, dels, cfg, rng)
         if strand == 1:
             seq = revcomp_ints(fwd)
             g_of_r = g_of_r[::-1].copy()

@@ -57,6 +57,11 @@ def daccord_main(argv=None) -> int:
                         "window); the cap binds on most windows at >24x depth "
                         "(topm_overflow stat) — raising it trades quadratic "
                         "path-DP cost for graph fidelity")
+    p.add_argument("--overflow-rescue", action="store_true",
+                   help="re-solve windows whose top-M cap bound at the rescue "
+                        "active-set size (reference full-graph semantics for "
+                        "exactly the truncated windows; costs one extra wide "
+                        "sub-batch when any window overflows)")
     p.add_argument("--mode", choices=("split", "patch"), default="split",
                    help="unsolved windows split the read or get patched with raw bases")
     p.add_argument("-E", "--eprof", default=None, metavar="PATH",
@@ -153,7 +158,8 @@ def daccord_main(argv=None) -> int:
                          feeder_threads=args.threads, use_pallas=args.pallas,
                          end_trim=not args.no_end_trim,
                          qv_track=args.qv_track or None,
-                         empirical_ol=not args.no_empirical_ol)
+                         empirical_ol=not args.no_empirical_ol,
+                         overflow_rescue=args.overflow_rescue)
 
     import os
 
@@ -208,7 +214,8 @@ def daccord_main(argv=None) -> int:
                                       use_pallas=args.pallas,
                                       offset_counts=ol_counts,
                                       max_kmers=cfg.max_kmers,
-                                      rescue_max_kmers=cfg.rescue_max_kmers)
+                                      rescue_max_kmers=cfg.rescue_max_kmers,
+                                      overflow_rescue=cfg.overflow_rescue)
 
     if args.profile:
         import jax

@@ -213,3 +213,52 @@ def test_packed_result_roundtrip_unit():
     np.testing.assert_array_equal(back["m_ovf"], m_ovf)
     np.testing.assert_array_equal(back["solved"], tier >= 0)
     assert back["esc_overflow"] == 12345
+
+
+def test_overflow_rescue_parity(fixture):
+    """Overflow rescue: device ladder == host-routed ladder bitwise, the
+    rescue clears most top-M flags, and every still-flagged window is the
+    only allowed oracle-divergence source (full-graph semantics restored)."""
+    import jax.numpy as jnp
+
+    from daccord_tpu.kernels.tiers import _ladder_jit, fetch
+
+    ccfg, windows, prof, ols, batch, shape = fixture
+    # tiny tier-0 cap so the cap binds on many windows and the rescue fires
+    lad = TierLadder.from_config(prof, ccfg, max_kmers=24,
+                                 rescue_max_kmers=256, overflow_rescue=True)
+    assert lad.wide_p0 is not None and lad.wide_p0.max_kmers == 256
+    tables = tuple(lad.tables[p.k] for p in lad.params)
+    dev = fetch(_ladder_jit(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
+                            jnp.asarray(batch.nsegs), tables,
+                            tuple(lad.params), batch.size, False, False,
+                            lad.wide_p0))
+    host = solve_tiered(batch, lad, compact_size=32)
+    for key in ("solved", "cons_len", "cons", "tier", "m_ovf"):
+        np.testing.assert_array_equal(np.asarray(dev[key]), host[key], key)
+
+    # vs the same cap without rescue: flags shrink, solve rate never drops
+    base = solve_tiered(batch,
+                        TierLadder.from_config(prof, ccfg, max_kmers=24),
+                        compact_size=32)
+    assert base["m_ovf"].sum() > 0, "cap must bind for this test to bite"
+    assert host["m_ovf"].sum() < base["m_ovf"].sum()
+    assert host["solved"].sum() >= base["solved"].sum()
+
+    # rescued windows carry full-graph results: oracle agreement with the
+    # M=256 flag as the only tolerated divergence, tier-0 windows only
+    # (escalated windows solve at different k than the oracle's)
+    p = DBGParams(k=8, min_count=2, edge_min_count=2)
+    bad = []
+    for i, ws in enumerate(windows):
+        if host["tier"][i] != 0:
+            continue
+        segs = [np.asarray(s[: shape.seg_len], dtype=np.int8)
+                for s in ws.segments[: shape.depth]]
+        r = window_consensus(segs, ols[8], p, wlen=40)
+        ks = host["cons"][i][: host["cons_len"][i]] if host["solved"][i] else None
+        ok = (r.seq is None) == (ks is None) and (
+            r.seq is None or np.array_equal(r.seq, ks))
+        if not ok and not host["m_ovf"][i]:
+            bad.append(i)
+    assert not bad, bad[:10]

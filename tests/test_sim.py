@@ -104,3 +104,96 @@ def test_repeat_divergence():
     # diverged copies add ~3% pair error on cross-copy alignments (a little
     # less in practice: clamping and error-site collisions absorb some)
     assert r_div > r0 + 0.015, (r_div, r0)
+
+
+def test_mismatch_knobs_off_stream_stable():
+    """Knobs-off runs must reproduce the legacy rng stream exactly: cached
+    fixtures and parity thresholds were generated with it."""
+    a = simulate(CFG)
+    b = simulate(SimConfig(**{**CFG.__dict__}))
+    assert len(a.reads) == len(b.reads)
+    for ra, rb in zip(a.reads, b.reads):
+        np.testing.assert_array_equal(ra.seq, rb.seq)
+    assert len(a.overlaps) == len(b.overlaps)
+
+
+def test_homopolymer_indel_concentration():
+    """With hp_indel_slope on, indels concentrate in homopolymer runs."""
+    from daccord_tpu.sim.synth import _sample_noisy
+
+    rng = np.random.default_rng(7)
+    # genome rich in homopolymer runs
+    g = np.repeat(rng.integers(0, 4, size=1500, dtype=np.int8),
+                  rng.integers(1, 7, size=1500))
+
+    change = np.nonzero(np.diff(g))[0] + 1
+    bounds = np.concatenate([[0], change, [len(g)]])
+    runlen = np.repeat(np.diff(bounds), np.diff(bounds))
+    long_run = np.nonzero(runlen >= 4)[0]
+    single = np.nonzero(runlen == 1)[0]
+
+    def rate_ratio(cfg):
+        r = np.random.default_rng(3)
+        _, _, _, dels = _sample_noisy(g, 0, len(g), cfg, r,
+                                      rmult=1.0 + 1e-12)  # force mismatch path
+        r_long = np.isin(dels, long_run).sum() / len(long_run)
+        r_single = max(np.isin(dels, single).sum() / len(single), 1e-9)
+        return r_long / r_single
+
+    assert rate_ratio(SimConfig(genome_len=100)) < 2.0
+    assert rate_ratio(SimConfig(genome_len=100, hp_indel_slope=2.0)) > 3.0
+
+
+def test_read_rate_dispersion():
+    """read_rate_sigma spreads per-read error rates (fat right tail)."""
+    cfg0 = SimConfig(genome_len=4000, coverage=15, read_len_mean=900, seed=9)
+    cfgd = SimConfig(**{**cfg0.__dict__, "read_rate_sigma": 0.6})
+
+    def per_read_rates(res):
+        return np.array([r.err.sum() / max(len(r.seq), 1) for r in res.reads])
+
+    r0 = per_read_rates(simulate(cfg0))
+    rd = per_read_rates(simulate(cfgd))
+    assert rd.std() > 2.0 * r0.std(), (r0.std(), rd.std())
+
+
+def test_chimera_trace_accounting():
+    """Chimeric reads keep the sim's core invariants: trace b-spans sum to
+    the B interval and tile diffs reflect the foreign span's divergence."""
+    cfg = SimConfig(genome_len=4000, coverage=18, read_len_mean=1200,
+                    p_chimera=1.0, chimera_frac=0.25, seed=21)
+    res = simulate(cfg)
+    assert len(res.overlaps) > 20
+    for o in res.overlaps[:80]:
+        assert o.trace[:, 1].sum() == o.bepos - o.bbpos
+        assert o.trace.shape[0] == o.ntiles(cfg.tspace)
+    # every read long enough got a foreign insert: err runs of >= 50
+    n_chim = 0
+    for r in res.reads:
+        if len(r.seq) > 600:
+            d = np.diff(np.concatenate([[0], r.err.astype(np.int32), [0]]))
+            runs = np.nonzero(d == -1)[0] - np.nonzero(d == 1)[0]
+            if len(runs) and runs.max() >= 50:
+                n_chim += 1
+    assert n_chim >= max(1, sum(len(r.seq) > 600 for r in res.reads) // 2)
+
+
+def test_coverage_dropout():
+    """dropout_frac thins coverage inside the dropout region."""
+    from daccord_tpu.sim.synth import _make_genome  # noqa: F401  (doc import)
+
+    cfg = SimConfig(genome_len=20_000, coverage=20, read_len_mean=1500,
+                    dropout_frac=0.2, dropout_factor=5.0, seed=33)
+    res = simulate(cfg)
+    # recover the dropout interval the same way simulate() drew it
+    rng = np.random.default_rng(cfg.seed)
+    _make_genome(cfg, rng)
+    dlen = int(cfg.genome_len * cfg.dropout_frac)
+    d0 = int(rng.integers(0, cfg.genome_len - dlen + 1))
+    cov = np.zeros(cfg.genome_len)
+    for r in res.reads:
+        cov[r.start:r.end] += 1
+    inside = cov[d0 + 200 : d0 + dlen - 200].mean()
+    outside = np.concatenate([cov[: max(d0 - 200, 0)],
+                              cov[d0 + dlen + 200 :]]).mean()
+    assert inside < 0.55 * outside, (inside, outside)
