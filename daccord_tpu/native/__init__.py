@@ -82,6 +82,13 @@ def load():
         lib.decode_reads.restype = c.c_int
         lib.decode_reads.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
                                      c.c_int32, c.c_void_p, c.c_void_p]
+        lib.solve_windows.restype = c.c_int
+        lib.solve_windows.argtypes = (
+            [c.c_void_p] * 3 + [c.c_int32] * 3     # seqs/lens/nsegs, B D L
+            + [c.c_void_p] * 7 + [c.c_int32]       # tables, off, tier arrays, n_tiers
+            + [c.c_int32] * 6                      # wlen..min_depth
+            + [c.c_float] * 2 + [c.c_int32]        # max_err, count_frac, n_threads
+            + [c.c_void_p] * 4)                    # cons, lens, errs, tiers
         lib.process_pile.restype = c.c_int
         lib.process_pile.argtypes = (
             [c.c_void_p, c.c_int32, c.c_int32]        # a, alen, novl

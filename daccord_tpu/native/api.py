@@ -177,3 +177,57 @@ def las_merge_native(in_paths: list[str], out_path: str, tspace: int) -> int:
     if n < 0:
         raise IOError(f"las_merge failed: {n}")
     return int(n)
+
+
+def solve_windows_native(batch, ol_tables: dict, cfg, n_threads: int = 1) -> dict:
+    """Native tier-ladder consensus over a WindowBatch (full-graph oracle
+    semantics — no top-M cap; the C++ replica of ``oracle.consensus.
+    solve_window`` over every window). Returns the ``solve_tiered``-shaped
+    dict (m_ovf all-False: nothing is ever truncated here).
+
+    ``ol_tables``: k -> OffsetLikely (oracle ``make_offset_likely`` output).
+    ``cfg``: ConsensusConfig (tiers + dbg params + w).
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    import ctypes
+
+    d = cfg.dbg
+    tiers = list(cfg.tiers)
+    tabs = []
+    offs = [0]
+    for k, _, _ in tiers:
+        t = np.ascontiguousarray(ol_tables[k].table, dtype=np.float32)
+        tabs.append(t.reshape(-1))
+        offs.append(offs[-1] + t.size)
+    tables = np.concatenate(tabs)
+    table_off = np.asarray(offs[:-1], dtype=np.int64)
+    tier_k = np.asarray([t[0] for t in tiers], dtype=np.int32)
+    tier_minc = np.asarray([t[1] for t in tiers], dtype=np.int32)
+    tier_eminc = np.asarray([t[2] for t in tiers], dtype=np.int32)
+    tier_P = np.asarray([ol_tables[t[0]].P for t in tiers], dtype=np.int32)
+    tier_O = np.asarray([ol_tables[t[0]].O for t in tiers], dtype=np.int32)
+
+    seqs = np.ascontiguousarray(batch.seqs, dtype=np.int8)
+    lens = np.ascontiguousarray(batch.lens, dtype=np.int32)
+    nsegs = np.ascontiguousarray(batch.nsegs, dtype=np.int32)
+    B, D, L = seqs.shape
+    CL = cfg.w + d.len_slack
+    cons = np.empty((B, CL), dtype=np.int8)
+    cons_len = np.empty(B, dtype=np.int32)
+    errs = np.empty(B, dtype=np.float32)
+    tiers_out = np.empty(B, dtype=np.int32)
+    rc = lib.solve_windows(
+        _ptr(seqs), _ptr(lens), _ptr(nsegs), B, D, L,
+        _ptr(tables), _ptr(table_off), _ptr(tier_k), _ptr(tier_minc),
+        _ptr(tier_eminc), _ptr(tier_P), _ptr(tier_O), len(tiers),
+        cfg.w, d.anchor_slack, d.end_slack, d.len_slack, d.n_candidates,
+        d.min_depth, ctypes.c_float(d.max_err), ctypes.c_float(d.count_frac),
+        int(n_threads),
+        _ptr(cons), _ptr(cons_len), _ptr(errs), _ptr(tiers_out))
+    if rc != 0:
+        raise RuntimeError(f"solve_windows failed: {rc}")
+    return dict(cons=cons, cons_len=cons_len, err=errs,
+                solved=tiers_out >= 0, tier=tiers_out,
+                m_ovf=np.zeros(B, dtype=bool))

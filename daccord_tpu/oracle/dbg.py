@@ -161,7 +161,10 @@ def window_consensus(segments: list[np.ndarray], ol: OffsetLikely,
     end_scores = score[t_lo : t_hi + 1].copy()
     end_scores[:, ~snk_ok] = NEG
     flat = end_scores.reshape(-1)
-    order = np.argsort(-flat)
+    # stable: ties resolve to the lowest flat index — a DEFINED order that
+    # the native C++ engine (dazz_native.cpp solve_windows) replicates; the
+    # default introsort's tie order is implementation-specific
+    order = np.argsort(-flat, kind="stable")
 
     # ---- 5. candidates + rescore ---------------------------------------
     best_err = np.inf

@@ -64,6 +64,14 @@ class PipelineConfig:
                                  # samples (estimator-variance probe,
                                  # tools/profilevar.py)
     use_native: bool = True      # C++ host path when available
+    native_solver: bool = False  # solve windows with the native C++
+                                 # full-graph tier ladder (dazz_native.cpp
+                                 # solve_windows) instead of a device/JAX
+                                 # ladder: oracle semantics (no top-M cap),
+                                 # measured 4-7x the JAX-CPU fallback per
+                                 # core — the degraded-mode engine and the
+                                 # reference-class CPU baseline in one
+                                 # (tools/consensusbench.py)
     depth_rank: bool = True      # best-alignments-first before depth capping
     qv_track: str | None = "inqual"  # intrinsic-QV track consumed by the
                                  # consensus run (reference: daccord loads the
@@ -481,15 +489,33 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             profile = estimate_profile_for_shard(db, las, cfg, start, end)
     if not cfg.empirical_ol:
         offset_counts = None
-    ladder = TierLadder.from_config(profile, cfg.consensus,
-                                    max_kmers=cfg.max_kmers,
-                                    rescue_max_kmers=cfg.rescue_max_kmers,
-                                    offset_counts=offset_counts,
-                                    overflow_rescue=cfg.overflow_rescue)
+    ladder = None
+    if not (solver is None and cfg.native_solver):
+        # the native C++ solver builds its own OffsetLikely tables from the
+        # same make_offset_likely call — constructing the (unused) device
+        # ladder too would do that work twice
+        ladder = TierLadder.from_config(profile, cfg.consensus,
+                                        max_kmers=cfg.max_kmers,
+                                        rescue_max_kmers=cfg.rescue_max_kmers,
+                                        offset_counts=offset_counts,
+                                        overflow_rescue=cfg.overflow_rescue)
     from ..utils.obs import JsonlLogger
 
     log = JsonlLogger(cfg.log_path)
     fetch_many_fn = None
+    if solver is None and cfg.native_solver:
+        from ..native import available as _nat_avail
+        from ..native.api import solve_windows_native
+        from ..oracle.consensus import make_offset_likely
+
+        if not _nat_avail():
+            raise SystemExit("--backend native: native library unavailable "
+                             "(g++ build failed?)")
+        ols = make_offset_likely(profile, cfg.consensus,
+                                 offset_counts=offset_counts)
+        nt = max(cfg.feeder_threads, 1)
+        solver = lambda b: solve_windows_native(b, ols, cfg.consensus,
+                                                n_threads=nt)   # noqa: E731
     if solver is not None:
         if hasattr(solver, "dispatch") and hasattr(solver, "fetch"):
             # async solver (e.g. the mesh-sharded ladder): pipeline batches

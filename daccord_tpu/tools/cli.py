@@ -100,10 +100,14 @@ def daccord_main(argv=None) -> int:
                    help="keep rescue-tier solutions at read ends (default: "
                         "trim them — thin end-of-read piles solved with the "
                         "frequency filter off carry ~10x the interior error rate)")
-    p.add_argument("--backend", choices=("auto", "cpu", "tpu"), default="auto",
+    p.add_argument("--backend", choices=("auto", "cpu", "tpu", "native"),
+                   default="auto",
                    help="device backend (SURVEY.md §5 config row); 'cpu' forces the "
                         "host platform before any backend init — the only reliable "
-                        "override under this image's axon plugin")
+                        "override under this image's axon plugin; 'native' solves "
+                        "windows with the C++ full-graph tier ladder (oracle "
+                        "semantics, no device: the fast degraded mode, 4-7x the "
+                        "JAX-CPU path per core)")
     p.add_argument("--pallas", action="store_true",
                    help="run the heaviest-path DP as the Pallas TPU kernel "
                         "(bit-identical results; TPU backend only)")
@@ -116,7 +120,9 @@ def daccord_main(argv=None) -> int:
     _add_J(p)
     args = p.parse_args(argv)
 
-    if args.backend == "cpu":
+    if args.backend in ("cpu", "native"):
+        # native solves on host C++, but incidental jax usage (estimation
+        # helpers) must still never touch a possibly-dead TPU tunnel
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -126,6 +132,9 @@ def daccord_main(argv=None) -> int:
 
     if args.block is not None and args.J is not None:
         raise SystemExit("--block and -J are mutually exclusive")
+    if args.backend == "native" and args.mesh > 1:
+        raise SystemExit("--backend native solves on host C++; it cannot be "
+                         "combined with --mesh (pick one)")
     if args.block is not None:
         from ..formats.dazzdb import db_blocks
         from ..formats.las import range_for_areads
@@ -159,7 +168,8 @@ def daccord_main(argv=None) -> int:
                          end_trim=not args.no_end_trim,
                          qv_track=args.qv_track or None,
                          empirical_ol=not args.no_empirical_ol,
-                         overflow_rescue=args.overflow_rescue)
+                         overflow_rescue=args.overflow_rescue,
+                         native_solver=args.backend == "native")
 
     import os
 

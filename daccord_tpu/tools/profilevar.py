@@ -59,9 +59,17 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--piles", default="2,4,16,48")
     ap.add_argument("--offsets", type=int, default=3,
-                    help="disjoint sample offsets probed at the DEFAULT size")
+                    help="disjoint sample offsets probed at the --probe-size")
+    ap.add_argument("--probe-size", type=int, default=None,
+                    help="sample size whose across-sample spread decides the "
+                         "verdict (default: PipelineConfig's production "
+                         "default)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.probe_size is None:
+        from daccord_tpu.runtime.pipeline import PipelineConfig
+
+        args.probe_size = PipelineConfig().profile_sample_piles
     import jax
 
     jax.config.update("jax_platforms", "cpu")   # Q is backend-independent
@@ -70,8 +78,11 @@ def main(argv=None) -> int:
     enable_compilation_cache()
     paths = _dataset("profilevar", **_SHAPE)
     rows = []
-    for sp in (int(x) for x in args.piles.split(",")):
-        n_off = args.offsets if sp == 4 else 1
+    sizes = [int(x) for x in args.piles.split(",")]
+    if args.probe_size not in sizes:
+        sizes.append(args.probe_size)
+    for sp in sizes:
+        n_off = args.offsets if sp == args.probe_size else 1
         for off in range(n_off):
             row = run_cell(paths, sp, off)
             rows.append(row)
@@ -79,12 +90,15 @@ def main(argv=None) -> int:
             if args.out:
                 with open(args.out, "at") as fh:
                     fh.write(json.dumps(row) + "\n")
-    qs = [r["q"] for r in rows if r["piles"] == 4 and r["q"] is not None]
+    qs = [r["q"] for r in rows
+          if r["piles"] == args.probe_size and r["q"] is not None]
     if len(qs) > 1:
         spread = max(qs) - min(qs)
-        print(json.dumps({"default_size_q_spread": round(spread, 3),
-                          "verdict": "4 piles sufficient" if spread <= 0.1
-                          else "raise profile_sample_piles"}), flush=True)
+        v = (f"{args.probe_size} piles sufficient" if spread <= 0.1
+             else "raise profile_sample_piles")
+        print(json.dumps({"probe_size": args.probe_size,
+                          "probe_size_q_spread": round(spread, 3),
+                          "verdict": v}), flush=True)
     return 0
 
 

@@ -129,3 +129,65 @@ def test_wide_tspace_native_pipeline_parity(tmp_path):
     assert st_n.native_host and not st_p.native_host
     assert open(fa_native).read() == open(fa_python).read()
     assert st_n.n_solved == st_p.n_solved > 0
+
+
+def test_native_consensus_oracle_parity(dataset):
+    """solve_windows (C++ full-graph tier ladder) vs the Python oracle
+    solve_window, window by window on identical truncated segments: same
+    solved set, same tier, identical consensus bases. Float accumulation
+    differs from BLAS in the last ulp, so err agrees to 1e-5 and parity is
+    asserted on the sequences (dazz_native.cpp solve_windows docstring)."""
+    from dataclasses import replace
+
+    from daccord_tpu.native.api import solve_windows_native
+    from daccord_tpu.oracle import estimate_profile_two_pass
+    from daccord_tpu.oracle.consensus import (ConsensusConfig,
+                                              make_offset_likely)
+    from daccord_tpu.oracle.dbg import DBGParams, window_consensus
+
+    (paths, d) = dataset
+    db = read_db(paths["db"])
+    las = LasFile(paths["las"])
+    ccfg = ConsensusConfig()
+    windows = []
+    for aread, pile in las.iter_piles():
+        a = db.read_bases(aread)
+        refined = [refine_overlap(o, a, db.read_bases(o.bread), las.tspace)
+                   for o in pile]
+        windows.extend(cut_windows(a, refined, w=ccfg.w, adv=ccfg.adv))
+        if len(windows) >= 160:
+            break
+    prof = estimate_profile_two_pass(
+        refined, windows[:40], ccfg, sample=12)
+    ols = make_offset_likely(prof, ccfg)
+    shape = BatchShape(depth=24, seg_len=64, wlen=ccfg.w)
+    batch = tensorize_windows([(0, ws) for ws in windows], shape)
+
+    out = solve_windows_native(batch, ols, ccfg)
+
+    n_solved = mism = 0
+    for i, ws in enumerate(windows):
+        segs = [np.asarray(s[: shape.seg_len], dtype=np.int8)
+                for s in ws.segments[: shape.depth]]
+        o_seq, o_tier = None, -1
+        if len(segs) >= ccfg.dbg.min_depth:
+            for ti, (k, mc, emc) in enumerate(ccfg.tiers):
+                p = DBGParams(**{**ccfg.dbg.__dict__, "k": k,
+                                 "min_count": mc, "edge_min_count": emc})
+                r = window_consensus(segs, ols[k], p, wlen=ccfg.w)
+                if r.seq is not None:
+                    o_seq, o_tier = r.seq, ti
+                    break
+        n_seq = (out["cons"][i][: out["cons_len"][i]]
+                 if out["solved"][i] else None)
+        same = (o_seq is None) == (n_seq is None) and (
+            o_seq is None or (np.array_equal(o_seq, n_seq)
+                              and o_tier == out["tier"][i]))
+        if not same:
+            mism += 1
+        if o_seq is not None:
+            n_solved += 1
+    assert n_solved > 100, n_solved
+    # sequential-f32 vs BLAS weight sums can flip exact score ties; allow a
+    # whisker, require essentially-total agreement
+    assert mism <= max(1, len(windows) // 100), (mism, len(windows))

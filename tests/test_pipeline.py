@@ -342,3 +342,31 @@ def test_depth_cap_excludes_cross_copy_segments():
     # capping must at least halve the cross-copy fraction vs the raw pile
     assert frac_cross_top <= 0.5 * frac_cross_pile, \
         (frac_cross_top, frac_cross_pile)
+
+
+def test_native_solver_end_to_end(dataset):
+    """--backend native (C++ full-graph tier ladder as the window solver):
+    corrects end to end at quality matching the device/JAX path, with zero
+    top-M truncation by construction."""
+    native = pytest.importorskip("daccord_tpu.native")
+    if not native.available():
+        pytest.skip("native library unavailable")
+    out, d = dataset
+    res = out["result"]
+    fasta = os.path.join(d, "corr_nat.fasta")
+    stats = correct_to_fasta(out["db"], out["las"], fasta,
+                             PipelineConfig(batch_size=256, native_solver=True))
+    assert stats.n_solved / stats.n_windows > 0.9
+    assert stats.n_topm_overflow == 0
+
+    tot_e = tot_l = 0
+    for rec in read_fasta(fasta):
+        rid = int(rec.name[4:].split("/")[0])
+        r = res.reads[rid]
+        truth = res.genome[r.start : r.end]
+        if r.strand == 1:
+            truth = revcomp_ints(truth)
+        f = seq_to_ints(rec.seq)
+        tot_e += infix_distance(f, truth)
+        tot_l += len(f)
+    assert tot_e / tot_l < 0.02, tot_e / tot_l
