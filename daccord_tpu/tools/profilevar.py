@@ -36,6 +36,11 @@ def run_cell(paths: dict, n_piles: int, offset: int) -> dict:
     from daccord_tpu.runtime.pipeline import (PipelineConfig, correct_to_fasta,
                                               estimate_profile_for_shard)
 
+    # the verdict governs the PRODUCTION configuration, so the probe solves
+    # with the production (top-M-capped) ladder semantics, not the native
+    # full-graph engine: the capped ladder could be more profile-sensitive
+    # (tables interact with which k-mers survive the cap), and a verdict
+    # measured under a different engine could lock in an undersized default
     cfg = PipelineConfig(profile_sample_piles=n_piles,
                          profile_sample_offset=offset)
     t0 = time.perf_counter()

@@ -48,16 +48,20 @@ CELLS = [("M48", 48, False), ("M64", 64, False), ("M96", 96, False),
          ("M128", 128, False), ("M64+rescue", 64, True)]
 
 
-def run_cell(paths: dict, label: str, max_kmers: int, rescue: bool) -> dict:
+def run_cell(paths: dict, label: str, max_kmers: int, rescue: bool,
+             prof=None, counts=None) -> dict:
     from daccord_tpu.formats.dazzdb import read_db
     from daccord_tpu.formats.las import LasFile
     from daccord_tpu.runtime.pipeline import (PipelineConfig, correct_to_fasta,
                                               estimate_profile_for_shard)
 
     cfg = PipelineConfig(max_kmers=max_kmers, overflow_rescue=rescue)
-    prof, counts = estimate_profile_for_shard(read_db(paths["db"]),
-                                              LasFile(paths["las"]), cfg,
-                                              collect_offsets=True)
+    if prof is None:
+        # estimation is cap-independent; callers sweeping cells on one
+        # dataset estimate once and pass it in
+        prof, counts = estimate_profile_for_shard(read_db(paths["db"]),
+                                                  LasFile(paths["las"]), cfg,
+                                                  collect_offsets=True)
     out_fa = os.path.join(os.path.dirname(paths["db"]),
                           f"tm_{label.replace('+', '_')}.fasta")
     t0 = time.perf_counter()
@@ -85,12 +89,21 @@ def main(argv=None) -> int:
 
     enable_compilation_cache()
     want = set(args.cells.split(","))
+    from daccord_tpu.formats.dazzdb import read_db
+    from daccord_tpu.formats.las import LasFile
+    from daccord_tpu.runtime.pipeline import (PipelineConfig,
+                                              estimate_profile_for_shard)
+
     for name in args.regimes.split(","):
         paths = _dataset(f"tm_{name}", **REGIMES[name])
+        prof, counts = estimate_profile_for_shard(
+            read_db(paths["db"]), LasFile(paths["las"]), PipelineConfig(),
+            collect_offsets=True)
         for label, mk, rescue in CELLS:
             if label not in want:
                 continue
-            row = {"regime": name, **run_cell(paths, label, mk, rescue)}
+            row = {"regime": name,
+                   **run_cell(paths, label, mk, rescue, prof, counts)}
             print(json.dumps(row), flush=True)
             if args.out:
                 with open(args.out, "at") as fh:
