@@ -54,9 +54,10 @@ def daccord_main(argv=None) -> int:
     p.add_argument("--seg-len", type=int, default=64, help="max segment length")
     p.add_argument("-M", "--max-kmers", type=int, default=64,
                    help="tier-0 compacted active-set size (top-M k-mers per "
-                        "window); the cap binds on most windows at >24x depth "
-                        "(topm_overflow stat) — raising it trades quadratic "
-                        "path-DP cost for graph fidelity")
+                        "window). Measured across 4 regimes (BASELINE.md r3 "
+                        "top-M table): 64 is the best default; 48 is better "
+                        "AND cheaper on high-error CLR; the full graph "
+                        "(--overflow-rescue) is never better")
     p.add_argument("--overflow-rescue", action="store_true",
                    help="re-solve windows whose top-M cap bound at the rescue "
                         "active-set size (reference full-graph semantics for "

@@ -118,14 +118,14 @@ def main(argv=None) -> int:
 
 
 def batch_slice(batch, n: int):
-    """First-n-windows view of a WindowBatch (warmup helper)."""
-    import copy
+    """First-n-windows view of a WindowBatch (warmup helper); slices the
+    bookkeeping arrays too so the batch's parallel-lists invariant holds."""
+    import dataclasses
 
-    b = copy.copy(batch)
-    b.seqs = batch.seqs[:n]
-    b.lens = batch.lens[:n]
-    b.nsegs = batch.nsegs[:n]
-    return b
+    return dataclasses.replace(
+        batch, seqs=batch.seqs[:n], lens=batch.lens[:n],
+        nsegs=batch.nsegs[:n], read_ids=batch.read_ids[:n],
+        wstarts=batch.wstarts[:n])
 
 
 if __name__ == "__main__":
