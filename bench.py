@@ -322,12 +322,9 @@ def cpu_fallback_throughput(data: dict, n_windows: int = 2048,
 
 
 def _slice_batch(batch, n: int):
-    import dataclasses
+    from daccord_tpu.tools.consensusbench import batch_slice
 
-    return dataclasses.replace(
-        batch, seqs=batch.seqs[:n], lens=batch.lens[:n],
-        nsegs=batch.nsegs[:n], read_ids=batch.read_ids[:n],
-        wstarts=batch.wstarts[:n])
+    return batch_slice(batch, n)
 
 
 def _device_alive(timeout_s: int = 150) -> bool:

@@ -113,13 +113,19 @@ class PipelineConfig:
                                  # Pallas TPU kernel (pallas_dp); bit-identical
                                  # results (tests/test_pallas.py), TPU only —
                                  # ignored on the CPU solve_tiered path
-    empirical_ol: bool = True    # blend the estimation pass's measured
+    empirical_ol: bool = False   # blend the estimation pass's measured
                                  # per-position offset distributions into the
                                  # OffsetLikely tables (reference: tables come
                                  # from per-window error stats, SURVEY.md:160);
-                                 # off = pure analytic convolution. Only
-                                 # applies when the profile is estimated here
-                                 # (an external --eprof profile has no counts)
+                                 # off = pure analytic convolution. Default
+                                 # FLIPPED OFF in r3: the blend measured
+                                 # -0.04..-0.52 Q in 7/8 mismatch regimes and
+                                 # the variance probe showed more empirical
+                                 # weight scoring strictly worse (BASELINE.md
+                                 # r3) — the 4-pile x 32-window sample's noise
+                                 # outweighs any model correction at every
+                                 # scale tested. Re-enable via --empirical-ol
+                                 # for runs with a much larger profile sample
     end_trim: bool = True        # treat prefix/suffix runs of windows solved
                                  # only by a low-confidence rescue tier
                                  # (min_count<=1) as unsolved: read ends have

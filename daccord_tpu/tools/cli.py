@@ -93,10 +93,19 @@ def daccord_main(argv=None) -> int:
                         "daccord loads the computeintrinsicqv track). "
                         "Missing track falls back to trace-diff ranking; "
                         "'' disables")
+    p.add_argument("--empirical-ol", action="store_true",
+                   help="blend the estimation pass's measured offset "
+                        "distributions into the OffsetLikely tables. Default "
+                        "off since r3: measured -0.04..-0.52 Q in 7/8 "
+                        "mismatch regimes at the default 4-pile sample "
+                        "(BASELINE.md r3); consider together with a larger "
+                        "--profile-sample")
+    p.add_argument("--profile-sample", type=int, default=None, metavar="N",
+                   help="piles sampled by the error-profile estimation pass "
+                        "(default 4 — measured sufficient, 0.08 Q spread; "
+                        "BASELINE.md r3 variance probe)")
     p.add_argument("--no-empirical-ol", action="store_true",
-                   help="use the pure analytic OffsetLikely tables instead of "
-                        "blending in the estimation pass's measured offset "
-                        "distributions")
+                   help=argparse.SUPPRESS)   # pre-r3 compat; off is default
     p.add_argument("--no-end-trim", action="store_true",
                    help="keep rescue-tier solutions at read ends (default: "
                         "trim them — thin end-of-read piles solved with the "
@@ -168,7 +177,10 @@ def daccord_main(argv=None) -> int:
                          feeder_threads=args.threads, use_pallas=args.pallas,
                          end_trim=not args.no_end_trim,
                          qv_track=args.qv_track or None,
-                         empirical_ol=not args.no_empirical_ol,
+                         empirical_ol=args.empirical_ol
+                                      and not args.no_empirical_ol,
+                         profile_sample_piles=args.profile_sample
+                         or PipelineConfig().profile_sample_piles,
                          overflow_rescue=args.overflow_rescue,
                          native_solver=args.backend == "native")
 
@@ -688,6 +700,11 @@ def shard_main(argv=None) -> int:
     p.add_argument("--checkpoint-every", type=int, default=64,
                    help="checkpoint progress every N emitted reads (0 = off)")
     p.add_argument("--force", action="store_true", help="recompute even if manifest exists")
+    p.add_argument("--empirical-ol", action="store_true",
+                   help="blend measured offset distributions into the OL "
+                        "tables (default off since r3, see daccord --help)")
+    p.add_argument("--profile-sample", type=int, default=None, metavar="N",
+                   help="piles sampled by the profile estimation pass")
     p.add_argument("--backend", choices=("auto", "cpu", "tpu"), default="auto")
     args = p.parse_args(argv)
     if args.backend == "cpu":
@@ -702,8 +719,11 @@ def shard_main(argv=None) -> int:
         raise SystemExit(f"bad -J {args.J}")
     from ..parallel.launch import run_shard
 
-    m = run_shard(args.db, args.las, args.outdir, i, n,
-                  PipelineConfig(batch_size=args.batch),
+    scfg = PipelineConfig(batch_size=args.batch,
+                          empirical_ol=args.empirical_ol)
+    if args.profile_sample:
+        scfg.profile_sample_piles = args.profile_sample
+    m = run_shard(args.db, args.las, args.outdir, i, n, scfg,
                   force=args.force, checkpoint_every=args.checkpoint_every)
     print(json.dumps(m), file=sys.stderr)
     return 0

@@ -42,7 +42,9 @@ def run_cell(paths: dict, n_piles: int, offset: int) -> dict:
     # (tables interact with which k-mers survive the cap), and a verdict
     # measured under a different engine could lock in an undersized default
     cfg = PipelineConfig(profile_sample_piles=n_piles,
-                         profile_sample_offset=offset)
+                         profile_sample_offset=offset,
+                         empirical_ol=True)   # the probe measures the blend;
+                                              # r3 flipped the global default off
     t0 = time.perf_counter()
     prof, counts = estimate_profile_for_shard(read_db(paths["db"]),
                                               LasFile(paths["las"]), cfg,
