@@ -85,10 +85,10 @@ def load():
         lib.solve_windows.restype = c.c_int
         lib.solve_windows.argtypes = (
             [c.c_void_p] * 3 + [c.c_int32] * 3     # seqs/lens/nsegs, B D L
-            + [c.c_void_p] * 7 + [c.c_int32]       # tables, off, tier arrays, n_tiers
+            + [c.c_void_p] * 8 + [c.c_int32]       # tables, off, tier arrays (k/minc/eminc/P/O/M), n_tiers
             + [c.c_int32] * 6                      # wlen..min_depth
             + [c.c_float] * 2 + [c.c_int32]        # max_err, count_frac, n_threads
-            + [c.c_void_p] * 4)                    # cons, lens, errs, tiers
+            + [c.c_void_p] * 5)                    # cons, lens, errs, tiers, movf
         lib.process_pile.restype = c.c_int
         lib.process_pile.argtypes = (
             [c.c_void_p, c.c_int32, c.c_int32]        # a, alen, novl

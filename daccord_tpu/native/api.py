@@ -179,11 +179,19 @@ def las_merge_native(in_paths: list[str], out_path: str, tspace: int) -> int:
     return int(n)
 
 
-def solve_windows_native(batch, ol_tables: dict, cfg, n_threads: int = 1) -> dict:
-    """Native tier-ladder consensus over a WindowBatch (full-graph oracle
-    semantics — no top-M cap; the C++ replica of ``oracle.consensus.
-    solve_window`` over every window). Returns the ``solve_tiered``-shaped
-    dict (m_ovf all-False: nothing is ever truncated here).
+def solve_windows_native(batch, ol_tables: dict, cfg, n_threads: int = 1,
+                         max_kmers: int = 0,
+                         rescue_max_kmers: int = 256) -> dict:
+    """Native tier-ladder consensus over a WindowBatch; the C++ replica of
+    ``oracle.consensus.solve_window``. Returns the ``solve_tiered``-shaped
+    dict.
+
+    ``max_kmers=0`` (default) = full-graph oracle semantics, no truncation,
+    ``m_ovf`` all False. ``max_kmers>0`` mirrors the device ladder's top-M
+    compaction (count desc, smaller code wins ties; min_count<=1 rescue
+    tiers get ``rescue_max_kmers``) — measured a beneficial noise filter on
+    CLR regimes (BASELINE.md r3 top-M table); ``m_ovf`` flags truncated
+    windows.
 
     ``ol_tables``: k -> OffsetLikely (oracle ``make_offset_likely`` output).
     ``cfg``: ConsensusConfig (tiers + dbg params + w).
@@ -208,6 +216,9 @@ def solve_windows_native(batch, ol_tables: dict, cfg, n_threads: int = 1) -> dic
     tier_eminc = np.asarray([t[2] for t in tiers], dtype=np.int32)
     tier_P = np.asarray([ol_tables[t[0]].P for t in tiers], dtype=np.int32)
     tier_O = np.asarray([ol_tables[t[0]].O for t in tiers], dtype=np.int32)
+    tier_M = np.asarray([0 if max_kmers <= 0 else
+                         (rescue_max_kmers if t[1] <= 1 else max_kmers)
+                         for t in tiers], dtype=np.int32)
 
     seqs = np.ascontiguousarray(batch.seqs, dtype=np.int8)
     lens = np.ascontiguousarray(batch.lens, dtype=np.int32)
@@ -218,16 +229,18 @@ def solve_windows_native(batch, ol_tables: dict, cfg, n_threads: int = 1) -> dic
     cons_len = np.empty(B, dtype=np.int32)
     errs = np.empty(B, dtype=np.float32)
     tiers_out = np.empty(B, dtype=np.int32)
+    movf = np.empty(B, dtype=np.uint8)
     rc = lib.solve_windows(
         _ptr(seqs), _ptr(lens), _ptr(nsegs), B, D, L,
         _ptr(tables), _ptr(table_off), _ptr(tier_k), _ptr(tier_minc),
-        _ptr(tier_eminc), _ptr(tier_P), _ptr(tier_O), len(tiers),
+        _ptr(tier_eminc), _ptr(tier_P), _ptr(tier_O), _ptr(tier_M),
+        len(tiers),
         cfg.w, d.anchor_slack, d.end_slack, d.len_slack, d.n_candidates,
         d.min_depth, ctypes.c_float(d.max_err), ctypes.c_float(d.count_frac),
         int(n_threads),
-        _ptr(cons), _ptr(cons_len), _ptr(errs), _ptr(tiers_out))
+        _ptr(cons), _ptr(cons_len), _ptr(errs), _ptr(tiers_out), _ptr(movf))
     if rc != 0:
         raise RuntimeError(f"solve_windows failed: {rc}")
     return dict(cons=cons, cons_len=cons_len, err=errs,
                 solved=tiers_out >= 0, tier=tiers_out,
-                m_ovf=np.zeros(B, dtype=bool))
+                m_ovf=movf.astype(bool))

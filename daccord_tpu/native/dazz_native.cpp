@@ -692,6 +692,10 @@ static int32_t edit_distance_spec(const int8_t* a, int n, const int8_t* b,
 
 struct TierSpec {
   int32_t k, min_count, edge_min_count, P, O;
+  int32_t max_kmers;   // 0 = unbounded (full graph); > 0 mirrors the device
+                       // ladder's top-M compaction (count desc, smaller code
+                       // wins ties — lax.top_k semantics), measured a
+                       // beneficial noise filter (BASELINE.md r3 top-M table)
   const float* table;  // [P][O]
 };
 
@@ -711,14 +715,22 @@ struct Scratch {
   std::vector<int32_t> path;
   std::vector<int8_t> cand, best;
   std::vector<int32_t> seen;
+  // top-M compaction temporaries (swap targets; kept here so ALL per-thread
+  // scratch lives in one audited struct)
+  std::vector<int32_t> sel, off2, cnt2, occ_o2;
+  std::vector<int64_t> kept2;
+  std::vector<float> occ_c2;
+  std::vector<uint8_t> src2, snk2;
 };
 
 // one window, one tier. Returns 0 solved (cons/err written), else -1.
+// *movf is set when the top-M cap truncated the surviving k-mer set.
 static int try_tier(const int8_t* seqs, const int32_t* lens, int nseg, int L,
                     const TierSpec& ts, int wlen, int anchor_slack,
                     int end_slack, int len_slack, int n_candidates,
                     float max_err, float count_frac, Scratch& S,
-                    int8_t* cons_out, int32_t* cons_len, float* err_out) {
+                    int8_t* cons_out, int32_t* cons_len, float* err_out,
+                    uint8_t* movf) {
   const int k = ts.k;
   const int O = ts.O;
   // ---- 1. per-occurrence k-mers/(k+1)-mers with offsets + anchor flags ----
@@ -767,6 +779,7 @@ static int try_tier(const int8_t* seqs, const int32_t* lens, int nseg, int L,
       std::max(ts.min_count, (int)std::ceil(count_frac * nseg));
   S.kept.clear();
   S.kid_off.clear();
+  S.kid_cnt.clear();
   S.occ_o.clear();
   S.occ_c.clear();
   S.src_ok.clear();
@@ -801,12 +814,47 @@ static int try_tier(const int8_t* seqs, const int32_t* lens, int nseg, int L,
       }
       S.src_ok.push_back(s_ok);
       S.snk_ok.push_back(e_ok);
+      S.kid_cnt.push_back(e - i);
     }
     i = e;
   }
-  const int nk = (int)S.kept.size();
-  if (nk == 0) return -1;  // "allfiltered"
+  if (S.kept.empty()) return -1;  // "allfiltered"
   S.kid_off.push_back((int)S.occ_o.size());
+
+  // ---- 2a. top-M compaction (device-ladder semantics) --------------------
+  if (ts.max_kmers > 0 && (int)S.kept.size() > ts.max_kmers) {
+    const int nk0 = (int)S.kept.size();
+    S.sel.resize(nk0);
+    for (int i = 0; i < nk0; ++i) S.sel[i] = i;
+    std::partial_sort(S.sel.begin(), S.sel.begin() + ts.max_kmers,
+                      S.sel.end(),
+                      [&](int a, int b) {
+                        if (S.kid_cnt[a] != S.kid_cnt[b])
+                          return S.kid_cnt[a] > S.kid_cnt[b];
+                        return a < b;   // lax.top_k: lower index wins ties
+                      });
+    S.sel.resize(ts.max_kmers);
+    std::sort(S.sel.begin(), S.sel.end());  // kept must stay code-ascending
+    S.kept2.clear(); S.off2.clear(); S.cnt2.clear();
+    S.occ_o2.clear(); S.occ_c2.clear(); S.src2.clear(); S.snk2.clear();
+    for (int id : S.sel) {
+      S.kept2.push_back(S.kept[id]);
+      S.off2.push_back((int)S.occ_o2.size());
+      for (int q = S.kid_off[id]; q < S.kid_off[id + 1]; ++q) {
+        S.occ_o2.push_back(S.occ_o[q]);
+        S.occ_c2.push_back(S.occ_c[q]);
+      }
+      S.cnt2.push_back(S.kid_cnt[id]);
+      S.src2.push_back(S.src_ok[id]);
+      S.snk2.push_back(S.snk_ok[id]);
+    }
+    S.off2.push_back((int)S.occ_o2.size());
+    S.kept.swap(S.kept2); S.kid_off.swap(S.off2); S.kid_cnt.swap(S.cnt2);
+    S.occ_o.swap(S.occ_o2); S.occ_c.swap(S.occ_c2);
+    S.src_ok.swap(S.src2); S.snk_ok.swap(S.snk2);
+    *movf = 1;
+  }
+  const int nk = (int)S.kept.size();
 
   // ---- 2b. edges from (k+1)-mer support ----------------------------------
   std::sort(S.codes1.begin(), S.codes1.end());
@@ -953,23 +1001,27 @@ extern "C" {
 
 // Batched tier-ladder consensus over the WindowBatch tensor layout.
 // cons [B, CL] (CL = wlen + len_slack, PAD-filled), cons_lens/errs/tiers [B];
-// tier = -1 unsolved (err left at +inf). n_threads > 1 splits windows
+// tier = -1 unsolved (err left at +inf); movf_out [B] = 1 when any attempted
+// tier's top-M cap truncated the k-mer set (tier_M[i] = 0 disables the cap
+// for that tier -> full-graph oracle semantics). n_threads > 1 splits windows
 // across std::threads (engine is stateless per window; scratch thread_local).
 int solve_windows(const int8_t* seqs, const int32_t* lens,
                   const int32_t* nsegs, int32_t B, int32_t D, int32_t L,
                   const float* tables, const int64_t* table_off,
                   const int32_t* tier_k, const int32_t* tier_minc,
                   const int32_t* tier_eminc, const int32_t* tier_P,
-                  const int32_t* tier_O, int32_t n_tiers, int32_t wlen,
+                  const int32_t* tier_O, const int32_t* tier_M,
+                  int32_t n_tiers, int32_t wlen,
                   int32_t anchor_slack, int32_t end_slack, int32_t len_slack,
                   int32_t n_candidates, int32_t min_depth, float max_err,
                   float count_frac, int32_t n_threads, int8_t* cons,
-                  int32_t* cons_lens, float* errs, int32_t* tiers_out) {
+                  int32_t* cons_lens, float* errs, int32_t* tiers_out,
+                  uint8_t* movf_out) {
   const int CL = wlen + len_slack;
   std::vector<dbgc::TierSpec> ts(n_tiers);
   for (int i = 0; i < n_tiers; ++i)
     ts[i] = {tier_k[i], tier_minc[i], tier_eminc[i], tier_P[i], tier_O[i],
-             tables + table_off[i]};
+             tier_M[i], tables + table_off[i]};
   std::atomic<int32_t> next(0);
   auto worker = [&]() {
     dbgc::Scratch S;
@@ -981,12 +1033,13 @@ int solve_windows(const int8_t* seqs, const int32_t* lens,
       cons_lens[b] = 0;
       errs[b] = std::numeric_limits<float>::infinity();
       tiers_out[b] = -1;
+      movf_out[b] = 0;
       if (nsegs[b] < min_depth) continue;  // oracle: "depth" for every tier
       for (int ti = 0; ti < n_tiers; ++ti) {
         if (dbgc::try_tier(seqs + (size_t)b * D * L, lens + (size_t)b * D,
                            nsegs[b], L, ts[ti], wlen, anchor_slack, end_slack,
                            len_slack, n_candidates, max_err, count_frac, S, c,
-                           &cons_lens[b], &errs[b]) == 0) {
+                           &cons_lens[b], &errs[b], &movf_out[b]) == 0) {
           tiers_out[b] = ti;
           break;
         }

@@ -520,8 +520,42 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         ols = make_offset_likely(profile, cfg.consensus,
                                  offset_counts=offset_counts)
         nt = max(cfg.feeder_threads, 1)
-        solver = lambda b: solve_windows_native(b, ols, cfg.consensus,
-                                                n_threads=nt)   # noqa: E731
+
+        def _native_solver(b):
+            # same top-M semantics as the device ladder (measured beneficial
+            # on CLR, BASELINE.md r3 top-M table); -M 0 gives the full graph
+            out = solve_windows_native(b, ols, cfg.consensus, n_threads=nt,
+                                       max_kmers=cfg.max_kmers,
+                                       rescue_max_kmers=cfg.rescue_max_kmers)
+            if (cfg.overflow_rescue
+                    and 0 < cfg.max_kmers < cfg.rescue_max_kmers
+                    and out["m_ovf"].any()):
+                # same guard as TierLadder.from_config: the rescue only
+                # exists when it genuinely widens the set (never downgrade a
+                # wider first pass, never re-solve at the same width)
+                # device-ladder rescue semantics: capped windows re-solve at
+                # the rescue set size; the wide result replaces the capped
+                # one wherever it solves (kernels/tiers.py ladder_core)
+                import dataclasses
+
+                idx = np.nonzero(out["m_ovf"])[0]
+                sub = dataclasses.replace(
+                    b, seqs=b.seqs[idx], lens=b.lens[idx],
+                    nsegs=b.nsegs[idx], read_ids=b.read_ids[idx],
+                    wstarts=b.wstarts[idx])
+                wide = solve_windows_native(
+                    sub, ols, cfg.consensus, n_threads=nt,
+                    max_kmers=cfg.rescue_max_kmers,
+                    rescue_max_kmers=cfg.rescue_max_kmers)
+                take = wide["solved"]
+                ti = idx[take]
+                for key in ("cons", "cons_len", "err", "tier"):
+                    out[key][ti] = wide[key][take]
+                out["solved"][ti] = True
+                out["m_ovf"][ti] = wide["m_ovf"][take]
+            return out
+
+        solver = _native_solver
     if solver is not None:
         if hasattr(solver, "dispatch") and hasattr(solver, "fetch"):
             # async solver (e.g. the mesh-sharded ladder): pipeline batches

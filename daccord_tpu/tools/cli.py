@@ -179,8 +179,10 @@ def daccord_main(argv=None) -> int:
                          qv_track=args.qv_track or None,
                          empirical_ol=args.empirical_ol
                                       and not args.no_empirical_ol,
-                         profile_sample_piles=args.profile_sample
-                         or PipelineConfig().profile_sample_piles,
+                         profile_sample_piles=(
+                             args.profile_sample
+                             if args.profile_sample is not None
+                             else PipelineConfig().profile_sample_piles),
                          overflow_rescue=args.overflow_rescue,
                          native_solver=args.backend == "native")
 
@@ -721,7 +723,7 @@ def shard_main(argv=None) -> int:
 
     scfg = PipelineConfig(batch_size=args.batch,
                           empirical_ol=args.empirical_ol)
-    if args.profile_sample:
+    if args.profile_sample is not None:
         scfg.profile_sample_piles = args.profile_sample
     m = run_shard(args.db, args.las, args.outdir, i, n, scfg,
                   force=args.force, checkpoint_every=args.checkpoint_every)

@@ -83,7 +83,7 @@ def _qveval(fasta: str, truth: str, raw_db: str | None) -> dict:
 
 
 def run_rung(name: str, sim_kw: dict, feeder_threads: int = 0,
-             mesh: int = 0) -> dict:
+             mesh: int = 0, native: bool = False) -> dict:
     """One ladder rung through the production pipeline; returns the JSON row."""
     import jax
 
@@ -92,7 +92,8 @@ def run_rung(name: str, sim_kw: dict, feeder_threads: int = 0,
 
     enable_compilation_cache()
     paths = _dataset(name, **sim_kw)
-    cfg = PipelineConfig(feeder_threads=feeder_threads)
+    cfg = PipelineConfig(feeder_threads=feeder_threads,
+                         native_solver=native and mesh <= 1)
     out_fa = os.path.join(CACHE, f"ladder_{name}", "corrected.fasta")
 
     # profile estimation runs OUTSIDE the timed window for every rung, so
@@ -271,6 +272,11 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--configs", default="cfg1,cfg2,cfg3")
     p.add_argument("--threads", type=int, default=0, help="feeder threads")
+    p.add_argument("--native", action="store_true",
+                   help="solve with the native C++ engine (--backend "
+                        "native's degraded-mode path, device-ladder top-M "
+                        "semantics at the default -M 64; single-device "
+                        "rungs only — mesh/tracks rungs unchanged)")
     p.add_argument("--inner", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
@@ -287,7 +293,7 @@ def main(argv=None) -> int:
             jax.config.update("jax_num_cpu_devices", mesh)
             jax.config.update("jax_platforms", "cpu")
         row = run_rung(args.inner, r["sim_kw"], feeder_threads=args.threads,
-                       mesh=mesh)
+                       mesh=mesh)   # --inner is only used for mesh rungs
         print(json.dumps(row))
         return 0
 
@@ -349,7 +355,7 @@ def main(argv=None) -> int:
                 print(out[-1])
         else:
             row = run_rung(name, r["sim_kw"], feeder_threads=args.threads,
-                           mesh=mesh)
+                           mesh=mesh, native=args.native)
             print(json.dumps({**row, "fallback": fallback}))
     return 0
 

@@ -191,3 +191,44 @@ def test_native_consensus_oracle_parity(dataset):
     # sequential-f32 vs BLAS weight sums can flip exact score ties; allow a
     # whisker, require essentially-total agreement
     assert mism <= max(1, len(windows) // 100), (mism, len(windows))
+
+
+def test_native_consensus_topm_cap(dataset):
+    """Native top-M compaction: a huge cap is bitwise the full graph; a tiny
+    cap flags m_ovf on truncated windows and changes only flagged windows."""
+    from daccord_tpu.native.api import solve_windows_native
+    from daccord_tpu.oracle import estimate_profile_two_pass
+    from daccord_tpu.oracle.consensus import (ConsensusConfig,
+                                              make_offset_likely)
+
+    (paths, d) = dataset
+    db = read_db(paths["db"])
+    las = LasFile(paths["las"])
+    ccfg = ConsensusConfig()
+    windows = []
+    for aread, pile in las.iter_piles():
+        a = db.read_bases(aread)
+        refined = [refine_overlap(o, a, db.read_bases(o.bread), las.tspace)
+                   for o in pile]
+        windows.extend(cut_windows(a, refined, w=ccfg.w, adv=ccfg.adv))
+        if len(windows) >= 120:
+            break
+    prof = estimate_profile_two_pass(refined, windows[:40], ccfg, sample=12)
+    ols = make_offset_likely(prof, ccfg)
+    shape = BatchShape(depth=24, seg_len=64, wlen=ccfg.w)
+    batch = tensorize_windows([(0, ws) for ws in windows], shape)
+
+    full = solve_windows_native(batch, ols, ccfg)
+    huge = solve_windows_native(batch, ols, ccfg, max_kmers=100_000,
+                                rescue_max_kmers=100_000)
+    for key in ("cons", "cons_len", "solved", "tier"):
+        np.testing.assert_array_equal(full[key], huge[key], key)
+    assert not huge["m_ovf"].any()
+
+    tiny = solve_windows_native(batch, ols, ccfg, max_kmers=16)
+    assert tiny["m_ovf"].sum() > 10, int(tiny["m_ovf"].sum())
+    # windows the cap never touched must match the full graph exactly
+    clean = ~tiny["m_ovf"]
+    np.testing.assert_array_equal(tiny["cons"][clean], full["cons"][clean])
+    np.testing.assert_array_equal(tiny["cons_len"][clean],
+                                  full["cons_len"][clean])

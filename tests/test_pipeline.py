@@ -345,9 +345,10 @@ def test_depth_cap_excludes_cross_copy_segments():
 
 
 def test_native_solver_end_to_end(dataset):
-    """--backend native (C++ full-graph tier ladder as the window solver):
-    corrects end to end at quality matching the device/JAX path, with zero
-    top-M truncation by construction."""
+    """--backend native (C++ tier ladder as the window solver): corrects end
+    to end at quality matching the device/JAX path. -M 0 gives full-graph
+    oracle semantics (zero truncation by construction); the default cap
+    mirrors the device ladder and flags its truncations."""
     native = pytest.importorskip("daccord_tpu.native")
     if not native.available():
         pytest.skip("native library unavailable")
@@ -355,9 +356,10 @@ def test_native_solver_end_to_end(dataset):
     res = out["result"]
     fasta = os.path.join(d, "corr_nat.fasta")
     stats = correct_to_fasta(out["db"], out["las"], fasta,
-                             PipelineConfig(batch_size=256, native_solver=True))
+                             PipelineConfig(batch_size=256, native_solver=True,
+                                            max_kmers=0))
     assert stats.n_solved / stats.n_windows > 0.9
-    assert stats.n_topm_overflow == 0
+    assert stats.n_topm_overflow == 0   # full graph: nothing truncated
 
     tot_e = tot_l = 0
     for rec in read_fasta(fasta):
