@@ -654,6 +654,11 @@ namespace dbgc {
 
 constexpr float NEGF = -1e30f;
 
+static int band_for(int n, int m) {  // the one band formula (spec + fast)
+  int band = std::abs(n - m) + std::max(16, std::max(n, m) >> 2);
+  return std::max(band, std::abs(n - m) + 1);
+}
+
 // oracle.align.edit_distance replica: banded unit-cost DP, int32, band
 // derived exactly as the spec does (NOT verify-retried — the banded value IS
 // the spec the kernel parity tests are calibrated against).
@@ -661,8 +666,7 @@ static int32_t edit_distance_spec(const int8_t* a, int n, const int8_t* b,
                                   int m) {
   if (n == 0) return m;
   if (m == 0) return n;
-  int band = std::abs(n - m) + std::max(16, std::max(n, m) >> 2);
-  band = std::max(band, std::abs(n - m) + 1);
+  const int band = band_for(n, m);
   static thread_local std::vector<int32_t> pv, cv;
   pv.resize(m + 1);
   cv.resize(m + 1);
@@ -688,6 +692,66 @@ static int32_t edit_distance_spec(const int8_t* a, int n, const int8_t* b,
     std::swap(prev, cur);
   }
   return prev[m];
+}
+
+// Myers/Hyyrö bit-parallel exact edit distance for candidate rescoring,
+// n <= 64 (cand_len <= wlen + len_slack = 48): one uint64 word of VP/VN,
+// ~15 bitwise ops per segment char. Formulation mirrors the device kernel's
+// _edit_distance_myers (window_kernel.py), which is bit-parity-tested
+// against the exact anti-diagonal DP. The SPEC the oracle defines is the
+// BANDED distance (edit_distance_spec above), which equals the exact
+// distance whenever exact <= band — always true for real candidate/segment
+// pairs at these lengths; rare junk pairs (and any out-of-alphabet bytes)
+// fall back to the banded replica so native == oracle stays bit-exact.
+
+struct MyersCand {   // per-candidate precompute, reused across all segments
+  uint64_t peq[5];
+  uint64_t vp_init, hb;
+  int n;
+  bool ok;
+};
+
+static void myers_prep(const int8_t* a, int n, MyersCand& mc) {
+  mc.n = n;
+  mc.ok = n > 0 && n <= 64;
+  if (!mc.ok) return;
+  for (int c = 0; c < 5; ++c) mc.peq[c] = 0;
+  for (int i = 0; i < n; ++i) {
+    const uint8_t c = (uint8_t)a[i];
+    if (c > 4) { mc.ok = false; return; }   // out-of-alphabet: spec path
+    mc.peq[c] |= 1ull << i;
+  }
+  mc.vp_init = (n == 64) ? ~0ull : ((1ull << n) - 1);
+  mc.hb = 1ull << (n - 1);
+}
+
+static int32_t edit_distance_fast(const MyersCand& mc, const int8_t* a,
+                                  const int8_t* b, int m, bool b_checked) {
+  const int n = mc.n;
+  if (!mc.ok || m == 0) return edit_distance_spec(a, n, b, m);
+  if (!b_checked)   // callers that pre-validate their segments skip the scan
+    for (int j = 0; j < m; ++j)
+      if ((uint8_t)b[j] > 4) return edit_distance_spec(a, n, b, m);
+  uint64_t vp = mc.vp_init;
+  uint64_t vn = 0;
+  int32_t score = n;
+  const uint64_t hb = mc.hb;
+  for (int j = 0; j < m; ++j) {
+    const uint64_t eq = mc.peq[(uint8_t)b[j]];
+    const uint64_t x = eq | vn;
+    const uint64_t ad = x & vp;
+    const uint64_t s = vp + ad;
+    const uint64_t d0 = (s ^ vp) | x;
+    const uint64_t hn = vp & d0;
+    const uint64_t hp = vn | ~(vp | d0);
+    score += (hp & hb) ? 1 : ((hn & hb) ? -1 : 0);
+    const uint64_t x2 = (hp << 1) | 1ull;   // D[0,j] = j carry-in
+    const uint64_t h2 = hn << 1;
+    vn = x2 & d0;
+    vp = h2 | ~(x2 | d0);
+  }
+  if (score <= band_for(n, m)) return score;  // banded spec == exact here
+  return edit_distance_spec(a, n, b, m);
 }
 
 struct TierSpec {
@@ -934,6 +998,12 @@ static int try_tier(const int8_t* seqs, const int32_t* lens, int nseg, int L,
   }
 
   // ---- 5. candidates: sort (score desc, flat idx asc), rescore -----------
+  bool segs_ok = true;   // alphabet check hoisted out of the rescore loop
+  for (int j = 0; j < nseg && segs_ok; ++j) {
+    const int8_t* sb = seqs + (size_t)j * L;
+    for (int q = 0; q < lens[j]; ++q)
+      if ((uint8_t)sb[q] > 4) { segs_ok = false; break; }
+  }
   const int t_lo = std::max(0, wlen - k - len_slack);
   const int t_hi = std::min(P - 1, wlen - k + len_slack);
   if (t_hi < t_lo) return -1;
@@ -974,10 +1044,12 @@ static int try_tier(const int8_t* seqs, const int32_t* lens, int nseg, int L,
     for (int tt = 1; tt <= t; ++tt)
       S.cand[k + tt - 1] = (int8_t)(S.kept[S.path[tt]] & 3);
     ++n_cand;
+    MyersCand mc;
+    myers_prep(S.cand.data(), (int)S.cand.size(), mc);
     int64_t tot = 0;
     for (int j = 0; j < nseg; ++j)
-      tot += edit_distance_spec(S.cand.data(), (int)S.cand.size(),
-                                seqs + (size_t)j * L, lens[j]);
+      tot += edit_distance_fast(mc, S.cand.data(),
+                                seqs + (size_t)j * L, lens[j], segs_ok);
     const double err = (double)tot / (double)std::max<int64_t>(seg_total, 1);
     if (err < best_err) {
       best_err = err;
