@@ -179,12 +179,98 @@ def las_merge_native(in_paths: list[str], out_path: str, tspace: int) -> int:
     return int(n)
 
 
+class NativeLadder:
+    """Pre-packed tier tables/params for the C++ consensus engine.
+
+    ``solve_windows_native`` rebuilt the concatenated table arrays on every
+    call; a pipeline run makes thousands of calls against the same tables,
+    so the prep is hoisted here — build once per run, call ``solve`` per
+    batch. Semantics identical to :func:`solve_windows_native`.
+    """
+
+    def __init__(self, ol_tables: dict, cfg, max_kmers: int = 0,
+                 rescue_max_kmers: int = 256, _share=None):
+        self.cfg = cfg
+        d = cfg.dbg
+        tiers = list(cfg.tiers)
+        if _share is not None:
+            # caps-only variant: the packed tables (the heavy part) are
+            # shared with the donor ladder — see with_caps
+            for f in ("tables", "table_off", "tier_k", "tier_minc",
+                      "tier_eminc", "tier_P", "tier_O"):
+                setattr(self, f, getattr(_share, f))
+        else:
+            tabs = []
+            offs = [0]
+            for k, _, _ in tiers:
+                t = np.ascontiguousarray(ol_tables[k].table, dtype=np.float32)
+                tabs.append(t.reshape(-1))
+                offs.append(offs[-1] + t.size)
+            self.tables = np.concatenate(tabs)
+            self.table_off = np.asarray(offs[:-1], dtype=np.int64)
+            self.tier_k = np.asarray([t[0] for t in tiers], dtype=np.int32)
+            self.tier_minc = np.asarray([t[1] for t in tiers], dtype=np.int32)
+            self.tier_eminc = np.asarray([t[2] for t in tiers],
+                                         dtype=np.int32)
+            self.tier_P = np.asarray([ol_tables[t[0]].P for t in tiers],
+                                     dtype=np.int32)
+            self.tier_O = np.asarray([ol_tables[t[0]].O for t in tiers],
+                                     dtype=np.int32)
+        self.tier_M = np.asarray(
+            [0 if max_kmers <= 0 else
+             (rescue_max_kmers if t[1] <= 1 else max_kmers)
+             for t in tiers], dtype=np.int32)
+        self.n_tiers = len(tiers)
+        self.CL = cfg.w + d.len_slack
+        self._d = d
+
+    def with_caps(self, max_kmers: int, rescue_max_kmers: int = 256
+                  ) -> "NativeLadder":
+        """Caps-only variant sharing this ladder's packed tables (tier_M is
+        the only per-cap array; everything heavy is reused)."""
+        return NativeLadder(None, self.cfg, max_kmers, rescue_max_kmers,
+                            _share=self)
+
+    def solve(self, batch, n_threads: int = 1) -> dict:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        import ctypes
+
+        d = self._d
+        seqs = np.ascontiguousarray(batch.seqs, dtype=np.int8)
+        lens = np.ascontiguousarray(batch.lens, dtype=np.int32)
+        nsegs = np.ascontiguousarray(batch.nsegs, dtype=np.int32)
+        B, D, L = seqs.shape
+        cons = np.empty((B, self.CL), dtype=np.int8)
+        cons_len = np.empty(B, dtype=np.int32)
+        errs = np.empty(B, dtype=np.float32)
+        tiers_out = np.empty(B, dtype=np.int32)
+        movf = np.empty(B, dtype=np.uint8)
+        rc = lib.solve_windows(
+            _ptr(seqs), _ptr(lens), _ptr(nsegs), B, D, L,
+            _ptr(self.tables), _ptr(self.table_off), _ptr(self.tier_k),
+            _ptr(self.tier_minc), _ptr(self.tier_eminc), _ptr(self.tier_P),
+            _ptr(self.tier_O), _ptr(self.tier_M), self.n_tiers,
+            self.cfg.w, d.anchor_slack, d.end_slack, d.len_slack,
+            d.n_candidates, d.min_depth, ctypes.c_float(d.max_err),
+            ctypes.c_float(d.count_frac), int(n_threads),
+            _ptr(cons), _ptr(cons_len), _ptr(errs), _ptr(tiers_out),
+            _ptr(movf))
+        if rc != 0:
+            raise RuntimeError(f"solve_windows failed: {rc}")
+        return dict(cons=cons, cons_len=cons_len, err=errs,
+                    solved=tiers_out >= 0, tier=tiers_out,
+                    m_ovf=movf.astype(bool))
+
+
 def solve_windows_native(batch, ol_tables: dict, cfg, n_threads: int = 1,
                          max_kmers: int = 0,
                          rescue_max_kmers: int = 256) -> dict:
     """Native tier-ladder consensus over a WindowBatch; the C++ replica of
     ``oracle.consensus.solve_window``. Returns the ``solve_tiered``-shaped
-    dict.
+    dict. One-shot convenience over :class:`NativeLadder` (which callers
+    making many calls against the same tables should hold instead).
 
     ``max_kmers=0`` (default) = full-graph oracle semantics, no truncation,
     ``m_ovf`` all False. ``max_kmers>0`` mirrors the device ladder's top-M
@@ -196,51 +282,5 @@ def solve_windows_native(batch, ol_tables: dict, cfg, n_threads: int = 1,
     ``ol_tables``: k -> OffsetLikely (oracle ``make_offset_likely`` output).
     ``cfg``: ConsensusConfig (tiers + dbg params + w).
     """
-    lib = load()
-    if lib is None:
-        raise RuntimeError("native library unavailable")
-    import ctypes
-
-    d = cfg.dbg
-    tiers = list(cfg.tiers)
-    tabs = []
-    offs = [0]
-    for k, _, _ in tiers:
-        t = np.ascontiguousarray(ol_tables[k].table, dtype=np.float32)
-        tabs.append(t.reshape(-1))
-        offs.append(offs[-1] + t.size)
-    tables = np.concatenate(tabs)
-    table_off = np.asarray(offs[:-1], dtype=np.int64)
-    tier_k = np.asarray([t[0] for t in tiers], dtype=np.int32)
-    tier_minc = np.asarray([t[1] for t in tiers], dtype=np.int32)
-    tier_eminc = np.asarray([t[2] for t in tiers], dtype=np.int32)
-    tier_P = np.asarray([ol_tables[t[0]].P for t in tiers], dtype=np.int32)
-    tier_O = np.asarray([ol_tables[t[0]].O for t in tiers], dtype=np.int32)
-    tier_M = np.asarray([0 if max_kmers <= 0 else
-                         (rescue_max_kmers if t[1] <= 1 else max_kmers)
-                         for t in tiers], dtype=np.int32)
-
-    seqs = np.ascontiguousarray(batch.seqs, dtype=np.int8)
-    lens = np.ascontiguousarray(batch.lens, dtype=np.int32)
-    nsegs = np.ascontiguousarray(batch.nsegs, dtype=np.int32)
-    B, D, L = seqs.shape
-    CL = cfg.w + d.len_slack
-    cons = np.empty((B, CL), dtype=np.int8)
-    cons_len = np.empty(B, dtype=np.int32)
-    errs = np.empty(B, dtype=np.float32)
-    tiers_out = np.empty(B, dtype=np.int32)
-    movf = np.empty(B, dtype=np.uint8)
-    rc = lib.solve_windows(
-        _ptr(seqs), _ptr(lens), _ptr(nsegs), B, D, L,
-        _ptr(tables), _ptr(table_off), _ptr(tier_k), _ptr(tier_minc),
-        _ptr(tier_eminc), _ptr(tier_P), _ptr(tier_O), _ptr(tier_M),
-        len(tiers),
-        cfg.w, d.anchor_slack, d.end_slack, d.len_slack, d.n_candidates,
-        d.min_depth, ctypes.c_float(d.max_err), ctypes.c_float(d.count_frac),
-        int(n_threads),
-        _ptr(cons), _ptr(cons_len), _ptr(errs), _ptr(tiers_out), _ptr(movf))
-    if rc != 0:
-        raise RuntimeError(f"solve_windows failed: {rc}")
-    return dict(cons=cons, cons_len=cons_len, err=errs,
-                solved=tiers_out >= 0, tier=tiers_out,
-                m_ovf=movf.astype(bool))
+    return NativeLadder(ol_tables, cfg, max_kmers,
+                        rescue_max_kmers).solve(batch, n_threads)

@@ -483,10 +483,16 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     if cfg.batch_size is None:
         import dataclasses
 
-        import jax
+        if cfg.native_solver and solver is None:
+            # no compile cost scales with the batch shape here, and each
+            # solve call pays fixed Python/ctypes overhead — bigger is
+            # strictly better until accumulation latency matters
+            cfg = dataclasses.replace(cfg, batch_size=4096)
+        else:
+            import jax
 
-        cfg = dataclasses.replace(
-            cfg, batch_size=2048 if jax.default_backend() == "tpu" else 512)
+            cfg = dataclasses.replace(
+                cfg, batch_size=2048 if jax.default_backend() == "tpu" else 512)
     if profile is None:
         if cfg.empirical_ol:
             profile, offset_counts = estimate_profile_for_shard(
@@ -509,9 +515,10 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
     log = JsonlLogger(cfg.log_path)
     fetch_many_fn = None
-    if solver is None and cfg.native_solver:
+    native_dispatch = solver is None and cfg.native_solver
+    if native_dispatch:
         from ..native import available as _nat_avail
-        from ..native.api import solve_windows_native
+        from ..native.api import NativeLadder
         from ..oracle.consensus import make_offset_likely
 
         if not _nat_avail():
@@ -520,22 +527,25 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         ols = make_offset_likely(profile, cfg.consensus,
                                  offset_counts=offset_counts)
         nt = max(cfg.feeder_threads, 1)
+        # tables packed ONCE; thousands of per-batch calls share them
+        nladder = NativeLadder(ols, cfg.consensus, max_kmers=cfg.max_kmers,
+                               rescue_max_kmers=cfg.rescue_max_kmers)
+        wide_nladder = (nladder.with_caps(cfg.rescue_max_kmers,
+                                          cfg.rescue_max_kmers)
+                        if cfg.overflow_rescue
+                        and 0 < cfg.max_kmers < cfg.rescue_max_kmers
+                        else None)
 
         def _native_solver(b):
             # same top-M semantics as the device ladder (measured beneficial
             # on CLR, BASELINE.md r3 top-M table); -M 0 gives the full graph
-            out = solve_windows_native(b, ols, cfg.consensus, n_threads=nt,
-                                       max_kmers=cfg.max_kmers,
-                                       rescue_max_kmers=cfg.rescue_max_kmers)
-            if (cfg.overflow_rescue
-                    and 0 < cfg.max_kmers < cfg.rescue_max_kmers
-                    and out["m_ovf"].any()):
-                # same guard as TierLadder.from_config: the rescue only
-                # exists when it genuinely widens the set (never downgrade a
-                # wider first pass, never re-solve at the same width)
-                # device-ladder rescue semantics: capped windows re-solve at
-                # the rescue set size; the wide result replaces the capped
-                # one wherever it solves (kernels/tiers.py ladder_core)
+            out = nladder.solve(b, n_threads=nt)
+            if wide_nladder is not None and out["m_ovf"].any():
+                # widen-only guard applied at wide_nladder construction
+                # (same rule as TierLadder.from_config); device-ladder rescue
+                # semantics: capped windows re-solve at the rescue set size,
+                # the wide result replaces the capped one wherever it solves
+                # (kernels/tiers.py ladder_core)
                 import dataclasses
 
                 idx = np.nonzero(out["m_ovf"])[0]
@@ -543,10 +553,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     b, seqs=b.seqs[idx], lens=b.lens[idx],
                     nsegs=b.nsegs[idx], read_ids=b.read_ids[idx],
                     wstarts=b.wstarts[idx])
-                wide = solve_windows_native(
-                    sub, ols, cfg.consensus, n_threads=nt,
-                    max_kmers=cfg.rescue_max_kmers,
-                    rescue_max_kmers=cfg.rescue_max_kmers)
+                wide = wide_nladder.solve(sub, n_threads=nt)
                 take = wide["solved"]
                 ti = idx[take]
                 for key in ("cons", "cons_len", "err", "tier"):
@@ -721,7 +728,10 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 batch = WindowBatch(seqs=seqs[:take], lens=lens[:take], nsegs=nsg[:take],
                                     shape=shapes[bi], read_ids=rid[:take],
                                     wstarts=widx[:take].astype(np.int64) * adv)
-                batch = pad_batch(batch, cfg.batch_size)
+                if not native_dispatch:
+                    # padding exists only for jit static shapes; the native
+                    # engine iterates real rows and would just walk PAD
+                    batch = pad_batch(batch, cfg.batch_size)
                 stats.pad_cells += batch.seqs.size
                 stats.used_cells += int(batch.lens.sum())
                 handle = dispatch_fn(batch)
