@@ -14,6 +14,7 @@ runs once up front on the first piles of the shard.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 from dataclasses import dataclass, field
@@ -64,11 +65,13 @@ class PipelineConfig:
                                  # samples (estimator-variance probe,
                                  # tools/profilevar.py)
     use_native: bool = True      # C++ host path when available
-    native_solver: bool = False  # solve windows with the native C++
-                                 # full-graph tier ladder (dazz_native.cpp
-                                 # solve_windows) instead of a device/JAX
-                                 # ladder: oracle semantics (no top-M cap),
-                                 # measured 4-7x the JAX-CPU fallback per
+    native_solver: bool = False  # solve windows with the native C++ tier
+                                 # ladder (dazz_native.cpp solve_windows)
+                                 # instead of a device/JAX ladder. Same
+                                 # top-M cap semantics as the device ladder
+                                 # by default (max_kmers applies); -M 0
+                                 # restores full-graph oracle semantics.
+                                 # Measured 4-7x the JAX-CPU fallback per
                                  # core — the degraded-mode engine and the
                                  # reference-class CPU baseline in one
                                  # (tools/consensusbench.py)
@@ -95,6 +98,10 @@ class PipelineConfig:
                                  # native pile processor releases the GIL, so
                                  # piles window in parallel while the device
                                  # solves earlier batches
+    native_threads: int = 0      # C++ solve_windows engine threads when
+                                 # --backend native (0 = all host cores);
+                                 # distinct from feeder_threads, which only
+                                 # drives the host windowing pool
     depth_buckets: tuple = (8, 16)   # sub-depth buckets below `depth`; windows
                                  # route to the smallest bucket holding their
                                  # segment count, so shallow windows don't pay
@@ -526,7 +533,8 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                              "(g++ build failed?)")
         ols = make_offset_likely(profile, cfg.consensus,
                                  offset_counts=offset_counts)
-        nt = max(cfg.feeder_threads, 1)
+        nt = cfg.native_threads if cfg.native_threads > 0 else (
+            os.cpu_count() or 1)
         # tables packed ONCE; thousands of per-batch calls share them
         nladder = NativeLadder(ols, cfg.consensus, max_kmers=cfg.max_kmers,
                                rescue_max_kmers=cfg.rescue_max_kmers)
@@ -827,6 +835,9 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             frags = ready.pop(r)
             stats.n_fragments += len(frags)
             stats.bases_out += sum(len(f) for f in frags)
+            # keep wall_s live so mid-stream consumers (progress reporters)
+            # see real bases_per_sec(), not 0 until exhaustion
+            stats.wall_s = time.time() - t_start
             yield r, frags, stats
             emit_idx += 1
 
@@ -836,6 +847,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         frags = ready.pop(r, [])
         stats.n_fragments += len(frags)
         stats.bases_out += sum(len(f) for f in frags)
+        stats.wall_s = time.time() - t_start
         yield r, frags, stats
         emit_idx += 1
     stats.wall_s = time.time() - t_start

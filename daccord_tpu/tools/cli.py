@@ -50,14 +50,19 @@ def daccord_main(argv=None) -> int:
     p.add_argument("-b", "--batch", type=int, default=None, help="device batch size (default auto: 2048 on tpu, 512 otherwise)")
     p.add_argument("-t", "--threads", type=int, default=0,
                    help="host windowing threads (reference -t; 0 = synchronous)")
+    p.add_argument("--native-threads", type=int, default=0,
+                   help="C++ engine threads for --backend native "
+                        "(0 = all host cores); independent of -t, which "
+                        "only drives the host windowing pool")
     p.add_argument("--depth", type=int, default=32, help="max segments per window")
     p.add_argument("--seg-len", type=int, default=64, help="max segment length")
     p.add_argument("-M", "--max-kmers", type=int, default=64,
                    help="tier-0 compacted active-set size (top-M k-mers per "
                         "window). Measured across 4 regimes (BASELINE.md r3 "
                         "top-M table): 64 is the best default; 48 is better "
-                        "AND cheaper on high-error CLR; the full graph "
-                        "(--overflow-rescue) is never better")
+                        "AND cheaper on high-error CLR; uncapped rescue "
+                        "(--overflow-rescue) and the full graph (-M 0, "
+                        "--backend native only) measured never better")
     p.add_argument("--overflow-rescue", action="store_true",
                    help="re-solve windows whose top-M cap bound at the rescue "
                         "active-set size (reference full-graph semantics for "
@@ -115,9 +120,10 @@ def daccord_main(argv=None) -> int:
                    help="device backend (SURVEY.md §5 config row); 'cpu' forces the "
                         "host platform before any backend init — the only reliable "
                         "override under this image's axon plugin; 'native' solves "
-                        "windows with the C++ full-graph tier ladder (oracle "
-                        "semantics, no device: the fast degraded mode, 4-7x the "
-                        "JAX-CPU path per core)")
+                        "windows with the C++ tier ladder (device-ladder top-M "
+                        "semantics by default, -M 0 for the full graph; no "
+                        "device: the fast degraded mode, 4-7x the JAX-CPU "
+                        "path per core)")
     p.add_argument("--pallas", action="store_true",
                    help="run the heaviest-path DP as the Pallas TPU kernel "
                         "(bit-identical results; TPU backend only)")
@@ -145,6 +151,12 @@ def daccord_main(argv=None) -> int:
     if args.backend == "native" and args.mesh > 1:
         raise SystemExit("--backend native solves on host C++; it cannot be "
                          "combined with --mesh (pick one)")
+    if args.max_kmers == 0 and args.backend != "native":
+        # on the device ladder M=0 means top_k(…, 0): an empty active set
+        # that silently solves nothing — only the native engine interprets
+        # 0 as "uncapped full graph"
+        raise SystemExit("-M 0 (full graph) requires --backend native; the "
+                         "device ladder needs a positive top-M cap")
     if args.block is not None:
         from ..formats.dazzdb import db_blocks
         from ..formats.las import range_for_areads
@@ -184,7 +196,8 @@ def daccord_main(argv=None) -> int:
                              if args.profile_sample is not None
                              else PipelineConfig().profile_sample_piles),
                          overflow_rescue=args.overflow_rescue,
-                         native_solver=args.backend == "native")
+                         native_solver=args.backend == "native",
+                         native_threads=args.native_threads)
 
     import os
 

@@ -63,9 +63,11 @@ def main(argv=None) -> int:
     refined = []
     for aread, pile in las.iter_piles():
         a = db.read_bases(aread)
-        refined = [refine_overlap(o, a, db.read_bases(o.bread), las.tspace)
-                   for o in pile]
-        windows.extend(cut_windows(a, refined, w=ccfg.w, adv=ccfg.adv))
+        pile_refined = [refine_overlap(o, a, db.read_bases(o.bread),
+                                       las.tspace)
+                        for o in pile]
+        refined.extend(pile_refined)
+        windows.extend(cut_windows(a, pile_refined, w=ccfg.w, adv=ccfg.adv))
         if len(windows) >= args.windows:
             windows = windows[: args.windows]
             break

@@ -93,7 +93,12 @@ def run_rung(name: str, sim_kw: dict, feeder_threads: int = 0,
     enable_compilation_cache()
     paths = _dataset(name, **sim_kw)
     cfg = PipelineConfig(feeder_threads=feeder_threads,
-                         native_solver=native and mesh <= 1)
+                         native_solver=native and mesh <= 1,
+                         # pin engine threads to the bench's thread setting so
+                         # --threads 1 stays a per-core anchor (comparable to
+                         # the recorded r3 baselines) even though the CLI
+                         # defaults native_threads to all cores
+                         native_threads=max(feeder_threads, 1))
     out_fa = os.path.join(CACHE, f"ladder_{name}", "corrected.fasta")
 
     # profile estimation runs OUTSIDE the timed window for every rung, so

@@ -1,0 +1,20 @@
+#!/bin/sh
+# Tunnel liveness poller (VERDICT r3 item 1): append a probe record to
+# TUNNEL_LOG.jsonl every ~20 min so "the tunnel was down all round" is a
+# record, not an assumption. Run in the background for the whole session.
+cd /root/repo || exit 1
+while true; do
+  python - <<'EOF'
+import json, time
+from daccord_tpu.utils.obs import probe_default_backend
+t0 = time.time()
+n = probe_default_backend(120)
+rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+       "devices": n, "alive": n > 0, "probe_s": round(time.time() - t0, 1),
+       "round": 4}
+with open("TUNNEL_LOG.jsonl", "a") as f:
+    f.write(json.dumps(rec) + "\n")
+print(rec)
+EOF
+  sleep 1080
+done
