@@ -341,11 +341,148 @@ def read_repeat_track(db: DazzDB, track: str = "rep") -> list[np.ndarray]:
             for r in raw]
 
 
+_MBINS = 1 << 20   # rate-histogram resolution for the streaming exact median
+
+
+def _rate_bins(r: np.ndarray) -> np.ndarray:
+    # rates live in [0, ~0.5]; anything >= 1 (pathological traces) shares the
+    # overflow bin. Binning is pure float64 multiply+floor, so every pass
+    # maps a given record to the same bin deterministically.
+    return np.minimum((r * _MBINS).astype(np.int64), _MBINS)
+
+
+class _StreamMedian:
+    """Exact ``np.median`` over a streamed sequence in O(bins) memory.
+
+    Pass 1 (:meth:`add`) histograms the values; :meth:`plan` locates the
+    bins holding the middle order statistics; pass 2 (:meth:`collect`)
+    gathers only the values in those bins (bins strictly between the two
+    middle bins are provably empty); :meth:`result` reproduces ``np.median``
+    exactly — same middle elements, same float mean of the two."""
+
+    def __init__(self):
+        self.hist = np.zeros(_MBINS + 1, dtype=np.int64)
+        self.n = 0
+        self._bins: np.ndarray | None = None
+        self._vals: list[np.ndarray] = []
+
+    def add(self, vals: np.ndarray) -> None:
+        if len(vals):
+            # touch only the bins present: a minlength=_MBINS bincount would
+            # allocate 8 MB per call, paid once per pile in the fallback path
+            u, c = np.unique(_rate_bins(vals), return_counts=True)
+            self.hist[u] += c
+            self.n += len(vals)
+
+    def plan(self) -> None:
+        assert self.n > 0
+        k1, k2 = (self.n - 1) // 2, self.n // 2
+        cum = np.cumsum(self.hist)
+        b1 = int(np.searchsorted(cum, k1 + 1))
+        b2 = int(np.searchsorted(cum, k2 + 1))
+        self._k1, self._k2 = k1, k2
+        self._below = int(cum[b1 - 1]) if b1 else 0
+        self._bins = np.unique([b1, b2])
+
+    def collect(self, vals: np.ndarray) -> None:
+        if len(vals):
+            m = np.isin(_rate_bins(vals), self._bins)
+            if m.any():
+                self._vals.append(np.asarray(vals[m], dtype=np.float64))
+
+    def result(self) -> float:
+        v = np.sort(np.concatenate(self._vals))
+        v1 = v[self._k1 - self._below]
+        v2 = v[self._k2 - self._below]
+        return float(v1) if self._k1 == self._k2 else float((v1 + v2) / 2.0)
+
+
+def _chunk_filter_stats(col, reps):
+    """(prates, uspan, alen) for one columnar chunk — the per-record math of
+    the native filter path, shared by the whole-file and bounded-memory
+    streaming variants so they cannot diverge."""
+    n = col.novl
+    alen = np.maximum(col.aepos.astype(np.int64) - col.abpos, 1)
+    pairs = col.trace_flat[::2]
+    if len(pairs):
+        # a zero sentinel keeps trailing empty-trace groups in range
+        # without clipping into the previous group's last element;
+        # zero-length groups (which alias the next group's first
+        # element under reduceat) are masked after
+        pairs_s = np.concatenate([pairs, [0]])
+        dsum = np.add.reduceat(pairs_s, col.trace_off[:-1] // 2)
+        dsum = np.where(np.diff(col.trace_off) > 0, dsum, 0)
+    else:
+        dsum = np.zeros(n, np.int64)
+    prates = dsum / alen
+    rep_reads = ({i for i in range(len(reps)) if len(reps[i])}
+                 if reps is not None else set())
+    uspan = (col.aepos.astype(np.int64) - col.abpos).copy()
+    if rep_reads:
+        # repeat-bearing reads dominate exactly the piles this tool
+        # targets, so the subtraction is grouped by read and done with
+        # searchsorted against the read's interval boundaries instead
+        # of a per-record Python loop
+        sel = np.nonzero(np.isin(
+            col.aread, np.fromiter(rep_reads, np.int64)))[0]
+        sel = sel[np.argsort(col.aread[sel], kind="stable")]
+        grp = np.split(sel, np.nonzero(np.diff(col.aread[sel]))[0] + 1)
+        for g in grp:
+            if not len(g):
+                continue
+            a = int(col.aread[g[0]])
+            iv = np.asarray(reps[a], dtype=np.int64).reshape(-1, 2)
+            st, en = iv[:, 0], iv[:, 1]
+            ab = col.abpos[g].astype(np.int64)
+            ae = col.aepos[g].astype(np.int64)
+            if len(iv) and np.all(st[1:] >= en[:-1]):
+                # sorted disjoint intervals (the track writer's
+                # invariant): covered length via prefix sums minus
+                # the two end overhangs
+                cum = np.concatenate([[0], np.cumsum(en - st)])
+                i0 = np.searchsorted(en, ab, side="right")
+                i1 = np.searchsorted(st, ae, side="left")
+                has = i1 > i0
+                cov = cum[i1] - cum[i0]
+                cov -= np.where(has, np.maximum(
+                    0, ab - st[np.minimum(i0, len(iv) - 1)]), 0)
+                cov -= np.where(has, np.maximum(
+                    0, en[np.maximum(i1, 1) - 1] - ae), 0)
+                uspan[g] = (ae - ab) - cov
+            else:
+                for j, i in enumerate(g):
+                    span = int(ae[j] - ab[j])
+                    for s, e in reps[a]:
+                        span -= max(0, min(int(ae[j]), int(e))
+                                    - max(int(ab[j]), int(s)))
+                    uspan[i] = span
+    return prates, uspan, alen
+
+
+def _pile_keep(prates, uspan, alen, pile_starts, gmed: float,
+               max_err: float | None, min_unique_span: int,
+               rep_margin: float) -> np.ndarray:
+    """Apply the per-pile consistency rule (shared whole-file/streaming)."""
+    is_uniq = uspan >= min_unique_span
+    span_ok = alen >= min_unique_span
+    keep = np.zeros(len(prates), dtype=bool)
+    for p in range(len(pile_starts) - 1):
+        s, e = int(pile_starts[p]), int(pile_starts[p + 1])
+        u = is_uniq[s:e]
+        med = float(np.median(prates[s:e][u])) if u.sum() >= 5 else gmed
+        cut = max_err if max_err is not None else max(2.0 * med, med + 0.15)
+        keep[s:e] = np.where(
+            u, prates[s:e] <= cut,
+            prates[s:e] <= med + rep_margin) & span_ok[s:e]
+    return keep
+
+
 def filter_alignments(db: DazzDB, las: LasFile, out_path: str,
                       max_err: float | None = None,
                       repeat_track: str | None = "rep",
                       min_unique_span: int = 100,
-                      rep_margin: float = 0.015) -> int:
+                      rep_margin: float = 0.015,
+                      mem_records: int | None = None) -> int:
     """Drop alignments inconsistent with the unique-region error profile.
 
     The paper's "local genomic consistency analysis" at the file level
@@ -366,6 +503,13 @@ def filter_alignments(db: DazzDB, las: LasFile, out_path: str,
 
     The unique-rate reference is the pile's median over its own unique
     alignments when it has >= 5 of them, else the file-wide median.
+
+    ``mem_records``: bound peak memory to ~that many records at a time (the
+    pre-filter LAS is by design the largest file of the workflow; at
+    CHM-scale 1e9 records the whole-file columnar load would need 40+ GB).
+    The streaming variant makes pile-aligned chunked passes — histogram +
+    exact-median-collect + apply — and writes kept records as it goes;
+    output is byte-identical to the whole-file path (parity-tested).
     """
     tspace = las.tspace
     reps = None
@@ -383,109 +527,133 @@ def filter_alignments(db: DazzDB, las: LasFile, out_path: str,
             span -= max(0, min(aepos, int(e)) - max(abpos, int(s)))
         return span
 
+    if mem_records is not None and mem_records <= 0:
+        mem_records = None   # 0 / negative: "no bound", not a chunk size
+
     if _native_ok():
-        # columnar pass: per-overlap rates and per-pile medians vectorized;
+        # columnar passes: per-overlap rates and per-pile medians vectorized;
         # only overlaps on repeat-annotated reads pay the interval check
+        from ..formats.las import shard_ranges
         from ..native.api import ColumnarLas
 
-        col = ColumnarLas(las.path)
-        n = col.novl
-        keep = np.zeros(n, dtype=bool)
-        if n:
-            alen = np.maximum(col.aepos.astype(np.int64) - col.abpos, 1)
-            pairs = col.trace_flat[::2]
-            if len(pairs):
-                # a zero sentinel keeps trailing empty-trace groups in range
-                # without clipping into the previous group's last element;
-                # zero-length groups (which alias the next group's first
-                # element under reduceat) are masked after
-                pairs_s = np.concatenate([pairs, [0]])
-                dsum = np.add.reduceat(pairs_s, col.trace_off[:-1] // 2)
-                dsum = np.where(np.diff(col.trace_off) > 0, dsum, 0)
-            else:
-                dsum = np.zeros(n, np.int64)
-            prates = dsum / alen
-            rep_reads = ({i for i in range(len(reps)) if len(reps[i])}
-                         if reps is not None else set())
-            uspan = (col.aepos.astype(np.int64) - col.abpos).copy()
-            if rep_reads:
-                # repeat-bearing reads dominate exactly the piles this tool
-                # targets, so the subtraction is grouped by read and done with
-                # searchsorted against the read's interval boundaries instead
-                # of a per-record Python loop
-                sel = np.nonzero(np.isin(
-                    col.aread, np.fromiter(rep_reads, np.int64)))[0]
-                sel = sel[np.argsort(col.aread[sel], kind="stable")]
-                grp = np.split(sel, np.nonzero(np.diff(col.aread[sel]))[0] + 1)
-                for g in grp:
-                    if not len(g):
-                        continue
-                    a = int(col.aread[g[0]])
-                    iv = np.asarray(reps[a], dtype=np.int64).reshape(-1, 2)
-                    st, en = iv[:, 0], iv[:, 1]
-                    ab = col.abpos[g].astype(np.int64)
-                    ae = col.aepos[g].astype(np.int64)
-                    if len(iv) and np.all(st[1:] >= en[:-1]):
-                        # sorted disjoint intervals (the track writer's
-                        # invariant): covered length via prefix sums minus
-                        # the two end overhangs
-                        cum = np.concatenate([[0], np.cumsum(en - st)])
-                        i0 = np.searchsorted(en, ab, side="right")
-                        i1 = np.searchsorted(st, ae, side="left")
-                        has = i1 > i0
-                        cov = cum[i1] - cum[i0]
-                        cov -= np.where(has, np.maximum(
-                            0, ab - st[np.minimum(i0, len(iv) - 1)]), 0)
-                        cov -= np.where(has, np.maximum(
-                            0, en[np.maximum(i1, 1) - 1] - ae), 0)
-                        uspan[g] = (ae - ab) - cov
-                    else:
-                        for j, i in enumerate(g):
-                            uspan[i] = unique_span(a, int(ab[j]), int(ae[j]))
-            is_uniq = uspan >= min_unique_span
-            span_ok = alen >= min_unique_span
-            gmed = float(np.median(prates[is_uniq])) if is_uniq.any() \
-                else float(np.median(prates))
-            for p in range(len(col.pile_starts) - 1):
-                s, e = int(col.pile_starts[p]), int(col.pile_starts[p + 1])
-                u = is_uniq[s:e]
-                med = float(np.median(prates[s:e][u])) if u.sum() >= 5 else gmed
-                cut = max_err if max_err is not None else max(2.0 * med, med + 0.15)
-                keep[s:e] = np.where(
-                    u, prates[s:e] <= cut,
-                    prates[s:e] <= med + rep_margin) & span_ok[s:e]
-        kept = [o for i, o in enumerate(las) if keep[i]]
+        if mem_records is not None and las.novl > mem_records:
+            ranges = [r for r in shard_ranges(
+                las.path, max(1, -(-las.novl // mem_records))) if r[0] < r[1]]
+        else:
+            ranges = None
+
+        rec_iter = iter(las)
+
+        def stream_write(keep_per_chunk):
+            def kept_iter():
+                for keep in keep_per_chunk:
+                    for flag in keep:
+                        o = next(rec_iter)
+                        if flag:
+                            yield o
+            return write_las(out_path, tspace, kept_iter())
+
+        if ranges is None:
+            # whole-file: one parse, one stats computation, direct median
+            col = ColumnarLas(las.path)
+            if not col.novl:
+                return write_las(out_path, tspace, iter(()))
+            pr, uspan, alen = _chunk_filter_stats(col, reps)
+            uq = uspan >= min_unique_span
+            gmed = float(np.median(pr[uq])) if uq.any() \
+                else float(np.median(pr))
+            keep = _pile_keep(pr, uspan, alen, col.pile_starts, gmed,
+                              max_err, min_unique_span, rep_margin)
+            return stream_write([keep])
+
+        def chunks():
+            for b0, b1 in ranges:
+                col = ColumnarLas(las.path, b0, b1)
+                if col.novl:
+                    yield col
+
+        # pass 1: global unique-rate median (exact, O(bins) memory)
+        med_u, med_a = _StreamMedian(), _StreamMedian()
+        for col in chunks():
+            pr, uspan, _ = _chunk_filter_stats(col, reps)
+            med_u.add(pr[uspan >= min_unique_span])
+            med_a.add(pr)
+        sel = med_u if med_u.n else med_a
+        gmed = 0.0
+        if sel.n:
+            sel.plan()
+            for col in chunks():
+                pr, uspan, _ = _chunk_filter_stats(col, reps)
+                sel.collect(pr[uspan >= min_unique_span]
+                            if sel is med_u else pr)
+            gmed = sel.result()
+
+        # pass 2: per-pile rule, records streamed straight into the writer
+        def keeps():
+            for col in chunks():
+                pr, uspan, alen = _chunk_filter_stats(col, reps)
+                yield _pile_keep(pr, uspan, alen, col.pile_starts, gmed,
+                                 max_err, min_unique_span, rep_margin)
+
+        return stream_write(keeps())
     else:
-        # global pass 1: unique-rate reference
-        all_rates: list[float] = []
-        all_uniq: list[bool] = []
-        for aread, pile in las.iter_piles():
-            for o in pile:
-                alen = max(o.aepos - o.abpos, 1)
-                all_rates.append(float(o.trace[:, 0].sum()) / alen)
-                all_uniq.append(unique_span(aread, o.abpos, o.aepos)
-                                >= min_unique_span)
-        ra = np.asarray(all_rates)
-        ua = np.asarray(all_uniq)
-        gmed = float(np.median(ra[ua])) if ua.any() else \
-            (float(np.median(ra)) if len(ra) else 0.0)
-        kept = []
+        # pure-python fallback: one pile in memory at a time
+        def pile_stats(aread, pile):
+            r = np.asarray([float(o.trace[:, 0].sum())
+                            / max(o.aepos - o.abpos, 1) for o in pile])
+            u = np.asarray([unique_span(aread, o.abpos, o.aepos)
+                            >= min_unique_span for o in pile], dtype=bool)
+            return r, u
+
+        bounded = mem_records is not None and las.novl > mem_records
+        if not bounded:
+            # two passes: per-record rates kept in memory, direct np.median
+            ra, ua = [], []
+            for aread, pile in las.iter_piles():
+                r, u = pile_stats(aread, pile)
+                ra.append(r)
+                ua.append(u)
+            ra = np.concatenate(ra) if ra else np.zeros(0)
+            ua = np.concatenate(ua) if ua else np.zeros(0, bool)
+            gmed = float(np.median(ra[ua])) if ua.any() else \
+                (float(np.median(ra)) if len(ra) else 0.0)
+        else:
+            # three streaming passes (rates recomputed per pass; the python
+            # record parse dominates either way); exact-median machinery
+            med_u, med_a = _StreamMedian(), _StreamMedian()
+            for aread, pile in las.iter_piles():
+                r, u = pile_stats(aread, pile)
+                med_u.add(r[u])
+                med_a.add(r)
+            sel = med_u if med_u.n else med_a
+            gmed = 0.0
+            if sel.n:
+                sel.plan()
+                for aread, pile in las.iter_piles():
+                    r, u = pile_stats(aread, pile)
+                    sel.collect(r[u] if sel is med_u else r)
+                gmed = sel.result()
+
         i0 = 0
-        for aread, pile in las.iter_piles():
-            e = i0 + len(pile)
-            u = ua[i0:e]
-            r = ra[i0:e]
-            med = float(np.median(r[u])) if u.sum() >= 5 else gmed
-            cut = max_err if max_err is not None else max(2.0 * med, med + 0.15)
-            for j, o in enumerate(pile):
-                if o.aepos - o.abpos < min_unique_span:
-                    continue
-                ok = (r[j] <= cut) if u[j] else (r[j] <= med + rep_margin)
-                if ok:
-                    kept.append(o)
-            i0 = e
-    write_las(out_path, tspace, kept)
-    return len(kept)
+
+        def kept_iter():
+            nonlocal i0
+            for aread, pile in las.iter_piles():
+                if bounded:
+                    r, u = pile_stats(aread, pile)
+                else:
+                    r, u = ra[i0 : i0 + len(pile)], ua[i0 : i0 + len(pile)]
+                    i0 += len(pile)
+                med = float(np.median(r[u])) if u.sum() >= 5 else gmed
+                cut = max_err if max_err is not None \
+                    else max(2.0 * med, med + 0.15)
+                for j, o in enumerate(pile):
+                    if o.aepos - o.abpos < min_unique_span:
+                        continue
+                    if (r[j] <= cut) if u[j] else (r[j] <= med + rep_margin):
+                        yield o
+
+        return write_las(out_path, tspace, kept_iter())
 
 
 def filter_symmetric(las_path: str, out_path: str, db: DazzDB | None = None) -> int:

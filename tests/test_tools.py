@@ -516,3 +516,53 @@ def test_detect_repeats_qv_gate(tmp_path):
     lastools.detect_repeats(db, las, depth=12, cov_factor=1.8, qv_track=None)
     off = lastools.read_repeat_track(db)
     assert all(np.array_equal(a, b) for a, b in zip(base, off))
+
+
+def test_stream_median_matches_numpy():
+    """_StreamMedian reproduces np.median exactly over chunked streams."""
+    rng = np.random.default_rng(5)
+    for n in (1, 2, 7, 100, 1001):
+        vals = np.round(rng.random(n) * 0.4, 6)
+        sm = lastools._StreamMedian()
+        for c in np.array_split(vals, 3):
+            sm.add(c)
+        sm.plan()
+        for c in np.array_split(vals, 3):
+            sm.collect(c)
+        assert sm.result() == float(np.median(vals)), n
+    # heavy ties at the median
+    vals = np.asarray([0.15] * 50 + [0.1] * 10 + [0.2] * 10)
+    sm = lastools._StreamMedian()
+    sm.add(vals)
+    sm.plan()
+    sm.collect(vals)
+    assert sm.result() == float(np.median(vals))
+
+
+def test_filter_alignments_streaming_parity(dataset, tmp_path, monkeypatch):
+    """The bounded-memory chunked filter writes byte-identical output to the
+    whole-file path, native and fallback alike (VERDICT r3 item 3)."""
+    out, d = dataset
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    lastools.detect_repeats(db, las, depth=14, cov_factor=1.5)
+
+    from daccord_tpu.native import available
+
+    def run(tag: str, mem, native: bool, repeat_track="rep"):
+        monkeypatch.setattr(lastools, "_native_ok", lambda: native)
+        p = str(tmp_path / f"{tag}.las")
+        n = lastools.filter_alignments(db, las, p, repeat_track=repeat_track,
+                                       mem_records=mem)
+        return n, open(p, "rb").read()
+
+    if available():
+        n_full, b_full = run("full", None, True)
+        # small mem_records => many pile-aligned chunks
+        for mem in (50, 173, 1000):
+            n_s, b_s = run(f"s{mem}", mem, True)
+            assert (n_s, b_s) == (n_full, b_full), mem
+    # fallback path is always-streaming now; must agree with itself and
+    # (already covered by test_filter_alignments_native_parity) with native
+    n_p, b_p = run("pyfall", None, False)
+    assert n_p > 0 and b_p
