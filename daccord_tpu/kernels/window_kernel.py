@@ -329,7 +329,6 @@ def _finish_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
 
     # ---- candidates: top states with distinct final k-mer --------------
     CL = p.cons_len
-    seg_total = jnp.maximum(jnp.sum(lens), 1).astype(jnp.float32)
 
     # gather-free backtrack: the pointer chase and the path->k-mer lookup both
     # run as one-hot multiply-reduces over the M lanes (per-step dynamic
@@ -376,6 +375,15 @@ def _finish_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
         chosen = chosen | (ar_m == v_best)
     cand_arr, clen_arr = jax.vmap(backtrack)(jnp.stack(tbs), jnp.stack(vbs))
     ok_arr = jnp.stack(oks)                           # [C]
+    return _rescore_pick_one(seqs, lens, nsegs, cand_arr, clen_arr, ok_arr, p)
+
+
+def _rescore_pick_one(seqs, lens, nsegs, cand_arr, clen_arr, ok_arr,
+                      p: KernelParams):
+    """Myers-rescore the C candidates of one window and accept the argmin —
+    the tail of the solve shared by the scan and fused-Pallas paths (so
+    their acceptance semantics cannot diverge)."""
+    seg_total = jnp.maximum(jnp.sum(lens), 1).astype(jnp.float32)
 
     def rescore_one(cons, cons_len):
         dists = jax.vmap(lambda sg, sl: _edit_distance_myers(cons, cons_len, sg, sl))(
@@ -411,21 +419,29 @@ def _solve_one(seqs: jnp.ndarray, lens: jnp.ndarray, nsegs: jnp.ndarray,
 
 def solve_batch_pallas_core(seqs, lens, nsegs, ol, p: KernelParams,
                             interpret: bool = False):
-    """Batch solve with the heaviest-path DP as the Pallas TPU kernel.
+    """Batch solve with DP + candidate selection + backtrack as ONE fused
+    Pallas kernel (``pallas_window.dp_backtrack_batch``).
 
     Same contract (and bitwise the same results, enforced by
-    tests/test_pallas.py) as ``vmap(_solve_one)``: graph construction and
-    candidate stages run vmapped, the P-step max-plus recurrence runs as one
-    ``pallas_dp.heaviest_path_batch`` call with all DP state in VMEM."""
-    from .pallas_dp import heaviest_path_batch
+    tests/test_pallas.py) as ``vmap(_solve_one)``: graph construction runs
+    vmapped (sort/top-k/einsum are XLA/MXU-native), then one kernel owns
+    the window until its C candidate sequences exist — the [B, P, M]
+    score/pointer stacks never leave VMEM — and the shared Myers rescore
+    accepts the winner."""
+    from .pallas_window import dp_backtrack_batch
 
     g = jax.vmap(functools.partial(_prep_one, p=p),
                  in_axes=(0, 0, 0, None))(seqs, lens, nsegs, ol)
     wt = jnp.transpose(g["W"], (0, 2, 1))                 # [B, P, M]
-    scores, ptrs = heaviest_path_batch(g["adjW"], wt, g["score0"],
-                                       interpret=interpret)
-    out = jax.vmap(functools.partial(_finish_one, p=p))(
-        seqs, lens, nsegs, scores, ptrs, g["sel"], g["snk_ok"])
+    P = wt.shape[1]
+    t_lo = max(0, p.wlen - p.k - p.len_slack)
+    t_hi = min(P - 1, p.wlen - p.k + p.len_slack)
+    cand, clen, ok = dp_backtrack_batch(
+        g["adjW"], wt, g["score0"], g["snk_ok"], g["sel"], k=p.k,
+        cons_len=p.cons_len, n_candidates=p.n_candidates, t_lo=t_lo,
+        t_hi=t_hi, interpret=interpret)
+    out = jax.vmap(functools.partial(_rescore_pick_one, p=p))(
+        seqs, lens, nsegs, cand.astype(jnp.int8), clen, ok)
     out["m_overflow"] = g["m_overflow"]
     return out
 

@@ -74,6 +74,14 @@ def main(argv=None) -> int:
                      read_ids=np.zeros(B, np.int64), wstarts=np.zeros(B, np.int64))
     timed("ladder_full", lambda: fetch(solve_ladder_async(wb, ladder)))
 
+    # full ladder with the fused Pallas kernel (DP+selection+backtrack in one
+    # pallas_call, pallas_window.py) — the on-chip fused-vs-scan decision row
+    # (VERDICT r3 item 4); interpret mode off-TPU is parity-only, not a perf
+    # signal, so the arm is TPU-gated
+    if jax.default_backend() == "tpu":
+        timed("ladder_pallas",
+              lambda: fetch(solve_ladder_async(wb, ladder, use_pallas=True)))
+
     # tier0 alone
     f_t0 = jax.jit(jax.vmap(functools.partial(_solve_one, p=p0),
                             in_axes=(0, 0, 0, None)))
