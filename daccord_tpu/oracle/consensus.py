@@ -37,6 +37,12 @@ class ConsensusConfig:
     dbg: DBGParams = field(default_factory=DBGParams)
     mode: str = "split"          # "split" | "patch"
     min_fragment: int = 40
+    # homopolymer rescue (oracle/hp.py): re-solve hp-damaged windows in
+    # run-length-compressed space. Host-side, engine-agnostic post-pass.
+    hp_rescue: bool = False
+    hp_err: float = 0.18         # route solved windows above this err
+    hp_min_run: int = 3          # ...only when a run at least this long exists
+    hp_margin: float = 0.005     # expanded result must beat direct err by this
 
     @property
     def k_values(self) -> tuple[int, ...]:
@@ -110,8 +116,15 @@ def solve_window(ws: WindowSegments, ol_tables: dict[int, OffsetLikely],
                          "min_count": mc, "edge_min_count": emc})
         res = window_consensus(ws.segments, ol_tables[k], p, wlen=ws.wlen)
         if res.seq is not None:
-            return res
+            best = res
+            break
         best = res
+    if cfg.hp_rescue and len(ws.segments) >= cfg.dbg.min_depth:
+        from .hp import hp_candidate
+
+        hp = hp_candidate(ws.segments, best.seq, best.err, ol_tables, cfg)
+        if hp is not None:
+            return hp
     return best
 
 

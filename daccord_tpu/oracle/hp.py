@@ -1,0 +1,152 @@
+"""Homopolymer-robust consensus rescue (run-length-compressed DBG tier).
+
+Motivation (BASELINE.md r3 mismatch table): PacBio-rate indels with a
+homopolymer slope — p_indel x (1 + runlen) — push in-run error to the
+30-45% clip, where k-mer consensus degenerates: a run >= k is
+self-repeating in k-mer space, so the graph cannot count its length, and
+the heaviest path picks an essentially arbitrary run length. The r3
+measurement: hp-regime Q collapses to 10.7 vs a 26.4 clean control. The
+reference's full-graph DBG shares this failure class (a k-mer graph has no
+run-length observable either); this tier is a capability the reference does
+NOT have — the "beat the reference" item of VERDICT r3 (#2).
+
+Mechanism: in run-length-compressed space the hp indel process is
+*invisible* — changing a run's length does not change the compressed
+sequence at all. So:
+
+  1. run-length-compress every segment (keep per-position run lengths);
+  2. solve the ordinary DBG consensus in compressed space, where only
+     substitutions and inter-run indels remain (a LOW-error subproblem);
+  3. re-expand the compressed consensus: each position's run length is a
+     vote over the run lengths of segment positions that align to it with
+     the same base (alignment via the banded edit-distance traceback);
+  4. accept the expansion only if its rescored error against the ORIGINAL
+     segments beats the direct solver's result (or clears ``max_err`` where
+     the direct solver failed) — clean-data non-regression by construction.
+
+Routing is engine-agnostic: the pipeline applies this pass on host after
+any engine (JAX device ladder, C++ native, oracle) returns per-window
+``err``; only windows that failed or solved badly AND show a long run are
+routed, so the clean-data cost is a cheap max-run scan.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+import numpy as np
+
+from .align import align_path, edit_distance
+from .dbg import DBGParams, WindowResult, window_consensus
+
+HP_TIER = 29  # tier code reported for hp-rescued windows (pack_result's
+              # 5-bit tier field allows < 31; the ladder itself is ~4 deep)
+
+
+def hp_compress(seg: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Run-length encode: returns (compressed int8 bases, int32 run lengths)."""
+    seg = np.asarray(seg, dtype=np.int8)
+    n = len(seg)
+    if n == 0:
+        return seg, np.zeros(0, dtype=np.int32)
+    starts = np.concatenate(([0], np.flatnonzero(seg[1:] != seg[:-1]) + 1))
+    runs = np.diff(np.concatenate((starts, [n]))).astype(np.int32)
+    return seg[starts], runs
+
+
+def hp_expand(cseq: np.ndarray, runs: np.ndarray) -> np.ndarray:
+    return np.repeat(cseq, np.maximum(runs, 1)).astype(np.int8)
+
+
+def max_run(seg: np.ndarray) -> int:
+    """Length of the longest homopolymer run (0 for empty input)."""
+    if len(seg) == 0:
+        return 0
+    return int(hp_compress(seg)[1].max())
+
+
+def vote_runs(cons_c: np.ndarray,
+              comp: list[tuple[np.ndarray, np.ndarray]]) -> np.ndarray:
+    """Per-position run lengths for the compressed consensus by aligned vote.
+
+    For each compressed segment, the edit-distance traceback maps every
+    consensus position to a span of segment positions; run lengths of
+    same-base matches are collected and the (rounded) median wins — depth
+    ~20 independent noisy run-length observations beat any single read's
+    hp-inflated indels. Positions with no evidence keep run length 1.
+    """
+    n = len(cons_c)
+    votes: list[list[int]] = [[] for _ in range(n)]
+    for cseg, runs in comp:
+        if len(cseg) == 0:
+            continue
+        _, a2b = align_path(cons_c, cseg)
+        for i in range(n):
+            lo, hi = int(a2b[i]), int(a2b[i + 1])
+            for j in range(lo, hi):
+                if cseg[j] == cons_c[i]:
+                    votes[i].append(int(runs[j]))
+    out = np.ones(n, dtype=np.int32)
+    for i, v in enumerate(votes):
+        if v:
+            out[i] = max(1, int(round(float(np.median(v)))))
+    return out
+
+
+def solve_window_hp(segments: list[np.ndarray], ol, dbg: DBGParams,
+                    wlen: int) -> WindowResult | None:
+    """Solve one window in run-length-compressed space and re-expand.
+
+    ``ol`` is the tier's OffsetLikely table (compressed-space offsets are a
+    subset of its domain — the compressed window is strictly shorter, so the
+    table's P/O cover it; the analytic shape is approximate there, which the
+    rescoring acceptance rule absorbs). Returns None when the compressed
+    subproblem is degenerate or unsolved; the caller keeps the direct result.
+    """
+    comp = [hp_compress(s) for s in segments]
+    clens = [len(c) for c, _ in comp]
+    if not clens:
+        return None
+    wlen_c = int(np.median(clens))
+    if wlen_c < dbg.k + 4:
+        return None
+    res = window_consensus([c for c, _ in comp], ol, dbg, wlen=wlen_c)
+    if res.seq is None:
+        return None
+    runs = vote_runs(res.seq, comp)
+    seq = hp_expand(res.seq, runs)
+    # pathological expansions (a mis-voted giant run) never beat the direct
+    # result anyway; bound them before paying the rescore
+    if not (wlen // 2 <= len(seq) <= 2 * wlen):
+        return None
+    tot = sum(len(s) for s in segments)
+    err = sum(edit_distance(seq, s) for s in segments) / max(tot, 1)
+    return WindowResult(seq, err=float(err), k=dbg.k, reason="hp")
+
+
+def hp_candidate(segments: list[np.ndarray], direct_seq, direct_err: float,
+                 ol_tables: dict, cfg) -> WindowResult | None:
+    """Route + solve + accept gate for one window; None = keep direct result.
+
+    ``cfg`` is a ConsensusConfig. Routing: the window failed or solved with
+    err > ``hp_err``, and a run >= ``hp_min_run`` is present (in the direct
+    consensus if solved, else in any segment) — without a long run there is
+    nothing an hp vote could fix. Acceptance: the expanded candidate must
+    beat the direct err by ``hp_margin`` (or clear max_err where the direct
+    solver failed).
+    """
+    solved = direct_seq is not None
+    if solved and direct_err <= cfg.hp_err:
+        return None
+    probe = [direct_seq] if solved else segments
+    if max(max_run(s) for s in probe) < cfg.hp_min_run:
+        return None
+    k, mc, emc = cfg.tiers[0]
+    dbg = replace(cfg.dbg, k=k, min_count=mc, edge_min_count=emc)
+    res = solve_window_hp(segments, ol_tables[k], dbg, cfg.w)
+    if res is None:
+        return None
+    bar = (direct_err - cfg.hp_margin) if solved else cfg.dbg.max_err
+    if res.err >= bar:
+        return None
+    return res

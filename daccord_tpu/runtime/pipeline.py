@@ -157,6 +157,8 @@ class PipelineStats:
                                  # kernel-vs-oracle divergence source;
                                  # VERDICT r1 weak #4)
     qv_ranked: bool = False
+    n_hp_rescued: int = 0        # windows replaced by the run-length-
+                                 # compressed rescue (oracle/hp.py)
     n_end_trimmed: int = 0
     n_fragments: int = 0
     bases_in: int = 0
@@ -608,6 +610,19 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             fetch_fn = _fetch
             fetch_many_fn = _fetch_many
 
+    hp_ols = None
+    if cfg.consensus.hp_rescue:
+        # homopolymer rescue (oracle/hp.py) is a host-side post-pass over any
+        # engine's per-window err, so it needs host OffsetLikely tables even
+        # when the solve runs on device
+        if native_dispatch:
+            hp_ols = ols
+        else:
+            from ..oracle.consensus import make_offset_likely
+
+            hp_ols = make_offset_likely(profile, cfg.consensus,
+                                        offset_counts=offset_counts)
+
     try:
         from ..native import available as native_available
         native_ok = cfg.use_native and native_available()
@@ -645,7 +660,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
     from collections import deque
 
-    inflight: deque = deque()    # (handle, rid, widx, take, t_dispatch)
+    inflight: deque = deque()    # (handle, rid, widx, take, t_dispatch, hp_ctx)
 
     # rescue tiers = frequency filter effectively off (min_count <= 1);
     # their end-of-read solutions get trimmed (see PipelineConfig.end_trim).
@@ -661,15 +676,53 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         ready[r] = stitch_results(pr.a_bases, rows, cfg.consensus)
         del pending[r]
 
-    def scatter(out, rid, widx, take):
+    def hp_pass(out, hp_ctx, take) -> dict[int, np.ndarray]:
+        """Homopolymer rescue over one fetched batch (oracle/hp.py).
+
+        Routes windows that failed or solved with err > hp_err through the
+        run-length-compressed solver; accepted candidates override the
+        result row (their sequence may exceed the packed cons capacity, so
+        they travel as a side dict consumed by scatter)."""
+        from ..oracle.hp import HP_TIER, hp_candidate
+
+        seqs_b, lens_b, nsegs_b = hp_ctx
+        ccfg = cfg.consensus
+        overrides: dict[int, np.ndarray] = {}
+        for i in range(take):
+            nseg = int(nsegs_b[i])
+            if nseg < min_depth:
+                continue
+            solved = bool(out["solved"][i])
+            derr = float(out["err"][i]) if solved else float("inf")
+            if solved and derr <= ccfg.hp_err:
+                continue   # fast path; hp_candidate re-checks
+            dseq = (np.asarray(out["cons"][i][: out["cons_len"][i]],
+                               dtype=np.int8) if solved else None)
+            segs = [np.asarray(seqs_b[i, d, : lens_b[i, d]], dtype=np.int8)
+                    for d in range(nseg)]
+            res = hp_candidate(segs, dseq, derr, hp_ols, ccfg)
+            if res is None:
+                continue
+            overrides[i] = res.seq
+            out["err"][i] = res.err
+            out["solved"][i] = True
+            out["tier"][i] = HP_TIER
+            stats.n_hp_rescued += 1
+        return overrides
+
+    def scatter(out, rid, widx, take, hp_over=None):
         n_batch_solved = 0
         if "m_ovf" in out:
             stats.n_topm_overflow += int(np.sum(out["m_ovf"][:take]))
         for i in range(take):
             r = int(rid[i])
             pr = pending[r]
-            seq = (np.asarray(out["cons"][i][: out["cons_len"][i]], dtype=np.int8)
-                   if out["solved"][i] else None)
+            if hp_over is not None and i in hp_over:
+                seq = hp_over[i]
+            else:
+                seq = (np.asarray(out["cons"][i][: out["cons_len"][i]],
+                                  dtype=np.int8)
+                       if out["solved"][i] else None)
             wj = int(widx[i])
             pr.results[wj] = (wj * adv, w, seq)
             pr.n_done += 1
@@ -701,8 +754,9 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         # (in-flight batches overlap, so summing dispatch->fetch spans
         # would double-count and can exceed wall time)
         stats.device_s += now - t_f
-        for (handle, rid, widx, take, t0), out in zip(entries, outs):
-            n_s = scatter(out, rid, widx, take)
+        for (handle, rid, widx, take, t0, hp_ctx), out in zip(entries, outs):
+            hp_over = hp_pass(out, hp_ctx, take) if hp_ctx is not None else None
+            n_s = scatter(out, rid, widx, take, hp_over)
             log.log("batch", windows=take, solved=n_s,
                     overflow=int(out.get("esc_overflow", 0)),
                     inflight=len(inflight), t_turnaround=round(now - t0, 4))
@@ -743,7 +797,11 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 stats.pad_cells += batch.seqs.size
                 stats.used_cells += int(batch.lens.sum())
                 handle = dispatch_fn(batch)
-                inflight.append((handle, rid, widx, take, time.time()))
+                # hp rescue reconstructs segments from the dispatched rows, so
+                # keep them alive until the fetch (a few MB per in-flight batch)
+                hp_ctx = ((batch.seqs, batch.lens, batch.nsegs)
+                          if hp_ols is not None else None)
+                inflight.append((handle, rid, widx, take, time.time(), hp_ctx))
                 # let the in-flight window FILL, then drain half of it in one
                 # grouped fetch — steady state pays one tunnel RTT per
                 # max_inflight/2 batches instead of one per batch
@@ -855,6 +913,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     log.log("shard_done", reads=stats.n_reads, windows=stats.n_windows,
             solved=stats.n_solved, skipped_shallow=stats.n_skipped_shallow,
             topm_overflow=stats.n_topm_overflow,
+            hp_rescued=stats.n_hp_rescued,
             qv_ranked=stats.qv_ranked, bases_out=stats.bases_out,
             pad_waste=round(stats.pad_waste, 4), wall_s=round(stats.wall_s, 3),
             tiers=stats.tier_histogram, native=stats.native_host,

@@ -63,6 +63,13 @@ def daccord_main(argv=None) -> int:
                         "AND cheaper on high-error CLR; uncapped rescue "
                         "(--overflow-rescue) and the full graph (-M 0, "
                         "--backend native only) measured never better")
+    p.add_argument("--hp-rescue", action="store_true",
+                   help="homopolymer rescue: re-solve windows that failed or "
+                        "solved badly in run-length-compressed space, where "
+                        "length-dependent hp indels are invisible, then "
+                        "re-expand runs by aligned per-position vote "
+                        "(oracle/hp.py; capability the reference's k-mer DBG "
+                        "lacks — runs >= k are self-repeating for it too)")
     p.add_argument("--overflow-rescue", action="store_true",
                    help="re-solve windows whose top-M cap bound at the rescue "
                         "active-set size (reference full-graph semantics for "
@@ -181,7 +188,8 @@ def daccord_main(argv=None) -> int:
 
     ccfg = ConsensusConfig(w=args.w, adv=args.a, mode=args.mode, tiers=tiers,
                            dbg=DBGParams(n_candidates=args.candidates,
-                                         max_err=args.max_err))
+                                         max_err=args.max_err),
+                           hp_rescue=args.hp_rescue)
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
                          depth=args.depth, seg_len=args.seg_len,
                          max_kmers=args.max_kmers,
@@ -343,11 +351,16 @@ def filteralignments_main(argv=None) -> int:
                         "rate is within this of the unique-region profile "
                         "(cross-repeat-copy alignments carry the copies' "
                         "divergence on top of it)")
+    p.add_argument("--mem-records", type=int, default=2_000_000,
+                   help="bound peak memory to ~this many records (the "
+                        "pre-filter LAS is the workflow's largest file); "
+                        "chunked pile-aligned passes, byte-identical output")
     args = p.parse_args(argv)
     db = read_db(args.db, load_bases=False)
     las = LasFile(args.las)
     n = lastools.filter_alignments(db, las, args.out, max_err=args.max_err,
-                                   rep_margin=args.rep_margin)
+                                   rep_margin=args.rep_margin,
+                                   mem_records=args.mem_records)
     print(f"kept {n} of {las.novl}", file=sys.stderr)
     return 0
 

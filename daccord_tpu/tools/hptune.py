@@ -1,0 +1,62 @@
+"""hp-rescue routing-threshold sweep on the mismatchbench hp regime.
+
+One-off decision tool for the r4 default: reuses the cached ``mm_hp``
+dataset + a single estimation pass, then runs ``correct_to_fasta`` arms over
+(hp_err, hp_min_run) and prints Q / errors / rescued / wall per arm.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--arms", default="0.18:3,0.12:3,0.12:2,0.25:3")
+    ap.add_argument("--regime", default="hp")
+    args = ap.parse_args(argv)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from daccord_tpu.utils.obs import enable_compilation_cache
+
+    enable_compilation_cache()
+    import os
+
+    from daccord_tpu.formats.dazzdb import read_db
+    from daccord_tpu.formats.las import LasFile
+    from daccord_tpu.oracle.consensus import ConsensusConfig
+    from daccord_tpu.runtime.pipeline import (PipelineConfig, correct_to_fasta,
+                                              estimate_profile_for_shard)
+    from daccord_tpu.tools.ladderbench import _dataset, _qveval
+    from daccord_tpu.tools.mismatchbench import REGIMES
+
+    paths = _dataset(f"mm_{args.regime}", **REGIMES[args.regime])
+    d = os.path.dirname(paths["db"])
+    prof = estimate_profile_for_shard(read_db(paths["db"]),
+                                      LasFile(paths["las"]), PipelineConfig())
+    for arm in args.arms.split(","):
+        he, hmr = arm.split(":")
+        ccfg = ConsensusConfig(hp_rescue=True, hp_err=float(he),
+                               hp_min_run=int(hmr))
+        cfg = PipelineConfig(empirical_ol=False, consensus=ccfg)
+        out_fa = os.path.join(d, f"corr_hp_{he}_{hmr}.fasta")
+        t0 = time.perf_counter()
+        stats = correct_to_fasta(paths["db"], paths["las"], out_fa, cfg,
+                                 profile=prof)
+        q = _qveval(out_fa, paths["truth"], None)
+        print(json.dumps({"hp_err": float(he), "hp_min_run": int(hmr),
+                          "q": q.get("qscore"), "errors": q.get("errors"),
+                          "solve": round(stats.n_solved
+                                         / max(stats.n_windows, 1), 4),
+                          "rescued": stats.n_hp_rescued,
+                          "wall_s": round(time.perf_counter() - t0, 1)}),
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,7 +55,7 @@ REGIMES: dict[str, dict] = {
 }
 
 
-def run_regime(name: str, sim_kw: dict) -> dict:
+def run_regime(name: str, sim_kw: dict, hp_arm: bool = False) -> dict:
     from daccord_tpu.formats.dazzdb import read_db
     from daccord_tpu.formats.las import LasFile
     from daccord_tpu.runtime.pipeline import (PipelineConfig, correct_to_fasta,
@@ -70,8 +70,15 @@ def run_regime(name: str, sim_kw: dict) -> dict:
     row: dict = {"regime": name, "p_ins": round(prof.p_ins, 4),
                  "p_del": round(prof.p_del, 4), "p_sub": round(prof.p_sub, 4)}
     t0 = time.perf_counter()
-    for arm, use_eol in (("eol", True), ("noeol", False)):
-        acfg = PipelineConfig(empirical_ol=use_eol)
+    arms = [("eol", True, False), ("noeol", False, False)]
+    if hp_arm:
+        # homopolymer rescue arm (oracle/hp.py), on top of the noeol config
+        arms.append(("hp", False, True))
+    for arm, use_eol, use_hp in arms:
+        from daccord_tpu.oracle.consensus import ConsensusConfig
+
+        acfg = PipelineConfig(empirical_ol=use_eol,
+                              consensus=ConsensusConfig(hp_rescue=use_hp))
         out_fa = os.path.join(d, f"corr_{arm}.fasta")
         stats = correct_to_fasta(paths["db"], paths["las"], out_fa, acfg,
                                  profile=prof,
@@ -80,6 +87,8 @@ def run_regime(name: str, sim_kw: dict) -> dict:
         row[f"q_{arm}"] = q.get("qscore")
         row[f"errors_{arm}"] = q.get("errors")
         row[f"solve_{arm}"] = round(stats.n_solved / max(stats.n_windows, 1), 4)
+        if use_hp:
+            row["hp_rescued"] = stats.n_hp_rescued
         if arm == "eol":
             row["q_raw"] = q.get("raw_qscore")
             row["windows"] = stats.n_windows
@@ -91,6 +100,8 @@ def run_regime(name: str, sim_kw: dict) -> dict:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--regimes", default=",".join(REGIMES))
+    ap.add_argument("--hp", action="store_true",
+                    help="add a third arm with --hp-rescue on")
     ap.add_argument("--out", default=None, help="also append rows to this jsonl")
     ap.add_argument("--backend", default="cpu", choices=("cpu", "auto"),
                     help="cpu (default: Q is backend-independent and the "
@@ -105,7 +116,7 @@ def main(argv=None) -> int:
     enable_compilation_cache()
     os.makedirs(CACHE, exist_ok=True)
     for name in args.regimes.split(","):
-        row = run_regime(name, REGIMES[name])
+        row = run_regime(name, REGIMES[name], hp_arm=args.hp)
         print(json.dumps(row), flush=True)
         if args.out:
             with open(args.out, "at") as fh:

@@ -1,0 +1,93 @@
+"""Homopolymer rescue tier (oracle/hp.py): mechanism + gating unit tests."""
+
+import numpy as np
+import pytest
+
+from daccord_tpu.oracle.consensus import ConsensusConfig, make_offset_likely
+from daccord_tpu.oracle.dbg import DBGParams, window_consensus
+from daccord_tpu.oracle.hp import (hp_candidate, hp_compress, hp_expand,
+                                   max_run, vote_runs)
+from daccord_tpu.oracle.profile import ErrorProfile
+
+TRUTH = np.array([0, 1, 2, 2, 2, 2, 3, 0, 1, 1, 1, 3, 2, 0, 0, 0, 0, 0,
+                  1, 2, 3, 3, 1, 0, 2, 1, 1, 1, 1, 3, 0, 2, 3, 1, 0, 0,
+                  0, 2, 1, 3], dtype=np.int8)
+
+
+def test_hp_compress_expand_roundtrip():
+    for seg in (TRUTH, np.zeros(5, np.int8), np.array([2], np.int8),
+                np.zeros(0, np.int8)):
+        c, r = hp_compress(seg)
+        assert len(c) == len(r)
+        assert np.array_equal(hp_expand(c, r), seg)
+        if len(c) > 1:
+            assert np.all(c[1:] != c[:-1])   # no adjacent equal bases
+    assert max_run(TRUTH) == 5
+    assert max_run(np.zeros(0, np.int8)) == 0
+
+
+def _hp_noisy(rng, seg, slope=1.0, p_ind=0.12, p_sub=0.02):
+    """Length-dependent run-length noise: the hp stress process in miniature."""
+    c, runs = hp_compress(seg)
+    out = []
+    for b, r in zip(c, runs):
+        rr = int(r)
+        p = min(0.45, p_ind * (1 + slope * min(r - 1, 8)))
+        for _ in range(int(r)):
+            u = rng.random()
+            if u < p / 2:
+                rr -= 1
+            elif u < p:
+                rr += 1
+        out.extend([b] * max(0, rr))
+    s = np.array(out, dtype=np.int8)
+    subm = rng.random(len(s)) < p_sub
+    if subm.any():
+        s[subm] = (s[subm] + rng.integers(1, 4, subm.sum())) % 4
+    return s
+
+
+def test_vote_runs_recovers_truth_lengths():
+    rng = np.random.default_rng(11)
+    cseq, truth_runs = hp_compress(TRUTH)
+    comp = [hp_compress(_hp_noisy(rng, TRUTH)) for _ in range(20)]
+    voted = vote_runs(cseq, comp)
+    # depth-20 median vote recovers (nearly) every run length the individual
+    # reads scramble
+    assert np.abs(voted - truth_runs).sum() <= 1
+
+
+def test_hp_candidate_beats_direct_on_damaged_windows():
+    from daccord_tpu.oracle.align import edit_distance
+
+    rng = np.random.default_rng(7)
+    cfg = ConsensusConfig(hp_rescue=True)
+    ols = make_offset_likely(ErrorProfile(p_ins=0.06, p_del=0.06, p_sub=0.02),
+                             cfg)
+    p = DBGParams(k=8)
+    d_tot = h_tot = wins = loses = 0
+    for _ in range(8):
+        segs = [_hp_noisy(rng, TRUTH) for _ in range(20)]
+        direct = window_consensus(segs, ols[8], p, wlen=40)
+        d_ed = 99 if direct.seq is None else edit_distance(direct.seq, TRUTH)
+        hp = hp_candidate(segs, direct.seq, direct.err, ols, cfg)
+        h_ed = d_ed if hp is None else edit_distance(hp.seq, TRUTH)
+        d_tot += d_ed
+        h_tot += h_ed
+        wins += h_ed < d_ed
+        loses += h_ed > d_ed
+    assert wins >= 2 and loses == 0, (wins, loses)
+    assert h_tot < d_tot          # strict improvement in truth edits overall
+
+
+def test_hp_candidate_not_routed_on_clean_solve():
+    rng = np.random.default_rng(3)
+    cfg = ConsensusConfig(hp_rescue=True)
+    ols = make_offset_likely(ErrorProfile(p_ins=0.02, p_del=0.02, p_sub=0.01),
+                             cfg)
+    p = DBGParams(k=8)
+    segs = [_hp_noisy(rng, TRUTH, slope=0.0, p_ind=0.03, p_sub=0.01)
+            for _ in range(20)]
+    direct = window_consensus(segs, ols[8], p, wlen=40)
+    assert direct.seq is not None and direct.err <= cfg.hp_err
+    assert hp_candidate(segs, direct.seq, direct.err, ols, cfg) is None
