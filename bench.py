@@ -149,6 +149,17 @@ def device_throughput(data: dict, max_batches: int | None = None,
     # warmup / compile all tier shapes
     fetch(solve_ladder_async(make_batch(0), ladder))
 
+    # tunnel RTT estimate (sidecar provenance): median of 3 tiny blocking
+    # fetches — the fixed per-device_get cost the pipelined dispatch amortizes
+    tiny = jax.device_put(jax.numpy.zeros(8, jax.numpy.int32))
+    jax.block_until_ready(tiny)
+    rtts = []
+    for _ in range(3):
+        tr = time.perf_counter()
+        jax.device_get(tiny)
+        rtts.append(time.perf_counter() - tr)
+    rtt_ms = round(sorted(rtts)[1] * 1e3, 1)
+
     t0 = time.perf_counter()
     bases = 0
     solved = 0
@@ -173,7 +184,8 @@ def device_throughput(data: dict, max_batches: int | None = None,
     dt = time.perf_counter() - t0
     info = dict(windows=nb * BATCH, solved=solved, wall_s=round(dt, 3),
                 device=str(jax.devices()[0]).replace(" ", ""),
-                solve_rate=round(solved / (nb * BATCH), 4))
+                solve_rate=round(solved / (nb * BATCH), 4),
+                batch=BATCH, rtt_ms=rtt_ms)
     return bases / dt, info
 
 
@@ -378,8 +390,21 @@ def main() -> None:
     tracked = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_TPU_LAST.json")
     if not fallback:
+        # provenance fields (VERDICT r3 weak #1 / item 7): a sidecar must be
+        # recomputable — record the code SHA, batch size, and the measured
+        # per-fetch tunnel RTT alongside the headline number
+        try:
+            import subprocess
+            sha = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                                 capture_output=True, text=True, timeout=10,
+                                 cwd=os.path.dirname(os.path.abspath(__file__))
+                                 ).stdout.strip() or None
+        except Exception:
+            sha = None
         payload = {"value": line["value"], "wall_s": info["wall_s"],
                    "windows": info["windows"], "device": info["device"],
+                   "git_sha": sha, "batch": info.get("batch"),
+                   "rtt_ms": info.get("rtt_ms"),
                    "ts": round(time.time(), 1)}
         if "device_compute_bases_per_sec" in info:
             payload["device_compute_bases_per_sec"] = \

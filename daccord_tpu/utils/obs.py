@@ -68,6 +68,32 @@ def device_alive(timeout_s: int = 150) -> bool:
     return probe_default_backend(timeout_s) > 0
 
 
+def _host_cpu_fingerprint() -> str:
+    """Short stable hash of this host's CPU feature flags.
+
+    XLA:CPU AOT-compiled cache entries embed the build host's CPU features;
+    loading them on a host with fewer features can SIGILL (warning observed
+    in BENCH_r03 and again in r4: "Machine type used for XLA:CPU compilation
+    doesn't match the machine type for execution"). Keying the persistent
+    cache directory by CPU flags gives identical hosts a shared cache and a
+    differing future host a fresh one — the same hazard rule the native
+    ``.so`` rebuild guard applies (native/__init__.py)."""
+    import hashlib
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        import platform
+
+        flags = platform.machine() + platform.processor()
+    return hashlib.sha256(flags.encode()).hexdigest()[:10]
+
+
 def enable_compilation_cache() -> str | None:
     """Turn on JAX's persistent compilation cache (opt out:
     DACCORD_NO_COMPCACHE=1; relocate: DACCORD_COMPCACHE=dir).
@@ -81,7 +107,7 @@ def enable_compilation_cache() -> str | None:
     if os.environ.get("DACCORD_NO_COMPCACHE"):
         return None
     path = os.environ.get("DACCORD_COMPCACHE") or os.path.expanduser(
-        "~/.cache/daccord_tpu/xla")
+        "~/.cache/daccord_tpu/xla-" + _host_cpu_fingerprint())
     try:
         import jax
 
