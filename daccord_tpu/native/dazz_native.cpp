@@ -79,21 +79,28 @@ static int32_t fill_banded(const int8_t* a, int n, const int8_t* b, int m,
 // identically to the full matrix — so the result is bit-identical to the
 // full DP (the Python oracle's align_path) by construction, at ~half the
 // cells for typical ~15%-error trace tiles. d >= B doubles the band.
+// verify-retry driver: fill with a band of slack B, accept when d < B (every
+// optimal path provably interior -> exact), else double. Leaves D filled for
+// backtrack. The ONE copy of the exactness rule (align_path AND
+// edit_distance_sum call it).
+static int32_t fill_exact(const int8_t* a, int n, const int8_t* b, int m,
+                          int32_t* D, int W, int32_t band_hint) {
+  const int diff_lo = std::min(0, m - n), diff_hi = std::max(0, m - n);
+  for (int32_t B = std::max(4, band_hint);; B *= 2) {
+    if (diff_hi - diff_lo + 2 * B >= m)   // band no narrower than full width
+      return fill_banded(a, n, b, m, D, W, -n, m);
+    const int32_t d = fill_banded(a, n, b, m, D, W, diff_lo - B, diff_hi + B);
+    if (d < B) return d;
+  }
+}
+
 void align_path(const int8_t* a, int n, const int8_t* b, int m,
                 std::vector<int32_t>& Dbuf, int64_t* a2b,
                 int32_t band_hint = 24) {
   const int W = m + 1;
   Dbuf.resize((size_t)(n + 1) * W);
   int32_t* D = Dbuf.data();
-  const int diff_lo = std::min(0, m - n), diff_hi = std::max(0, m - n);
-  for (int32_t B = std::max(4, band_hint);; B *= 2) {
-    if (diff_hi - diff_lo + 2 * B >= m) {  // band no narrower than full width
-      fill_banded(a, n, b, m, D, W, -n, m);
-      break;
-    }
-    const int32_t d = fill_banded(a, n, b, m, D, W, diff_lo - B, diff_hi + B);
-    if (d < B) break;
-  }
+  fill_exact(a, n, b, m, D, W, band_hint);
   // backtrack (diagonal > deletion > insertion), matching oracle.align
   int i = n, j = m;
   a2b[n] = m;
@@ -363,6 +370,39 @@ int decode_reads(const uint8_t* bps, const int64_t* boff, const int32_t* rlen,
       dst[k] = (src[k / 4] >> (6 - 2 * (k % 4))) & 3;
   }
   return 0;
+}
+
+// exact unit-cost edit distance (verify-retry banded: a returned d < band
+// slack proves every optimal path stayed interior, so the value equals the
+// full DP's) of one candidate vs each of nsegs segments, summed — the
+// oracle/hp rescore hot loop as ONE ctypes call (oracle.align
+// edit_distance_sum; ~75 ms/window of Python row-DP replaced by ~100 us).
+int64_t edit_distance_sum(const int8_t* cand, int32_t n, const int8_t* segs,
+                          const int64_t* offs, const int32_t* lens,
+                          int32_t nsegs) {
+  static thread_local std::vector<int32_t> Dbuf;
+  int64_t tot = 0;
+  for (int32_t s = 0; s < nsegs; ++s) {
+    const int8_t* b = segs + offs[s];
+    const int m = lens[s];
+    if (n == 0) { tot += m; continue; }
+    if (m == 0) { tot += n; continue; }
+    const int W = m + 1;
+    Dbuf.resize((size_t)(n + 1) * W);
+    tot += fill_exact(cand, n, b, m, Dbuf.data(), W, 16);
+  }
+  return tot;
+}
+
+// exact a2b prefix map (oracle.align.align_path semantics, bit-identical
+// backtrack tie order) — the hp run-length vote's per-segment alignment.
+// Returns the edit distance (the final fill's D[n][m], exact by the
+// verify-retry rule).
+int64_t align_map(const int8_t* a, int32_t n, const int8_t* b, int32_t m,
+                  int64_t* a2b) {
+  static thread_local std::vector<int32_t> Dbuf;
+  align_path(a, n, b, m, Dbuf, a2b);
+  return Dbuf[(size_t)n * (m + 1) + m];
 }
 
 }  // extern "C"

@@ -32,8 +32,52 @@ def _native_lib():
         return None
 
 
+def pack_segments(segs: list[np.ndarray]) -> tuple:
+    """Flatten a segment list once for repeated :func:`edit_distance_sum`
+    calls (the candidate loop rescores the same pile per candidate)."""
+    lens = np.asarray([len(s) for s in segs], dtype=np.int32)
+    offs = np.zeros(len(segs), dtype=np.int64)
+    if len(lens):
+        np.cumsum(lens[:-1], out=offs[1:])
+    flat = (np.ascontiguousarray(
+        np.concatenate([np.asarray(s, np.int8) for s in segs]), dtype=np.int8)
+        if len(lens) and lens.sum() else np.zeros(1, np.int8))
+    return flat, offs, lens, segs
+
+
+def edit_distance_sum(cand: np.ndarray, segs) -> int:
+    """Sum of exact edit distances of ``cand`` vs each segment.
+
+    ``segs`` is a segment list or a :func:`pack_segments` result. The
+    consensus-rescore hot loop (oracle ``window_consensus`` candidates,
+    hp-rescue acceptance) as ONE native call when the C++ library is up:
+    the per-pair Python row-DP costs ~0.5 ms in interpreter overhead alone,
+    ~75 ms per hp-routed window; the native verify-retry banded DP does the
+    whole pile in ~100 us."""
+    packed = segs if isinstance(segs, tuple) else pack_segments(segs)
+    flat, offs, lens, seg_list = packed
+    lib = _native_lib()
+    if lib is None or not len(lens):
+        return sum(edit_distance(cand, s) for s in seg_list)
+    import ctypes
+
+    cand = np.ascontiguousarray(cand, dtype=np.int8)
+    lib.edit_distance_sum.restype = ctypes.c_int64
+    return int(lib.edit_distance_sum(
+        cand.ctypes.data_as(ctypes.c_void_p), len(cand),
+        flat.ctypes.data_as(ctypes.c_void_p),
+        offs.ctypes.data_as(ctypes.c_void_p),
+        lens.ctypes.data_as(ctypes.c_void_p), len(lens)))
+
+
 def edit_distance(a: np.ndarray, b: np.ndarray, band: int | None = None) -> int:
-    """Unit-cost edit distance between int8 base arrays (banded)."""
+    """Unit-cost edit distance between int8 base arrays.
+
+    ``band=None`` (the default) is EXACT on every host: the native path and
+    the Python fallback both use the verify-retry rule (a result d below the
+    band slack proves every optimal path stayed interior, so the banded
+    value equals the full DP's; otherwise the band doubles). An explicit
+    ``band`` requests the plain banded approximation."""
     a = np.asarray(a)
     b = np.asarray(b)
     n, m = len(a), len(b)
@@ -42,8 +86,33 @@ def edit_distance(a: np.ndarray, b: np.ndarray, band: int | None = None) -> int:
     if m == 0:
         return n
     if band is None:
-        band = abs(n - m) + max(16, (max(n, m) >> 2))
-    band = max(band, abs(n - m) + 1)
+        lib = _native_lib()
+        if lib is not None:
+            # native exact path (verify-retry banded, see edit_distance_sum)
+            import ctypes
+
+            a8 = np.ascontiguousarray(a, dtype=np.int8)
+            b8 = np.ascontiguousarray(b, dtype=np.int8)
+            offs = np.zeros(1, dtype=np.int64)
+            lens = np.asarray([m], dtype=np.int32)
+            lib.edit_distance_sum.restype = ctypes.c_int64
+            return int(lib.edit_distance_sum(
+                a8.ctypes.data_as(ctypes.c_void_p), n,
+                b8.ctypes.data_as(ctypes.c_void_p),
+                offs.ctypes.data_as(ctypes.c_void_p),
+                lens.ctypes.data_as(ctypes.c_void_p), 1))
+        # python fallback: same verify-retry exactness rule as the native
+        # path, so results never depend on whether the .so built
+        B = abs(n - m) + max(16, (max(n, m) >> 2))
+        while True:
+            d = _edit_distance_banded(a, b, n, m, B)
+            if d < B or B > n + m:
+                return d
+            B *= 2
+    return _edit_distance_banded(a, b, n, m, max(band, abs(n - m) + 1))
+
+
+def _edit_distance_banded(a, b, n: int, m: int, band: int) -> int:
     prev = np.arange(m + 1, dtype=np.int32)
     for i in range(1, n + 1):
         lo = max(1, i - band)
@@ -61,8 +130,7 @@ def edit_distance(a: np.ndarray, b: np.ndarray, band: int | None = None) -> int:
         ar = np.arange(len(vals), dtype=np.int32)
         cur[lo - 1 + 1 : hi + 1] = (np.minimum.accumulate(vals - ar) + ar)[1:]
         prev = cur
-    d = int(prev[m])
-    return d
+    return int(prev[m])
 
 
 def align_path(a: np.ndarray, b: np.ndarray, band: int | None = None) -> tuple[int, np.ndarray]:
@@ -76,6 +144,22 @@ def align_path(a: np.ndarray, b: np.ndarray, band: int | None = None) -> tuple[i
     a = np.asarray(a)
     b = np.asarray(b)
     n, m = len(a), len(b)
+    if band is None and n and m:
+        lib = _native_lib()
+        if lib is not None:
+            # native verify-retry banded DP: bit-identical a2b by
+            # construction (same backtrack tie order; see dazz_native.cpp
+            # align_path), used by window cutting and the hp run-length vote
+            import ctypes
+
+            a8 = np.ascontiguousarray(a, dtype=np.int8)
+            b8 = np.ascontiguousarray(b, dtype=np.int8)
+            a2b = np.zeros(n + 1, dtype=np.int64)
+            lib.align_map.restype = ctypes.c_int64
+            d = int(lib.align_map(a8.ctypes.data_as(ctypes.c_void_p), n,
+                                  b8.ctypes.data_as(ctypes.c_void_p), m,
+                                  a2b.ctypes.data_as(ctypes.c_void_p)))
+            return d, a2b
     D = np.empty((n + 1, m + 1), dtype=np.int32)
     D[0] = np.arange(m + 1)
     D[:, 0] = np.arange(n + 1)

@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from .align import edit_distance
+from .align import edit_distance_sum, pack_segments
 from .profile import OffsetLikely
 
 NEG = np.float32(-1e30)
@@ -171,6 +171,7 @@ def window_consensus(segments: list[np.ndarray], ol: OffsetLikely,
     best_seq = None
     n_cand = 0
     seg_total = sum(len(s) for s in segments)
+    packed_segs = pack_segments(segments)   # flattened once for all candidates
     seen_final: set[int] = set()
     for idx in order[: 4 * params.n_candidates]:
         s = flat[idx]
@@ -194,7 +195,7 @@ def window_consensus(segments: list[np.ndarray], ol: OffsetLikely,
             bases.append(int(kept[path[tt]] & 3))
         cand = np.asarray(bases, dtype=np.int8)
         n_cand += 1
-        tot = sum(edit_distance(cand, seg) for seg in segments)
+        tot = edit_distance_sum(cand, packed_segs)
         err = tot / max(seg_total, 1)
         if err < best_err:
             best_err = err

@@ -36,7 +36,7 @@ from dataclasses import replace
 
 import numpy as np
 
-from .align import align_path, edit_distance
+from .align import align_path, edit_distance_sum
 from .dbg import DBGParams, WindowResult, window_consensus
 
 HP_TIER = 29  # tier code reported for hp-rescued windows (pack_result's
@@ -120,7 +120,7 @@ def solve_window_hp(segments: list[np.ndarray], ol, dbg: DBGParams,
     if not (wlen // 2 <= len(seq) <= 2 * wlen):
         return None
     tot = sum(len(s) for s in segments)
-    err = sum(edit_distance(seq, s) for s in segments) / max(tot, 1)
+    err = edit_distance_sum(seq, segments) / max(tot, 1)
     return WindowResult(seq, err=float(err), k=dbg.k, reason="hp")
 
 

@@ -1,0 +1,90 @@
+"""Empirical-OL fate probe at large sample sizes (VERDICT r3 item 9).
+
+Round 3 measured the empirical-OL blend slightly *negative* in 7/8 mismatch
+regimes at the production 4-pile x 32-window sample and flipped the default
+off; the open question was whether the sign flips once the offset sample is
+large (sampling noise was the suspected mechanism). The native engine makes
+a 256-pile estimation + solve cheap, so this probe runs:
+
+    eol off | eol on @ 4 piles | eol on @ 48 | eol on @ 256
+
+all solving with the production top-M semantics via the native engine
+(``--backend native`` carries the device ladder's caps; cross-engine e2e
+agreement is tested), on the profilevar dataset. Decision rule: if eol@256
+beats eol-off by > 0.1 Q, the blend stays with a documented minimum sample;
+if it is still <= eol-off, the r3 default-off verdict is confirmed at every
+affordable sample size and the feature is retired per VERDICT r3 #9.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--piles", default="4,48,256")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")   # Q is backend-independent
+    from daccord_tpu.utils.obs import enable_compilation_cache
+
+    enable_compilation_cache()
+    from daccord_tpu.formats.dazzdb import read_db
+    from daccord_tpu.formats.las import LasFile
+    from daccord_tpu.runtime.pipeline import (PipelineConfig, correct_to_fasta,
+                                              estimate_profile_for_shard)
+    from daccord_tpu.tools.ladderbench import _dataset, _qveval
+    from daccord_tpu.tools.profilevar import _SHAPE
+
+    paths = _dataset("profilevar", **_SHAPE)
+    d = os.path.dirname(paths["db"])
+    db = read_db(paths["db"])
+    las = LasFile(paths["las"])
+
+    def cell(label: str, use_eol: bool, n_piles: int) -> dict:
+        cfg = PipelineConfig(profile_sample_piles=n_piles,
+                             empirical_ol=use_eol, native_solver=True)
+        t0 = time.perf_counter()
+        if use_eol:
+            prof, counts = estimate_profile_for_shard(db, las, cfg,
+                                                      collect_offsets=True)
+        else:
+            prof, counts = estimate_profile_for_shard(db, las, cfg), None
+        out_fa = os.path.join(d, f"eol_{label}.fasta")
+        stats = correct_to_fasta(paths["db"], paths["las"], out_fa, cfg,
+                                 profile=prof, offset_counts=counts)
+        q = _qveval(out_fa, paths["truth"], None)
+        row = {"arm": label, "piles": n_piles, "eol": use_eol,
+               "q": q.get("qscore"), "errors": q.get("errors"),
+               "solve": round(stats.n_solved / max(stats.n_windows, 1), 4),
+               "wall_s": round(time.perf_counter() - t0, 1)}
+        print(json.dumps(row), flush=True)
+        if args.out:
+            with open(args.out, "at") as fh:
+                fh.write(json.dumps(row) + "\n")
+        return row
+
+    sizes = [int(x) for x in args.piles.split(",")]
+    off = cell("off", False, max(sizes))
+    best = None
+    for sp in sizes:
+        r = cell(f"on{sp}", True, sp)
+        if best is None or (r["q"] or 0) > (best["q"] or 0):
+            best = r
+    dq = round((best["q"] or 0) - (off["q"] or 0), 3)
+    verdict = ("keep: eol wins at large sample" if dq > 0.1
+               else "retire: eol <= off at every affordable sample size")
+    print(json.dumps({"best_eol_arm": best["arm"], "delta_q_vs_off": dq,
+                      "verdict": verdict}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

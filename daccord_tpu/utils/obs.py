@@ -77,7 +77,14 @@ def _host_cpu_fingerprint() -> str:
     doesn't match the machine type for execution"). Keying the persistent
     cache directory by CPU flags gives identical hosts a shared cache and a
     differing future host a fresh one — the same hazard rule the native
-    ``.so`` rebuild guard applies (native/__init__.py)."""
+    ``.so`` rebuild guard applies (native/__init__.py).
+
+    Note (r4 finding): the warning itself fires even for SAME-host cache
+    entries, because XLA appends tuning pseudo-features (+prefer-no-scatter,
+    +prefer-no-gather) to the compile-time feature string that never appear
+    in the parsed host feature list — the named "unsupported" features in a
+    same-host load are exactly those two. Treat the warning as noise unless
+    a genuine ISA feature is named; this keying removes the genuine case."""
     import hashlib
 
     flags = ""

@@ -91,3 +91,42 @@ def test_hp_candidate_not_routed_on_clean_solve():
     direct = window_consensus(segs, ols[8], p, wlen=40)
     assert direct.seq is not None and direct.err <= cfg.hp_err
     assert hp_candidate(segs, direct.seq, direct.err, ols, cfg) is None
+
+
+def test_native_align_parity_random():
+    """Native align_map / edit_distance_sum are bit-identical to the python
+    align_path / per-pair fallback (and exact vs brute force)."""
+    from daccord_tpu.native import available
+
+    if not available():
+        pytest.skip("native host path unavailable")
+    from daccord_tpu.oracle import align as A
+
+    rng = np.random.default_rng(13)
+    for _ in range(60):
+        n, m = int(rng.integers(1, 70)), int(rng.integers(1, 70))
+        a = rng.integers(0, 4, n).astype(np.int8)
+        b = rng.integers(0, 4, m).astype(np.int8)
+        d_nat, map_nat = A.align_path(a, b)
+        orig = A._native_lib
+        A._native_lib = lambda: None
+        try:
+            d_py, map_py = A.align_path(a, b)
+            d_ed = A.edit_distance(a, b)
+        finally:
+            A._native_lib = orig
+        assert d_nat == d_py
+        assert np.array_equal(map_nat, map_py)
+        # both paths are exact by the verify-retry rule => equal, not <=
+        assert A.edit_distance(a, b) == d_ed
+    segs = [rng.integers(0, 4, int(rng.integers(1, 60))).astype(np.int8)
+            for _ in range(25)]
+    cand = rng.integers(0, 4, 45).astype(np.int8)
+    s_nat = A.edit_distance_sum(cand, segs)
+    orig = A._native_lib
+    A._native_lib = lambda: None
+    try:
+        s_py = sum(A.edit_distance(cand, s) for s in segs)
+    finally:
+        A._native_lib = orig
+    assert s_nat == s_py
