@@ -40,7 +40,10 @@ class ConsensusConfig:
     # homopolymer rescue (oracle/hp.py): re-solve hp-damaged windows in
     # run-length-compressed space. Host-side, engine-agnostic post-pass.
     hp_rescue: bool = False
-    hp_err: float = 0.18         # route solved windows above this err
+    hp_err: float = 0.12         # route solved windows above this err
+                                 # (r4 sweep: 0.12 -> Q 14.23 vs 13.40 at
+                                 # 0.18 on the hp regime; 0.25 -> 11.53;
+                                 # min_run 2 vs 3 a wash — BASELINE.md r4)
     hp_min_run: int = 3          # ...only when a run at least this long exists
     hp_margin: float = 0.005     # expanded result must beat direct err by this
 

@@ -404,3 +404,31 @@ def test_native_vs_jax_ladder_consistency(dataset):
     e_nat = _fasta_err_rate(fa_nat, out["result"])
     e_jax = _fasta_err_rate(fa_jax, out["result"])
     assert abs(e_nat - e_jax) < 2e-3, (e_nat, e_jax)
+
+
+def test_hp_rescue_pipeline_end_to_end(tmp_path):
+    """--hp-rescue through the full pipeline on an hp-sloped sim: rescues
+    windows, lifts quality, and never regresses the direct result (the
+    acceptance gate requires the expanded candidate to beat it)."""
+    native = pytest.importorskip("daccord_tpu.native")
+    if not native.available():
+        pytest.skip("native library unavailable")
+    from daccord_tpu.oracle.consensus import ConsensusConfig
+
+    d = str(tmp_path)
+    cfg = SimConfig(genome_len=4000, coverage=18, read_len_mean=900,
+                    min_overlap=300, hp_indel_slope=1.0, seed=31)
+    out = make_dataset(d, cfg, name="hp")
+    res = out["result"]
+
+    base_cfg = PipelineConfig(batch_size=256, native_solver=True)
+    hp_cfg = PipelineConfig(batch_size=256, native_solver=True,
+                            consensus=ConsensusConfig(hp_rescue=True))
+    f_off = os.path.join(d, "hp_off.fasta")
+    f_on = os.path.join(d, "hp_on.fasta")
+    correct_to_fasta(out["db"], out["las"], f_off, base_cfg)
+    stats = correct_to_fasta(out["db"], out["las"], f_on, hp_cfg)
+    assert stats.n_hp_rescued > 0
+    e_off = _fasta_err_rate(f_off, res)
+    e_on = _fasta_err_rate(f_on, res)
+    assert e_on < e_off, (e_on, e_off)
