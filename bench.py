@@ -93,7 +93,13 @@ def _make_batch(data: dict, i: int, batch_size: int, shape):
 
 
 def oracle_baseline(data: dict, n: int = 48) -> float:
-    """Single-core numpy oracle throughput (consensus bases/sec)."""
+    """Single-core numpy oracle throughput (consensus bases/sec).
+
+    Pins the PURE-python alignment path (native lib masked for the timing):
+    r4 routed the oracle's rescore through the native exact DP, which would
+    silently deflate every round's vs_baseline ratio — the baseline must
+    stay the same numpy program it was in r1-r3 to remain comparable."""
+    from daccord_tpu.oracle import align as _align
     from daccord_tpu.oracle.consensus import ConsensusConfig, make_offset_likely, solve_window
     from daccord_tpu.oracle.profile import ErrorProfile
     from daccord_tpu.oracle.windows import WindowSegments
@@ -102,15 +108,20 @@ def oracle_baseline(data: dict, n: int = 48) -> float:
     ccfg = ConsensusConfig()
     ols = make_offset_likely(prof, ccfg)
     idx = np.linspace(0, len(data["nsegs"]) - 1, n).astype(int)
-    t0 = time.perf_counter()
-    bases = 0
-    for i in idx:
-        segs = [data["seqs"][i, d, : data["lens"][i, d]] for d in range(int(data["nsegs"][i]))]
-        ws = WindowSegments(wstart=0, wlen=WLEN, segments=segs, breads=[0] * len(segs))
-        r = solve_window(ws, ols, ccfg)
-        if r.seq is not None:
-            bases += len(r.seq)
-    dt = time.perf_counter() - t0
+    orig_lib = _align._native_lib
+    _align._native_lib = lambda: None
+    try:
+        t0 = time.perf_counter()
+        bases = 0
+        for i in idx:
+            segs = [data["seqs"][i, d, : data["lens"][i, d]] for d in range(int(data["nsegs"][i]))]
+            ws = WindowSegments(wstart=0, wlen=WLEN, segments=segs, breads=[0] * len(segs))
+            r = solve_window(ws, ols, ccfg)
+            if r.seq is not None:
+                bases += len(r.seq)
+        dt = time.perf_counter() - t0
+    finally:
+        _align._native_lib = orig_lib
     return bases / dt if dt > 0 else 0.0
 
 

@@ -38,18 +38,14 @@ class TierLadder:
     @classmethod
     def from_config(cls, profile: ErrorProfile, cfg: ConsensusConfig,
                     max_kmers: int = 64, rescue_max_kmers: int = 256,
-                    offset_counts=None, overflow_rescue: bool = False
-                    ) -> "TierLadder":
-        """``offset_counts``: empirical [P, O] offset samples from the
-        estimation pass; blended into every tier's OL table (see
-        ``oracle.profile.OffsetLikely``). Table construction delegates to the
-        oracle's ``make_offset_likely`` so kernel and oracle tables cannot
-        desynchronize (the bit-parity tests depend on identical tables)."""
+                    overflow_rescue: bool = False) -> "TierLadder":
+        """Table construction delegates to the oracle's ``make_offset_likely``
+        so kernel and oracle tables cannot desynchronize (the bit-parity
+        tests depend on identical tables)."""
         from ..oracle.consensus import make_offset_likely
 
         tables = {k: jnp.asarray(t.table)
-                  for k, t in make_offset_likely(
-                      profile, cfg, offset_counts=offset_counts).items()}
+                  for k, t in make_offset_likely(profile, cfg).items()}
         params = [
             KernelParams(k=k, min_count=mc, edge_min_count=emc,
                          count_frac=cfg.dbg.count_frac,

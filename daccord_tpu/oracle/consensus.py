@@ -60,33 +60,24 @@ class CorrectedRead:
     k_histogram: dict = field(default_factory=dict)
 
 
-def make_offset_likely(profile: ErrorProfile, cfg: ConsensusConfig,
-                       offset_counts: np.ndarray | None = None) -> dict[int, OffsetLikely]:
-    """One OL table per k tier (P spans the admissible DP lengths).
-
-    ``offset_counts`` are empirical [P, O] samples from the estimation pass;
-    each tier's table blends them with the analytic model (see
-    ``OffsetLikely``)."""
+def make_offset_likely(profile: ErrorProfile,
+                       cfg: ConsensusConfig) -> dict[int, OffsetLikely]:
+    """One OL table per k tier (P spans the admissible DP lengths)."""
     tables = {}
     for k in cfg.k_values:
         P = cfg.w - k + 1 + cfg.dbg.len_slack
         O = cfg.w + 16
-        tables[k] = OffsetLikely(profile, positions=P, max_offset=O,
-                                 counts=offset_counts)
+        tables[k] = OffsetLikely(profile, positions=P, max_offset=O)
     return tables
 
 
-def estimate_profile_and_offsets(refined: list[RefinedOverlap],
-                                 windows: list[WindowSegments],
-                                 cfg: ConsensusConfig,
-                                 sample: int = 48
-                                 ) -> tuple[ErrorProfile, np.ndarray]:
+def estimate_profile_two_pass(refined: list[RefinedOverlap],
+                              windows: list[WindowSegments],
+                              cfg: ConsensusConfig,
+                              sample: int = 48) -> ErrorProfile:
     """Reference-style error-profile pass: rough estimate from trace diffs,
     then true single-read rates from segments aligned to a sample consensus
-    (SURVEY.md §3.1 'error-profile estimation pass'). Also returns the
-    empirical per-position offset counts [P, O] those alignments produced
-    (the reference's per-window error statistics feeding OffsetLikely,
-    SURVEY.md:160)."""
+    (SURVEY.md §3.1 'error-profile estimation pass')."""
     rough = rough_profile(refined)
     ol1 = make_offset_likely(rough, cfg)
     stride = max(1, len(windows) // sample)
@@ -95,19 +86,9 @@ def estimate_profile_and_offsets(refined: list[RefinedOverlap],
         res = solve_window(ws, ol1, cfg)
         if res.seq is not None:
             pairs.extend((res.seq, seg) for seg in ws.segments)
-    # P covers every tier's table rows (P_k = w - k + 1 + len_slack <= this)
-    counts = np.zeros((cfg.w + cfg.dbg.len_slack, cfg.w + 16), dtype=np.float64)
     if not pairs:
-        return rough, counts
-    return profile_vs_consensus(pairs, counts), counts
-
-
-def estimate_profile_two_pass(refined: list[RefinedOverlap],
-                              windows: list[WindowSegments],
-                              cfg: ConsensusConfig,
-                              sample: int = 48) -> ErrorProfile:
-    """Profile-only form of :func:`estimate_profile_and_offsets`."""
-    return estimate_profile_and_offsets(refined, windows, cfg, sample)[0]
+        return rough
+    return profile_vs_consensus(pairs)
 
 
 def solve_window(ws: WindowSegments, ol_tables: dict[int, OffsetLikely],

@@ -56,8 +56,9 @@ class ErrorProfile:
 
     @classmethod
     def load(cls, path: str) -> "ErrorProfile":
-        """Rates-only view of an eprof file (either version); use
-        :func:`load_eprof` to also get a v2 file's empirical OL counts."""
+        """Read an eprof file. v2 files (the retired empirical-OL format,
+        which also carried offset counts) still load — the counts are
+        ignored; see the retirement note on :class:`OffsetLikely`."""
         import json
 
         with open(path, "rt") as fh:
@@ -66,40 +67,6 @@ class ErrorProfile:
             raise ValueError(f"{path}: not a daccord-tpu error-profile file")
         return cls(p_ins=float(d["p_ins"]), p_del=float(d["p_del"]),
                    p_sub=float(d["p_sub"]))
-
-
-def save_eprof(path: str, profile: ErrorProfile,
-               offset_counts: np.ndarray | None = None) -> None:
-    """Persist an estimation result: profile + (optionally) the empirical
-    OL offset counts, so a cached-eprof run (and every ``-J`` shard sharing
-    the file) blends the SAME tables as the run that estimated it. Atomic
-    like ``ErrorProfile.save``."""
-    import json
-    import os
-
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wt") as fh:
-        json.dump({"format": "daccord-tpu-eprof-v2", "p_ins": profile.p_ins,
-                   "p_del": profile.p_del, "p_sub": profile.p_sub,
-                   "ol_counts": (offset_counts.tolist()
-                                 if offset_counts is not None else None)}, fh)
-        fh.write("\n")
-    os.replace(tmp, path)
-
-
-def load_eprof(path: str) -> tuple[ErrorProfile, np.ndarray | None]:
-    """Read a v2 eprof (profile, counts) — or a v1 file as (profile, None)."""
-    import json
-
-    with open(path, "rt") as fh:
-        d = json.load(fh)
-    if d.get("format") not in ("daccord-tpu-eprof-v1", "daccord-tpu-eprof-v2"):
-        raise ValueError(f"{path}: not a daccord-tpu error-profile file")
-    prof = ErrorProfile(p_ins=float(d["p_ins"]), p_del=float(d["p_del"]),
-                        p_sub=float(d["p_sub"]))
-    counts = d.get("ol_counts")
-    return prof, (np.asarray(counts, dtype=np.float64)
-                  if counts is not None else None)
 
 
 def estimate_profile(refined: list[RefinedOverlap], a_len_total: int | None = None) -> ErrorProfile:
@@ -150,21 +117,14 @@ def rough_profile(refined: list[RefinedOverlap]) -> ErrorProfile:
     return ErrorProfile(p_ins=0.55 * e, p_del=0.30 * e, p_sub=0.15 * e)
 
 
-def profile_vs_consensus(pairs: list[tuple[np.ndarray, np.ndarray]],
-                         offset_counts: np.ndarray | None = None) -> ErrorProfile:
+def profile_vs_consensus(
+        pairs: list[tuple[np.ndarray, np.ndarray]]) -> ErrorProfile:
     """Second-pass profile: ops of (segment vs consensus) alignments.
 
     Each pair is (consensus, segment); the consensus stands in for the truth,
     so op counts give the *single-read* error process directly: a consensus
     base consuming 0 segment bases is a deletion, 2+ an insertion run, and a
     mismatching 1-step a substitution.
-
-    ``offset_counts`` (optional, shape [P, O]) accumulates the *empirical*
-    offset distribution from the same alignments: consensus position ``p``
-    realized at segment offset ``c2s[p]`` bumps ``offset_counts[p, c2s[p]]``.
-    These are exactly the samples OffsetLikely models analytically — the
-    reference derives its tables from per-window error statistics of the
-    estimation pass (SURVEY.md:160) rather than a closed-form convolution.
     """
     from .align import align_path  # local import to avoid cycle at module load
 
@@ -181,12 +141,6 @@ def profile_vs_consensus(pairs: list[tuple[np.ndarray, np.ndarray]],
             idx = np.nonzero(one)[0]
             n_sub += int(np.sum(cons[idx] != seg[c2s[idx]]))
         n_pos += len(steps)
-        if offset_counts is not None:
-            P, O = offset_counts.shape
-            n = min(len(cons), P)
-            off = c2s[:n]
-            ok = off < O
-            np.add.at(offset_counts, (np.arange(n)[ok], off[ok]), 1)
     if n_pos == 0:
         return ErrorProfile(0.08, 0.04, 0.015)
     i_o, d_o, s_o = n_ins / n_pos, n_del / n_pos, n_sub / n_pos
@@ -210,19 +164,21 @@ def profile_vs_consensus(pairs: list[tuple[np.ndarray, np.ndarray]],
 
 
 class OffsetLikely:
-    """OL[p, o] tables for p in [0, P) and o in [0, O).
+    """OL[p, o] tables for p in [0, P) and o in [0, O), analytic convolution.
 
-    With ``counts`` (empirical [P', O'] offset samples from the estimation
-    pass, see :func:`profile_vs_consensus`), each position row blends the
-    measured distribution with the analytic convolution as a pseudo-count
-    prior: ``(counts[p] + m0 * analytic[p]) / (n[p] + m0)``. Well-sampled
-    rows are dominated by the data (the reference's per-window empirical
-    statistics, SURVEY.md:160); thin rows fall back smoothly to the model.
+    RETIRED (r4): the empirical-OL blend — mixing measured offset counts
+    from the estimation pass into these tables as a pseudo-count prior —
+    was measured slightly NEGATIVE in 7/8 mismatch regimes at the
+    production sample (r3) and still <= the analytic tables at 4/48/256
+    piles (r4 eolprobe: −0.08/−0.32/−0.22 Q vs off). The sampling noise
+    hypothesis did not hold at large samples, so the blend and its
+    plumbing (offset-count collection, eprof-v2 counts, per-config
+    offset_counts threading) were deleted per VERDICT r3 item 9; this
+    docstring and BASELINE.md r3/r4 are the record.
     """
 
     def __init__(self, profile: ErrorProfile, positions: int, max_offset: int,
-                 ins_tail: int = 6, counts: np.ndarray | None = None,
-                 pseudo_count: float = 20.0):
+                 ins_tail: int = 6):
         self.profile = profile
         self.P = positions
         self.O = max_offset
@@ -246,13 +202,6 @@ class OffsetLikely:
             if s > 0:
                 cur = cur / s
             ol[p] = cur
-        if counts is not None and counts.size:
-            cP = min(positions, counts.shape[0])
-            cO = min(max_offset, counts.shape[1])
-            c = np.zeros((positions, max_offset), dtype=np.float64)
-            c[:cP, :cO] = counts[:cP, :cO]
-            n = c.sum(axis=1, keepdims=True)
-            ol = (c + pseudo_count * ol) / (n + pseudo_count)
         self.table = ol.astype(np.float32)
 
     def weights(self, occ: np.ndarray) -> np.ndarray:

@@ -145,22 +145,21 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
     # the error profile is estimated ONCE (from the shard's own start) and
     # persisted, so a resumed run reproduces the uninterrupted run's output
     # byte-for-byte rather than re-estimating from the resume point
-    import numpy as np
-
-    ol_counts = None
     if prog is not None and "profile" in prog:
-        profile = ErrorProfile(*prog["profile"])
         if prog.get("ol_counts") is not None:
-            ol_counts = np.asarray(prog["ol_counts"], dtype=np.float64)
-    elif cfg.empirical_ol:
-        # counts persist with the profile: a resumed run must blend the SAME
-        # empirical OL tables or its output would not be byte-identical
-        profile, ol_counts = estimate_profile_for_shard(db, las, cfg, start,
-                                                        end, collect_offsets=True)
+            # pre-r4 checkpoint written with the retired --empirical-ol
+            # blend: the emitted head used blended OL tables this code can
+            # no longer reproduce, so resuming would splice analytically-
+            # corrected tail onto a blended head — refuse rather than emit
+            # a silently mixed FASTA (rerun the shard with --force)
+            raise SystemExit(
+                f"shard {shard}: checkpoint was written by a pre-r4 run "
+                "with --empirical-ol (retired); a resume cannot reproduce "
+                "its tables — rerun the shard with --force")
+        profile = ErrorProfile(*prog["profile"])
     else:
         profile = estimate_profile_for_shard(db, las, cfg, start, end)
     prof_row = [float(profile.p_ins), float(profile.p_del), float(profile.p_sub)]
-    counts_row = ol_counts.tolist() if ol_counts is not None else None
     counters = dict(base)
     # truncate any partial tail past the last checkpoint, then append
     mode = "r+t" if emitted else "wt"
@@ -169,8 +168,7 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
         out.seek(fasta_bytes)
         since = 0
         for rid, frags, st in correct_shard(db, las, cfg, resume_off, end,
-                                            profile=profile,
-                                            offset_counts=ol_counts):
+                                            profile=profile):
             write_fasta(out, [FastaRecord(f"read{rid}/{fi}", ints_to_seq(f))
                               for fi, f in enumerate(frags)])
             emitted += 1
@@ -187,7 +185,6 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
                 with open(tmp, "wt") as fh:
                     json.dump({"emitted": emitted, "fasta_bytes": out.tell(),
                                "counters": counters, "profile": prof_row,
-                               "ol_counts": counts_row,
                                "byte_range": [start, end]}, fh)
                 os.replace(tmp, paths["progress"])
                 since = 0

@@ -156,13 +156,10 @@ def make_sharded_solver(ladder: TierLadder, mesh: Mesh, esc_cap: int | None = No
 def build_sharded_solver(n_devices: int, profile, consensus_cfg,
                          esc_cap: int | None = None,
                          use_pallas: bool = False,
-                         offset_counts=None,
                          max_kmers: int = 64,
                          rescue_max_kmers: int = 256,
                          overflow_rescue: bool = False) -> ShardedLadderSolver:
-    """Device-count-checked mesh solver from an error profile (plus the
-    estimation pass's empirical OL counts, when collected — the mesh path
-    must blend the same tables as the single-device path).
+    """Device-count-checked mesh solver from an error profile.
 
     The one construction path shared by the ``daccord --mesh`` CLI and the
     ladder bench; raises ``SystemExit`` with the off-pod recipe when fewer
@@ -177,7 +174,6 @@ def build_sharded_solver(n_devices: int, profile, consensus_cfg,
     ladder = TierLadder.from_config(profile, consensus_cfg,
                                     max_kmers=max_kmers,
                                     rescue_max_kmers=rescue_max_kmers,
-                                    offset_counts=offset_counts,
                                     overflow_rescue=overflow_rescue)
     interpret = use_pallas and pallas_needs_interpret()
     return make_sharded_solver(ladder, make_mesh(n_devices), esc_cap,

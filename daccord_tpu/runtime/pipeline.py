@@ -120,19 +120,9 @@ class PipelineConfig:
                                  # Pallas TPU kernel (pallas_dp); bit-identical
                                  # results (tests/test_pallas.py), TPU only —
                                  # ignored on the CPU solve_tiered path
-    empirical_ol: bool = False   # blend the estimation pass's measured
-                                 # per-position offset distributions into the
-                                 # OffsetLikely tables (reference: tables come
-                                 # from per-window error stats, SURVEY.md:160);
-                                 # off = pure analytic convolution. Default
-                                 # FLIPPED OFF in r3: the blend measured
-                                 # -0.04..-0.52 Q in 7/8 mismatch regimes and
-                                 # the variance probe showed more empirical
-                                 # weight scoring strictly worse (BASELINE.md
-                                 # r3) — the 4-pile x 32-window sample's noise
-                                 # outweighs any model correction at every
-                                 # scale tested. Re-enable via --empirical-ol
-                                 # for runs with a much larger profile sample
+    # (empirical-OL blending RETIRED in r4: measured <= analytic tables at
+    # every sample size up to all piles — see OffsetLikely's docstring and
+    # BASELINE.md r3/r4 for the record)
     end_trim: bool = True        # treat prefix/suffix runs of windows solved
                                  # only by a low-confidence rescue tier
                                  # (min_count<=1) as unsolved: read ends have
@@ -351,13 +341,12 @@ def _strided_pile_ranges(las: LasFile, n: int, start: int | None,
 
 
 def estimate_profile_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
-                               start: int | None = None, end: int | None = None,
-                               collect_offsets: bool = False):
+                               start: int | None = None,
+                               end: int | None = None) -> ErrorProfile:
     """Profile pass over ``cfg.profile_sample_piles`` piles strided across the
     shard (oracle path: the sample is tiny and this doubles as a continuous
-    cross-check of the native path). With ``collect_offsets``, also returns
-    the empirical offset counts for the OffsetLikely tables."""
-    from ..oracle.consensus import estimate_profile_and_offsets
+    cross-check of the native path)."""
+    from ..oracle.consensus import estimate_profile_two_pass
 
     refined_all = []
     windows_all: list[WindowSegments] = []
@@ -371,9 +360,8 @@ def estimate_profile_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             windows_all.extend(cut_windows(a_bases, refined, w=cfg.consensus.w,
                                            adv=cfg.consensus.adv))
             break   # one pile per strided range
-    prof, counts = estimate_profile_and_offsets(refined_all, windows_all,
-                                                cfg.consensus, sample=32)
-    return (prof, counts) if collect_offsets else prof
+    return estimate_profile_two_pass(refined_all, windows_all, cfg.consensus,
+                                     sample=32)
 
 
 def _window_one_pile(db: DazzDB, col, cfg: PipelineConfig, aread: int, s: int, e: int,
@@ -478,14 +466,12 @@ def _iter_pile_blocks_threaded(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                   start: int | None = None, end: int | None = None,
                   profile: ErrorProfile | None = None,
-                  offset_counts: np.ndarray | None = None,
                   solver=None):
     """Correct every pile in the byte range; yields (aread, fragments, stats).
 
     ``solver`` maps a WindowBatch to a solve_tiered-style output dict; defaults
     to the local single-device ladder. The parallel backend passes the
-    mesh-sharded one. Callers that pre-estimate ``profile`` pass the matching
-    empirical ``offset_counts`` alongside (or None for analytic tables).
+    mesh-sharded one.
     """
     stats = PipelineStats()
     t_start = time.time()
@@ -503,13 +489,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             cfg = dataclasses.replace(
                 cfg, batch_size=2048 if jax.default_backend() == "tpu" else 512)
     if profile is None:
-        if cfg.empirical_ol:
-            profile, offset_counts = estimate_profile_for_shard(
-                db, las, cfg, start, end, collect_offsets=True)
-        else:
-            profile = estimate_profile_for_shard(db, las, cfg, start, end)
-    if not cfg.empirical_ol:
-        offset_counts = None
+        profile = estimate_profile_for_shard(db, las, cfg, start, end)
     ladder = None
     if not (solver is None and cfg.native_solver):
         # the native C++ solver builds its own OffsetLikely tables from the
@@ -518,7 +498,6 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         ladder = TierLadder.from_config(profile, cfg.consensus,
                                         max_kmers=cfg.max_kmers,
                                         rescue_max_kmers=cfg.rescue_max_kmers,
-                                        offset_counts=offset_counts,
                                         overflow_rescue=cfg.overflow_rescue)
     from ..utils.obs import JsonlLogger
 
@@ -533,8 +512,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         if not _nat_avail():
             raise SystemExit("--backend native: native library unavailable "
                              "(g++ build failed?)")
-        ols = make_offset_likely(profile, cfg.consensus,
-                                 offset_counts=offset_counts)
+        ols = make_offset_likely(profile, cfg.consensus)
         nt = cfg.native_threads if cfg.native_threads > 0 else (
             os.cpu_count() or 1)
         # tables packed ONCE; thousands of per-batch calls share them
@@ -620,8 +598,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         else:
             from ..oracle.consensus import make_offset_likely
 
-            hp_ols = make_offset_likely(profile, cfg.consensus,
-                                        offset_counts=offset_counts)
+            hp_ols = make_offset_likely(profile, cfg.consensus)
 
     try:
         from ..native import available as native_available
@@ -926,12 +903,10 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 def correct_to_fasta(db_path: str, las_path: str, out_path, cfg: PipelineConfig | None = None,
                      start: int | None = None, end: int | None = None,
                      profile: ErrorProfile | None = None,
-                     offset_counts: np.ndarray | None = None,
                      solver=None) -> PipelineStats:
     """Run the pipeline and write corrected fragments as FASTA (stdout with '-').
 
     ``profile`` skips the estimation pass (reference: cached error profile);
-    ``offset_counts`` carries the matching empirical OL samples, if any.
     ``solver`` overrides the window solver (e.g. the mesh-sharded ladder)."""
     cfg = cfg or PipelineConfig()
     db = read_db(db_path)
@@ -940,7 +915,6 @@ def correct_to_fasta(db_path: str, las_path: str, out_path, cfg: PipelineConfig 
     stats: PipelineStats | None = None
     recs = []
     for rid, frags, st in correct_shard(db, las, cfg, start, end, profile=profile,
-                                        offset_counts=offset_counts,
                                         solver=solver):
         stats = st
         for fi, f in enumerate(frags):

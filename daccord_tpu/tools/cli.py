@@ -105,19 +105,10 @@ def daccord_main(argv=None) -> int:
                         "daccord loads the computeintrinsicqv track). "
                         "Missing track falls back to trace-diff ranking; "
                         "'' disables")
-    p.add_argument("--empirical-ol", action="store_true",
-                   help="blend the estimation pass's measured offset "
-                        "distributions into the OffsetLikely tables. Default "
-                        "off since r3: measured -0.04..-0.52 Q in 7/8 "
-                        "mismatch regimes at the default 4-pile sample "
-                        "(BASELINE.md r3); consider together with a larger "
-                        "--profile-sample")
     p.add_argument("--profile-sample", type=int, default=None, metavar="N",
                    help="piles sampled by the error-profile estimation pass "
                         "(default 4 — measured sufficient, 0.08 Q spread; "
                         "BASELINE.md r3 variance probe)")
-    p.add_argument("--no-empirical-ol", action="store_true",
-                   help=argparse.SUPPRESS)   # pre-r3 compat; off is default
     p.add_argument("--no-end-trim", action="store_true",
                    help="keep rescue-tier solutions at read ends (default: "
                         "trim them — thin end-of-read piles solved with the "
@@ -197,8 +188,6 @@ def daccord_main(argv=None) -> int:
                          feeder_threads=args.threads, use_pallas=args.pallas,
                          end_trim=not args.no_end_trim,
                          qv_track=args.qv_track or None,
-                         empirical_ol=args.empirical_ol
-                                      and not args.no_empirical_ol,
                          profile_sample_piles=(
                              args.profile_sample
                              if args.profile_sample is not None
@@ -209,15 +198,11 @@ def daccord_main(argv=None) -> int:
 
     import os
 
-    from ..oracle.profile import load_eprof, save_eprof
+    from ..oracle.profile import ErrorProfile
 
     prof = None
-    ol_counts = None
     if args.eprof and os.path.exists(args.eprof) and not args.eprof_only:
-        # v2 eprof files carry the empirical OL counts, so cached runs (and
-        # every -J shard sharing the file) blend the same tables the
-        # estimating run did; v1 files load as analytic
-        prof, ol_counts = load_eprof(args.eprof)
+        prof = ErrorProfile.load(args.eprof)
     elif args.eprof or args.eprof_only:
         if not args.eprof:
             raise SystemExit("--eprof-only requires -E/--eprof PATH")
@@ -225,40 +210,26 @@ def daccord_main(argv=None) -> int:
 
         # opens db/las a second time (correct_to_fasta reopens from paths);
         # that is one extra index parse — noise next to the estimation pass
-        prof, ol_counts = estimate_profile_for_shard(
-            read_db(args.db), LasFile(args.las), cfg, start, end,
-            collect_offsets=True)
-        save_eprof(args.eprof, prof, ol_counts)
+        prof = estimate_profile_for_shard(read_db(args.db), LasFile(args.las),
+                                          cfg, start, end)
+        prof.save(args.eprof)
         if args.eprof_only:
             print(json.dumps({"eprof": args.eprof, "p_ins": prof.p_ins,
                               "p_del": prof.p_del, "p_sub": prof.p_sub}),
                   file=sys.stderr)
             return 0
 
-    if not cfg.empirical_ol:
-        # opt-out must bind every consumer (mesh solver included), not just
-        # correct_shard's internal gate
-        ol_counts = None
     solver = None
     if args.mesh > 1:
         from ..parallel.mesh import build_sharded_solver
         from ..runtime.pipeline import estimate_profile_for_shard
 
         if prof is None:
-            # collect the empirical OL counts here too, or the mesh path
-            # would silently solve with analytic-only tables while the
-            # single-device path blends (same flags, different quality)
-            if cfg.empirical_ol:
-                prof, ol_counts = estimate_profile_for_shard(
-                    read_db(args.db), LasFile(args.las), cfg, start, end,
-                    collect_offsets=True)
-            else:
-                prof = estimate_profile_for_shard(read_db(args.db),
-                                                  LasFile(args.las), cfg,
-                                                  start, end)
+            prof = estimate_profile_for_shard(read_db(args.db),
+                                              LasFile(args.las), cfg,
+                                              start, end)
         solver = build_sharded_solver(args.mesh, prof, cfg.consensus,
                                       use_pallas=args.pallas,
-                                      offset_counts=ol_counts,
                                       max_kmers=cfg.max_kmers,
                                       rescue_max_kmers=cfg.rescue_max_kmers,
                                       overflow_rescue=cfg.overflow_rescue)
@@ -268,12 +239,10 @@ def daccord_main(argv=None) -> int:
 
         with jax.profiler.trace(args.profile):
             stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
-                                     end=end, profile=prof,
-                                     offset_counts=ol_counts, solver=solver)
+                                     end=end, profile=prof, solver=solver)
     else:
         stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
-                                 end=end, profile=prof,
-                                 offset_counts=ol_counts, solver=solver)
+                                 end=end, profile=prof, solver=solver)
     line = {
         "reads": stats.n_reads, "windows": stats.n_windows, "solved": stats.n_solved,
         "skipped_shallow": stats.n_skipped_shallow, "qv_ranked": stats.qv_ranked,
@@ -728,9 +697,6 @@ def shard_main(argv=None) -> int:
     p.add_argument("--checkpoint-every", type=int, default=64,
                    help="checkpoint progress every N emitted reads (0 = off)")
     p.add_argument("--force", action="store_true", help="recompute even if manifest exists")
-    p.add_argument("--empirical-ol", action="store_true",
-                   help="blend measured offset distributions into the OL "
-                        "tables (default off since r3, see daccord --help)")
     p.add_argument("--profile-sample", type=int, default=None, metavar="N",
                    help="piles sampled by the profile estimation pass")
     p.add_argument("--backend", choices=("auto", "cpu", "tpu"), default="auto")
@@ -747,8 +713,7 @@ def shard_main(argv=None) -> int:
         raise SystemExit(f"bad -J {args.J}")
     from ..parallel.launch import run_shard
 
-    scfg = PipelineConfig(batch_size=args.batch,
-                          empirical_ol=args.empirical_ol)
+    scfg = PipelineConfig(batch_size=args.batch)
     if args.profile_sample is not None:
         scfg.profile_sample_piles = args.profile_sample
     m = run_shard(args.db, args.las, args.outdir, i, n, scfg,

@@ -42,7 +42,7 @@ def main(argv=None) -> int:
         he, hmr = arm.split(":")
         ccfg = ConsensusConfig(hp_rescue=True, hp_err=float(he),
                                hp_min_run=int(hmr))
-        cfg = PipelineConfig(empirical_ol=False, consensus=ccfg)
+        cfg = PipelineConfig(consensus=ccfg)
         out_fa = os.path.join(d, f"corr_hp_{he}_{hmr}.fasta")
         t0 = time.perf_counter()
         stats = correct_to_fasta(paths["db"], paths["las"], out_fa, cfg,

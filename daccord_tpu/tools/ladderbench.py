@@ -107,20 +107,16 @@ def run_rung(name: str, sim_kw: dict, feeder_threads: int = 0,
     from daccord_tpu.formats.las import LasFile
     from daccord_tpu.runtime.pipeline import estimate_profile_for_shard
 
-    prof, counts = estimate_profile_for_shard(read_db(paths["db"]),
-                                              LasFile(paths["las"]), cfg,
-                                              collect_offsets=True)
-    if not cfg.empirical_ol:
-        counts = None
+    prof = estimate_profile_for_shard(read_db(paths["db"]),
+                                      LasFile(paths["las"]), cfg)
     solver = None
     if mesh > 1:
         from daccord_tpu.parallel.mesh import build_sharded_solver
 
-        solver = build_sharded_solver(mesh, prof, cfg.consensus,
-                                      offset_counts=counts)
+        solver = build_sharded_solver(mesh, prof, cfg.consensus)
     t0 = time.perf_counter()
     stats = correct_to_fasta(paths["db"], paths["las"], out_fa, cfg,
-                             profile=prof, offset_counts=counts, solver=solver)
+                             profile=prof, solver=solver)
     wall = time.perf_counter() - t0
 
     q = _qveval(out_fa, paths["truth"], paths["db"])

@@ -5,11 +5,12 @@ generative model — the same iid ins/del/sub family the error-profile estimator
 and the OffsetLikely tables assume. In a sealed environment (no real sequencer
 data, SURVEY.md §4 item 5), the strongest available robustness evidence is a
 *mis-specified* simulator: generate with processes the model does not contain,
-then measure how far consensus quality and solve rate degrade, and whether
-empirical-OL blending (the measured offset counts mixed into the analytic
-tables, `oracle/profile.py`) helps or hurts under mismatch.
+then measure how far consensus quality and solve rate degrade. (The
+empirical-OL on/off arms this bench originally carried are gone with the
+feature — retired in r4 after measuring <= the analytic tables at every
+sample size; BASELINE.md r3/r4.)
 
-Regimes (one row each; every row runs TWO arms: empirical-OL on / off):
+Regimes (one row each; ``--hp`` adds an ``--hp-rescue`` arm):
 
   base     clean PacBio-like control (the estimator's own model)
   hp       homopolymer-length-dependent indels (ONT's signature failure)
@@ -64,36 +65,33 @@ def run_regime(name: str, sim_kw: dict, hp_arm: bool = False) -> dict:
     paths = _dataset(f"mm_{name}", **sim_kw)
     d = os.path.dirname(paths["db"])
     cfg = PipelineConfig()
-    prof, counts = estimate_profile_for_shard(read_db(paths["db"]),
-                                              LasFile(paths["las"]), cfg,
-                                              collect_offsets=True)
+    prof = estimate_profile_for_shard(read_db(paths["db"]),
+                                      LasFile(paths["las"]), cfg)
     row: dict = {"regime": name, "p_ins": round(prof.p_ins, 4),
                  "p_del": round(prof.p_del, 4), "p_sub": round(prof.p_sub, 4)}
     t0 = time.perf_counter()
-    arms = [("eol", True, False), ("noeol", False, False)]
+    arms = [("std", False)]
     if hp_arm:
-        # homopolymer rescue arm (oracle/hp.py), on top of the noeol config
-        arms.append(("hp", False, True))
-    for arm, use_eol, use_hp in arms:
+        arms.append(("hp", True))   # homopolymer rescue arm (oracle/hp.py)
+    for arm, use_hp in arms:
         from daccord_tpu.oracle.consensus import ConsensusConfig
 
-        acfg = PipelineConfig(empirical_ol=use_eol,
-                              consensus=ConsensusConfig(hp_rescue=use_hp))
+        acfg = PipelineConfig(consensus=ConsensusConfig(hp_rescue=use_hp))
         out_fa = os.path.join(d, f"corr_{arm}.fasta")
         stats = correct_to_fasta(paths["db"], paths["las"], out_fa, acfg,
-                                 profile=prof,
-                                 offset_counts=counts if use_eol else None)
-        q = _qveval(out_fa, paths["truth"], paths["db"] if arm == "eol" else None)
+                                 profile=prof)
+        q = _qveval(out_fa, paths["truth"], paths["db"] if arm == "std" else None)
         row[f"q_{arm}"] = q.get("qscore")
         row[f"errors_{arm}"] = q.get("errors")
         row[f"solve_{arm}"] = round(stats.n_solved / max(stats.n_windows, 1), 4)
         if use_hp:
             row["hp_rescued"] = stats.n_hp_rescued
-        if arm == "eol":
+        if arm == "std":
             row["q_raw"] = q.get("raw_qscore")
             row["windows"] = stats.n_windows
     row["wall_s"] = round(time.perf_counter() - t0, 1)
-    row["delta_q_eol"] = round((row["q_eol"] or 0) - (row["q_noeol"] or 0), 2)
+    if hp_arm:
+        row["delta_q_hp"] = round((row["q_hp"] or 0) - (row["q_std"] or 0), 2)
     return row
 
 

@@ -2,9 +2,10 @@
 
 The error-profile pass samples ``profile_sample_piles`` piles strided across
 the shard (``runtime/pipeline.py _strided_pile_ranges``) and 32 windows per
-pile for the second (OffsetLikely/empirical-OL) pass. The production default
-is 4 piles — a thin sample whose variance had never been measured (VERDICT r2
-weak #4). This probe runs the full pipeline with the profile estimated from
+pile for the second pass (single-read rates vs a sample consensus). The
+production default is 4 piles — a thin sample whose variance had never been
+measured (VERDICT r2 weak #4). This probe runs the full pipeline with the
+profile estimated from
 
   - sample sizes ``--piles`` (default 2,4,16,48), and
   - for the default size, several disjoint sample offsets
@@ -42,18 +43,15 @@ def run_cell(paths: dict, n_piles: int, offset: int) -> dict:
     # (tables interact with which k-mers survive the cap), and a verdict
     # measured under a different engine could lock in an undersized default
     cfg = PipelineConfig(profile_sample_piles=n_piles,
-                         profile_sample_offset=offset,
-                         empirical_ol=True)   # the probe measures the blend;
-                                              # r3 flipped the global default off
+                         profile_sample_offset=offset)
     t0 = time.perf_counter()
-    prof, counts = estimate_profile_for_shard(read_db(paths["db"]),
-                                              LasFile(paths["las"]), cfg,
-                                              collect_offsets=True)
+    prof = estimate_profile_for_shard(read_db(paths["db"]),
+                                      LasFile(paths["las"]), cfg)
     est_s = time.perf_counter() - t0
     out_fa = os.path.join(os.path.dirname(paths["db"]),
                           f"pv_{n_piles}_{offset}.fasta")
     stats = correct_to_fasta(paths["db"], paths["las"], out_fa, cfg,
-                             profile=prof, offset_counts=counts)
+                             profile=prof)
     q = _qveval(out_fa, paths["truth"], None)
     return {"piles": n_piles, "offset": offset,
             "p_ins": round(prof.p_ins, 4), "p_del": round(prof.p_del, 4),

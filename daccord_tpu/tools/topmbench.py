@@ -49,7 +49,7 @@ CELLS = [("M48", 48, False), ("M64", 64, False), ("M96", 96, False),
 
 
 def run_cell(paths: dict, label: str, max_kmers: int, rescue: bool,
-             prof=None, counts=None) -> dict:
+             prof=None) -> dict:
     from daccord_tpu.formats.dazzdb import read_db
     from daccord_tpu.formats.las import LasFile
     from daccord_tpu.runtime.pipeline import (PipelineConfig, correct_to_fasta,
@@ -59,14 +59,13 @@ def run_cell(paths: dict, label: str, max_kmers: int, rescue: bool,
     if prof is None:
         # estimation is cap-independent; callers sweeping cells on one
         # dataset estimate once and pass it in
-        prof, counts = estimate_profile_for_shard(read_db(paths["db"]),
-                                                  LasFile(paths["las"]), cfg,
-                                                  collect_offsets=True)
+        prof = estimate_profile_for_shard(read_db(paths["db"]),
+                                          LasFile(paths["las"]), cfg)
     out_fa = os.path.join(os.path.dirname(paths["db"]),
                           f"tm_{label.replace('+', '_')}.fasta")
     t0 = time.perf_counter()
     stats = correct_to_fasta(paths["db"], paths["las"], out_fa, cfg,
-                             profile=prof, offset_counts=counts)
+                             profile=prof)
     wall = time.perf_counter() - t0
     q = _qveval(out_fa, paths["truth"], None)
     return {"cell": label, "max_kmers": max_kmers, "rescue": rescue,
@@ -96,14 +95,13 @@ def main(argv=None) -> int:
 
     for name in args.regimes.split(","):
         paths = _dataset(f"tm_{name}", **REGIMES[name])
-        prof, counts = estimate_profile_for_shard(
-            read_db(paths["db"]), LasFile(paths["las"]), PipelineConfig(),
-            collect_offsets=True)
+        prof = estimate_profile_for_shard(
+            read_db(paths["db"]), LasFile(paths["las"]), PipelineConfig())
         for label, mk, rescue in CELLS:
             if label not in want:
                 continue
             row = {"regime": name,
-                   **run_cell(paths, label, mk, rescue, prof, counts)}
+                   **run_cell(paths, label, mk, rescue, prof)}
             print(json.dumps(row), flush=True)
             if args.out:
                 with open(args.out, "at") as fh:

@@ -171,40 +171,3 @@ def test_profile_decollapse_accuracy(pile_fixture):
     assert prof.p_sub < 2.0 * cfg.p_sub
 
 
-def test_offset_likely_empirical_blend():
-    """Rows with many samples follow the data; unsampled rows stay analytic."""
-    from daccord_tpu.oracle.profile import ErrorProfile, OffsetLikely
-
-    prof = ErrorProfile(p_ins=0.08, p_del=0.04, p_sub=0.015)
-    P, O = 8, 16
-    analytic = OffsetLikely(prof, positions=P, max_offset=O).table
-    counts = np.zeros((P, O))
-    # position 2: 1000 samples all at offset 5 (nothing like the model)
-    counts[2, 5] = 1000.0
-    blended = OffsetLikely(prof, positions=P, max_offset=O, counts=counts).table
-    assert blended[2, 5] > 0.97                      # data dominates
-    np.testing.assert_allclose(blended[3], analytic[3], rtol=1e-6)  # no samples
-    np.testing.assert_allclose(blended.sum(axis=1), 1.0, rtol=1e-5)
-
-    # a thin row (3 samples vs pseudo_count 20) stays close to the model
-    counts2 = np.zeros((P, O))
-    counts2[4, 9] = 3.0
-    thin = OffsetLikely(prof, positions=P, max_offset=O, counts=counts2).table
-    assert abs(float(thin[4, 9] - analytic[4, 9])) < 3.0 / 23.0 + 1e-6
-
-
-def test_estimate_profile_and_offsets(pile_fixture):
-    """The estimation pass yields offset counts whose early-position mass
-    sits near the diagonal (offset ~= position +- drift)."""
-    from daccord_tpu.oracle.consensus import estimate_profile_and_offsets
-
-    _, _, _, a, refined = pile_fixture
-    ccfg = ConsensusConfig()
-    windows = cut_windows(a, refined, w=ccfg.w, adv=ccfg.adv)
-    prof, counts = estimate_profile_and_offsets(refined, windows, ccfg, sample=16)
-    assert counts.shape == (ccfg.w + ccfg.dbg.len_slack, ccfg.w + 16)
-    assert counts.sum() > 100           # real samples were collected
-    assert counts[0].argmax() == 0      # position 0 realizes at offset 0
-    # position 10's distribution centers within a few bases of offset 10
-    p10 = counts[10]
-    assert abs(int(p10.argmax()) - 10) <= 3

@@ -281,37 +281,6 @@ def test_qv_ranked_pipeline_native_parity(dataset):
     assert s_off.n_solved > 0
 
 
-def test_empirical_ol_ab(dataset):
-    """Empirical OffsetLikely blending must not degrade correction quality
-    (it should match or beat the analytic tables on well-sampled data)."""
-    out, d = dataset
-    res = out["result"]
-    f_emp = os.path.join(d, "emp.fasta")
-    f_ana = os.path.join(d, "ana.fasta")
-    correct_to_fasta(out["db"], out["las"], f_emp,
-                     PipelineConfig(batch_size=256, empirical_ol=True))
-    correct_to_fasta(out["db"], out["las"], f_ana,
-                     PipelineConfig(batch_size=256, empirical_ol=False))
-
-    def err_rate(path):
-        tot_e = tot_l = 0
-        for rec in read_fasta(path):
-            rid = int(rec.name[4:].split("/")[0])
-            r = res.reads[rid]
-            truth = res.genome[r.start : r.end]
-            if r.strand == 1:
-                truth = revcomp_ints(truth)
-            f = seq_to_ints(rec.seq)
-            tot_e += infix_distance(f, truth)
-            tot_l += len(f)
-        return tot_e / max(tot_l, 1)
-
-    e_emp, e_ana = err_rate(f_emp), err_rate(f_ana)
-    # both are strong corrections; empirical must not be meaningfully worse
-    assert e_emp < 0.02 and e_ana < 0.02
-    assert e_emp <= e_ana * 1.5 + 1e-4, (e_emp, e_ana)
-
-
 def test_depth_cap_excludes_cross_copy_segments():
     """In-pile repeat handling: when a repeat-inflated pile is deeper than
     the depth cap, quality-ranked capping (trace-diff rate, which carries
