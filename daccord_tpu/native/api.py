@@ -224,6 +224,51 @@ class NativeLadder:
         self.CL = cfg.w + d.len_slack
         self._d = d
 
+    def hp_rescue(self, batch, out: dict, n_threads: int = 1) -> int:
+        """In-engine homopolymer rescue (oracle/hp.py semantics, C++ — see
+        ``dazz_native.cpp hp_rescue_windows``): post-processes a ``solve``
+        result in place. Rescued rows may exceed CL, so ``out['cons']`` is
+        re-allocated at the hp width (2*w) with rescued rows overwritten;
+        ``cons_len``/``err``/``tier`` update in place (tier 29 = HP_TIER).
+        Returns the rescued count. Run AFTER any overflow-rescue pass so
+        ordering matches the python host pass."""
+        lib = load()
+        import ctypes
+
+        cfg = self.cfg
+        d = self._d
+        k0, minc0, eminc0 = cfg.tiers[0]
+        seqs = np.ascontiguousarray(batch.seqs, dtype=np.int8)
+        lens = np.ascontiguousarray(batch.lens, dtype=np.int32)
+        nsegs = np.ascontiguousarray(batch.nsegs, dtype=np.int32)
+        B, D, L = seqs.shape
+        CLH = 2 * cfg.w
+        hp_cons = np.full((B, CLH), 4, dtype=np.int8)
+        cons_in = np.ascontiguousarray(out["cons"], dtype=np.int8)
+        lib.hp_rescue_windows.restype = ctypes.c_int64
+        n = int(lib.hp_rescue_windows(
+            _ptr(seqs), _ptr(lens), _ptr(nsegs), B, D, L,
+            _ptr(self.tables), int(self.tier_P[0]), int(self.tier_O[0]),
+            int(k0), int(minc0), int(eminc0),
+            cfg.w, d.anchor_slack, d.end_slack, d.len_slack,
+            d.n_candidates, d.min_depth, ctypes.c_double(d.max_err),
+            ctypes.c_float(d.count_frac),
+            ctypes.c_double(cfg.hp_err), int(cfg.hp_min_run),
+            ctypes.c_double(cfg.hp_margin), int(n_threads),
+            _ptr(cons_in), int(cons_in.shape[1]),
+            _ptr(hp_cons), CLH,
+            _ptr(out["cons_len"]), _ptr(out["err"]), _ptr(out["tier"])))
+        if n < 0:
+            raise RuntimeError(f"hp_rescue_windows failed: {n}")
+        if n:
+            rescued = out["tier"] == 29
+            merged = np.full((B, max(CLH, cons_in.shape[1])), 4, dtype=np.int8)
+            merged[:, : cons_in.shape[1]] = cons_in
+            merged[rescued, :CLH] = hp_cons[rescued]
+            out["cons"] = merged
+            out["solved"] = out["tier"] >= 0
+        return n
+
     def with_caps(self, max_kmers: int, rescue_max_kmers: int = 256
                   ) -> "NativeLadder":
         """Caps-only variant sharing this ladder's packed tables (tier_M is

@@ -1208,4 +1208,182 @@ int solve_windows(const int8_t* seqs, const int32_t* lens,
   return 0;
 }
 
+// Homopolymer rescue post-pass over a solve_windows result (oracle/hp.py
+// semantics, bit-identical by construction — see tests). Routing per window:
+// failed or err > hp_err, with a run >= hp_min_run present (in the direct
+// consensus if solved, else in any segment). Solve: run-length-compress the
+// segments, run the FULL-GRAPH tier-0 DBG (M=0: the python path calls the
+// oracle window_consensus) at wlen_c = int(median(compressed lens)), then
+// re-expand each position's run length by the aligned median vote
+// (round-half-even, numpy/python parity). Accept only when the expanded
+// candidate's exact rescored error beats the direct result (hp_margin) or
+// clears max_err where the direct solve failed. Rescued rows write their
+// (possibly longer-than-CL) sequence into hp_cons[CLH] and update
+// cons_lens/errs in place with tiers_io = 29 (HP_TIER). Returns count
+// rescued.
+int64_t hp_rescue_windows(
+    const int8_t* seqs, const int32_t* lens, const int32_t* nsegs,
+    int32_t B, int32_t D, int32_t L,
+    const float* table0, int32_t P0, int32_t O0,
+    int32_t k0, int32_t minc0, int32_t eminc0,
+    int32_t wlen, int32_t anchor_slack, int32_t end_slack, int32_t len_slack,
+    int32_t n_candidates, int32_t min_depth, double max_err,
+    float count_frac,
+    double hp_err, int32_t hp_min_run, double hp_margin, int32_t n_threads,
+    const int8_t* cons_in, int32_t CL,
+    int8_t* hp_cons, int32_t CLH,
+    int32_t* cons_lens, float* errs, int32_t* tiers_io) {
+  const dbgc::TierSpec ts_hp = {k0, minc0, eminc0, P0, O0, 0, table0};
+  std::atomic<int32_t> next(0);
+  std::atomic<int64_t> rescued(0);
+  auto max_run_of = [](const int8_t* s, int n) {
+    int best = 0, run = 0;
+    for (int i = 0; i < n; ++i) {
+      run = (i > 0 && s[i] == s[i - 1]) ? run + 1 : 1;
+      if (run > best) best = run;
+    }
+    return best;
+  };
+  auto worker = [&]() {
+    dbgc::Scratch S;
+    std::vector<int8_t> cseqs((size_t)D * L);
+    std::vector<int32_t> clens(D), cruns((size_t)D * L), med_buf;
+    std::vector<int32_t> runs_out;
+    std::vector<int8_t> hcons, expanded;
+    std::vector<int64_t> a2b;
+    std::vector<int32_t> Dbuf_v;   // align_path / rescore DP matrix
+    std::vector<std::vector<int32_t>> pos_votes;
+    for (;;) {
+      const int b = next.fetch_add(1);
+      if (b >= B) return;
+      const int nseg = nsegs[b];
+      if (nseg < min_depth) continue;
+      const bool solved = tiers_io[b] >= 0;
+      // thresholds stay double end to end: the python host pass compares
+      // float64 config values, and a float32-narrowed 0.12 differs from
+      // float64 0.12 by enough to flip borderline routing decisions
+      const double derr = solved ? (double)errs[b]
+                                 : std::numeric_limits<double>::infinity();
+      if (solved && derr <= hp_err) continue;
+      const int8_t* wseqs = seqs + (size_t)b * D * L;
+      const int32_t* wlens = lens + (size_t)b * D;
+      // routing probe: a long run must exist for a vote to fix anything
+      int mrun = 0;
+      if (solved) {
+        mrun = max_run_of(cons_in + (size_t)b * CL, cons_lens[b]);
+      } else {
+        for (int j = 0; j < nseg && mrun < hp_min_run; ++j)
+          mrun = std::max(mrun, max_run_of(wseqs + (size_t)j * L, wlens[j]));
+      }
+      if (mrun < hp_min_run) continue;
+      // ---- run-length compress into the same [D, L] layout --------------
+      int64_t seg_total = 0;
+      for (int j = 0; j < nseg; ++j) {
+        const int8_t* s = wseqs + (size_t)j * L;
+        const int n = wlens[j];
+        seg_total += n;
+        int8_t* cs = cseqs.data() + (size_t)j * L;
+        int32_t* cr = cruns.data() + (size_t)j * L;
+        int m = 0;
+        for (int i = 0; i < n; ++i) {
+          if (m > 0 && s[i] == cs[m - 1]) {
+            ++cr[m - 1];
+          } else {
+            cs[m] = s[i];
+            cr[m] = 1;
+            ++m;
+          }
+        }
+        clens[j] = m;
+      }
+      // wlen_c = int(np.median(clens)): sorted middle, even -> mean then
+      // int() truncation toward zero
+      med_buf.assign(clens.begin(), clens.begin() + nseg);
+      std::sort(med_buf.begin(), med_buf.end());
+      const int mid = nseg / 2;
+      const int wlen_c =
+          (nseg & 1) ? med_buf[mid]
+                     : (int)((med_buf[mid - 1] + med_buf[mid]) / 2.0);
+      if (wlen_c < k0 + 4) continue;
+      // ---- full-graph DBG on the compressed subproblem -------------------
+      hcons.assign((size_t)wlen_c + len_slack, PAD);
+      int32_t hlen = 0;
+      float herr = 0.0f;
+      uint8_t hm = 0;
+      if (dbgc::try_tier(cseqs.data(), clens.data(), nseg, L, ts_hp, wlen_c,
+                         anchor_slack, end_slack, len_slack, n_candidates,
+                         (float)max_err, count_frac, S, hcons.data(), &hlen,
+                         &herr, &hm) != 0)
+        continue;
+      // ---- aligned per-position run-length vote --------------------------
+      pos_votes.assign(hlen, {});
+      a2b.resize(hlen + 1);
+      for (int j = 0; j < nseg; ++j) {
+        const int m = clens[j];
+        if (m == 0) continue;
+        align_path(hcons.data(), hlen, cseqs.data() + (size_t)j * L, m,
+                   Dbuf_v, a2b.data());
+        const int32_t* cr = cruns.data() + (size_t)j * L;
+        const int8_t* cs = cseqs.data() + (size_t)j * L;
+        for (int i = 0; i < hlen; ++i)
+          for (int64_t q = a2b[i]; q < a2b[i + 1]; ++q)
+            if (cs[q] == hcons[i]) pos_votes[i].push_back(cr[q]);
+      }
+      runs_out.assign(hlen, 1);
+      int64_t out_len = 0;
+      for (int i = 0; i < hlen; ++i) {
+        auto& v = pos_votes[i];   // sort in place: no per-position copies
+        if (!v.empty()) {
+          std::sort(v.begin(), v.end());
+          const int vm = (int)v.size() / 2;
+          const double med = (v.size() & 1) ? (double)v[vm]
+                                            : (v[vm - 1] + v[vm]) / 2.0;
+          // int(round(med)): python round() is half-to-even; nearbyint
+          // honors the default FE_TONEAREST (ties-to-even) mode
+          runs_out[i] = std::max(1, (int)std::nearbyint(med));
+        }
+        out_len += runs_out[i];
+      }
+      if (out_len < wlen / 2 || out_len > 2 * wlen || out_len > CLH)
+        continue;
+      expanded.resize(out_len);
+      {
+        int64_t w = 0;
+        for (int i = 0; i < hlen; ++i)
+          for (int r = 0; r < runs_out[i]; ++r) expanded[w++] = hcons[i];
+      }
+      // ---- exact rescore vs the ORIGINAL segments ------------------------
+      int64_t tot = 0;
+      for (int j = 0; j < nseg; ++j) {
+        const int m = wlens[j];
+        const int n = (int)out_len;
+        if (n == 0) { tot += m; continue; }
+        if (m == 0) { tot += n; continue; }
+        Dbuf_v.resize((size_t)(n + 1) * (m + 1));
+        tot += fill_exact(expanded.data(), n, wseqs + (size_t)j * L, m,
+                          Dbuf_v.data(), m + 1, 16);
+      }
+      const double err_hp =
+          (double)tot / (double)std::max<int64_t>(seg_total, 1);
+      const double bar = solved ? derr - hp_margin : max_err;
+      if (err_hp >= bar) continue;
+      int8_t* out_row = hp_cons + (size_t)b * CLH;
+      std::memset(out_row, PAD, CLH);
+      std::memcpy(out_row, expanded.data(), out_len);
+      cons_lens[b] = (int32_t)out_len;
+      errs[b] = (float)err_hp;
+      tiers_io[b] = 29;  // HP_TIER (oracle/hp.py)
+      rescued.fetch_add(1);
+    }
+  };
+  if (n_threads <= 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    for (int i = 0; i < n_threads; ++i) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+  return rescued.load();
+}
+
 }  // extern "C"

@@ -153,9 +153,9 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
             # corrected tail onto a blended head — refuse rather than emit
             # a silently mixed FASTA (rerun the shard with --force)
             raise SystemExit(
-                f"shard {shard}: checkpoint was written by a pre-r4 run "
-                "with --empirical-ol (retired); a resume cannot reproduce "
-                "its tables — rerun the shard with --force")
+                f"{paths['progress']}: checkpoint was written by a pre-r4 "
+                "run with --empirical-ol (retired); a resume cannot "
+                "reproduce its tables — rerun the shard with --force")
         profile = ErrorProfile(*prog["profile"])
     else:
         profile = estimate_profile_for_shard(db, las, cfg, start, end)

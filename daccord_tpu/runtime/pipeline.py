@@ -116,6 +116,10 @@ class PipelineConfig:
                                  # all fit go to a narrower batch — exact, like
                                  # depth buckets, but multiplies compile count;
                                  # off by default until measured on hardware
+    hp_native: bool = True       # --backend native runs the hp rescue in
+                                 # the C++ engine (hp_rescue_windows,
+                                 # oracle/hp.py parity by test); False forces
+                                 # the python host pass (the parity arm)
     use_pallas: bool = False     # route the heaviest-path DP through the
                                  # Pallas TPU kernel (pallas_dp); bit-identical
                                  # results (tests/test_pallas.py), TPU only —
@@ -548,6 +552,10 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     out[key][ti] = wide[key][take]
                 out["solved"][ti] = True
                 out["m_ovf"][ti] = wide["m_ovf"][take]
+            if cfg.consensus.hp_rescue and cfg.hp_native:
+                # in-engine hp rescue (C++, oracle/hp.py parity): runs after
+                # the overflow rescue, matching the host pass's ordering
+                stats.n_hp_rescued += nladder.hp_rescue(b, out, n_threads=nt)
             return out
 
         solver = _native_solver
@@ -594,7 +602,9 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         # engine's per-window err, so it needs host OffsetLikely tables even
         # when the solve runs on device
         if native_dispatch:
-            hp_ols = ols
+            # the C++ engine runs the rescue in-engine (NativeLadder
+            # .hp_rescue, bit-identical by test) unless hp_native is off
+            hp_ols = None if cfg.hp_native else ols
         else:
             from ..oracle.consensus import make_offset_likely
 

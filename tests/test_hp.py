@@ -130,3 +130,33 @@ def test_native_align_parity_random():
     finally:
         A._native_lib = orig
     assert s_nat == s_py
+
+
+def test_native_hp_rescue_parity(tmp_path):
+    """The C++ in-engine hp rescue (hp_rescue_windows) is byte-identical to
+    the python host pass on an hp-damaged sim, end to end."""
+    import os
+
+    from daccord_tpu.native import available
+
+    if not available():
+        pytest.skip("native host path unavailable")
+    from daccord_tpu.runtime.pipeline import PipelineConfig, correct_to_fasta
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path)
+    out = make_dataset(d, SimConfig(genome_len=4000, coverage=18,
+                                    read_len_mean=900, min_overlap=300,
+                                    hp_indel_slope=1.0, seed=31), name="hp")
+    f_cpp = os.path.join(d, "hp_cpp.fasta")
+    f_py = os.path.join(d, "hp_py.fasta")
+    ccfg = ConsensusConfig(hp_rescue=True)
+    s_cpp = correct_to_fasta(out["db"], out["las"], f_cpp,
+                             PipelineConfig(batch_size=256, native_solver=True,
+                                            consensus=ccfg, hp_native=True))
+    s_py = correct_to_fasta(out["db"], out["las"], f_py,
+                            PipelineConfig(batch_size=256, native_solver=True,
+                                           consensus=ccfg, hp_native=False))
+    assert s_cpp.n_hp_rescued > 0
+    assert s_cpp.n_hp_rescued == s_py.n_hp_rescued
+    assert open(f_cpp, "rb").read() == open(f_py, "rb").read()
