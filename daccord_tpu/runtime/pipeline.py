@@ -597,18 +597,31 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             fetch_many_fn = _fetch_many
 
     hp_ols = None
+    hp_nladder = None
+    hp_nt = cfg.native_threads if cfg.native_threads > 0 else (
+        os.cpu_count() or 1)
     if cfg.consensus.hp_rescue:
         # homopolymer rescue (oracle/hp.py) is a host-side post-pass over any
-        # engine's per-window err, so it needs host OffsetLikely tables even
-        # when the solve runs on device
+        # engine's per-window err; the C++ engine runs it when available
+        # (bit-identical by test, ~20x the python loop) — for the DEVICE
+        # ladder path too, where the python loop would dominate the drain
         if native_dispatch:
-            # the C++ engine runs the rescue in-engine (NativeLadder
-            # .hp_rescue, bit-identical by test) unless hp_native is off
             hp_ols = None if cfg.hp_native else ols
         else:
             from ..oracle.consensus import make_offset_likely
 
             hp_ols = make_offset_likely(profile, cfg.consensus)
+            if cfg.hp_native:
+                try:
+                    from ..native import available as _nat_avail
+                    from ..native.api import NativeLadder as _NL
+
+                    if _nat_avail():
+                        hp_nladder = _NL(hp_ols, cfg.consensus,
+                                         max_kmers=cfg.max_kmers,
+                                         rescue_max_kmers=cfg.rescue_max_kmers)
+                except Exception:
+                    hp_nladder = None
 
     try:
         from ..native import available as native_available
@@ -675,6 +688,35 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         seqs_b, lens_b, nsegs_b = hp_ctx
         ccfg = cfg.consensus
         overrides: dict[int, np.ndarray] = {}
+        if hp_nladder is not None:
+            # C++ engine pass (bit-identical to the python loop below by
+            # test). The fetched result arrays can be strided views over the
+            # packed wire array OR already-contiguous solver outputs — in
+            # the latter case np.array copies would alias via
+            # ascontiguousarray, so force copies: rescued rows are
+            # identified against a pre-call tier snapshot and written back
+            # explicitly (their sequence travels via the override dict;
+            # the row's in-array cons stays the direct result)
+            from types import SimpleNamespace
+
+            shim = SimpleNamespace(seqs=seqs_b[:take], lens=lens_b[:take],
+                                   nsegs=nsegs_b[:take])
+            sub = {"cons": np.array(out["cons"][:take], dtype=np.int8),
+                   "cons_len": np.array(out["cons_len"][:take],
+                                        dtype=np.int32),
+                   "err": np.array(out["err"][:take], dtype=np.float32),
+                   "tier": np.array(out["tier"][:take], dtype=np.int32)}
+            n = hp_nladder.hp_rescue(shim, sub, n_threads=hp_nt)
+            if n:
+                stats.n_hp_rescued += n
+                for i in np.nonzero(sub["tier"] == HP_TIER)[0]:
+                    i = int(i)
+                    cl = int(sub["cons_len"][i])
+                    overrides[i] = sub["cons"][i][:cl].copy()
+                    out["err"][i] = sub["err"][i]
+                    out["solved"][i] = True
+                    out["tier"][i] = HP_TIER
+            return overrides
         for i in range(take):
             nseg = int(nsegs_b[i])
             if nseg < min_depth:

@@ -160,3 +160,34 @@ def test_native_hp_rescue_parity(tmp_path):
     assert s_cpp.n_hp_rescued > 0
     assert s_cpp.n_hp_rescued == s_py.n_hp_rescued
     assert open(f_cpp, "rb").read() == open(f_py, "rb").read()
+
+
+def test_device_path_native_hp_parity(tmp_path):
+    """The C++ hp pass wired into the DEVICE-ladder drain path (fetched
+    strided results -> contiguous shim -> write-back) matches the python
+    host loop byte-for-byte."""
+    import os
+
+    from daccord_tpu.native import available
+
+    if not available():
+        pytest.skip("native host path unavailable")
+    from daccord_tpu.runtime.pipeline import PipelineConfig, correct_to_fasta
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path)
+    out = make_dataset(d, SimConfig(genome_len=3000, coverage=16,
+                                    read_len_mean=800, min_overlap=300,
+                                    hp_indel_slope=1.0, seed=37), name="hpd")
+    ccfg = ConsensusConfig(hp_rescue=True)
+    f_cpp = os.path.join(d, "d_cpp.fasta")
+    f_py = os.path.join(d, "d_py.fasta")
+    s_cpp = correct_to_fasta(out["db"], out["las"], f_cpp,
+                             PipelineConfig(batch_size=256, consensus=ccfg,
+                                            hp_native=True))
+    s_py = correct_to_fasta(out["db"], out["las"], f_py,
+                            PipelineConfig(batch_size=256, consensus=ccfg,
+                                           hp_native=False))
+    assert s_cpp.n_hp_rescued > 0
+    assert s_cpp.n_hp_rescued == s_py.n_hp_rescued
+    assert open(f_cpp, "rb").read() == open(f_py, "rb").read()
