@@ -63,13 +63,18 @@ def daccord_main(argv=None) -> int:
                         "AND cheaper on high-error CLR; uncapped rescue "
                         "(--overflow-rescue) and the full graph (-M 0, "
                         "--backend native only) measured never better")
-    p.add_argument("--hp-rescue", action="store_true",
+    p.add_argument("--hp-rescue", action=argparse.BooleanOptionalAction,
+                   default=None,
                    help="homopolymer rescue: re-solve windows that failed or "
                         "solved badly in run-length-compressed space, where "
                         "length-dependent hp indels are invisible, then "
                         "re-expand runs by aligned per-position vote "
                         "(oracle/hp.py; capability the reference's k-mer DBG "
-                        "lacks — runs >= k are self-repeating for it too)")
+                        "lacks — runs >= k are self-repeating for it too). "
+                        "Measured +0.6..+4.0 Q on every PacBio-like regime "
+                        "(BASELINE.md r4). Default ON for --backend native "
+                        "(the C++ engine makes it cheap); opt-in elsewhere "
+                        "until the on-chip cost is measured")
     p.add_argument("--overflow-rescue", action="store_true",
                    help="re-solve windows whose top-M cap bound at the rescue "
                         "active-set size (reference full-graph semantics for "
@@ -121,7 +126,9 @@ def daccord_main(argv=None) -> int:
                         "windows with the C++ tier ladder (device-ladder top-M "
                         "semantics by default, -M 0 for the full graph; no "
                         "device: the fast degraded mode, 4-7x the JAX-CPU "
-                        "path per core)")
+                        "path per core) AND defaults --hp-rescue ON — for a "
+                        "cross-backend output-parity check, pass an explicit "
+                        "--hp-rescue/--no-hp-rescue to both arms")
     p.add_argument("--pallas", action="store_true",
                    help="run the heaviest-path DP as the Pallas TPU kernel "
                         "(bit-identical results; TPU backend only)")
@@ -180,7 +187,9 @@ def daccord_main(argv=None) -> int:
     ccfg = ConsensusConfig(w=args.w, adv=args.a, mode=args.mode, tiers=tiers,
                            dbg=DBGParams(n_candidates=args.candidates,
                                          max_err=args.max_err),
-                           hp_rescue=args.hp_rescue)
+                           hp_rescue=(args.hp_rescue
+                                      if args.hp_rescue is not None
+                                      else args.backend == "native"))
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
                          depth=args.depth, seg_len=args.seg_len,
                          max_kmers=args.max_kmers,
