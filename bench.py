@@ -35,6 +35,30 @@ if not (0 < BATCH <= N_BENCH_WINDOWS and N_BENCH_WINDOWS % BATCH == 0):
     raise SystemExit(   # not assert: stripped under python -O, and a
         # non-dividing batch silently drops the trailing partial batch
         f"DACCORD_BENCH_BATCH={BATCH} must divide N_BENCH_WINDOWS={N_BENCH_WINDOWS}")
+# queued-hardware-experiment levers (ARCHITECTURE.md 2/3): override the
+# escalation capacity (default: full batch) and the candidate count so the
+# esc_cap=B/8 and --candidates 5 measurements are one env var each.
+# Unset = the shipped config defaults (never pinned here, so a future
+# default flip is what a plain run benches).
+ESC_CAP = os.environ.get("DACCORD_BENCH_ESC_CAP")
+ESC_CAP = int(ESC_CAP) if ESC_CAP else None
+if ESC_CAP is not None and ESC_CAP <= 0:
+    raise SystemExit(f"DACCORD_BENCH_ESC_CAP={ESC_CAP} must be positive "
+                     "(0 would silently drop every escalated window)")
+N_CANDIDATES = os.environ.get("DACCORD_BENCH_CANDIDATES")
+N_CANDIDATES = int(N_CANDIDATES) if N_CANDIDATES else None
+
+
+def _bench_consensus_config():
+    """ConsensusConfig for both throughput paths (pipelined AND compute
+    ceiling must bench the SAME config or pipeline_efficiency mixes
+    configs); env levers apply only when set."""
+    from daccord_tpu.oracle.consensus import ConsensusConfig
+    from daccord_tpu.oracle.dbg import DBGParams
+
+    if N_CANDIDATES is None:
+        return ConsensusConfig()
+    return ConsensusConfig(dbg=DBGParams(n_candidates=N_CANDIDATES))
 DEPTH, SEG_LEN, WLEN = 32, 64, 40
 
 
@@ -145,8 +169,7 @@ def device_throughput(data: dict, max_batches: int | None = None,
     from daccord_tpu.oracle.profile import ErrorProfile
 
     prof = ErrorProfile(float(data["p_ins"]), float(data["p_del"]), float(data["p_sub"]))
-    ccfg = ConsensusConfig()
-    ladder = TierLadder.from_config(prof, ccfg)
+    ladder = TierLadder.from_config(prof, _bench_consensus_config())
     shape = BatchShape(depth=DEPTH, seg_len=SEG_LEN, wlen=WLEN)
 
     N = len(data["nsegs"])
@@ -158,7 +181,7 @@ def device_throughput(data: dict, max_batches: int | None = None,
         return _make_batch(data, i, BATCH, shape)
 
     # warmup / compile all tier shapes
-    fetch(solve_ladder_async(make_batch(0), ladder))
+    fetch(solve_ladder_async(make_batch(0), ladder, esc_cap=ESC_CAP))
 
     # tunnel RTT estimate (sidecar provenance): median of 3 tiny blocking
     # fetches — the fixed per-device_get cost the pipelined dispatch amortizes
@@ -188,7 +211,8 @@ def device_throughput(data: dict, max_batches: int | None = None,
             solved += int(out["solved"].sum())
 
     for i in range(nb):
-        inflight.append(solve_ladder_async(make_batch(i), ladder))
+        inflight.append(solve_ladder_async(make_batch(i), ladder,
+                                           esc_cap=ESC_CAP))
         if len(inflight) >= max_inflight:
             drain(max_inflight // 2)
     drain(0)
@@ -197,6 +221,10 @@ def device_throughput(data: dict, max_batches: int | None = None,
                 device=str(jax.devices()[0]).replace(" ", ""),
                 solve_rate=round(solved / (nb * BATCH), 4),
                 batch=BATCH, rtt_ms=rtt_ms)
+    if ESC_CAP is not None:
+        info["esc_cap"] = ESC_CAP
+    if N_CANDIDATES is not None:
+        info["n_candidates"] = N_CANDIDATES
     return bases / dt, info
 
 
@@ -218,7 +246,7 @@ def device_compute_throughput(data: dict, max_batches: int | None = None
     from daccord_tpu.oracle.profile import ErrorProfile
 
     prof = ErrorProfile(float(data["p_ins"]), float(data["p_del"]), float(data["p_sub"]))
-    ladder = TierLadder.from_config(prof, ConsensusConfig())
+    ladder = TierLadder.from_config(prof, _bench_consensus_config())
     tables = tuple(ladder.tables[p.k] for p in ladder.params)
     params = tuple(ladder.params)
     cl = ladder.params[0].cons_len
@@ -229,7 +257,9 @@ def device_compute_throughput(data: dict, max_batches: int | None = None
         nb = min(nb, max_batches)
 
     def run(staged):
-        return _ladder_packed_jit(*staged, tables, params, esc_cap=BATCH)
+        return _ladder_packed_jit(*staged, tables, params,
+                                  esc_cap=ESC_CAP if ESC_CAP is not None
+                                  else BATCH)
 
     # H2D: stage every batch's inputs as committed device arrays
     t0 = time.perf_counter()
