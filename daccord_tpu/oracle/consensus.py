@@ -47,6 +47,19 @@ class ConsensusConfig:
     hp_min_run: int = 3          # ...only when a run at least this long exists
     hp_margin: float = 0.005     # expanded result must beat direct err by this
 
+    def __post_init__(self):
+        # pack_result's 5-bit tier field reserves HP_TIER (29) for
+        # hp-rescued windows; a ladder that deep would alias direct-solved
+        # rows as rescued in the histogram and the hp write-back
+        from .hp import HP_TIER
+
+        # tier codes are 0-based indices into ``tiers``, so depth HP_TIER
+        # (codes 0..HP_TIER-1) is still legal; one more collides
+        if len(self.tiers) > HP_TIER:
+            raise ValueError(
+                f"ladder depth {len(self.tiers)} collides with the reserved "
+                f"hp tier code {HP_TIER}; use fewer tiers")
+
     @property
     def k_values(self) -> tuple[int, ...]:
         return tuple(sorted({t[0] for t in self.tiers}))

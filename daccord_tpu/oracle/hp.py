@@ -40,7 +40,8 @@ from .align import align_path, edit_distance_sum
 from .dbg import DBGParams, WindowResult, window_consensus
 
 HP_TIER = 29  # tier code reported for hp-rescued windows (pack_result's
-              # 5-bit tier field allows < 31; the ladder itself is ~4 deep)
+              # 5-bit tier field allows < 31; ConsensusConfig rejects
+              # ladders deep enough to collide with this code)
 
 
 def hp_compress(seg: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

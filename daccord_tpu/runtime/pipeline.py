@@ -694,9 +694,11 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             # packed wire array OR already-contiguous solver outputs — in
             # the latter case np.array copies would alias via
             # ascontiguousarray, so force copies: rescued rows are
-            # identified against a pre-call tier snapshot and written back
-            # explicitly (their sequence travels via the override dict;
-            # the row's in-array cons stays the direct result)
+            # identified by tier == HP_TIER after the call (safe: the
+            # ladder can never reach HP_TIER — ConsensusConfig rejects
+            # that depth) and written back explicitly (their sequence
+            # travels via the override dict; the row's in-array cons
+            # stays the direct result)
             from types import SimpleNamespace
 
             shim = SimpleNamespace(seqs=seqs_b[:take], lens=lens_b[:take],

@@ -141,6 +141,41 @@ def daccord_main(argv=None) -> int:
     _add_J(p)
     args = p.parse_args(argv)
 
+    # cheap argument validation BEFORE any backend resolution: the auto
+    # probe below can take 150 s on a dead tunnel, and a usage error should
+    # never wait behind it
+    if args.block is not None and args.J is not None:
+        raise SystemExit("--block and -J are mutually exclusive")
+    k = args.k
+    if not (4 <= k <= 11):  # k+4 must still pack into int32 k-mer codes
+        raise SystemExit(f"-k {k}: supported range is 4..11")
+    # kernel k-mer positions come from seg_len (npos = seg_len - k + 1 > 0);
+    # window size only needs to accommodate the base k
+    if k + 4 > min(args.w, args.seg_len - 1):
+        raise SystemExit(f"escalated k {k + 4} (from -k {k}) needs window size > "
+                         f"{k + 4} and --seg-len > {k + 5}")
+    if args.backend == "native" and args.mesh > 1:
+        raise SystemExit("--backend native solves on host C++; it cannot be "
+                         "combined with --mesh (pick one)")
+    if args.max_kmers == 0 and args.backend not in ("native", "auto"):
+        # on the device ladder M=0 means top_k(…, 0): an empty active set
+        # that silently solves nothing — only the native engine interprets
+        # 0 as "uncapped full graph"
+        raise SystemExit("-M 0 (full graph) requires --backend native; the "
+                         "device ladder needs a positive top-M cap")
+
+    backend_auto = args.backend == "auto"
+    if backend_auto:
+        # a dead axon tunnel hangs default-backend init forever; auto must
+        # probe (bounded, subprocess) and fall back before any jax touch.
+        # --mesh shards over devices — incompatible with the native engine,
+        # so a dead tunnel then falls back to the CPU device ladder
+        from ..utils.obs import resolve_auto_backend
+
+        args.backend = resolve_auto_backend(prefer_native=args.mesh <= 1)
+        if args.max_kmers == 0 and args.backend != "native":
+            raise SystemExit("-M 0 (full graph) requires --backend native; "
+                             "the device ladder needs a positive top-M cap")
     if args.backend in ("cpu", "native"):
         # native solves on host C++, but incidental jax usage (estimation
         # helpers) must still never touch a possibly-dead TPU tunnel
@@ -151,17 +186,6 @@ def daccord_main(argv=None) -> int:
 
     enable_compilation_cache()
 
-    if args.block is not None and args.J is not None:
-        raise SystemExit("--block and -J are mutually exclusive")
-    if args.backend == "native" and args.mesh > 1:
-        raise SystemExit("--backend native solves on host C++; it cannot be "
-                         "combined with --mesh (pick one)")
-    if args.max_kmers == 0 and args.backend != "native":
-        # on the device ladder M=0 means top_k(…, 0): an empty active set
-        # that silently solves nothing — only the native engine interprets
-        # 0 as "uncapped full graph"
-        raise SystemExit("-M 0 (full graph) requires --backend native; the "
-                         "device ladder needs a positive top-M cap")
     if args.block is not None:
         from ..formats.dazzdb import db_blocks
         from ..formats.las import range_for_areads
@@ -173,14 +197,6 @@ def daccord_main(argv=None) -> int:
         start, end = range_for_areads(args.las, lo, hi)
     else:
         start, end = _resolve_range(args, args.las)
-    k = args.k
-    if not (4 <= k <= 11):  # k+4 must still pack into int32 k-mer codes
-        raise SystemExit(f"-k {k}: supported range is 4..11")
-    # kernel k-mer positions come from seg_len (npos = seg_len - k + 1 > 0);
-    # window size only needs to accommodate the base k
-    if k + 4 > min(args.w, args.seg_len - 1):
-        raise SystemExit(f"escalated k {k + 4} (from -k {k}) needs window size > "
-                         f"{k + 4} and --seg-len > {k + 5}")
     tiers = ((k, 2, 2), (k + 2, 2, 2), (k + 4, 2, 2), (k, 1, 1))
     from ..oracle.dbg import DBGParams
 
@@ -189,7 +205,12 @@ def daccord_main(argv=None) -> int:
                                          max_err=args.max_err),
                            hp_rescue=(args.hp_rescue
                                       if args.hp_rescue is not None
-                                      else args.backend == "native"))
+                                      # an auto-resolved engine must not
+                                      # flip defaults with tunnel health:
+                                      # the same command has to produce the
+                                      # same bases today and tomorrow
+                                      else (args.backend == "native"
+                                            and not backend_auto)))
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
                          depth=args.depth, seg_len=args.seg_len,
                          max_kmers=args.max_kmers,
@@ -710,6 +731,12 @@ def shard_main(argv=None) -> int:
                    help="piles sampled by the profile estimation pass")
     p.add_argument("--backend", choices=("auto", "cpu", "tpu"), default="auto")
     args = p.parse_args(argv)
+    if args.backend == "auto":
+        from ..utils.obs import resolve_auto_backend
+
+        # shard jobs use the device ladder; native fallback handled by
+        # PipelineConfig defaults, so a dead tunnel only needs the cpu pin
+        args.backend = resolve_auto_backend(prefer_native=False)
     if args.backend == "cpu":
         import jax
 

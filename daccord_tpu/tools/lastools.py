@@ -375,7 +375,9 @@ class _StreamMedian:
             self.n += len(vals)
 
     def plan(self) -> None:
-        assert self.n > 0
+        if self.n == 0:
+            raise ValueError("_StreamMedian.plan() on an empty stream "
+                             "(no values added)")
         k1, k2 = (self.n - 1) // 2, self.n // 2
         cum = np.cumsum(self.hist)
         b1 = int(np.searchsorted(cum, k1 + 1))

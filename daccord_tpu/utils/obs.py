@@ -39,15 +39,24 @@ class NullLogger(JsonlLogger):
         super().__init__(None)
 
 
-def probe_default_backend(timeout_s: int = 150) -> int:
+def probe_default_backend(timeout_s: int | None = None) -> int:
     """Device count of the default backend, probed from a throwaway
     subprocess: a dead axon tunnel HANGS forever inside make_c_api_client
     (it does not error), which would wedge any process that touches the
     default backend — the subprocess bounds the hang to ``timeout_s``.
     Returns 0 when the backend is dead/unreachable. The one probe (and one
-    timeout policy) shared by bench.py, ladderbench and __graft_entry__."""
+    timeout policy) shared by bench.py, ladderbench, __graft_entry__ and the
+    CLI's ``--backend auto``; the default 150 s can be overridden process-wide
+    via ``DACCORD_PROBE_TIMEOUT_S`` (malformed values fall back to 150)."""
+    import os
     import subprocess
     import sys
+
+    if timeout_s is None:
+        try:
+            timeout_s = int(os.environ.get("DACCORD_PROBE_TIMEOUT_S", "150"))
+        except ValueError:
+            timeout_s = 150
 
     code = ("import jax, jax.numpy as jnp;"
             "jax.block_until_ready(jnp.ones((8,8)) @ jnp.ones((8,8)));"
@@ -66,6 +75,39 @@ def probe_default_backend(timeout_s: int = 150) -> int:
 def device_alive(timeout_s: int = 150) -> bool:
     """True iff default-backend init + one matmul succeeds (see probe)."""
     return probe_default_backend(timeout_s) > 0
+
+
+def resolve_auto_backend(prefer_native: bool = True) -> str:
+    """Resolve ``--backend auto`` without ever wedging on a dead tunnel.
+
+    ``jax.default_backend()`` on this image hangs FOREVER when the axon
+    tunnel is down (no error, no timeout — see probe_default_backend), so
+    "auto" must decide from a bounded subprocess probe BEFORE any in-process
+    backend init. Dead tunnel → the native C++ engine when built (fastest
+    host path), else the CPU device ladder; either way the process pins
+    ``jax_platforms='cpu'`` so no later jax touch can wedge. Probe timeout
+    via ``DACCORD_PROBE_TIMEOUT_S`` (see probe_default_backend).
+    """
+    if probe_default_backend() > 0:
+        return "tpu"
+    import sys
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if prefer_native:
+        try:
+            from ..native import available as _nat_avail
+
+            if _nat_avail():
+                print("daccord: device backend unreachable (probe timed out); "
+                      "using the native host engine", file=sys.stderr)
+                return "native"
+        except Exception:
+            pass
+    print("daccord: device backend unreachable (probe timed out); "
+          "using the CPU device ladder", file=sys.stderr)
+    return "cpu"
 
 
 def _host_cpu_fingerprint() -> str:

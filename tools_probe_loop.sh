@@ -11,7 +11,7 @@ t0 = time.time()
 n = probe_default_backend(120)
 rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
        "devices": n, "alive": n > 0, "probe_s": round(time.time() - t0, 1),
-       "round": 4}
+       "round": 5}
 with open("TUNNEL_LOG.jsonl", "a") as f:
     f.write(json.dumps(rec) + "\n")
 print(rec)
