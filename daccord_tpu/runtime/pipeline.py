@@ -153,6 +153,9 @@ class PipelineStats:
     qv_ranked: bool = False
     n_hp_rescued: int = 0        # windows replaced by the run-length-
                                  # compressed rescue (oracle/hp.py)
+    hp_wall_s: float = 0.0       # host wall spent in the hp drain pass
+                                 # (device paths only; the native engine
+                                 # runs hp in-engine inside its solve call)
     n_end_trimmed: int = 0
     n_fragments: int = 0
     bases_in: int = 0
@@ -786,7 +789,12 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         # would double-count and can exceed wall time)
         stats.device_s += now - t_f
         for (handle, rid, widx, take, t0, hp_ctx), out in zip(entries, outs):
-            hp_over = hp_pass(out, hp_ctx, take) if hp_ctx is not None else None
+            if hp_ctx is not None:
+                t_hp = time.time()
+                hp_over = hp_pass(out, hp_ctx, take)
+                stats.hp_wall_s += time.time() - t_hp
+            else:
+                hp_over = None
             n_s = scatter(out, rid, widx, take, hp_over)
             log.log("batch", windows=take, solved=n_s,
                     overflow=int(out.get("esc_overflow", 0)),
