@@ -46,6 +46,9 @@ class ConsensusConfig:
                                  # min_run 2 vs 3 a wash — BASELINE.md r4)
     hp_min_run: int = 3          # ...only when a run at least this long exists
     hp_margin: float = 0.005     # expanded result must beat direct err by this
+    hp_vote: str = "median"      # run-length vote: "median" (flat, r4) or
+                                 # "posterior" (profile-calibrated length
+                                 # posterior, oracle/hp.py r5)
 
     def __post_init__(self):
         # pack_result's 5-bit tier field reserves HP_TIER (29) for
@@ -59,6 +62,9 @@ class ConsensusConfig:
             raise ValueError(
                 f"ladder depth {len(self.tiers)} collides with the reserved "
                 f"hp tier code {HP_TIER}; use fewer tiers")
+        if self.hp_vote not in ("median", "posterior"):
+            raise ValueError(f"hp_vote={self.hp_vote!r}: must be 'median' "
+                             "or 'posterior'")
 
     @property
     def k_values(self) -> tuple[int, ...]:

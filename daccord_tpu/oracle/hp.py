@@ -66,6 +66,111 @@ def max_run(seg: np.ndarray) -> int:
     return int(hp_compress(seg)[1].max())
 
 
+_LTAB_CACHE: dict = {}
+
+
+def hp_length_tables(profile, Lmax: int = 20, Omax: int = 56) -> np.ndarray:
+    """``T[L, o] = log P(observed same-base length o | true run length L)``.
+
+    Observation model (matches the fit in profile_vs_consensus): each of the
+    L true bases survives with prob (1-qd)(1-psub) and is followed by
+    Geom(qi) same-base insertions, with the indel intensity length-scaled:
+    q(L) = hp_base * (1 + hp_slope * min(L-1, hp_cap)), split del:ins by the
+    global ratio, clipped at 0.45. P(o|L) is the L-fold convolution of the
+    per-base contribution. Rows L=1..Lmax; row 0 is unused (-inf).
+    An unfit profile (hp_base == 0) falls back to the global rates with
+    slope 0 — a flat-rate posterior, still split-robust vs the median.
+    """
+    key = (round(profile.p_del, 5), round(profile.p_ins, 5),
+           round(profile.p_sub, 5), round(profile.hp_slope, 3),
+           round(profile.hp_base, 4), profile.hp_cap, Lmax, Omax)
+    hit = _LTAB_CACHE.get(key)
+    if hit is not None:
+        return hit
+    tot = profile.p_del + profile.p_ins
+    fd = profile.p_del / tot if tot > 0 else 0.33
+    base, slope = profile.hp_base, profile.hp_slope
+    if base <= 0.0:
+        base, slope = max(tot, 1e-4), 0.0
+    T = np.full((Lmax + 1, Omax + 1), -np.inf)
+    for L in range(1, Lmax + 1):
+        x = min(L - 1, profile.hp_cap)
+        qd = min(base * fd * (1.0 + slope * x), 0.45)
+        qi = min(base * (1.0 - fd) * (1.0 + slope * x), 0.45)
+        q0 = 1.0 - (1.0 - qd) * (1.0 - profile.p_sub)   # contributes no
+        # same-base symbol (deleted or substituted); insertions still follow
+        gi = (1.0 - qi) * np.power(qi, np.arange(Omax + 1))
+        contrib = q0 * gi
+        contrib[1:] += (1.0 - q0) * gi[:-1]
+        dist = contrib
+        for _ in range(L - 1):
+            dist = np.convolve(dist, contrib)[: Omax + 1]
+        # renormalize the truncation tail so long-L rows stay comparable
+        s = dist.sum()
+        if s > 0:
+            dist = dist / s
+        with np.errstate(divide="ignore"):
+            T[L] = np.log(dist)
+    _LTAB_CACHE[key] = T
+    if len(_LTAB_CACHE) > 64:
+        _LTAB_CACHE.pop(next(iter(_LTAB_CACHE)))
+    return T
+
+
+def vote_runs_posterior(cons_c: np.ndarray,
+                        comp: list[tuple[np.ndarray, np.ndarray]],
+                        ltab: np.ndarray) -> np.ndarray:
+    """Calibrated per-position run lengths: length-posterior argmax.
+
+    Per segment the observation is the SUM of same-base run lengths over the
+    aligned span (split pieces from in-run substitutions are merged — the
+    bias the flat median inherits), with one-position greedy extension when
+    the optimal path attributed a boundary piece to the neighbor. The vote
+    is argmax_L sum_s log P(o_s | L) under the profile-calibrated
+    observation model (hp_length_tables); ties break to the smaller L.
+    Positions with no evidence keep run length 1.
+    """
+    n = len(cons_c)
+    Lmax = ltab.shape[0] - 1
+    Omax = ltab.shape[1] - 1
+    ll = np.zeros((n, Lmax + 1))
+    nvotes = np.zeros(n, dtype=np.int64)
+    for cseg, runs in comp:
+        if len(cseg) == 0:
+            continue
+        m = len(cseg)
+        _, a2b = align_path(cons_c, cseg)
+        claimed = [0, 0, 0, 0]   # per base: end of the last counted span
+        for i in range(n):
+            c = cons_c[i]
+            lo = max(int(a2b[i]), claimed[c])
+            hi = max(int(a2b[i + 1]), lo)
+            # greedy one-position extension: a boundary same-base piece the
+            # path gave to the neighbor belongs to this run (cons_c runs
+            # are maximal, so the immediate neighbor never claims base c).
+            # The per-base `claimed` cursor keeps same-base counted spans
+            # disjoint — a merged piece (deleted spacer between two
+            # same-base runs) is counted by exactly one position.
+            if hi < m and cseg[hi] == c:
+                hi += 1
+            if lo > claimed[c] and cseg[lo - 1] == c:
+                lo -= 1
+            if hi <= lo:
+                continue
+            claimed[c] = hi
+            o = 0
+            for j in range(lo, hi):
+                if cseg[j] == c:
+                    o += int(runs[j])
+            ll[i] += ltab[:, min(o, Omax)]
+            nvotes[i] += 1
+    out = np.ones(n, dtype=np.int32)
+    voted = nvotes > 0
+    if voted.any():
+        out[voted] = np.argmax(ll[voted, 1:], axis=1).astype(np.int32) + 1
+    return out
+
+
 def vote_runs(cons_c: np.ndarray,
               comp: list[tuple[np.ndarray, np.ndarray]]) -> np.ndarray:
     """Per-position run lengths for the compressed consensus by aligned vote.
@@ -95,7 +200,7 @@ def vote_runs(cons_c: np.ndarray,
 
 
 def solve_window_hp(segments: list[np.ndarray], ol, dbg: DBGParams,
-                    wlen: int) -> WindowResult | None:
+                    wlen: int, vote: str = "median") -> WindowResult | None:
     """Solve one window in run-length-compressed space and re-expand.
 
     ``ol`` is the tier's OffsetLikely table (compressed-space offsets are a
@@ -114,7 +219,10 @@ def solve_window_hp(segments: list[np.ndarray], ol, dbg: DBGParams,
     res = window_consensus([c for c, _ in comp], ol, dbg, wlen=wlen_c)
     if res.seq is None:
         return None
-    runs = vote_runs(res.seq, comp)
+    if vote == "posterior":
+        runs = vote_runs_posterior(res.seq, comp, hp_length_tables(ol.profile))
+    else:
+        runs = vote_runs(res.seq, comp)
     seq = hp_expand(res.seq, runs)
     # pathological expansions (a mis-voted giant run) never beat the direct
     # result anyway; bound them before paying the rescore
@@ -144,7 +252,8 @@ def hp_candidate(segments: list[np.ndarray], direct_seq, direct_err: float,
         return None
     k, mc, emc = cfg.tiers[0]
     dbg = replace(cfg.dbg, k=k, min_count=mc, edge_min_count=emc)
-    res = solve_window_hp(segments, ol_tables[k], dbg, cfg.w)
+    res = solve_window_hp(segments, ol_tables[k], dbg, cfg.w,
+                          vote=cfg.hp_vote)
     if res is None:
         return None
     bar = (direct_err - cfg.hp_margin) if solved else cfg.dbg.max_err

@@ -511,6 +511,10 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     log = JsonlLogger(cfg.log_path)
     fetch_many_fn = None
     native_dispatch = solver is None and cfg.native_solver
+    # the C++ hp pass implements the median vote only; a posterior vote must
+    # run the python host pass on EVERY backend, or an A/B would silently
+    # measure median under a 'posterior' label
+    hp_use_native = cfg.hp_native and cfg.consensus.hp_vote == "median"
     if native_dispatch:
         from ..native import available as _nat_avail
         from ..native.api import NativeLadder
@@ -555,7 +559,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     out[key][ti] = wide[key][take]
                 out["solved"][ti] = True
                 out["m_ovf"][ti] = wide["m_ovf"][take]
-            if cfg.consensus.hp_rescue and cfg.hp_native:
+            if cfg.consensus.hp_rescue and hp_use_native:
                 # in-engine hp rescue (C++, oracle/hp.py parity): runs after
                 # the overflow rescue, matching the host pass's ordering
                 stats.n_hp_rescued += nladder.hp_rescue(b, out, n_threads=nt)
@@ -609,12 +613,12 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         # (bit-identical by test, ~20x the python loop) — for the DEVICE
         # ladder path too, where the python loop would dominate the drain
         if native_dispatch:
-            hp_ols = None if cfg.hp_native else ols
+            hp_ols = None if hp_use_native else ols
         else:
             from ..oracle.consensus import make_offset_likely
 
             hp_ols = make_offset_likely(profile, cfg.consensus)
-            if cfg.hp_native:
+            if hp_use_native:
                 try:
                     from ..native import available as _nat_avail
                     from ..native.api import NativeLadder as _NL

@@ -1,0 +1,118 @@
+"""Measure the hp-rescue drain-pass cost on the DEVICE-ladder path.
+
+Decision harness for the device-backend hp_rescue default (VERDICT r4 weak
+#3): the native backend ships hp rescue ON (+2.0 Q clean control, +2.7 Q on
+cfg2 — BASELINE.md r4), but the device paths kept it opt-in pending a
+hardware overlap measurement that has been unrunnable for three rounds.
+This measures the same decision without a chip:
+
+  - the hp drain pass is HOST-side work (C++ via NativeLadder.hp_rescue)
+    whose wall does not depend on which device produced the batch — the
+    CPU-fallback pipeline exercises the identical drain code path
+    (runtime/pipeline.py hp_pass), so its measured ``hp_wall_s`` transfers;
+  - the worst-case NON-OVERLAPPED bound for a TPU run is therefore
+    hp_wall_s / (projected_device_wall + hp_wall_s), with the projected
+    device wall taken from the one measured TPU rate (windows / 14.8k w/s,
+    BENCH_TPU_LAST.json r1) — worst case because the async pipeline
+    (bounded in-flight deque) can overlap most of the drain behind device
+    compute + tunnel RTT, and because the r1 rate predates the r3/r4 device
+    optimizations.
+
+Two regimes per the r4 decision-table method: the clean control (routing
+cost only — a max-run scan plus a handful of routed windows) and the hp
+stress regime (hp_indel_slope=1.0, the most windows routed). One JSON line
+per regime.
+
+Run: ``python -m daccord_tpu.tools.hpdrainbench [--batch 512] [--out F]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+# measured once on the real chip (r1): 14.8k windows/s/chip end to end.
+# The honest anchor for "how long would the device side of this run take".
+TPU_WINDOWS_PER_SEC = 14_800.0
+
+
+def run_regime(name: str, sim_kw: dict, batch: int, tmp: str) -> dict:
+    from daccord_tpu.oracle.consensus import ConsensusConfig
+    from daccord_tpu.runtime.pipeline import PipelineConfig, correct_to_fasta
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = os.path.join(tmp, name)
+    out = make_dataset(d, SimConfig(**sim_kw), name=name)
+    rows = {}
+    for arm in ("off", "on"):
+        ccfg = ConsensusConfig(hp_rescue=(arm == "on"))
+        pcfg = PipelineConfig(batch_size=batch, consensus=ccfg,
+                              hp_native=True)
+        t0 = time.time()
+        st = correct_to_fasta(out["db"], out["las"],
+                              os.path.join(d, f"{arm}.fasta"), pcfg)
+        rows[arm] = dict(wall_s=round(time.time() - t0, 2),
+                         pipe_wall_s=round(st.wall_s, 2),
+                         hp_wall_s=round(st.hp_wall_s, 3),
+                         windows=st.n_windows, hp_rescued=st.n_hp_rescued)
+    on = rows["on"]
+    dev_wall = on["windows"] / TPU_WINDOWS_PER_SEC
+    bound = on["hp_wall_s"] / (dev_wall + on["hp_wall_s"])
+    line = {
+        "regime": name, "batch": batch,
+        "windows": on["windows"], "hp_rescued": on["hp_rescued"],
+        "hp_wall_s": on["hp_wall_s"],
+        "cpu_pipe_wall_on_s": on["pipe_wall_s"],
+        "cpu_pipe_wall_off_s": rows["off"]["pipe_wall_s"],
+        "cpu_hp_fraction": round(on["hp_wall_s"] / on["pipe_wall_s"], 4)
+        if on["pipe_wall_s"] else 0.0,
+        "tpu_projected_device_wall_s": round(dev_wall, 2),
+        "tpu_worst_case_nonoverlap_fraction": round(bound, 4),
+    }
+    print(json.dumps(line))
+    return line
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch", type=int, default=512,
+                   help="production CPU batch size (tpu default is 2048; "
+                        "hp cost scales with windows, not batch shape)")
+    p.add_argument("--out", default=None, help="also append JSON lines here")
+    p.add_argument("--keep", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")   # drain cost is host-side;
+    # the device ladder itself runs wherever — cpu keeps this chip-free
+
+    regimes = {
+        # cfg2's shape (the flagship single-chip rung), clean error model
+        "clean_cfg2": dict(genome_len=50_000, coverage=100,
+                           read_len_mean=8_000, seed=12),
+        # same shape under the hp stress knob: worst-case routing volume
+        "hp_cfg2": dict(genome_len=50_000, coverage=100, read_len_mean=8_000,
+                        hp_indel_slope=1.0, seed=12),
+    }
+    tmp = tempfile.mkdtemp(prefix="hpdrain_") if not args.keep else "/tmp/hpdrain"
+    lines = []
+    for name, kw in regimes.items():
+        lines.append(run_regime(name, kw, args.batch, tmp))
+    if args.out:
+        with open(args.out, "a") as fh:
+            for ln in lines:
+                fh.write(json.dumps(ln) + "\n")
+    if not args.keep:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

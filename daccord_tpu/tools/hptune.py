@@ -15,7 +15,12 @@ import time
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--arms", default="0.18:3,0.12:3,0.12:2,0.25:3")
+    ap.add_argument("--arms", default="0.18:3,0.12:3,0.12:2,0.25:3",
+                    help="hp_err:hp_min_run[:vote] per arm; vote in "
+                         "{median, posterior} (default median). The "
+                         "posterior arm runs the python host pass "
+                         "(hp_native off) — the C++ engine implements "
+                         "median only until the vote decision lands")
     ap.add_argument("--regime", default="hp")
     args = ap.parse_args(argv)
     import jax
@@ -39,16 +44,19 @@ def main(argv=None) -> int:
     prof = estimate_profile_for_shard(read_db(paths["db"]),
                                       LasFile(paths["las"]), PipelineConfig())
     for arm in args.arms.split(","):
-        he, hmr = arm.split(":")
+        parts = arm.split(":")
+        he, hmr = parts[0], parts[1]
+        vote = parts[2] if len(parts) > 2 else "median"
         ccfg = ConsensusConfig(hp_rescue=True, hp_err=float(he),
-                               hp_min_run=int(hmr))
-        cfg = PipelineConfig(consensus=ccfg)
-        out_fa = os.path.join(d, f"corr_hp_{he}_{hmr}.fasta")
+                               hp_min_run=int(hmr), hp_vote=vote)
+        cfg = PipelineConfig(consensus=ccfg, hp_native=(vote == "median"))
+        out_fa = os.path.join(d, f"corr_hp_{he}_{hmr}_{vote}.fasta")
         t0 = time.perf_counter()
         stats = correct_to_fasta(paths["db"], paths["las"], out_fa, cfg,
                                  profile=prof)
         q = _qveval(out_fa, paths["truth"], None)
         print(json.dumps({"hp_err": float(he), "hp_min_run": int(hmr),
+                          "vote": vote,
                           "q": q.get("qscore"), "errors": q.get("errors"),
                           "solve": round(stats.n_solved
                                          / max(stats.n_windows, 1), 4),

@@ -27,19 +27,22 @@ def test_hp_compress_expand_roundtrip():
 
 
 def _hp_noisy(rng, seg, slope=1.0, p_ind=0.12, p_sub=0.02):
-    """Length-dependent run-length noise: the hp stress process in miniature."""
+    """Length-dependent run-length noise: sim/synth.py's hp channel in
+    miniature — per-base deletion + GEOMETRIC same-base insertions, both
+    length-scaled, ins 2:1 over del. Run observations drift long, which the
+    calibrated posterior vote models and the flat median cannot."""
     c, runs = hp_compress(seg)
     out = []
     for b, r in zip(c, runs):
-        rr = int(r)
-        p = min(0.45, p_ind * (1 + slope * min(r - 1, 8)))
+        f = 1 + slope * min(int(r) - 1, 8)
+        pd = min(0.45, p_ind * f / 3)
+        pi = min(0.45, 2 * p_ind * f / 3)
+        rr = 0
         for _ in range(int(r)):
-            u = rng.random()
-            if u < p / 2:
-                rr -= 1
-            elif u < p:
+            if rng.random() >= pd:
                 rr += 1
-        out.extend([b] * max(0, rr))
+            rr += rng.geometric(1 - pi) - 1
+        out.extend([b] * rr)
     s = np.array(out, dtype=np.int8)
     subm = rng.random(len(s)) < p_sub
     if subm.any():
@@ -48,13 +51,96 @@ def _hp_noisy(rng, seg, slope=1.0, p_ind=0.12, p_sub=0.02):
 
 
 def test_vote_runs_recovers_truth_lengths():
+    """Median vote at depth 20 on MILD hp noise recovers run lengths; under
+    the full asymmetric stress process its drift bias shows (the posterior
+    test below covers that regime)."""
     rng = np.random.default_rng(11)
     cseq, truth_runs = hp_compress(TRUTH)
-    comp = [hp_compress(_hp_noisy(rng, TRUTH)) for _ in range(20)]
+    comp = [hp_compress(_hp_noisy(rng, TRUTH, slope=0.3, p_ind=0.06))
+            for _ in range(20)]
     voted = vote_runs(cseq, comp)
-    # depth-20 median vote recovers (nearly) every run length the individual
-    # reads scramble
     assert np.abs(voted - truth_runs).sum() <= 1
+
+
+def test_hp_slope_fit_separates_clean_from_damaged():
+    """profile_vs_consensus fits hp_slope ~ 0 on clean pairs and a clearly
+    positive slope (with a positive base intensity) on hp-damaged pairs."""
+    from daccord_tpu.oracle.profile import profile_vs_consensus
+
+    rng = np.random.default_rng(5)
+
+    def pairs_for(slope):
+        out = []
+        for _ in range(30):
+            g = np.concatenate([np.full(rng.integers(1, 7), rng.integers(0, 4))
+                                for _ in range(40)]).astype(np.int8)[:120]
+            out.append((g, _hp_noisy(rng, g, slope=slope,
+                                     p_ind=0.10 if slope else 0.04)))
+        return out
+
+    clean = profile_vs_consensus(pairs_for(0.0))
+    damaged = profile_vs_consensus(pairs_for(2.0))
+    assert clean.hp_slope <= 0.5
+    assert damaged.hp_slope >= 0.8
+    assert damaged.hp_base > 0
+
+
+def test_posterior_vote_beats_median_on_calibrated_noise():
+    """At the hp stress regime's rates the flat median mostly misses the true
+    run length; the calibrated posterior recovers it far more often."""
+    from daccord_tpu.oracle.hp import hp_length_tables, vote_runs_posterior
+
+    rng = np.random.default_rng(7)
+    prof = ErrorProfile(p_ins=0.08, p_del=0.04, p_sub=0.015,
+                        hp_slope=1.0, hp_base=0.12, hp_cap=8)
+    ltab = hp_length_tables(prof)
+
+    def obs_run(L, b, slope=1.0):
+        x = min(L - 1, 8)
+        qd = min(0.04 * (1 + slope * x), .45)
+        qi = min(0.08 * (1 + slope * x), .45)
+        seg = []
+        for _ in range(L):
+            u = rng.random()
+            if u < qd:
+                pass
+            elif u < qd + 0.015:
+                seg.append((b + 1) % 4)
+            else:
+                seg.append(b)
+            seg.extend([b] * (rng.geometric(1 - qi) - 1))
+        return seg
+
+    hits_m = hits_p = tot = 0
+    for _ in range(120):
+        L = int(rng.integers(3, 13))
+        cons = np.array([2, 0, 3], dtype=np.int8)
+        comp = [hp_compress(np.array([2] + obs_run(L, 0) + [3], dtype=np.int8))
+                for _ in range(20)]
+        hits_m += int(vote_runs(cons, comp)[1] == L)
+        hits_p += int(vote_runs_posterior(cons, comp, ltab)[1] == L)
+        tot += 1
+    assert hits_p / tot >= 0.4
+    assert hits_p > hits_m * 2
+
+
+def test_eprof_hp_fields_roundtrip(tmp_path):
+    p = ErrorProfile(p_ins=0.07, p_del=0.03, p_sub=0.01,
+                     hp_slope=1.25, hp_base=0.09, hp_cap=8)
+    f = str(tmp_path / "e.json")
+    p.save(f)
+    q = ErrorProfile.load(f)
+    assert (q.hp_slope, q.hp_base, q.hp_cap) == (1.25, 0.09, 8)
+    # pre-r5 files (no hp fields) load with slope 0 / base 0
+    import json
+
+    d = json.load(open(f))
+    for k in ("hp_slope", "hp_base", "hp_cap"):
+        d.pop(k)
+    with open(f, "wt") as fh:
+        json.dump(d, fh)
+    q = ErrorProfile.load(f)
+    assert (q.hp_slope, q.hp_base) == (0.0, 0.0)
 
 
 def test_hp_candidate_beats_direct_on_damaged_windows():
