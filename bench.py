@@ -47,6 +47,11 @@ if ESC_CAP is not None and ESC_CAP <= 0:
                      "(0 would silently drop every escalated window)")
 N_CANDIDATES = os.environ.get("DACCORD_BENCH_CANDIDATES")
 N_CANDIDATES = int(N_CANDIDATES) if N_CANDIDATES else None
+# queued experiment 7 (hp drain overlap): 1 = run the C++ hp rescue pass on
+# every fetched batch inside the pipelined drain, exactly where the
+# production pipeline runs it; the delta vs a plain run measures how much of
+# the host-side hp cost hides behind dispatch/RTT overlap on real hardware
+BENCH_HP = os.environ.get("DACCORD_BENCH_HP") == "1"
 
 
 def _bench_consensus_config():
@@ -194,25 +199,56 @@ def device_throughput(data: dict, max_batches: int | None = None,
         rtts.append(time.perf_counter() - tr)
     rtt_ms = round(sorted(rtts)[1] * 1e3, 1)
 
+    nladder = None
+    n_hp = 0
+    if BENCH_HP:
+        from daccord_tpu.native import available as _nat_avail
+        from daccord_tpu.native.api import NativeLadder
+        from daccord_tpu.oracle.consensus import make_offset_likely
+
+        if not _nat_avail():
+            raise SystemExit("DACCORD_BENCH_HP=1 needs the native library")
+        _ccfg = _bench_consensus_config()
+        import dataclasses as _dc
+
+        _ccfg = _dc.replace(_ccfg, hp_rescue=True)
+        nladder = NativeLadder(make_offset_likely(prof, _ccfg), _ccfg)
+
     t0 = time.perf_counter()
     bases = 0
     solved = 0
     inflight: deque = deque()
 
     def drain(to_depth: int):
-        nonlocal bases, solved
+        nonlocal bases, solved, n_hp
         n_pop = len(inflight) - to_depth
         if n_pop <= 0:
             return
         # ONE grouped fetch per drain: the tunnel charges its ~100 ms RTT per
         # device_get call, not per array (same discipline as the pipeline)
-        for out in fetch_many([inflight.popleft() for _ in range(n_pop)]):
+        entries = [inflight.popleft() for _ in range(n_pop)]
+        for (h, bi), out in zip(entries, fetch_many([h for h, _ in entries])):
+            if nladder is not None:
+                # the production drain's hp pass (runtime/pipeline.py
+                # hp_pass C++ branch) on this batch's host-side tensors
+                from types import SimpleNamespace
+
+                sl = slice(bi * BATCH, (bi + 1) * BATCH)
+                shim = SimpleNamespace(seqs=data["seqs"][sl],
+                                       lens=data["lens"][sl],
+                                       nsegs=data["nsegs"][sl])
+                sub = {"cons": np.array(out["cons"][:BATCH], dtype=np.int8),
+                       "cons_len": np.array(out["cons_len"][:BATCH],
+                                            dtype=np.int32),
+                       "err": np.array(out["err"][:BATCH], dtype=np.float32),
+                       "tier": np.array(out["tier"][:BATCH], dtype=np.int32)}
+                n_hp += nladder.hp_rescue(shim, sub, n_threads=1)
             bases += int(out["cons_len"].sum())
             solved += int(out["solved"].sum())
 
     for i in range(nb):
-        inflight.append(solve_ladder_async(make_batch(i), ladder,
-                                           esc_cap=ESC_CAP))
+        inflight.append((solve_ladder_async(make_batch(i), ladder,
+                                            esc_cap=ESC_CAP), i))
         if len(inflight) >= max_inflight:
             drain(max_inflight // 2)
     drain(0)
@@ -225,6 +261,9 @@ def device_throughput(data: dict, max_batches: int | None = None,
         info["esc_cap"] = ESC_CAP
     if N_CANDIDATES is not None:
         info["n_candidates"] = N_CANDIDATES
+    if BENCH_HP:
+        info["hp_drain"] = True
+        info["hp_rescued"] = n_hp
     return bases / dt, info
 
 

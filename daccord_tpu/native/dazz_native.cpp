@@ -94,13 +94,160 @@ static int32_t fill_exact(const int8_t* a, int n, const int8_t* b, int m,
   }
 }
 
-void align_path(const int8_t* a, int n, const int8_t* b, int m,
-                std::vector<int32_t>& Dbuf, int64_t* a2b,
-                int32_t band_hint = 24) {
+// ---------------------------------------------------------------------------
+// Hyyro/Myers bit-parallel exact DP (r5 feeder lever, SURVEY.md §7.3 item 5)
+// ---------------------------------------------------------------------------
+// Unbanded and EXACT by construction (no verify-retry needed): the b side
+// packs into K = ceil(m/64) words and each a row costs ~17 ops/word instead
+// of 2-3 ops/cell. Per-row VP/VN (the deltas D[i][j]-D[i][j-1] along b) are
+// stored — 16 bytes/row/word vs the int32 matrix's 4 bytes/cell — and the
+// backtrack recovers the EXACT SAME decisions as the matrix walk from delta
+// bits: with V = D[i][j]-D[i-1][j] (the step's HP/HN, recomputed per visited
+// row from the stored previous-row VP/VN) and Hp = D[i-1][j]-D[i-1][j-1]
+// (stored), the matrix conditions rewrite as
+//     diagonal:  D[i][j] == D[i-1][j-1] + c   <=>  V + Hp == c
+//     deletion:  D[i][j] == D[i-1][j] + 1     <=>  V == +1
+// evaluated in the identical diagonal > deletion > insertion order, so a2b
+// is bit-identical to the int32 backtrack (sealed by parity tests).
+constexpr int MYERS_MAX_M = 256;   // 4 words; wider falls back to the matrix
+
+struct MyersScratch {
+  std::vector<uint64_t> peq;   // [5][K] match masks (incl. PAD=4: the
+  //                              backtrack compares a!=b directly, so the
+  //                              fill must also treat PAD==PAD as a match)
+  std::vector<uint64_t> vp, vn;  // per-row stored deltas, (n+1)*K
+  std::vector<uint64_t> hp, hn;  // K words, scratch for one step
+  std::vector<uint64_t> t0, t1;  // discarded VP/VN outputs (backtrack
+  //                                recompute wants HP/HN only; outputs must
+  //                                NOT alias hp/hn — the step interleaves
+  //                                HP/VP writes per word)
+};
+
+// one Myers step: from row i-1's VP/VN produce row i's, plus the step's
+// HP/HN (= vertical deltas V(i, :) in matrix terms). Multi-word with carry.
+static inline void myers_step(const uint64_t* peq_t, const uint64_t* VPp,
+                              const uint64_t* VNp, uint64_t* HP, uint64_t* HN,
+                              uint64_t* VP, uint64_t* VN, int K) {
+  uint64_t carry = 0, hp_in = 1, hn_in = 0;   // hp_in=1: column 0 walks down
+  for (int w = 0; w < K; ++w) {
+    const uint64_t X = peq_t[w] | VNp[w];
+    const uint64_t av = X & VPp[w];
+    const uint64_t t = av + VPp[w];
+    const uint64_t sum = t + carry;
+    carry = (uint64_t)(t < av) | (uint64_t)(sum < t);
+    const uint64_t D0 = (sum ^ VPp[w]) | X;
+    const uint64_t hp = VNp[w] | ~(VPp[w] | D0);
+    const uint64_t hn = VPp[w] & D0;
+    HP[w] = hp; HN[w] = hn;
+    const uint64_t hpw = (hp << 1) | hp_in; hp_in = hp >> 63;
+    const uint64_t hnw = (hn << 1) | hn_in; hn_in = hn >> 63;
+    VN[w] = hpw & D0;
+    VP[w] = hnw | ~(hpw | D0);
+  }
+}
+
+static inline void myers_build_peq(const int8_t* b, int m, int K,
+                                   MyersScratch& S) {
+  S.peq.assign((size_t)5 * K, 0);
+  for (int j = 0; j < m; ++j) {
+    const int8_t c = b[j];
+    if (c >= 0 && c < 5)
+      S.peq[(size_t)c * K + (j >> 6)] |= (uint64_t)1 << (j & 63);
+  }
+}
+
+// distance-only variant (edit_distance_sum's path): no row storage.
+static int32_t myers_dist(const int8_t* a, int n, const int8_t* b, int m,
+                          MyersScratch& S) {
+  const int K = (m + 63) >> 6;
+  myers_build_peq(b, m, K, S);
+  S.vp.assign(2 * K, ~(uint64_t)0);
+  S.vn.assign(2 * K, 0);
+  S.hp.resize(K); S.hn.resize(K);
+  uint64_t* vp0 = S.vp.data(); uint64_t* vp1 = vp0 + K;
+  uint64_t* vn0 = S.vn.data(); uint64_t* vn1 = vn0 + K;
+  int32_t score = m;
+  const int mw = (m - 1) >> 6;
+  const uint64_t mb = (uint64_t)1 << ((m - 1) & 63);
+  for (int i = 1; i <= n; ++i) {
+    const int8_t c = a[i - 1];
+    myers_step(S.peq.data() + (size_t)(c < 0 || c > 4 ? 4 : c) * K,
+               vp0, vn0, S.hp.data(), S.hn.data(), vp1, vn1, K);
+    score += (S.hp[mw] & mb) ? 1 : ((S.hn[mw] & mb) ? -1 : 0);
+    std::swap(vp0, vp1); std::swap(vn0, vn1);
+  }
+  return score;
+}
+
+// full path variant: stores every row's VP/VN, walks the backtrack from
+// delta bits. Returns the exact distance; writes the a2b prefix map.
+static int32_t myers_path(const int8_t* a, int n, const int8_t* b, int m,
+                          int64_t* a2b, MyersScratch& S) {
+  const int K = (m + 63) >> 6;
+  myers_build_peq(b, m, K, S);
+  S.vp.resize((size_t)(n + 1) * K);
+  S.vn.resize((size_t)(n + 1) * K);
+  S.hp.resize(K); S.hn.resize(K);
+  for (int w = 0; w < K; ++w) { S.vp[w] = ~(uint64_t)0; S.vn[w] = 0; }
+  int32_t score = m;
+  const int mw = (m - 1) >> 6;
+  const uint64_t mb = (uint64_t)1 << ((m - 1) & 63);
+  for (int i = 1; i <= n; ++i) {
+    const int8_t c = a[i - 1];
+    myers_step(S.peq.data() + (size_t)(c < 0 || c > 4 ? 4 : c) * K,
+               S.vp.data() + (size_t)(i - 1) * K,
+               S.vn.data() + (size_t)(i - 1) * K,
+               S.hp.data(), S.hn.data(),
+               S.vp.data() + (size_t)i * K, S.vn.data() + (size_t)i * K, K);
+    score += (S.hp[mw] & mb) ? 1 : ((S.hn[mw] & mb) ? -1 : 0);
+  }
+  int i = n, j = m;
+  a2b[n] = m;
+  int hrow = -1;   // row whose HP/HN currently sit in S.hp/S.hn
+  while (i > 0) {
+    if (j == 0) {             // first column: deletion is the only move
+      --i; a2b[i] = 0;
+      continue;
+    }
+    if (hrow != i) {
+      const int8_t c = a[i - 1];
+      S.t0.resize(K); S.t1.resize(K);
+      myers_step(S.peq.data() + (size_t)(c < 0 || c > 4 ? 4 : c) * K,
+                 S.vp.data() + (size_t)(i - 1) * K,
+                 S.vn.data() + (size_t)(i - 1) * K,
+                 S.hp.data(), S.hn.data(), S.t0.data(), S.t1.data(), K);
+      hrow = i;
+    }
+    const int w = (j - 1) >> 6;
+    const uint64_t bit = (uint64_t)1 << ((j - 1) & 63);
+    const int V = (S.hp[w] & bit) ? 1 : ((S.hn[w] & bit) ? -1 : 0);
+    const uint64_t* VPp = S.vp.data() + (size_t)(i - 1) * K;
+    const uint64_t* VNp = S.vn.data() + (size_t)(i - 1) * K;
+    const int Hp = (VPp[w] & bit) ? 1 : ((VNp[w] & bit) ? -1 : 0);
+    const int c = (a[i - 1] != b[j - 1]) ? 1 : 0;
+    if (V + Hp == c) {
+      --i; --j; a2b[i] = j;
+    } else if (V == 1) {
+      --i; a2b[i] = j;
+    } else {
+      --j;
+    }
+  }
+  a2b[0] = 0;
+  return score;
+}
+
+int32_t align_path(const int8_t* a, int n, const int8_t* b, int m,
+                   std::vector<int32_t>& Dbuf, int64_t* a2b,
+                   int32_t band_hint = 24) {
+  if (m > 0 && m <= MYERS_MAX_M && n > 0) {
+    static thread_local MyersScratch S;
+    return myers_path(a, n, b, m, a2b, S);
+  }
   const int W = m + 1;
   Dbuf.resize((size_t)(n + 1) * W);
   int32_t* D = Dbuf.data();
-  fill_exact(a, n, b, m, D, W, band_hint);
+  const int32_t dist = fill_exact(a, n, b, m, D, W, band_hint);
   // backtrack (diagonal > deletion > insertion), matching oracle.align
   int i = n, j = m;
   a2b[n] = m;
@@ -118,6 +265,7 @@ void align_path(const int8_t* a, int n, const int8_t* b, int m,
     }
   }
   a2b[0] = 0;
+  return dist;
 }
 
 }  // namespace
@@ -381,12 +529,17 @@ int64_t edit_distance_sum(const int8_t* cand, int32_t n, const int8_t* segs,
                           const int64_t* offs, const int32_t* lens,
                           int32_t nsegs) {
   static thread_local std::vector<int32_t> Dbuf;
+  static thread_local MyersScratch S;
   int64_t tot = 0;
   for (int32_t s = 0; s < nsegs; ++s) {
     const int8_t* b = segs + offs[s];
     const int m = lens[s];
     if (n == 0) { tot += m; continue; }
     if (m == 0) { tot += n; continue; }
+    if (m <= MYERS_MAX_M) {
+      tot += myers_dist(cand, n, b, m, S);
+      continue;
+    }
     const int W = m + 1;
     Dbuf.resize((size_t)(n + 1) * W);
     tot += fill_exact(cand, n, b, m, Dbuf.data(), W, 16);
@@ -396,13 +549,12 @@ int64_t edit_distance_sum(const int8_t* cand, int32_t n, const int8_t* segs,
 
 // exact a2b prefix map (oracle.align.align_path semantics, bit-identical
 // backtrack tie order) — the hp run-length vote's per-segment alignment.
-// Returns the edit distance (the final fill's D[n][m], exact by the
-// verify-retry rule).
+// Returns the exact edit distance (Myers score or the verify-retried
+// banded fill's D[n][m]).
 int64_t align_map(const int8_t* a, int32_t n, const int8_t* b, int32_t m,
                   int64_t* a2b) {
   static thread_local std::vector<int32_t> Dbuf;
-  align_path(a, n, b, m, Dbuf, a2b);
-  return Dbuf[(size_t)n * (m + 1) + m];
+  return align_path(a, n, b, m, Dbuf, a2b);
 }
 
 }  // extern "C"

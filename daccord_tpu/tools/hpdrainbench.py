@@ -47,30 +47,27 @@ def run_regime(name: str, sim_kw: dict, batch: int, tmp: str) -> dict:
 
     d = os.path.join(tmp, name)
     out = make_dataset(d, SimConfig(**sim_kw), name=name)
-    rows = {}
-    for arm in ("off", "on"):
-        ccfg = ConsensusConfig(hp_rescue=(arm == "on"))
-        pcfg = PipelineConfig(batch_size=batch, consensus=ccfg,
-                              hp_native=True)
-        t0 = time.time()
-        st = correct_to_fasta(out["db"], out["las"],
-                              os.path.join(d, f"{arm}.fasta"), pcfg)
-        rows[arm] = dict(wall_s=round(time.time() - t0, 2),
-                         pipe_wall_s=round(st.wall_s, 2),
-                         hp_wall_s=round(st.hp_wall_s, 3),
-                         windows=st.n_windows, hp_rescued=st.n_hp_rescued)
-    on = rows["on"]
-    dev_wall = on["windows"] / TPU_WINDOWS_PER_SEC
-    bound = on["hp_wall_s"] / (dev_wall + on["hp_wall_s"])
+    # ON arm only: the decision quantity is the per-window drain cost h
+    # (host wall / window); the worst-case non-overlapped TPU fraction is
+    # h / (1/r + h) with r the measured TPU window rate, independent of
+    # dataset size — an off arm would only re-measure the device ladder
+    ccfg = ConsensusConfig(hp_rescue=True)
+    pcfg = PipelineConfig(batch_size=batch, consensus=ccfg, hp_native=True)
+    t0 = time.time()
+    st = correct_to_fasta(out["db"], out["las"],
+                          os.path.join(d, "on.fasta"), pcfg)
+    wall = time.time() - t0
+    h = st.hp_wall_s / max(st.n_windows, 1)
+    bound = h / (1.0 / TPU_WINDOWS_PER_SEC + h)
     line = {
         "regime": name, "batch": batch,
-        "windows": on["windows"], "hp_rescued": on["hp_rescued"],
-        "hp_wall_s": on["hp_wall_s"],
-        "cpu_pipe_wall_on_s": on["pipe_wall_s"],
-        "cpu_pipe_wall_off_s": rows["off"]["pipe_wall_s"],
-        "cpu_hp_fraction": round(on["hp_wall_s"] / on["pipe_wall_s"], 4)
-        if on["pipe_wall_s"] else 0.0,
-        "tpu_projected_device_wall_s": round(dev_wall, 2),
+        "windows": st.n_windows, "hp_rescued": st.n_hp_rescued,
+        "hp_wall_s": round(st.hp_wall_s, 3),
+        "cpu_pipe_wall_s": round(st.wall_s, 2),
+        "cpu_total_wall_s": round(wall, 2),
+        "cpu_hp_fraction": round(st.hp_wall_s / st.wall_s, 4)
+        if st.wall_s else 0.0,
+        "hp_wall_per_window_us": round(1e6 * h, 2),
         "tpu_worst_case_nonoverlap_fraction": round(bound, 4),
     }
     print(json.dumps(line))
@@ -90,13 +87,17 @@ def main(argv=None) -> int:
 
     jax.config.update("jax_platforms", "cpu")   # drain cost is host-side;
     # the device ladder itself runs wherever — cpu keeps this chip-free
+    from daccord_tpu.utils.obs import enable_compilation_cache
+
+    enable_compilation_cache()
 
     regimes = {
-        # cfg2's shape (the flagship single-chip rung), clean error model
-        "clean_cfg2": dict(genome_len=50_000, coverage=100,
+        # cfg2's error model / pile depth at 2/5 genome scale (per-window
+        # drain cost is size-independent; only the routing MIX matters)
+        "clean_cfg2": dict(genome_len=20_000, coverage=100,
                            read_len_mean=8_000, seed=12),
         # same shape under the hp stress knob: worst-case routing volume
-        "hp_cfg2": dict(genome_len=50_000, coverage=100, read_len_mean=8_000,
+        "hp_cfg2": dict(genome_len=20_000, coverage=100, read_len_mean=8_000,
                         hp_indel_slope=1.0, seed=12),
     }
     tmp = tempfile.mkdtemp(prefix="hpdrain_") if not args.keep else "/tmp/hpdrain"

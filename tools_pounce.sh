@@ -1,0 +1,33 @@
+#!/bin/sh
+# Chip-revival pounce script (VERDICT r4 "Next round" #1): the ordered run
+# of every queued hardware experiment (ARCHITECTURE.md "Queued hardware
+# experiments"), each sidecar committed IMMEDIATELY so a tunnel that dies
+# mid-sequence still leaves evidence. Run the moment TUNNEL_LOG.jsonl
+# records alive:true:   sh tools_pounce.sh
+set -x
+cd /root/repo || exit 1
+stamp=$(date -u +%Y%m%dT%H%M%S)
+
+run() {  # run <name> <cmd...>: capture one experiment, commit its sidecar
+  name=$1; shift
+  out="POUNCE_${stamp}_${name}.json"
+  "$@" > "$out" 2> "POUNCE_${stamp}_${name}.log"
+  git add "$out" "POUNCE_${stamp}_${name}.log"
+  git commit -q -m "pounce: ${name} on live chip (${stamp})"
+}
+
+# 1. flagship bench first (pipelined + device_compute + stage breakdown)
+run bench            python bench.py
+# 2. batch sweep (experiment 1)
+run batch4096        env DACCORD_BENCH_BATCH=4096 python bench.py
+run batch8192        env DACCORD_BENCH_BATCH=8192 python bench.py
+# 3. esc_cap tail cost (experiment 3)
+run esccap256        env DACCORD_BENCH_ESC_CAP=256 python bench.py
+# 4. candidates=5 cost (experiment 2)
+run cand5            env DACCORD_BENCH_CANDIDATES=5 python bench.py
+# 5. fused Pallas vs scan decision row (experiment 6)
+run ladder_pallas    python -m daccord_tpu.tools.kernelbench --backend auto \
+                       --stages ladder_full,ladder_pallas
+# 6. hp drain overlap on the real pipeline (experiment 7): hp on vs off
+run hp_on            env DACCORD_BENCH_HP=1 python bench.py
+echo "pounce complete: POUNCE_${stamp}_*"
