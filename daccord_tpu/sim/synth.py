@@ -130,6 +130,23 @@ class SimRead:
     g_of_r: np.ndarray
     err: np.ndarray
     dels: np.ndarray
+    # lazy per-orientation cache for the overlap-construction hot path (r5):
+    # {comp: (gB, err_cum, neg_gB)} — recomputing cumsums/negations per
+    # overlap PAIR was the sim's top cost at scale. Values only, never
+    # semantics; built on first use by _omaps().
+    _oc: dict | None = None
+
+    def omaps(self, comp: bool) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if self._oc is None:
+            self._oc = {}
+        hit = self._oc.get(comp)
+        if hit is None:
+            gB, errB = _oriented_maps(self, comp)
+            gB = np.ascontiguousarray(gB)
+            hit = (gB, np.concatenate(([0], np.cumsum(errB, dtype=np.int64))),
+                   -gB)
+            self._oc[comp] = hit
+        return hit
 
 
 @dataclass
@@ -190,38 +207,65 @@ def _sample_noisy(genome: np.ndarray, start: int, end: int, cfg: SimConfig,
         is_sub = (~is_del) & (u < pd + ps)
         n_ins = rng.geometric(1.0 - pi) - 1 if n else np.zeros(0, np.int64)
 
-    out: list[np.ndarray] = []
-    gpos: list[np.ndarray] = []
-    errm: list[np.ndarray] = []
-    for i in range(n):
-        if is_del[i]:
-            pass
+    # Assembly is vectorized (r5: the per-base python loop was ~40% of sim
+    # wall at scale), but the rng draws MUST keep the original per-position
+    # call sequence — sub draw, then that position's insertion draw — so
+    # every existing seed reproduces its dataset bit-for-bit (cached
+    # fixtures, parity tests). The event loop below touches only positions
+    # that actually draw (~10% at typical rates); in-run insertions draw
+    # nothing (np.full in the original).
+    keep = ~is_del
+    sub_vals = np.zeros(0, dtype=np.int8)
+    ins_vals_parts: list[np.ndarray] = []
+    if n:
+        draw_sub = is_sub
+        draw_ins = n_ins > 0
+        if in_run is not None:
+            rand_ins = draw_ins & ~in_run
         else:
-            b = seg[i]
-            if is_sub[i]:
-                b = (b + rng.integers(1, 4)) % 4
-            out.append(np.array([b], dtype=np.int8))
-            gpos.append(np.array([start + i], dtype=np.int64))
-            errm.append(np.array([1 if is_sub[i] else 0], dtype=np.int8))
-        k = int(n_ins[i])
-        if k:
-            if in_run is not None and in_run[i]:
-                # homopolymer expansion: inserted bases duplicate the run
-                # base (the characteristic ONT indel), still errors vs truth
-                ins = np.full(k, seg[i], dtype=np.int8)
-            else:
-                ins = rng.integers(0, 4, size=k, dtype=np.int8)
-            out.append(ins)
-            gpos.append(np.full(k, start + i, dtype=np.int64))
-            errm.append(np.ones(k, dtype=np.int8))
-    if out:
-        read = np.concatenate(out)
-        g_of_r = np.concatenate(gpos)
-        err = np.concatenate(errm)
-    else:
-        read = np.zeros(0, dtype=np.int8)
-        g_of_r = np.zeros(0, dtype=np.int64)
-        err = np.zeros(0, dtype=np.int8)
+            rand_ins = draw_ins
+        sub_list = []
+        ev = np.nonzero(draw_sub | draw_ins)[0]
+        for i in ev:
+            if draw_sub[i]:
+                sub_list.append(rng.integers(1, 4))
+            k = int(n_ins[i])
+            if k:
+                if in_run is not None and in_run[i]:
+                    ins_vals_parts.append(np.full(k, seg[i], dtype=np.int8))
+                else:
+                    ins_vals_parts.append(rng.integers(0, 4, size=k,
+                                                       dtype=np.int8))
+        sub_vals = np.asarray(sub_list, dtype=np.int8)
+        del rand_ins
+    counts = keep.astype(np.int64) + n_ins
+    total = int(counts.sum()) if n else 0
+    read = np.empty(total, dtype=np.int8)
+    err = np.empty(total, dtype=np.int8)
+    g_of_r = np.repeat(start + np.arange(n, dtype=np.int64), counts)
+    if n:
+        offs = np.zeros(n, dtype=np.int64)
+        np.cumsum(counts[:-1], out=offs[1:])
+        base_pos = offs[keep]
+        bases = seg.copy()
+        if len(sub_vals):
+            si = np.nonzero(is_sub)[0]
+            bases[si] = (bases[si] + sub_vals) % 4
+        read[base_pos] = bases[keep]
+        err[base_pos] = is_sub[keep].astype(np.int8)
+        # insertion slots: for position i they follow its surviving base
+        ins_idx = np.nonzero(n_ins > 0)[0]
+        if len(ins_idx):
+            k_arr = n_ins[ins_idx]
+            starts_i = offs[ins_idx] + keep[ins_idx]
+            K = int(k_arr.sum())
+            flat = (np.repeat(starts_i, k_arr)
+                    + np.arange(K, dtype=np.int64)
+                    - np.repeat(np.concatenate(([0], np.cumsum(k_arr[:-1]))),
+                                k_arr))
+            read[flat] = (np.concatenate(ins_vals_parts)
+                          if ins_vals_parts else np.zeros(0, np.int8))
+            err[flat] = 1
     dels = (start + np.nonzero(is_del)[0]).astype(np.int64)
     return read, g_of_r, err, dels
 
@@ -286,16 +330,16 @@ def _oriented_maps(r: SimRead, comp: bool) -> tuple[np.ndarray, np.ndarray]:
     return r.g_of_r[::-1], r.err[::-1]
 
 
-def _positions_in(g_of_r: np.ndarray, glo: int, ghi: int, ascending: bool) -> tuple[int, int]:
+def _positions_in(g_of_r: np.ndarray, neg_g: np.ndarray, glo: int, ghi: int,
+                  ascending: bool) -> tuple[int, int]:
     """Half-open index range of read positions whose genome pos is in [glo, ghi)."""
     if ascending:
         lo = int(np.searchsorted(g_of_r, glo, side="left"))
         hi = int(np.searchsorted(g_of_r, ghi, side="left"))
     else:
-        # descending: negate
-        neg = -g_of_r
-        lo = int(np.searchsorted(neg, -(ghi - 1), side="left"))
-        hi = int(np.searchsorted(neg, -(glo - 1), side="left"))
+        # descending: search the (cached) negation
+        lo = int(np.searchsorted(neg_g, -(ghi - 1), side="left"))
+        hi = int(np.searchsorted(neg_g, -(glo - 1), side="left"))
     return lo, hi
 
 
@@ -320,10 +364,11 @@ def _true_overlap(a: SimRead, b: SimRead, ai: int, bi: int, cfg: SimConfig,
         return None
     comp = a.strand != b.strand
     # orientation chosen so B traverses the genome in the same direction as A
-    gB, errB = _oriented_maps(b, comp)
+    gA, a_err_cum, negA = a.omaps(False)
+    gB, b_err_cum, negB = b.omaps(comp)
     a_asc = a.strand == 0
-    abpos, aepos = _positions_in(a.g_of_r, glo, ghi, a_asc)
-    bbpos, bepos = _positions_in(gB, glo + shift, ghi + shift, a_asc)
+    abpos, aepos = _positions_in(gA, negA, glo, ghi, a_asc)
+    bbpos, bepos = _positions_in(gB, negB, glo + shift, ghi + shift, a_asc)
     if aepos - abpos < cfg.min_overlap // 2 or bepos - bbpos < cfg.min_overlap // 2:
         return None
 
@@ -335,13 +380,12 @@ def _true_overlap(a: SimRead, b: SimRead, ai: int, bi: int, cfg: SimConfig,
     gb = np.empty(len(bounds), dtype=np.int64)
     gb[:-1] = a.g_of_r[bounds[:-1]]
     gb[-1] = ghi  # end boundary maps to overlap end
-    # map genome coords to B positions
-    bpos = np.empty(len(bounds), dtype=np.int64)
-    for j, g in enumerate(gb):
-        if a_asc:
-            bpos[j] = np.searchsorted(gB, g + shift, side="left")
-        else:
-            bpos[j] = np.searchsorted(-gB, -(g + shift), side="left")
+    # map genome coords to B positions (vectorized r5: this function is the
+    # sim's hot spot at scale; identical arithmetic to the scalar loops)
+    if a_asc:
+        bpos = np.searchsorted(gB, gb + shift, side="left").astype(np.int64)
+    else:
+        bpos = np.searchsorted(negB, -(gb + shift), side="left").astype(np.int64)
     bpos[0] = bbpos
     bpos[-1] = bepos
     bpos = np.maximum.accumulate(np.clip(bpos, bbpos, bepos))
@@ -349,24 +393,20 @@ def _true_overlap(a: SimRead, b: SimRead, ai: int, bi: int, cfg: SimConfig,
     # per-tile diffs (approximation: A-edits + B-edits vs genome in the tile;
     # exact pair diffs are not needed — consumers use these only for error-rate
     # estimation, mirroring the trace-point diff semantics)
-    a_err_cum = np.concatenate([[0], np.cumsum(a.err)])
-    b_err_cum = np.concatenate([[0], np.cumsum(errB)])
     ntiles = len(bounds) - 1
     trace = np.zeros((ntiles, 2), dtype=np.int32)
-    for t in range(ntiles):
-        a0, a1 = bounds[t], bounds[t + 1]
-        b0, b1 = bpos[t], bpos[t + 1]
-        a_ed = int(a_err_cum[a1] - a_err_cum[a0])
-        b_ed = int(b_err_cum[b1] - b_err_cum[b0])
-        # deletions against the genome inside the tile's genome span
-        g0, g1 = min(gb[t], gb[t + 1]), max(gb[t], gb[t + 1])
-        a_dl = int(np.searchsorted(a.dels, g1) - np.searchsorted(a.dels, g0))
-        b_dl = int(np.searchsorted(b.dels, g1 + shift) - np.searchsorted(b.dels, g0 + shift))
-        dv = (int(np.searchsorted(div_sites, g1) - np.searchsorted(div_sites, g0))
-              if div_sites is not None else 0)
-        trace[t, 0] = min(a_ed + a_dl + b_ed + b_dl + dv,
-                          255 if cfg.tspace <= 125 else 65535)
-        trace[t, 1] = b1 - b0
+    a_ed = a_err_cum[bounds[1:]] - a_err_cum[bounds[:-1]]
+    b_ed = b_err_cum[bpos[1:]] - b_err_cum[bpos[:-1]]
+    gmin = np.minimum(gb[:-1], gb[1:])
+    gmax = np.maximum(gb[:-1], gb[1:])
+    a_dl = np.searchsorted(a.dels, gmax) - np.searchsorted(a.dels, gmin)
+    b_dl = (np.searchsorted(b.dels, gmax + shift)
+            - np.searchsorted(b.dels, gmin + shift))
+    tot = a_ed + a_dl + b_ed + b_dl
+    if div_sites is not None:
+        tot += np.searchsorted(div_sites, gmax) - np.searchsorted(div_sites, gmin)
+    trace[:, 0] = np.minimum(tot, 255 if cfg.tspace <= 125 else 65535)
+    trace[:, 1] = bpos[1:] - bpos[:-1]
     ovl.trace = trace
     ovl.diffs = int(trace[:, 0].sum())
     return ovl

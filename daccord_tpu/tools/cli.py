@@ -205,11 +205,19 @@ def daccord_main(argv=None) -> int:
                                          max_err=args.max_err),
                            hp_rescue=(args.hp_rescue
                                       if args.hp_rescue is not None
-                                      # an auto-resolved engine must not
-                                      # flip defaults with tunnel health:
-                                      # the same command has to produce the
-                                      # same bases today and tomorrow
-                                      else (args.backend == "native"
+                                      # default ON for the host engines: the
+                                      # drain costs 2.7% of the cpu-path wall
+                                      # (hpdrainbench r5) for +2.0 Q. OFF for
+                                      # tpu: worst-case non-overlapped bound
+                                      # is 64-80% of the chip's 67 us/window
+                                      # (BASELINE.md r5 hp drain table) -
+                                      # flip pending the on-chip overlap
+                                      # measurement (DACCORD_BENCH_HP=1).
+                                      # An auto-resolved engine must not flip
+                                      # defaults with tunnel health: the same
+                                      # command has to produce the same bases
+                                      # today and tomorrow
+                                      else (args.backend in ("native", "cpu")
                                             and not backend_auto)))
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
                          depth=args.depth, seg_len=args.seg_len,
@@ -729,15 +737,14 @@ def shard_main(argv=None) -> int:
     p.add_argument("--force", action="store_true", help="recompute even if manifest exists")
     p.add_argument("--profile-sample", type=int, default=None, metavar="N",
                    help="piles sampled by the profile estimation pass")
-    p.add_argument("--backend", choices=("auto", "cpu", "tpu"), default="auto")
+    p.add_argument("--backend", choices=("auto", "cpu", "tpu", "native"),
+                   default="auto")
     args = p.parse_args(argv)
     if args.backend == "auto":
         from ..utils.obs import resolve_auto_backend
 
-        # shard jobs use the device ladder; native fallback handled by
-        # PipelineConfig defaults, so a dead tunnel only needs the cpu pin
-        args.backend = resolve_auto_backend(prefer_native=False)
-    if args.backend == "cpu":
+        args.backend = resolve_auto_backend()
+    if args.backend in ("cpu", "native"):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -749,7 +756,8 @@ def shard_main(argv=None) -> int:
         raise SystemExit(f"bad -J {args.J}")
     from ..parallel.launch import run_shard
 
-    scfg = PipelineConfig(batch_size=args.batch)
+    scfg = PipelineConfig(batch_size=args.batch,
+                          native_solver=args.backend == "native")
     if args.profile_sample is not None:
         scfg.profile_sample_piles = args.profile_sample
     m = run_shard(args.db, args.las, args.outdir, i, n, scfg,
