@@ -69,7 +69,8 @@ def max_run(seg: np.ndarray) -> int:
 _LTAB_CACHE: dict = {}
 
 
-def hp_length_tables(profile, Lmax: int = 20, Omax: int = 56) -> np.ndarray:
+def hp_length_tables(profile, Lmax: int = 20, Omax: int = 56,
+                     mult: float = 1.0) -> np.ndarray:
     """``T[L, o] = log P(observed same-base length o | true run length L)``.
 
     Observation model (matches the fit in profile_vs_consensus): each of the
@@ -83,7 +84,8 @@ def hp_length_tables(profile, Lmax: int = 20, Omax: int = 56) -> np.ndarray:
     """
     key = (round(profile.p_del, 5), round(profile.p_ins, 5),
            round(profile.p_sub, 5), round(profile.hp_slope, 3),
-           round(profile.hp_base, 4), profile.hp_cap, Lmax, Omax)
+           round(profile.hp_base, 4), profile.hp_cap, Lmax, Omax,
+           round(mult, 2))
     hit = _LTAB_CACHE.get(key)
     if hit is not None:
         return hit
@@ -92,6 +94,11 @@ def hp_length_tables(profile, Lmax: int = 20, Omax: int = 56) -> np.ndarray:
     base, slope = profile.hp_base, profile.hp_slope
     if base <= 0.0:
         base, slope = max(tot, 1e-4), 0.0
+    # per-window intensity multiplier: the profile's hp fit comes from
+    # tier-0-SOLVED sample windows (biased clean on damaged regimes), so a
+    # routed window's own direct error rate, relative to the profile, says
+    # how much hotter its indel process runs than the fit assumed
+    base = base * mult
     T = np.full((Lmax + 1, Omax + 1), -np.inf)
     for L in range(1, Lmax + 1):
         x = min(L - 1, profile.hp_cap)
@@ -200,7 +207,8 @@ def vote_runs(cons_c: np.ndarray,
 
 
 def solve_window_hp(segments: list[np.ndarray], ol, dbg: DBGParams,
-                    wlen: int, vote: str = "median") -> WindowResult | None:
+                    wlen: int, vote: str = "median",
+                    direct_err: float = float("inf")) -> WindowResult | None:
     """Solve one window in run-length-compressed space and re-expand.
 
     ``ol`` is the tier's OffsetLikely table (compressed-space offsets are a
@@ -219,8 +227,23 @@ def solve_window_hp(segments: list[np.ndarray], ol, dbg: DBGParams,
     res = window_consensus([c for c, _ in comp], ol, dbg, wlen=wlen_c)
     if res.seq is None:
         return None
-    if vote == "posterior":
-        runs = vote_runs_posterior(res.seq, comp, hp_length_tables(ol.profile))
+    prof = ol.profile
+    if vote == "posterior" and prof.hp_slope >= 0.1:
+        # the calibrated posterior only engages when the PROFILE shows
+        # length-dependent indel structure (fitted slope >= 0.1): on clean
+        # data the fit is ~0 and the asymmetric observation model (plus the
+        # heat multiplier below) over-corrects runs the median gets right —
+        # measured −0.42 Q on the clean control without this gate
+        # (BASELINE.md r5 vote table)
+        # quantized per-window heat: direct_err / profile rate, in 0.25
+        # steps so the table cache stays small; unsolved windows (no direct
+        # err) get a middling boost — they are at least as damaged as the
+        # routing threshold implies
+        p_err = max(prof.p_ins + prof.p_del + prof.p_sub, 1e-3)
+        m = (direct_err / p_err) if np.isfinite(direct_err) else 1.5
+        m = float(np.clip(round(m * 4) / 4, 1.0, 3.0))
+        runs = vote_runs_posterior(res.seq, comp,
+                                   hp_length_tables(prof, mult=m))
     else:
         runs = vote_runs(res.seq, comp)
     seq = hp_expand(res.seq, runs)
@@ -253,7 +276,7 @@ def hp_candidate(segments: list[np.ndarray], direct_seq, direct_err: float,
     k, mc, emc = cfg.tiers[0]
     dbg = replace(cfg.dbg, k=k, min_count=mc, edge_min_count=emc)
     res = solve_window_hp(segments, ol_tables[k], dbg, cfg.w,
-                          vote=cfg.hp_vote)
+                          vote=cfg.hp_vote, direct_err=direct_err)
     if res is None:
         return None
     bar = (direct_err - cfg.hp_margin) if solved else cfg.dbg.max_err
