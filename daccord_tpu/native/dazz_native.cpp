@@ -536,7 +536,10 @@ int64_t edit_distance_sum(const int8_t* cand, int32_t n, const int8_t* segs,
     const int m = lens[s];
     if (n == 0) { tot += m; continue; }
     if (m == 0) { tot += n; continue; }
-    if (m <= MYERS_MAX_M) {
+    // distance-only Myers has no row storage, so the gate is far wider
+    // than the path variant's: n*K word-steps beat the banded fill well
+    // past window widths (e.g. whole-read 4k x 4k rescores)
+    if (m <= 8192) {
       tot += myers_dist(cand, n, b, m, S);
       continue;
     }
@@ -555,6 +558,56 @@ int64_t align_map(const int8_t* a, int32_t n, const int8_t* b, int32_t m,
                   int64_t* a2b) {
   static thread_local std::vector<int32_t> Dbuf;
   return align_path(a, n, b, m, Dbuf, a2b);
+}
+
+// best edit distance of needle a against ANY infix of haystack b
+// (oracle.align.infix_distance semantics: free start/end gaps in the
+// haystack). Myers' original approximate-search formulation: bits run along
+// the NEEDLE (multi-word), text consumed with a free-start boundary (no
+// carry-in on the HP shift), score tracked at the needle's last bit and
+// minimized over text positions. Exact; the Q-score harness's hot loop.
+int64_t infix_distance(const int8_t* a, int32_t n, const int8_t* b,
+                       int32_t m) {
+  if (n == 0) return 0;
+  if (m == 0) return n;
+  const int K = (n + 63) >> 6;
+  static thread_local std::vector<uint64_t> peq_v, vp_v, vn_v;
+  peq_v.assign((size_t)5 * K, 0);
+  for (int j = 0; j < n; ++j) {
+    const int8_t c = a[j];
+    if (c >= 0 && c < 5)
+      peq_v[(size_t)c * K + (j >> 6)] |= (uint64_t)1 << (j & 63);
+  }
+  vp_v.assign(K, ~(uint64_t)0);
+  vn_v.assign(K, 0);
+  uint64_t* VP = vp_v.data();
+  uint64_t* VN = vn_v.data();
+  const int nw = (n - 1) >> 6;
+  const uint64_t nb = (uint64_t)1 << ((n - 1) & 63);
+  int64_t score = n, best = n;
+  for (int i = 0; i < m; ++i) {
+    const int8_t c = b[i];
+    const uint64_t* peq = peq_v.data() + (size_t)(c < 0 || c > 4 ? 4 : c) * K;
+    uint64_t carry = 0, hp_in = 0, hn_in = 0;  // free text start: boundary
+    //                                            delta 0, no carry-in
+    for (int w = 0; w < K; ++w) {
+      const uint64_t X = peq[w] | VN[w];
+      const uint64_t av = X & VP[w];
+      const uint64_t t = av + VP[w];
+      const uint64_t sum = t + carry;
+      carry = (uint64_t)(t < av) | (uint64_t)(sum < t);
+      const uint64_t D0 = (sum ^ VP[w]) | X;
+      const uint64_t hp = VN[w] | ~(VP[w] | D0);
+      const uint64_t hn = VP[w] & D0;
+      if (w == nw) score += (hp & nb) ? 1 : ((hn & nb) ? -1 : 0);
+      const uint64_t hpw = (hp << 1) | hp_in; hp_in = hp >> 63;
+      const uint64_t hnw = (hn << 1) | hn_in; hn_in = hn >> 63;
+      VN[w] = hpw & D0;
+      VP[w] = hnw | ~(hpw | D0);
+    }
+    if (score < best) best = score;
+  }
+  return best;
 }
 
 }  // extern "C"

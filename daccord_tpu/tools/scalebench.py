@@ -47,10 +47,19 @@ def du_bytes(*paths: str) -> int:
     return tot
 
 
+_RSS_WRAPPER = (
+    "import resource, subprocess, sys;"
+    "rc = subprocess.run(sys.argv[1:]).returncode;"
+    "print('MAX_RSS_KB', resource.getrusage(resource.RUSAGE_CHILDREN)"
+    ".ru_maxrss, file=sys.stderr);"
+    "sys.exit(rc)")
+
+
 def timed_stage(name: str, argv: list[str], outputs: tuple[str, ...] = (),
                 env: dict | None = None) -> dict:
-    """Run one pipeline stage under /usr/bin/time -v; parse RSS + wall."""
-    cmd = ["/usr/bin/time", "-v", sys.executable, "-m",
+    """Run one pipeline stage in a subprocess; record wall + peak child RSS
+    (no GNU time binary in this image — ru_maxrss of RUSAGE_CHILDREN)."""
+    cmd = [sys.executable, "-c", _RSS_WRAPPER, sys.executable, "-m",
            "daccord_tpu.tools.cli", *argv]
     t0 = time.time()
     r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
@@ -59,7 +68,7 @@ def timed_stage(name: str, argv: list[str], outputs: tuple[str, ...] = (),
     if r.returncode != 0:
         raise RuntimeError(f"stage {name} failed (rc={r.returncode}):\n"
                            f"{r.stderr[-2000:]}")
-    m = re.search(r"Maximum resident set size \(kbytes\): (\d+)", r.stderr)
+    m = re.search(r"MAX_RSS_KB (\d+)", r.stderr)
     rss_mb = round(int(m.group(1)) / 1024, 1) if m else None
     row = {"stage": name, "wall_s": round(wall, 1), "peak_rss_mb": rss_mb,
            "out_bytes": du_bytes(*outputs)}
