@@ -191,6 +191,11 @@ class NativeLadder:
     def __init__(self, ol_tables: dict, cfg, max_kmers: int = 0,
                  rescue_max_kmers: int = 256, _share=None):
         self.cfg = cfg
+        # the hp posterior vote needs the error profile (every OL table
+        # carries the same one)
+        self.profile = (_share.profile if _share is not None else
+                        (next(iter(ol_tables.values())).profile
+                         if ol_tables else None))
         d = cfg.dbg
         tiers = list(cfg.tiers)
         if _share is not None:
@@ -245,6 +250,26 @@ class NativeLadder:
         CLH = 2 * cfg.w
         hp_cons = np.full((B, CLH), 4, dtype=np.int8)
         cons_in = np.ascontiguousarray(out["cons"], dtype=np.int8)
+        # calibrated posterior vote (r5): tables are built ONCE (cached on
+        # self) by the same numpy code as the python host pass (bit-exact
+        # likelihoods), one per quantized heat-grid multiplier (the shared
+        # grid constants in oracle/hp.py); the C++ side only mirrors the
+        # vote walk. Engages under the same slope gate as oracle/hp.py.
+        from ..oracle.hp import (HP_HEAT_LO, HP_HEAT_N, HP_HEAT_STEP,
+                                 hp_length_tables)
+
+        prof = self.profile
+        post_tabs = getattr(self, "_post_tabs", None)
+        if (post_tabs is None
+                and getattr(cfg, "hp_vote", "median") == "posterior"
+                and prof is not None and prof.hp_slope >= 0.1):
+            post_tabs = np.ascontiguousarray(
+                np.stack([hp_length_tables(
+                    prof, mult=HP_HEAT_LO + HP_HEAT_STEP * i)
+                    for i in range(HP_HEAT_N)]), dtype=np.float64)
+            self._post_tabs = post_tabs
+        p_err = ((prof.p_ins + prof.p_del + prof.p_sub)
+                 if prof is not None else 0.0)
         lib.hp_rescue_windows.restype = ctypes.c_int64
         n = int(lib.hp_rescue_windows(
             _ptr(seqs), _ptr(lens), _ptr(nsegs), B, D, L,
@@ -257,7 +282,13 @@ class NativeLadder:
             ctypes.c_double(cfg.hp_margin), int(n_threads),
             _ptr(cons_in), int(cons_in.shape[1]),
             _ptr(hp_cons), CLH,
-            _ptr(out["cons_len"]), _ptr(out["err"]), _ptr(out["tier"])))
+            _ptr(out["cons_len"]), _ptr(out["err"]), _ptr(out["tier"]),
+            _ptr(post_tabs) if post_tabs is not None else None,
+            HP_HEAT_N if post_tabs is not None else 0,
+            int(post_tabs.shape[1] - 1) if post_tabs is not None else 0,
+            int(post_tabs.shape[2] - 1) if post_tabs is not None else 0,
+            ctypes.c_double(p_err),
+            ctypes.c_double(HP_HEAT_LO), ctypes.c_double(HP_HEAT_STEP)))
         if n < 0:
             raise RuntimeError(f"hp_rescue_windows failed: {n}")
         if n:

@@ -1419,8 +1419,10 @@ int solve_windows(const int8_t* seqs, const int32_t* lens,
 // consensus if solved, else in any segment). Solve: run-length-compress the
 // segments, run the FULL-GRAPH tier-0 DBG (M=0: the python path calls the
 // oracle window_consensus) at wlen_c = int(median(compressed lens)), then
-// re-expand each position's run length by the aligned median vote
-// (round-half-even, numpy/python parity). Accept only when the expanded
+// re-expand each position's run length by the aligned MEDIAN vote
+// (round-half-even, numpy/python parity) or — when post_tabs is non-NULL —
+// the r5 CALIBRATED POSTERIOR vote (oracle/hp.py vote_runs_posterior
+// parity; tables built python-side). Accept only when the expanded
 // candidate's exact rescored error beats the direct result (hp_margin) or
 // clears max_err where the direct solve failed. Rescued rows write their
 // (possibly longer-than-CL) sequence into hp_cons[CLH] and update
@@ -1437,7 +1439,14 @@ int64_t hp_rescue_windows(
     double hp_err, int32_t hp_min_run, double hp_margin, int32_t n_threads,
     const int8_t* cons_in, int32_t CL,
     int8_t* hp_cons, int32_t CLH,
-    int32_t* cons_lens, float* errs, int32_t* tiers_io) {
+    int32_t* cons_lens, float* errs, int32_t* tiers_io,
+    // calibrated posterior vote (oracle/hp.py vote_runs_posterior), r5:
+    // post_tabs = [n_mult, Lmax+1, Omax+1] float64 log P(o|L) tables built
+    // by the PYTHON hp_length_tables (bit-exact likelihoods; C++ only
+    // mirrors the vote walk and same-order float64 accumulation), one per
+    // quantized heat multiplier 1.0,1.25,..; NULL = median vote (r4).
+    const double* post_tabs, int32_t n_mult, int32_t Lmax, int32_t Omax,
+    double p_err_prof, double mult_lo, double mult_step) {
   const dbgc::TierSpec ts_hp = {k0, minc0, eminc0, P0, O0, 0, table0};
   std::atomic<int32_t> next(0);
   std::atomic<int64_t> rescued(0);
@@ -1458,6 +1467,8 @@ int64_t hp_rescue_windows(
     std::vector<int64_t> a2b;
     std::vector<int32_t> Dbuf_v;   // align_path / rescore DP matrix
     std::vector<std::vector<int32_t>> pos_votes;
+    std::vector<double> ll_buf;    // posterior log-likelihood accumulator
+    std::vector<int32_t> nv_buf;
     for (;;) {
       const int b = next.fetch_add(1);
       if (b >= B) return;
@@ -1521,8 +1532,74 @@ int64_t hp_rescue_windows(
                          &herr, &hm) != 0)
         continue;
       // ---- aligned per-position run-length vote --------------------------
-      pos_votes.assign(hlen, {});
       a2b.resize(hlen + 1);
+      runs_out.assign(hlen, 1);
+      int64_t out_len = 0;
+      if (post_tabs != nullptr) {
+        // calibrated posterior (vote_runs_posterior parity): per segment,
+        // per-base claim cursors keep same-base counted spans disjoint;
+        // the observation is the summed same-base run length over the
+        // (one-position-extended) span; argmax_L of the summed log
+        // likelihood, first-max tie-break like np.argmax.
+        // heat grid comes from oracle/hp.py's shared constants (mult_lo,
+        // mult_step, n_mult) — the ONE definition; hp_heat() parity:
+        // round to the step grid (nearbyint = python round ties-even on
+        // the same exact power-of-two arithmetic), then clip
+        const int TL = Lmax + 1, TO = Omax + 1;
+        const double mult_hi = mult_lo + mult_step * (n_mult - 1);
+        const double m_raw = std::isfinite(derr)
+            ? derr / std::max(p_err_prof, 1e-3) : 1.5;
+        double mq = std::nearbyint(m_raw / mult_step) * mult_step;
+        if (mq < mult_lo) mq = mult_lo;
+        if (mq > mult_hi) mq = mult_hi;
+        int mi = (int)std::nearbyint((mq - mult_lo) / mult_step);
+        if (mi < 0) mi = 0;
+        if (mi >= n_mult) mi = n_mult - 1;
+        const double* tab = post_tabs + (size_t)mi * TL * TO;
+        ll_buf.assign((size_t)hlen * TL, 0.0);
+        nv_buf.assign(hlen, 0);
+        for (int j = 0; j < nseg; ++j) {
+          const int m = clens[j];
+          if (m == 0) continue;
+          align_path(hcons.data(), hlen, cseqs.data() + (size_t)j * L, m,
+                     Dbuf_v, a2b.data());
+          const int32_t* cr = cruns.data() + (size_t)j * L;
+          const int8_t* cs = cseqs.data() + (size_t)j * L;
+          int claimed[4] = {0, 0, 0, 0};
+          for (int i = 0; i < hlen; ++i) {
+            const int c = hcons[i];
+            if (c < 0 || c > 3) continue;
+            int lo = (int)a2b[i];
+            if (claimed[c] > lo) lo = claimed[c];
+            int hi = (int)a2b[i + 1];
+            if (hi < lo) hi = lo;
+            if (hi < m && cs[hi] == c) ++hi;
+            if (lo > claimed[c] && cs[lo - 1] == c) --lo;
+            if (hi <= lo) continue;
+            int64_t o = 0;
+            for (int q = lo; q < hi; ++q)
+              if (cs[q] == c) o += cr[q];
+            const int oc = o > Omax ? Omax : (int)o;
+            double* row = ll_buf.data() + (size_t)i * TL;
+            for (int Lv = 0; Lv < TL; ++Lv)
+              row[Lv] += tab[(size_t)Lv * TO + oc];
+            nv_buf[i] += 1;
+            claimed[c] = hi;
+          }
+        }
+        for (int i = 0; i < hlen; ++i) {
+          if (nv_buf[i]) {
+            const double* row = ll_buf.data() + (size_t)i * TL;
+            int bestL = 1;
+            double bestv = row[1];
+            for (int Lv = 2; Lv < TL; ++Lv)
+              if (row[Lv] > bestv) { bestv = row[Lv]; bestL = Lv; }
+            runs_out[i] = bestL;
+          }
+          out_len += runs_out[i];
+        }
+      } else {
+      pos_votes.assign(hlen, {});
       for (int j = 0; j < nseg; ++j) {
         const int m = clens[j];
         if (m == 0) continue;
@@ -1534,8 +1611,6 @@ int64_t hp_rescue_windows(
           for (int64_t q = a2b[i]; q < a2b[i + 1]; ++q)
             if (cs[q] == hcons[i]) pos_votes[i].push_back(cr[q]);
       }
-      runs_out.assign(hlen, 1);
-      int64_t out_len = 0;
       for (int i = 0; i < hlen; ++i) {
         auto& v = pos_votes[i];   // sort in place: no per-position copies
         if (!v.empty()) {
@@ -1548,6 +1623,7 @@ int64_t hp_rescue_windows(
           runs_out[i] = std::max(1, (int)std::nearbyint(med));
         }
         out_len += runs_out[i];
+      }
       }
       if (out_len < wlen / 2 || out_len > 2 * wlen || out_len > CLH)
         continue;

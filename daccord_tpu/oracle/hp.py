@@ -68,6 +68,23 @@ def max_run(seg: np.ndarray) -> int:
 
 _LTAB_CACHE: dict = {}
 
+# heat-multiplier grid for the posterior vote: per-window intensity
+# multipliers quantized to [LO, HI] in STEP increments. The ONE definition —
+# the python vote, the native table build (native/api.py) and the C++ index
+# map (dazz_native.cpp, passed these values) must all agree or votes would
+# silently read the wrong table.
+HP_HEAT_LO = 1.0
+HP_HEAT_HI = 3.0
+HP_HEAT_STEP = 0.25
+HP_HEAT_N = int(round((HP_HEAT_HI - HP_HEAT_LO) / HP_HEAT_STEP)) + 1
+
+
+def hp_heat(direct_err: float, p_err: float) -> float:
+    """Quantized per-window heat multiplier (shared by python + native)."""
+    m = (direct_err / max(p_err, 1e-3)) if np.isfinite(direct_err) else 1.5
+    return float(np.clip(round(m / HP_HEAT_STEP) * HP_HEAT_STEP,
+                         HP_HEAT_LO, HP_HEAT_HI))
+
 
 def hp_length_tables(profile, Lmax: int = 20, Omax: int = 56,
                      mult: float = 1.0) -> np.ndarray:
@@ -235,13 +252,10 @@ def solve_window_hp(segments: list[np.ndarray], ol, dbg: DBGParams,
         # heat multiplier below) over-corrects runs the median gets right —
         # measured −0.42 Q on the clean control without this gate
         # (BASELINE.md r5 vote table)
-        # quantized per-window heat: direct_err / profile rate, in 0.25
-        # steps so the table cache stays small; unsolved windows (no direct
-        # err) get a middling boost — they are at least as damaged as the
-        # routing threshold implies
-        p_err = max(prof.p_ins + prof.p_del + prof.p_sub, 1e-3)
-        m = (direct_err / p_err) if np.isfinite(direct_err) else 1.5
-        m = float(np.clip(round(m * 4) / 4, 1.0, 3.0))
+        # quantized per-window heat (hp_heat): direct_err / profile rate;
+        # unsolved windows (no direct err) get a middling boost — they are
+        # at least as damaged as the routing threshold implies
+        m = hp_heat(direct_err, prof.p_ins + prof.p_del + prof.p_sub)
         runs = vote_runs_posterior(res.seq, comp,
                                    hp_length_tables(prof, mult=m))
     else:

@@ -511,10 +511,10 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     log = JsonlLogger(cfg.log_path)
     fetch_many_fn = None
     native_dispatch = solver is None and cfg.native_solver
-    # the C++ hp pass implements the median vote only; a posterior vote must
-    # run the python host pass on EVERY backend, or an A/B would silently
-    # measure median under a 'posterior' label
-    hp_use_native = cfg.hp_native and cfg.consensus.hp_vote == "median"
+    # both votes are implemented in the C++ engine (r5: posterior tables
+    # are built python-side and passed in, bit-identical by test), so
+    # hp_native routes either vote
+    hp_use_native = cfg.hp_native
     if native_dispatch:
         from ..native import available as _nat_avail
         from ..native.api import NativeLadder
