@@ -1,0 +1,38 @@
+"""CLI behaviors that must hold WITHOUT a backend probe (fast tier).
+
+The r5 `--backend auto` fallback probes a possibly-dead tunnel for up to
+150 s; usage errors must be checked before that probe or a typo'd command
+stalls for minutes (code-review finding, r5). These tests run the real CLI
+in a subprocess with a tight wall-clock budget.
+"""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def _run(args, timeout=30):
+    t0 = time.time()
+    r = subprocess.run([sys.executable, "-m", "daccord_tpu.tools.cli", *args],
+                       capture_output=True, text=True, timeout=timeout)
+    return r, time.time() - t0
+
+
+@pytest.mark.parametrize("args,needle", [
+    (["daccord", "x.db", "x.las", "-o", "y.fa", "--block", "2", "-J", "0,4"],
+     "mutually exclusive"),
+    (["daccord", "x.db", "x.las", "-o", "y.fa", "-k", "3"],
+     "supported range"),
+    (["daccord", "x.db", "x.las", "-o", "y.fa", "--backend", "tpu",
+      "-M", "0"], "requires --backend native"),
+    (["daccord", "x.db", "x.las", "-o", "y.fa", "--backend", "native",
+      "--mesh", "4"], "cannot be"),
+])
+def test_usage_errors_fast_with_auto_backend(args, needle):
+    r, dt = _run(args)
+    assert r.returncode != 0
+    assert needle in r.stderr
+    # well under any probe timeout: the check ran before backend resolution
+    assert dt < 20
