@@ -49,6 +49,13 @@ class ConsensusConfig:
     hp_vote: str = "median"      # run-length vote: "median" (flat, r4) or
                                  # "posterior" (profile-calibrated length
                                  # posterior, oracle/hp.py r5)
+    hp_accept: str = "rescore"   # acceptance: "rescore" (raw unit-cost,
+                                 # r4) or "likelihood" (experimental
+                                 # likelihood-ratio under the observation
+                                 # model; python path only, engages with
+                                 # the posterior's slope gate)
+    hp_lambda_c: float = 3.0     # compressed-space edit penalty (log
+                                 # units) for the likelihood acceptance
 
     def __post_init__(self):
         # pack_result's 5-bit tier field reserves HP_TIER (29) for
@@ -65,6 +72,9 @@ class ConsensusConfig:
         if self.hp_vote not in ("median", "posterior"):
             raise ValueError(f"hp_vote={self.hp_vote!r}: must be 'median' "
                              "or 'posterior'")
+        if self.hp_accept not in ("rescore", "likelihood"):
+            raise ValueError(f"hp_accept={self.hp_accept!r}: must be "
+                             "'rescore' or 'likelihood'")
 
     @property
     def k_values(self) -> tuple[int, ...]:

@@ -195,6 +195,61 @@ def vote_runs_posterior(cons_c: np.ndarray,
     return out
 
 
+def hp_loglik(cand: np.ndarray,
+              comp: list[tuple[np.ndarray, np.ndarray]],
+              ltab: np.ndarray, lam_c: float) -> float:
+    """Log-likelihood of the segment data under a candidate sequence.
+
+    The calibrated ACCEPTANCE objective (cfg.hp_accept="likelihood"): the
+    candidate is run-length-compressed; each segment contributes its
+    run-length observations' log P(o_s | L_i) (the same claim-cursor walk
+    as the posterior vote) plus a compressed-space edit penalty
+    ``-lam_c * d_c`` (substitutions/inter-run indels are NOT part of the
+    length model; lam_c ~ -log(compressed-space per-base error rate)).
+    Comparing J across candidates compares how well each explains the SAME
+    data — unlike the raw unit-cost rescore, a true-length candidate is not
+    charged for fixing the data's own drift.
+    """
+    cc, cruns = hp_compress(cand)
+    n = len(cc)
+    if n == 0:
+        return -np.inf
+    Lmax = ltab.shape[0] - 1
+    Omax = ltab.shape[1] - 1
+    L_idx = np.clip(cruns, 1, Lmax)
+    J = 0.0
+    for cseg, runs in comp:
+        if len(cseg) == 0:
+            continue
+        m = len(cseg)
+        d_c, a2b = align_path(cc, cseg)
+        J -= lam_c * float(d_c)
+        claimed = [0, 0, 0, 0]
+        for i in range(n):
+            c = cc[i]
+            lo = max(int(a2b[i]), claimed[c])
+            hi = max(int(a2b[i + 1]), lo)
+            if hi < m and cseg[hi] == c:
+                hi += 1
+            if lo > claimed[c] and cseg[lo - 1] == c:
+                lo -= 1
+            if hi <= lo:
+                continue
+            claimed[c] = hi
+            o = 0
+            for j in range(lo, hi):
+                if cseg[j] == c:
+                    o += int(runs[j])
+            v = ltab[int(L_idx[i]), min(o, Omax)]
+            if np.isfinite(v):
+                J += float(v)
+            else:
+                J -= 60.0   # impossible-under-model observation: a finite
+                #             but crushing penalty (log ~ e-26) so one
+                #             outlier cannot veto via -inf
+    return J
+
+
 def vote_runs(cons_c: np.ndarray,
               comp: list[tuple[np.ndarray, np.ndarray]]) -> np.ndarray:
     """Per-position run lengths for the compressed consensus by aligned vote.
@@ -292,6 +347,27 @@ def hp_candidate(segments: list[np.ndarray], direct_seq, direct_err: float,
     res = solve_window_hp(segments, ol_tables[k], dbg, cfg.w,
                           vote=cfg.hp_vote, direct_err=direct_err)
     if res is None:
+        return None
+    prof = ol_tables[k].profile
+    if (cfg.hp_accept == "likelihood" and solved
+            and cfg.hp_vote == "posterior" and prof.hp_slope >= 0.1):
+        # likelihood-ratio acceptance (hp_loglik): accept the candidate
+        # that better EXPLAINS the segments under the calibrated model,
+        # instead of the raw unit-cost rescore (which charges a true-length
+        # candidate for fixing the data's own drift — BASELINE.md r5
+        # anatomy; the expected-deviation variant of this idea measured
+        # negative and is recorded there). Same slope gate as the vote;
+        # failed-direct windows keep the raw max_err bar below. A loose
+        # raw-error sanity bound keeps pathological likelihood wins out.
+        ltab = hp_length_tables(
+            prof, mult=hp_heat(direct_err,
+                               prof.p_ins + prof.p_del + prof.p_sub))
+        comp = [hp_compress(s) for s in segments]
+        lam_c = cfg.hp_lambda_c
+        if (hp_loglik(res.seq, comp, ltab, lam_c)
+                > hp_loglik(direct_seq, comp, ltab, lam_c)
+                and res.err <= direct_err + 0.10):
+            return res
         return None
     bar = (direct_err - cfg.hp_margin) if solved else cfg.dbg.max_err
     if res.err >= bar:

@@ -22,6 +22,12 @@ def main(argv=None) -> int:
                          "(hp_native off) — the C++ engine implements "
                          "median only until the vote decision lands")
     ap.add_argument("--regime", default="hp")
+    ap.add_argument("--accept", default="rescore",
+                    choices=("rescore", "likelihood"),
+                    help="acceptance objective for ALL arms (hp_accept); "
+                         "non-rescore arms run the python host pass")
+    ap.add_argument("--lambda-c", type=float, default=None,
+                    help="hp_lambda_c override for the likelihood arm")
     args = ap.parse_args(argv)
     import jax
 
@@ -47,10 +53,16 @@ def main(argv=None) -> int:
         parts = arm.split(":")
         he, hmr = parts[0], parts[1]
         vote = parts[2] if len(parts) > 2 else "median"
-        ccfg = ConsensusConfig(hp_rescue=True, hp_err=float(he),
-                               hp_min_run=int(hmr), hp_vote=vote)
-        cfg = PipelineConfig(consensus=ccfg, hp_native=(vote == "median"))
-        out_fa = os.path.join(d, f"corr_hp_{he}_{hmr}_{vote}.fasta")
+        kw = dict(hp_rescue=True, hp_err=float(he), hp_min_run=int(hmr),
+                  hp_vote=vote, hp_accept=args.accept)
+        if args.lambda_c is not None:
+            kw["hp_lambda_c"] = args.lambda_c
+        ccfg = ConsensusConfig(**kw)
+        cfg = PipelineConfig(consensus=ccfg,
+                             hp_native=(vote == "median"
+                                        and args.accept == "rescore"))
+        out_fa = os.path.join(
+            d, f"corr_hp_{he}_{hmr}_{vote}_{args.accept}.fasta")
         t0 = time.perf_counter()
         stats = correct_to_fasta(paths["db"], paths["las"], out_fa, cfg,
                                  profile=prof)
