@@ -75,6 +75,19 @@ def daccord_main(argv=None) -> int:
                         "(BASELINE.md r4). Default ON for --backend native "
                         "(the C++ engine makes it cheap); opt-in elsewhere "
                         "until the on-chip cost is measured")
+    p.add_argument("--hp-vote", choices=("median", "posterior"),
+                   default="median",
+                   help="hp run-length vote: median (r4) or the profile-"
+                        "calibrated length posterior (r5; engages only when "
+                        "the fitted hp slope shows length-dependent indels, "
+                        "so clean data is untouched). BASELINE.md r5 table")
+    p.add_argument("--hp-accept", choices=("rescore", "likelihood"),
+                   default="rescore",
+                   help="hp acceptance objective: raw unit-cost rescore (r4) "
+                        "or the likelihood-ratio under the calibrated "
+                        "observation model (r5: hp stress Q 14.23 -> 16.29, "
+                        "composite-stress Q 18.11 -> 23.29; python host "
+                        "pass). Same fitted-slope gate as --hp-vote")
     p.add_argument("--overflow-rescue", action="store_true",
                    help="re-solve windows whose top-M cap bound at the rescue "
                         "active-set size (reference full-graph semantics for "
@@ -218,7 +231,9 @@ def daccord_main(argv=None) -> int:
                                       # command has to produce the same bases
                                       # today and tomorrow
                                       else (args.backend in ("native", "cpu")
-                                            and not backend_auto)))
+                                            and not backend_auto)),
+                           hp_vote=args.hp_vote,
+                           hp_accept=args.hp_accept)
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
                          depth=args.depth, seg_len=args.seg_len,
                          max_kmers=args.max_kmers,
