@@ -288,7 +288,9 @@ class NativeLadder:
             int(post_tabs.shape[1] - 1) if post_tabs is not None else 0,
             int(post_tabs.shape[2] - 1) if post_tabs is not None else 0,
             ctypes.c_double(p_err),
-            ctypes.c_double(HP_HEAT_LO), ctypes.c_double(HP_HEAT_STEP)))
+            ctypes.c_double(HP_HEAT_LO), ctypes.c_double(HP_HEAT_STEP),
+            int(getattr(cfg, "hp_accept", "rescore") == "likelihood"),
+            ctypes.c_double(getattr(cfg, "hp_lambda_c", 3.0))))
         if n < 0:
             raise RuntimeError(f"hp_rescue_windows failed: {n}")
         if n:

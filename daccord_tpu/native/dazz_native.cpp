@@ -1428,6 +1428,71 @@ int solve_windows(const int8_t* seqs, const int32_t* lens,
 // (possibly longer-than-CL) sequence into hp_cons[CLH] and update
 // cons_lens/errs in place with tiers_io = 29 (HP_TIER). Returns count
 // rescued.
+namespace {
+// log-likelihood of the compressed segments under one candidate sequence
+// (oracle/hp.py hp_loglik parity): run-length-compress the candidate, then
+// per segment add -lambda_c per compressed edit plus the posterior walk's
+// per-position log P(o | L_i); float64, python's accumulation order.
+double hp_loglik_c(const int8_t* cand, int cand_len, const int8_t* cseqs,
+                   const int32_t* cruns_all, const int32_t* clens, int nseg,
+                   int L_stride, const double* tab, int Lmax, int Omax,
+                   double lam_c, std::vector<int8_t>& cc_buf,
+                   std::vector<int32_t>& cr_buf, std::vector<int64_t>& a2b,
+                   std::vector<int32_t>& Dbuf_v) {
+  cc_buf.clear();
+  cr_buf.clear();
+  for (int i = 0; i < cand_len; ++i) {
+    if (!cc_buf.empty() && cand[i] == cc_buf.back()) {
+      ++cr_buf.back();
+    } else {
+      cc_buf.push_back(cand[i]);
+      cr_buf.push_back(1);
+    }
+  }
+  const int n = (int)cc_buf.size();
+  if (n == 0) return -std::numeric_limits<double>::infinity();
+  const int TO = Omax + 1;
+  double J = 0.0;
+  a2b.resize(n + 1);
+  for (int j = 0; j < nseg; ++j) {
+    const int m = clens[j];
+    if (m == 0) continue;
+    const int8_t* cs = cseqs + (size_t)j * L_stride;
+    const int32_t* cr = cruns_all + (size_t)j * L_stride;
+    const int32_t d_c =
+        align_path(cc_buf.data(), n, cs, m, Dbuf_v, a2b.data());
+    J -= lam_c * (double)d_c;
+    int claimed[4] = {0, 0, 0, 0};
+    for (int i = 0; i < n; ++i) {
+      const int c = cc_buf[i];
+      if (c < 0 || c > 3) continue;
+      int lo = (int)a2b[i];
+      if (claimed[c] > lo) lo = claimed[c];
+      int hi = (int)a2b[i + 1];
+      if (hi < lo) hi = lo;
+      if (hi < m && cs[hi] == c) ++hi;
+      if (lo > claimed[c] && cs[lo - 1] == c) --lo;
+      if (hi <= lo) continue;
+      claimed[c] = hi;
+      int64_t o = 0;
+      for (int q = lo; q < hi; ++q)
+        if (cs[q] == c) o += cr[q];
+      int Li = cr_buf[i];
+      if (Li < 1) Li = 1;
+      if (Li > Lmax) Li = Lmax;
+      const double v = tab[(size_t)Li * TO + (o > Omax ? Omax : (int)o)];
+      if (std::isfinite(v)) {
+        J += v;
+      } else {
+        J -= 60.0;   // impossible-under-model observation: crushing but
+        //              finite, one outlier cannot veto via -inf
+      }
+    }
+  }
+  return J;
+}
+}  // namespace
+
 int64_t hp_rescue_windows(
     const int8_t* seqs, const int32_t* lens, const int32_t* nsegs,
     int32_t B, int32_t D, int32_t L,
@@ -1446,7 +1511,12 @@ int64_t hp_rescue_windows(
     // mirrors the vote walk and same-order float64 accumulation), one per
     // quantized heat multiplier 1.0,1.25,..; NULL = median vote (r4).
     const double* post_tabs, int32_t n_mult, int32_t Lmax, int32_t Omax,
-    double p_err_prof, double mult_lo, double mult_step) {
+    double p_err_prof, double mult_lo, double mult_step,
+    // likelihood-ratio acceptance (oracle/hp.py hp_loglik; r5): 1 = accept
+    // the candidate that better explains the segments under the model
+    // (only meaningful with post_tabs; solved windows only), 0 = raw
+    // rescore bar. lambda_c = compressed-space edit penalty (log units).
+    int32_t accept_likelihood, double lambda_c) {
   const dbgc::TierSpec ts_hp = {k0, minc0, eminc0, P0, O0, 0, table0};
   std::atomic<int32_t> next(0);
   std::atomic<int64_t> rescued(0);
@@ -1469,6 +1539,8 @@ int64_t hp_rescue_windows(
     std::vector<std::vector<int32_t>> pos_votes;
     std::vector<double> ll_buf;    // posterior log-likelihood accumulator
     std::vector<int32_t> nv_buf;
+    std::vector<int8_t> cc_buf;    // hp_loglik_c candidate compression
+    std::vector<int32_t> cr_buf;
     for (;;) {
       const int b = next.fetch_add(1);
       if (b >= B) return;
@@ -1535,6 +1607,7 @@ int64_t hp_rescue_windows(
       a2b.resize(hlen + 1);
       runs_out.assign(hlen, 1);
       int64_t out_len = 0;
+      const double* tab_sel = nullptr;   // heat-selected posterior table
       if (post_tabs != nullptr) {
         // calibrated posterior (vote_runs_posterior parity): per segment,
         // per-base claim cursors keep same-base counted spans disjoint;
@@ -1556,6 +1629,7 @@ int64_t hp_rescue_windows(
         if (mi < 0) mi = 0;
         if (mi >= n_mult) mi = n_mult - 1;
         const double* tab = post_tabs + (size_t)mi * TL * TO;
+        tab_sel = tab;
         ll_buf.assign((size_t)hlen * TL, 0.0);
         nv_buf.assign(hlen, 0);
         for (int j = 0; j < nseg; ++j) {
@@ -1646,8 +1720,23 @@ int64_t hp_rescue_windows(
       }
       const double err_hp =
           (double)tot / (double)std::max<int64_t>(seg_total, 1);
-      const double bar = solved ? derr - hp_margin : max_err;
-      if (err_hp >= bar) continue;
+      if (accept_likelihood && tab_sel != nullptr && solved) {
+        // likelihood-ratio acceptance (hp_loglik parity): the expanded
+        // candidate must EXPLAIN the segments better than the direct one,
+        // with a loose raw-error sanity bound (oracle/hp.py hp_candidate)
+        const double j_exp = hp_loglik_c(
+            expanded.data(), (int)out_len, cseqs.data(), cruns.data(),
+            clens.data(), nseg, L, tab_sel, Lmax, Omax, lambda_c,
+            cc_buf, cr_buf, a2b, Dbuf_v);
+        const double j_dir = hp_loglik_c(
+            cons_in + (size_t)b * CL, cons_lens[b], cseqs.data(),
+            cruns.data(), clens.data(), nseg, L, tab_sel, Lmax, Omax,
+            lambda_c, cc_buf, cr_buf, a2b, Dbuf_v);
+        if (!(j_exp > j_dir) || err_hp > derr + 0.10) continue;
+      } else {
+        const double bar = solved ? derr - hp_margin : max_err;
+        if (err_hp >= bar) continue;
+      }
       int8_t* out_row = hp_cons + (size_t)b * CLH;
       std::memset(out_row, PAD, CLH);
       std::memcpy(out_row, expanded.data(), out_len);

@@ -511,12 +511,11 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     log = JsonlLogger(cfg.log_path)
     fetch_many_fn = None
     native_dispatch = solver is None and cfg.native_solver
-    # both votes are implemented in the C++ engine (r5: posterior tables
-    # are built python-side and passed in, bit-identical by test); the
-    # experimental likelihood acceptance is python-only and must route the
-    # host pass or it would silently run the raw rescore
-    hp_use_native = (cfg.hp_native
-                     and cfg.consensus.hp_accept == "rescore")
+    # both votes AND both acceptance objectives are implemented in the C++
+    # engine (r5: posterior tables are built python-side and passed in;
+    # likelihood walk mirrored — all byte-identical by test), so hp_native
+    # routes every hp configuration
+    hp_use_native = cfg.hp_native
     if native_dispatch:
         from ..native import available as _nat_avail
         from ..native.api import NativeLadder

@@ -58,9 +58,9 @@ def main(argv=None) -> int:
         if args.lambda_c is not None:
             kw["hp_lambda_c"] = args.lambda_c
         ccfg = ConsensusConfig(**kw)
-        cfg = PipelineConfig(consensus=ccfg,
-                             hp_native=(vote == "median"
-                                        and args.accept == "rescore"))
+        # every vote/acceptance combination runs in the C++ engine now
+        # (byte-identical by test); --no-native would be the parity lever
+        cfg = PipelineConfig(consensus=ccfg)
         out_fa = os.path.join(
             d, f"corr_hp_{he}_{hmr}_{vote}_{args.accept}.fasta")
         t0 = time.perf_counter()
