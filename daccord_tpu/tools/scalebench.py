@@ -97,6 +97,14 @@ def main(argv=None) -> int:
     os.makedirs(d, exist_ok=True)
     rows = []
 
+    def emit(row: dict) -> None:
+        # every row lands on disk IMMEDIATELY: a multi-hour run killed by a
+        # wall-clock limit must keep the stages it finished
+        rows.append(row)
+        if args.out:
+            with open(args.out, "a") as fh:
+                fh.write(json.dumps(row) + "\n")
+
     # stage 0: synthetic dataset (sim is part of the measurement: it is this
     # environment's only read source at scale)
     gen = int(args.genome_mb * 1e6)
@@ -118,7 +126,7 @@ def main(argv=None) -> int:
                "out_bytes": du_bytes(out["db"], out["las"],
                                      os.path.join(d, ".scale.bps"))}
         print(json.dumps(row), flush=True)
-        rows.append(row)
+        emit(row)
     db, las = out["db"], out["las"]
     depth = str(int(args.coverage))
     mem = str(args.mem_records)
@@ -129,31 +137,31 @@ def main(argv=None) -> int:
     outdir = os.path.join(d, "shards")
     fa = os.path.join(d, "corrected.fasta")
 
-    rows.append(timed_stage("inqual", ["inqual", db, las, "-d", depth],
+    emit(timed_stage("inqual", ["inqual", db, las, "-d", depth],
                             outputs=(os.path.join(d, ".scale.inqual.anno"),
                                      os.path.join(d, ".scale.inqual.data"))))
-    rows.append(timed_stage("repeats", ["repeats", db, las, "-d", depth,
+    emit(timed_stage("repeats", ["repeats", db, las, "-d", depth,
                                         "--factor", "1.5"],
                             outputs=(os.path.join(d, ".scale.rep.anno"),
                                      os.path.join(d, ".scale.rep.data"))))
-    rows.append(timed_stage("filter", ["filter", db, las, filt,
+    emit(timed_stage("filter", ["filter", db, las, filt,
                                        "--mem-records", mem],
                             outputs=(filt,)))
-    rows.append(timed_stage("filtersym", ["filtersym", filt, sym,
+    emit(timed_stage("filtersym", ["filtersym", filt, sym,
                                           "--db", db, "--mem-records", mem],
                             outputs=(sym,)))
-    rows.append(timed_stage("lassort", ["lassort", sym, srt,
+    emit(timed_stage("lassort", ["lassort", sym, srt,
                                         "--mem-records", mem],
                             outputs=(srt,)))
     for s in range(args.shards):
-        rows.append(timed_stage(
+        emit(timed_stage(
             f"shard{s}", ["shard", db, srt, outdir,
                           "-J", f"{s},{args.shards}",
                           "--backend", "native", "--checkpoint-every", "256"],
             outputs=(outdir,)))
-    rows.append(timed_stage("merge", ["merge", outdir, str(args.shards), fa],
+    emit(timed_stage("merge", ["merge", outdir, str(args.shards), fa],
                             outputs=(fa,)))
-    rows.append(timed_stage("qveval", ["qveval", fa, out["truth"],
+    emit(timed_stage("qveval", ["qveval", fa, out["truth"],
                                        "--raw-db", db]))
 
     summary = {
@@ -166,8 +174,7 @@ def main(argv=None) -> int:
     print(json.dumps(summary), flush=True)
     if args.out:
         with open(args.out, "a") as fh:
-            for r in rows + [summary]:
-                fh.write(json.dumps(r) + "\n")
+            fh.write(json.dumps(summary) + "\n")
     if not args.keep:
         import shutil
 
