@@ -4,6 +4,11 @@
 # experiments"), each sidecar committed IMMEDIATELY so a tunnel that dies
 # mid-sequence still leaves evidence. Run the moment TUNNEL_LOG.jsonl
 # records alive:true:   sh tools_pounce.sh
+#
+# EXCLUSIVITY (2026-08-02): stop tools_probe_loop.sh before running this.
+# Each probe opens a fresh axon client; a concurrent client while a bench
+# holds the device can leave the bench's RPC unanswered indefinitely.
+# Probe manually between runs instead.
 set -x
 cd /root/repo || exit 1
 stamp=$(date -u +%Y%m%dT%H%M%S)
@@ -18,9 +23,11 @@ run() {  # run <name> <cmd...>: capture one experiment, commit its sidecar
 
 # 1. flagship bench first (pipelined + device_compute + stage breakdown)
 run bench            python bench.py
-# 2. batch sweep (experiment 1)
+# 2. batch sweep (experiment 1). 8192 dropped 2026-08-02: server-side XLA
+# compile scales superlinearly with B (measured 256->35s, 1024->242s,
+# 2048->925s; 8192 extrapolates to 2-4h) — precompile 2048/4096 via the
+# persistent cache first, see BASELINE.md "r5 live-chip" notes.
 run batch4096        env DACCORD_BENCH_BATCH=4096 python bench.py
-run batch8192        env DACCORD_BENCH_BATCH=8192 python bench.py
 # 3. esc_cap tail cost (experiment 3)
 run esccap256        env DACCORD_BENCH_ESC_CAP=256 python bench.py
 # 4. candidates=5 cost (experiment 2)
