@@ -146,41 +146,48 @@ def _fused_kernel(adjW_ref, wt_ref, s0_ref, snk_ref, sel_ref,
     ti = jax.lax.broadcasted_iota(jnp.int32, (P, CL), 0)
     onehot_tail = (ti == jnp.clip(jj - k + 1, 0, P - 1)).astype(jnp.float32)
 
+    # Mosaic note (2026-08-02, first real-v5e compile): every intermediate
+    # below stays rank>=2 ([TB, 1] instead of [TB]). Rank-1 vectors whose
+    # only dim lands on sublanes force an implicit-dim reshape that crashes
+    # the v5e Mosaic layout inferer (`inferReshape: arr.size() >=
+    # layout_rank` SIGABRT in tpu_compile_helper) — keepdims reductions and
+    # [TB, 1] broadcasts avoid the reshape entirely and lower identically.
     chosen = jnp.zeros((TB, M), dtype=jnp.bool_)
     flat_idx = iota_t * M + iota_v
     for c in range(C):
         chosen3 = jax.lax.broadcast_in_dim(chosen, (TB, P, M), (0, 2))
         fmask = jnp.where(chosen3, NEG, final)
-        mx = jnp.max(fmask, axis=(1, 2))                   # [TB]
-        mx3 = jax.lax.broadcast_in_dim(mx, (TB, P, M), (0,))
-        idx = jnp.min(jnp.where(fmask == mx3, flat_idx, P * M), axis=(1, 2))
-        t_best = idx // M                                  # [TB]
+        mx = jnp.max(jnp.max(fmask, axis=2), axis=1, keepdims=True)  # [TB,1]
+        mx3 = jax.lax.broadcast_in_dim(mx, (TB, P, M), (0, 1))
+        idx = jnp.min(jnp.min(jnp.where(fmask == mx3, flat_idx, P * M),
+                              axis=2), axis=1, keepdims=True)        # [TB,1]
+        t_best = idx // M                                  # [TB, 1]
         v_best = idx % M
-        v_bc = jax.lax.broadcast_in_dim(v_best, (TB, M), (0,))
+        v_bc = jax.lax.broadcast_in_dim(v_best, (TB, M), (0, 1))
         chosen = chosen | (iota_m == v_bc)
-        t_bc = jax.lax.broadcast_in_dim(t_best, (TB, M), (0,))
 
         # ---- gather-free one-hot backtrack ----------------------------
         def back_step(i, node):
             t = P - 1 - i
-            forced = jnp.where(t == t_best, v_best, node)
+            forced = jnp.where(t == t_best, v_best, node)  # [TB, 1]
             forced = jnp.clip(forced, 0, M - 1)
-            oh = iota_m == jax.lax.broadcast_in_dim(forced, (TB, M), (0,))
-            kmer = jnp.sum(jnp.where(oh, sel_i, 0), axis=1)
-            ptr_val = jnp.sum(jnp.where(oh, ptrs_ref[:, t, :], 0), axis=1)
-            kpath_ref[:, t] = kmer
+            oh = iota_m == jax.lax.broadcast_in_dim(forced, (TB, M), (0, 1))
+            kmer = jnp.sum(jnp.where(oh, sel_i, 0), axis=1, keepdims=True)
+            ptr_val = jnp.sum(jnp.where(oh, ptrs_ref[:, t, :], 0), axis=1,
+                              keepdims=True)
+            kpath_ref[:, pl.ds(t, 1)] = kmer
             return jnp.where((t <= t_best) & (t > 0), ptr_val, forced)
 
         jax.lax.fori_loop(0, P, back_step, jnp.zeros_like(v_best))
 
         kp = kpath_ref[:, :]                               # [TB, P]
-        first = jax.lax.broadcast_in_dim(kp[:, 0], (TB, CL), (0,))
+        first = jax.lax.broadcast_in_dim(kp[:, 0:1], (TB, CL), (0, 1))
         shifts = jnp.clip(2 * (k - 1 - iota_cl), 0, 30)
         head = jax.lax.shift_right_logical(first, shifts) & 3
         tail = jnp.dot((kp & 3).astype(jnp.float32), onehot_tail,
                        preferred_element_type=jnp.float32).astype(jnp.int32)
         base = jnp.where(iota_cl < k, head, tail)
-        tcl = jax.lax.broadcast_in_dim(t_best, (TB, CL), (0,))
+        tcl = jax.lax.broadcast_in_dim(t_best, (TB, CL), (0, 1))
         cand_ref[:, c, :] = jnp.where(iota_cl < tcl + k, base, PAD)
-        clen_ref[:, 0, c] = (t_best + k).astype(jnp.int32)
-        ok_ref[:, 0, c] = (mx > NEG / 2).astype(jnp.int32)
+        clen_ref[:, :, c] = (t_best + k).astype(jnp.int32)
+        ok_ref[:, :, c] = (mx > NEG / 2).astype(jnp.int32)
