@@ -52,6 +52,11 @@ N_CANDIDATES = int(N_CANDIDATES) if N_CANDIDATES else None
 # production pipeline runs it; the delta vs a plain run measures how much of
 # the host-side hp cost hides behind dispatch/RTT overlap on real hardware
 BENCH_HP = os.environ.get("DACCORD_BENCH_HP") == "1"
+# warm-the-cache mode (ADVICE r5 #2): compile the ladder at BATCH into the
+# persistent XLA cache, record the shape fingerprint, and exit — run this for
+# B=2048/4096 BEFORE the batch sweep so no timed bench sits behind a silent
+# multi-minute server-side compile
+BENCH_PRECOMPILE = os.environ.get("DACCORD_BENCH_PRECOMPILE") == "1"
 
 
 def _bench_consensus_config():
@@ -154,8 +159,62 @@ def oracle_baseline(data: dict, n: int = 48) -> float:
     return bases / dt if dt > 0 else 0.0
 
 
+def _ladder_fingerprint() -> str:
+    import jax
+
+    return f"{jax.default_backend()}:B{BATCH}xD{DEPTH}xL{SEG_LEN}"
+
+
+def _announce_compile(ev) -> bool:
+    """Echo the expected cold-compile wall BEFORE the warmup goes silent
+    (ADVICE r5 #2: two healthy benches were killed because a multi-minute
+    server-side compile is indistinguishable from a wedge). Returns whether
+    the shape fingerprint was already in the persistent-cache registry."""
+    import sys
+
+    from daccord_tpu.utils.obs import expected_compile_wall_s, fingerprint_seen
+
+    fp = _ladder_fingerprint()
+    cached = fingerprint_seen(fp)
+    exp = 0.0 if cached else expected_compile_wall_s(BATCH)
+    if ev:
+        ev.log("bench_compile", batch=BATCH, cached=cached,
+               expected_wall_s=round(exp, 1))
+    if not cached:
+        print(f"bench: cold ladder compile for B={BATCH} "
+              f"(fingerprint {fp} not in cache registry) — expect up to "
+              f"~{int(exp)}s of silence before the first batch; do NOT "
+              "kill the run", file=sys.stderr)
+    return cached
+
+
+def precompile_ladder(data: dict, ev=None) -> dict:
+    """Compile the ladder at BATCH into the persistent XLA cache and exit-
+    style report (DACCORD_BENCH_PRECOMPILE=1): the pounce sequence runs this
+    for B=2048/4096 first so the timed benches start solving in seconds."""
+    import jax
+
+    from daccord_tpu.kernels.tensorize import BatchShape
+    from daccord_tpu.kernels.tiers import TierLadder, fetch, solve_ladder_async
+    from daccord_tpu.oracle.profile import ErrorProfile
+    from daccord_tpu.utils.obs import record_fingerprint
+
+    prof = ErrorProfile(float(data["p_ins"]), float(data["p_del"]), float(data["p_sub"]))
+    ladder = TierLadder.from_config(prof, _bench_consensus_config())
+    shape = BatchShape(depth=DEPTH, seg_len=SEG_LEN, wlen=WLEN)
+    cached = _announce_compile(ev)
+    t0 = time.perf_counter()
+    fetch(solve_ladder_async(_make_batch(data, 0, BATCH, shape), ladder,
+                             esc_cap=ESC_CAP))
+    wall = time.perf_counter() - t0
+    record_fingerprint(_ladder_fingerprint())
+    return {"precompile": True, "batch": BATCH,
+            "compile_wall_s": round(wall, 3), "was_cached": cached,
+            "device": str(jax.devices()[0]).replace(" ", "")}
+
+
 def device_throughput(data: dict, max_batches: int | None = None,
-                      max_inflight: int = 8) -> tuple[float, dict]:
+                      max_inflight: int = 8, ev=None) -> tuple[float, dict]:
     """Pipelined-dispatch throughput (the pipeline's own dispatch discipline).
 
     A blocking fetch per batch would measure the axon tunnel's per-call
@@ -185,8 +244,13 @@ def device_throughput(data: dict, max_batches: int | None = None,
     def make_batch(i):
         return _make_batch(data, i, BATCH, shape)
 
-    # warmup / compile all tier shapes
+    # warmup / compile all tier shapes (with the expected-wall echo so a
+    # long-silent cold compile is not mistaken for a wedge)
+    _announce_compile(ev)
     fetch(solve_ladder_async(make_batch(0), ladder, esc_cap=ESC_CAP))
+    from daccord_tpu.utils.obs import record_fingerprint
+
+    record_fingerprint(_ladder_fingerprint())
 
     # tunnel RTT estimate (sidecar provenance): median of 3 tiny blocking
     # fetches — the fixed per-device_get cost the pipelined dispatch amortizes
@@ -227,6 +291,10 @@ def device_throughput(data: dict, max_batches: int | None = None,
         # ONE grouped fetch per drain: the tunnel charges its ~100 ms RTT per
         # device_get call, not per array (same discipline as the pipeline)
         entries = [inflight.popleft() for _ in range(n_pop)]
+        if ev:
+            # liveness heartbeat: a pounce watcher tailing the events file
+            # can tell a progressing bench from a wedged one
+            ev.log("bench_drain", fetched=n_pop, inflight=len(inflight))
         for (h, bi), out in zip(entries, fetch_many([h for h, _ in entries])):
             if nladder is not None:
                 # the production drain's hp pass (runtime/pipeline.py
@@ -419,35 +487,92 @@ def _slice_batch(batch, n: int):
     return batch_slice(batch, n)
 
 
-def _device_alive(timeout_s: int = 150) -> bool:
-    from daccord_tpu.utils.obs import device_alive
-
-    return device_alive(timeout_s)
-
-
 def main() -> None:
-    from daccord_tpu.utils.obs import enable_compilation_cache
+    import argparse
 
+    from daccord_tpu.utils.obs import (JsonlLogger, enable_compilation_cache,
+                                       probe_backend_status)
+
+    ap = argparse.ArgumentParser(description="consensus throughput bench")
+    ap.add_argument("--events", default=os.environ.get("DACCORD_BENCH_EVENTS"),
+                    metavar="PATH",
+                    help="jsonl events sidecar (compile expectations, drain "
+                         "heartbeats; schema: tools/eventcheck.py). Default: "
+                         "$DACCORD_BENCH_EVENTS")
+    args = ap.parse_args()
+    ev = JsonlLogger(args.events)
+    t_main0 = time.perf_counter()
     enable_compilation_cache()
     data = build_windows()
+    ev.log("bench_start", batch=BATCH, precompile=BENCH_PRECOMPILE)
     fallback = None
-    if not _device_alive():
-        import jax
+    # why the run fell back, machine-readably (ADVICE r5: a free-text device
+    # string made degraded runs impossible to triage): probe_timeout |
+    # init_error | no_devices | probe_error | device_loss_mid_run:<exc>
+    fallback_reason = os.environ.get("DACCORD_BENCH_FALLBACK_REASON")
+    if fallback_reason:
+        # re-exec'd child of a mid-run device loss (see below); platform is
+        # already pinned to cpu by the parent
+        fallback = "cpu-fallback (device lost mid-bench)"
+    else:
+        ndev, reason = probe_backend_status()
+        if ndev == 0:
+            import jax
 
-        jax.config.update("jax_platforms", "cpu")
-        fallback = "cpu-fallback (device init unreachable at bench time)"
+            jax.config.update("jax_platforms", "cpu")
+            fallback = "cpu-fallback (device init unreachable at bench time)"
+            fallback_reason = reason
+    if BENCH_PRECOMPILE:
+        if fallback:
+            line = {"precompile": True, "batch": BATCH, "skipped": True,
+                    "fallback_reason": fallback_reason}
+        else:
+            line = precompile_ladder(data, ev)
+        ev.log("bench_done", wall_s=round(time.perf_counter() - t_main0, 3))
+        print(json.dumps(line))
+        return
     if fallback:
         dev_bps, info = cpu_fallback_throughput(data)
         info["device"] = fallback
     else:
-        dev_bps, info = device_throughput(data)
-        # the compute-bound ceiling + stage breakdown next to the pipelined
-        # number: their ratio is the dispatch-overhead gap being attacked
-        comp_bps, comp_info = device_compute_throughput(data)
-        info["device_compute_bases_per_sec"] = round(comp_bps, 1)
-        info.update(comp_info)
-        info["pipeline_efficiency"] = round(dev_bps / comp_bps, 3) if comp_bps else None
+        try:
+            dev_bps, info = device_throughput(data, ev=ev)
+            # the compute-bound ceiling + stage breakdown next to the
+            # pipelined number: their ratio is the dispatch-overhead gap
+            # being attacked
+            comp_bps, comp_info = device_compute_throughput(data)
+            info["device_compute_bases_per_sec"] = round(comp_bps, 1)
+            info.update(comp_info)
+            info["pipeline_efficiency"] = round(dev_bps / comp_bps, 3) if comp_bps else None
+        except Exception as e:
+            # possibly the chip died mid-bench (the r5 failure mode) — but a
+            # plain host-side bug raises here too, and relabeling THAT as
+            # device loss would commit a degraded measurement blaming a
+            # healthy chip. Re-probe: still alive -> it's a bug, re-raise.
+            if probe_backend_status()[0] > 0:
+                raise
+            # dead chip confirmed. The TPU backend is already initialized in
+            # this process and cannot be swapped for cpu, so re-exec a
+            # cpu-pinned child to produce the honest degraded line — with
+            # the loss recorded, not hidden in free text
+            import subprocess
+            import sys as _sys
+
+            reason = f"device_loss_mid_run:{type(e).__name__}"
+            ev.log("bench_done", wall_s=round(time.perf_counter() - t_main0, 3),
+                   error=reason)
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       DACCORD_BENCH_FALLBACK_REASON=reason)
+            if args.events:
+                # a separate sidecar: appending the child's fresh-clock
+                # stream to the parent's file would break eventcheck's
+                # monotonic-t contract and blur the two attempts
+                env["DACCORD_BENCH_EVENTS"] = args.events + ".degraded"
+            r = subprocess.run([_sys.executable, os.path.abspath(__file__)],
+                               env=env)
+            raise SystemExit(r.returncode)
     info["fallback"] = bool(fallback)   # machine-detectable degraded run
+    info["fallback_reason"] = fallback_reason
     orc_bps = oracle_baseline(data)
     line = {
         "metric": "consensus_bases_per_sec_per_chip",
@@ -506,6 +631,7 @@ def main() -> None:
                 best = cand
         if best is not None:
             line["last_tpu_measurement"] = best
+    ev.log("bench_done", wall_s=round(time.perf_counter() - t_main0, 3))
     print(json.dumps(line))
 
 

@@ -318,11 +318,22 @@ def fetch_many(handles: list) -> list[dict]:
     per array (measured 2026-07-30: 8 sequential fetches 988 ms vs the same
     8 arrays grouped 91 ms), so draining the in-flight window in groups
     divides the per-batch fetch floor by the group size."""
-    if all(isinstance(h, _PackedHandle) for h in handles):
-        arrs = jax.device_get([h.arr for h in handles])
-        return [unpack_result(np.asarray(a), h.cl)
-                for a, h in zip(arrs, handles)]
-    return [fetch(h) for h in handles]
+    packed = [i for i, h in enumerate(handles)
+              if isinstance(h, _PackedHandle)]
+    if len(packed) <= 1:
+        return [fetch(h) for h in handles]
+    # group every packed handle into ONE device_get even when the list is
+    # mixed (e.g. a supervisor drain holding both device handles and
+    # degraded-mode results): only the non-packed stragglers pay their own
+    # fetch call
+    arrs = jax.device_get([handles[i].arr for i in packed])
+    outs: list = [None] * len(handles)
+    for i, a in zip(packed, arrs):
+        outs[i] = unpack_result(np.asarray(a), handles[i].cl)
+    for i, h in enumerate(handles):
+        if outs[i] is None:
+            outs[i] = fetch(h)
+    return outs
 
 
 def solve_ladder(batch: WindowBatch, ladder: TierLadder,

@@ -80,7 +80,12 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
                                  start=start, end=end)
         counters = {"reads": stats.n_reads, "windows": stats.n_windows,
                     "solved": stats.n_solved, "bases_out": stats.bases_out,
-                    "wall_s": stats.wall_s}
+                    "wall_s": stats.wall_s,
+                    # a shard that finished on the fallback engine is still
+                    # correct output, but the manifest must say so: reruns
+                    # and round reports need the degraded runs enumerable
+                    "degraded": stats.degraded,
+                    "fallback_reason": stats.fallback_reason}
     else:
         counters = _run_shard_checkpointed(db_path, las_path, paths, start, end,
                                            cfg, checkpoint_every)
@@ -163,12 +168,14 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
     counters = dict(base)
     # truncate any partial tail past the last checkpoint, then append
     mode = "r+t" if emitted else "wt"
+    last_st = None
     with open(paths["fasta"], mode) as out:
         out.truncate(fasta_bytes)
         out.seek(fasta_bytes)
         since = 0
         for rid, frags, st in correct_shard(db, las, cfg, resume_off, end,
                                             profile=profile):
+            last_st = st
             write_fasta(out, [FastaRecord(f"read{rid}/{fi}", ints_to_seq(f))
                               for fi, f in enumerate(frags)])
             emitted += 1
@@ -191,6 +198,11 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
     counters["wall_s"] = round(base["wall_s"] + (time.time() - t0), 3)
     if resumed is not None:
         counters["resumed_at_read"] = resumed
+    if last_st is not None:
+        # degraded state is only final once the shard's generator is
+        # exhausted (failover can happen in the last drain)
+        counters["degraded"] = last_st.degraded
+        counters["fallback_reason"] = last_st.fallback_reason
     return counters
 
 

@@ -139,6 +139,12 @@ class ShardedLadderSolver:
         outs = fetch_many_packed([ph for ph, _ in handles])
         return [self._trim(out, B0) for out, (_, B0) in zip(outs, handles)]
 
+    def describe(self) -> str:
+        """Short engine tag for supervisor events (what the run was on when
+        it died matters when reading the events file after the fact)."""
+        kinds = {d.platform for d in self.mesh.devices.flat}
+        return f"mesh{self.nd}-{'/'.join(sorted(kinds))}-ladder"
+
     def __call__(self, batch: WindowBatch) -> dict:
         return self.fetch(self.dispatch(batch))
 

@@ -1,3 +1,5 @@
 from .pipeline import PipelineConfig, PipelineStats, correct_shard, correct_to_fasta, estimate_profile_for_shard
+from .supervisor import DeviceSupervisor, SupervisorConfig
 
-__all__ = ["PipelineConfig", "PipelineStats", "correct_shard", "correct_to_fasta", "estimate_profile_for_shard"]
+__all__ = ["PipelineConfig", "PipelineStats", "correct_shard", "correct_to_fasta",
+           "estimate_profile_for_shard", "DeviceSupervisor", "SupervisorConfig"]

@@ -1,0 +1,175 @@
+"""Deterministic fault injection for the device supervisor.
+
+Every failure mode the supervisor handles (``runtime/supervisor.py``) can be
+reproduced on a CPU-only host from one env var, so the whole
+dispatch/fetch/failover state machine is testable without a TPU and without
+wall-clock waits::
+
+    DACCORD_FAULT=fetch_hang:3            # 3rd fetch times out once
+    DACCORD_FAULT=dispatch_error:5        # 5th dispatch raises once
+    DACCORD_FAULT=device_lost:7           # 7th device op: chip declared dead
+    DACCORD_FAULT=compile_stall           # first cold-shape op stalls once
+    DACCORD_FAULT=device_lost:2,crash:9   # comma-joins compose
+
+Grammar: ``kind[:N]`` with N the 1-based index of the triggering operation in
+that kind's counter domain (default 1). Counters advance once per *logical*
+operation (retries of the same op do not re-count), so a given spec fires at
+exactly one reproducible point in a run. All faults are one-shot except the
+state they leave behind: ``device_lost`` additionally marks the (virtual)
+device dead, which the supervisor's probe consults before any real probe —
+so the probe-declares-loss path runs deterministically too.
+
+``crash`` is a test-only kind: it raises :class:`InjectedCrash`, a
+``BaseException`` the supervisor deliberately does NOT catch, simulating a
+hard process death (SIGKILL-ish) for checkpoint/resume composition tests.
+
+Counter domains: ``fetch_hang`` counts fetches, ``dispatch_error`` counts
+dispatches, ``device_lost``/``crash`` count device ops (dispatch + fetch,
+interleaved in pipeline order), ``compile_stall`` counts cold-shape ops.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+class FaultInjected(Exception):
+    """Base class of injected (recoverable) faults. Instances carry the
+    spec's ``kind`` and the 1-based index ``n`` in that kind's own counter
+    domain, so event logs match the ``DACCORD_FAULT`` grammar exactly."""
+
+    kind = "fault"
+    n = 0
+
+
+class FaultHang(FaultInjected):
+    """Injected hang: the supervisor treats it exactly like a watchdog
+    deadline expiry (no real wall-clock is spent)."""
+
+
+class FaultDispatchError(FaultInjected):
+    """Injected transient dispatch failure (retry succeeds)."""
+
+
+class FaultDeviceLost(FaultInjected):
+    """Injected terminal device loss (probe reports dead afterwards)."""
+
+
+class FaultCompileStall(FaultInjected):
+    """Injected first-compile stall (exercises the COMPILING/heartbeat
+    path; the op then proceeds normally)."""
+
+
+class InjectedCrash(BaseException):
+    """Test-only hard crash: BaseException so no supervisor/pipeline
+    ``except Exception`` can swallow it — it must unwind like a kill."""
+
+
+_KINDS = ("fetch_hang", "dispatch_error", "device_lost", "compile_stall",
+          "crash")
+
+
+@dataclass
+class FaultSpec:
+    kind: str
+    at: int = 1        # 1-based index in the kind's counter domain
+    fired: bool = False
+
+
+@dataclass
+class FaultPlan:
+    specs: list = field(default_factory=list)
+    device_dead: bool = False
+    # logical-operation counters (advance once per op, not per retry)
+    n_dispatch: int = 0
+    n_fetch: int = 0
+    n_device: int = 0
+    n_compile: int = 0
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        specs = []
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            kind, _, at = part.partition(":")
+            if kind not in _KINDS:
+                raise ValueError(
+                    f"DACCORD_FAULT: unknown kind {kind!r} (known: "
+                    f"{', '.join(_KINDS)})")
+            try:
+                n = int(at) if at else 1
+            except ValueError:
+                raise ValueError(f"DACCORD_FAULT: bad count in {part!r}")
+            if n < 1:
+                raise ValueError(f"DACCORD_FAULT: count must be >= 1 in {part!r}")
+            specs.append(FaultSpec(kind, n))
+        return cls(specs=specs)
+
+    @classmethod
+    def from_env(cls, env=None) -> "FaultPlan | None":
+        """The process-wide plan, or None when ``DACCORD_FAULT`` is unset.
+        Read at supervisor construction (once per shard), so a test can set
+        the env var per run."""
+        text = (env if env is not None else os.environ).get("DACCORD_FAULT")
+        return cls.parse(text) if text else None
+
+    def _take(self, kind: str, count: int) -> FaultSpec | None:
+        for s in self.specs:
+            if s.kind == kind and not s.fired and count >= s.at:
+                s.fired = True
+                return s
+        return None
+
+    def op(self, domain: str, compiling: bool = False,
+           degraded: bool = False) -> None:
+        """Advance counters for one logical ``dispatch``/``fetch`` op and
+        raise the matching injected fault, if any. ``degraded`` ops (already
+        failed over; no device involved) only ever raise ``crash`` — the
+        device-fault kinds describe the primary engine."""
+        if domain == "dispatch":
+            self.n_dispatch += 1
+        elif domain == "fetch":
+            self.n_fetch += 1
+        else:
+            raise ValueError(f"unknown op domain {domain!r}")
+        self.n_device += 1
+        if compiling:
+            self.n_compile += 1
+        def _raise(exc_cls, kind: str, n: int, msg: str):
+            e = exc_cls(msg)
+            e.kind, e.n = kind, n
+            raise e
+
+        if self._take("crash", self.n_device) is not None:
+            raise InjectedCrash(f"injected crash at {domain} #{self.n_device}")
+        if degraded:
+            return
+        if self.device_dead:
+            # a lost device stays lost for every later primary op
+            _raise(FaultDeviceLost, "device_lost", self.n_device,
+                   f"device dead (injected) at {domain}")
+        if self._take("device_lost", self.n_device) is not None:
+            self.device_dead = True
+            _raise(FaultDeviceLost, "device_lost", self.n_device,
+                   f"injected device_lost at {domain} #{self.n_device}")
+        if domain == "fetch" and self._take("fetch_hang",
+                                            self.n_fetch) is not None:
+            _raise(FaultHang, "fetch_hang", self.n_fetch,
+                   f"injected fetch_hang at fetch #{self.n_fetch}")
+        if domain == "dispatch" and self._take(
+                "dispatch_error", self.n_dispatch) is not None:
+            _raise(FaultDispatchError, "dispatch_error", self.n_dispatch,
+                   f"injected dispatch_error at dispatch #{self.n_dispatch}")
+        if compiling and self._take("compile_stall",
+                                    self.n_compile) is not None:
+            _raise(FaultCompileStall, "compile_stall", self.n_compile,
+                   f"injected compile_stall at cold-shape op "
+                   f"#{self.n_compile}")
+
+    def probe_override(self) -> bool | None:
+        """False once device_lost fired (probe must agree the chip is dead);
+        None = no opinion, run the real probe."""
+        return False if self.device_dead else None

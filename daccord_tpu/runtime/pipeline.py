@@ -137,6 +137,28 @@ class PipelineConfig:
                                  # interior rescue windows keep the read
                                  # contiguous and are left alone
     log_path: str | None = None  # jsonl event log ('-' = stderr)
+    supervise: bool = True       # wrap dispatch/fetch in the device
+                                 # supervisor (runtime/supervisor.py):
+                                 # watchdog deadlines with compiling-vs-wedged
+                                 # classification, retry with backoff, and
+                                 # mid-run failover to the degraded engine on
+                                 # declared device loss. Off = the r5
+                                 # behavior (a dead tunnel wedges the run)
+    events_path: str | None = None   # supervisor/event jsonl (--events);
+                                 # None = share log_path's logger
+    failover_backend: str = "auto"   # degraded-mode engine on device loss:
+                                 # 'native' (C++ ladder — the production
+                                 # choice: oracle parity, and it cannot
+                                 # depend on the dead backend), 'cpu' (the
+                                 # same JAX ladder host-routed — exact bytes
+                                 # vs a cpu-platform primary, but unusable
+                                 # once a TPU backend wedged the process),
+                                 # 'auto' = cpu on a cpu platform (exact
+                                 # bytes), native on device platforms
+                                 # (clear error if not built)
+    failback: bool = False       # background re-probe may route dispatches
+                                 # back to a revived chip (opt-in: failback
+                                 # re-compiles every bucket shape)
     verbose: bool = False
 
 
@@ -162,6 +184,9 @@ class PipelineStats:
     bases_out: int = 0
     tier_histogram: dict = field(default_factory=dict)
     native_host: bool = False
+    degraded: bool = False       # supervisor failed over mid-run (the shard
+                                 # completed on the fallback engine)
+    fallback_reason: str | None = None
     pad_cells: int = 0
     used_cells: int = 0
     wall_s: float = 0.0
@@ -470,6 +495,65 @@ def _iter_pile_blocks_threaded(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 break
 
 
+def _native_wide_rescue(wide_nladder, b, out: dict, nt: int) -> None:
+    """Overflow rescue on the native engine, device-ladder semantics
+    (kernels/tiers.py ladder_core): windows whose top-M cap bound re-solve
+    at the rescue active-set size and the wide result replaces the capped
+    one wherever it solves. Widen-only guard applied at wide_nladder
+    construction (same rule as TierLadder.from_config)."""
+    import dataclasses
+
+    idx = np.nonzero(out["m_ovf"])[0]
+    sub = dataclasses.replace(
+        b, seqs=b.seqs[idx], lens=b.lens[idx],
+        nsegs=b.nsegs[idx], read_ids=b.read_ids[idx],
+        wstarts=b.wstarts[idx])
+    wide = wide_nladder.solve(sub, n_threads=nt)
+    take = wide["solved"]
+    ti = idx[take]
+    for key in ("cons", "cons_len", "err", "tier"):
+        out[key][ti] = wide[key][take]
+    out["solved"][ti] = True
+    out["m_ovf"][ti] = wide["m_ovf"][take]
+
+
+def _build_native_fallback(profile: ErrorProfile, cfg: PipelineConfig):
+    """Degraded-mode engine for the supervisor: the C++ tier ladder at the
+    run's cap config (oracle-parity semantics; no hp pass here — the
+    pipeline's host-side hp drain applies to fallback results exactly as it
+    does to fetched device results). Raises when the library isn't built."""
+    from ..native import available as _nat_avail
+    from ..native.api import NativeLadder
+    from ..oracle.consensus import make_offset_likely
+
+    if not _nat_avail():
+        raise RuntimeError("native library unavailable")
+    ols = make_offset_likely(profile, cfg.consensus)
+    nt = cfg.native_threads if cfg.native_threads > 0 else (
+        os.cpu_count() or 1)
+    # tables packed ONCE; thousands of per-batch calls share them
+    nladder = NativeLadder(ols, cfg.consensus, max_kmers=cfg.max_kmers,
+                           rescue_max_kmers=cfg.rescue_max_kmers)
+    # widen-only guard applied here (same rule as TierLadder.from_config)
+    wide = (nladder.with_caps(cfg.rescue_max_kmers, cfg.rescue_max_kmers)
+            if cfg.overflow_rescue
+            and 0 < cfg.max_kmers < cfg.rescue_max_kmers else None)
+
+    def solve(b):
+        # same top-M semantics as the device ladder (measured beneficial on
+        # CLR, BASELINE.md r3 top-M table); -M 0 gives the full graph
+        out = nladder.solve(b, n_threads=nt)
+        if wide is not None and out["m_ovf"].any():
+            _native_wide_rescue(wide, b, out, nt)
+        return out
+
+    solve.__name__ = "native-ladder"
+    # exposed so the --backend native primary can layer its in-engine hp
+    # rescue + stats on the SAME construction (one path, byte parity)
+    solve.nladder, solve.nt, solve.ols = nladder, nt, ols
+    return solve
+
+
 def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                   start: int | None = None, end: int | None = None,
                   profile: ErrorProfile | None = None,
@@ -518,52 +602,23 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     hp_use_native = cfg.hp_native
     if native_dispatch:
         from ..native import available as _nat_avail
-        from ..native.api import NativeLadder
-        from ..oracle.consensus import make_offset_likely
 
         if not _nat_avail():
             raise SystemExit("--backend native: native library unavailable "
                              "(g++ build failed?)")
-        ols = make_offset_likely(profile, cfg.consensus)
-        nt = cfg.native_threads if cfg.native_threads > 0 else (
-            os.cpu_count() or 1)
-        # tables packed ONCE; thousands of per-batch calls share them
-        nladder = NativeLadder(ols, cfg.consensus, max_kmers=cfg.max_kmers,
-                               rescue_max_kmers=cfg.rescue_max_kmers)
-        wide_nladder = (nladder.with_caps(cfg.rescue_max_kmers,
-                                          cfg.rescue_max_kmers)
-                        if cfg.overflow_rescue
-                        and 0 < cfg.max_kmers < cfg.rescue_max_kmers
-                        else None)
+        # one construction path shared with the supervisor's failover engine
+        # (_build_native_fallback): byte parity depends on the two never
+        # diverging
+        base_solve = _build_native_fallback(profile, cfg)
+        ols, nt = base_solve.ols, base_solve.nt
 
         def _native_solver(b):
-            # same top-M semantics as the device ladder (measured beneficial
-            # on CLR, BASELINE.md r3 top-M table); -M 0 gives the full graph
-            out = nladder.solve(b, n_threads=nt)
-            if wide_nladder is not None and out["m_ovf"].any():
-                # widen-only guard applied at wide_nladder construction
-                # (same rule as TierLadder.from_config); device-ladder rescue
-                # semantics: capped windows re-solve at the rescue set size,
-                # the wide result replaces the capped one wherever it solves
-                # (kernels/tiers.py ladder_core)
-                import dataclasses
-
-                idx = np.nonzero(out["m_ovf"])[0]
-                sub = dataclasses.replace(
-                    b, seqs=b.seqs[idx], lens=b.lens[idx],
-                    nsegs=b.nsegs[idx], read_ids=b.read_ids[idx],
-                    wstarts=b.wstarts[idx])
-                wide = wide_nladder.solve(sub, n_threads=nt)
-                take = wide["solved"]
-                ti = idx[take]
-                for key in ("cons", "cons_len", "err", "tier"):
-                    out[key][ti] = wide[key][take]
-                out["solved"][ti] = True
-                out["m_ovf"][ti] = wide["m_ovf"][take]
+            out = base_solve(b)
             if cfg.consensus.hp_rescue and hp_use_native:
                 # in-engine hp rescue (C++, oracle/hp.py parity): runs after
                 # the overflow rescue, matching the host pass's ordering
-                stats.n_hp_rescued += nladder.hp_rescue(b, out, n_threads=nt)
+                stats.n_hp_rescued += base_solve.nladder.hp_rescue(
+                    b, out, n_threads=nt)
             return out
 
         solver = _native_solver
@@ -603,6 +658,100 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 b, ladder, use_pallas=cfg.use_pallas, pallas_interpret=interp))
             fetch_fn = _fetch
             fetch_many_fn = _fetch_many
+
+    # device supervisor (runtime/supervisor.py): watchdog deadlines with
+    # compiling-vs-wedged classification, retry with backoff, and mid-run
+    # failover to the degraded engine — the robustness layer between the
+    # pipeline and whichever dispatch/fetch pair was resolved above
+    sup = None
+    ev_log = JsonlLogger(cfg.events_path) if cfg.events_path else log
+    if cfg.supervise:
+        from .supervisor import DeviceSupervisor, SupervisorConfig
+
+        rtt_s = None
+        inline = False
+        if native_dispatch:
+            # the primary IS the degraded engine: failover to itself keeps
+            # byte parity trivially while fault injection still exercises
+            # the full machinery
+            prim = solver
+            fallback_factory = (lambda: prim)
+            desc, fp_prefix = "native-ladder", "native:"
+            inline = True
+        else:
+            if solver is not None:
+                d = getattr(solver, "describe", None)
+                desc = d() if callable(d) else type(solver).__name__
+            else:
+                import jax
+
+                desc = ("cpu-ladder" if jax.default_backend() == "cpu"
+                        else "device-ladder")
+                # a host-local ladder cannot hang the way a tunnel can;
+                # skip the watchdog thread (its hand-off is the only
+                # measurable supervisor cost on the hot path)
+                inline = desc == "cpu-ladder"
+                if desc == "device-ladder":
+                    # RTT-scaled fetch deadline (the tunnel's fixed
+                    # per-device_get cost is the natural time unit here)
+                    from ..utils.obs import measure_rtt_s
+
+                    rtt_s = measure_rtt_s()
+            import jax
+
+            fp_prefix = jax.default_backend() + ":"
+            _lad = ladder
+
+            def fallback_factory():
+                import jax as _jax
+
+                kind = cfg.failover_backend
+                if kind == "auto":
+                    # a cpu-platform primary keeps the SAME ladder (byte-
+                    # exact degraded output, and the backend is by definition
+                    # still usable); any device platform needs the native
+                    # engine — the dead backend cannot be swapped for cpu
+                    # in-process, so without the native library there is no
+                    # usable fallback (raise a clear error, not a crash)
+                    if _jax.default_backend() == "cpu":
+                        kind = "cpu"
+                    else:
+                        try:
+                            from ..native import available as _na
+
+                            nat_ok = _na()
+                        except Exception:
+                            nat_ok = False
+                        if not nat_ok:
+                            raise RuntimeError(
+                                "device lost and the native library is not "
+                                "built: no usable degraded engine (the dead "
+                                "device backend cannot be swapped for cpu "
+                                "in-process)")
+                        kind = "native"
+                if kind == "native":
+                    return _build_native_fallback(profile, cfg)
+                # exact-ladder host fallback: the same TierLadder the
+                # primary used, host-routed
+                from ..kernels.tiers import solve_tiered as _st
+
+                def _cpu_fb(b):
+                    return _st(b, _lad)
+
+                _cpu_fb.__name__ = "cpu-ladder"
+                return _cpu_fb
+
+        sup = DeviceSupervisor(
+            dispatch_fn, fetch_fn, fetch_many_fn,
+            fallback_factory=fallback_factory, log=ev_log,
+            # --failback forces it on; otherwise DACCORD_SUP_FAILBACK decides
+            cfg=SupervisorConfig.from_env(
+                **({"failback": True} if cfg.failback else {})),
+            rtt_s=rtt_s, describe=desc, fingerprint_prefix=fp_prefix,
+            inline=inline)
+        dispatch_fn, fetch_fn = sup.dispatch, sup.fetch
+        if fetch_many_fn is not None:
+            fetch_many_fn = sup.fetch_many
 
     hp_ols = None
     hp_nladder = None
@@ -954,6 +1103,11 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         emit_idx += 1
     stats.wall_s = time.time() - t_start
     stats.host_s = stats.wall_s - stats.device_s
+    if sup is not None:
+        stats.degraded = sup.failed_over
+        stats.fallback_reason = sup.fail_reason
+        ev_log.log("sup_done", state=sup.state, degraded=sup.failed_over,
+                   **sup.counters)
     log.log("shard_done", reads=stats.n_reads, windows=stats.n_windows,
             solved=stats.n_solved, skipped_shallow=stats.n_skipped_shallow,
             topm_overflow=stats.n_topm_overflow,
@@ -963,7 +1117,10 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             tiers=stats.tier_histogram, native=stats.native_host,
             # north-star counters (BASELINE.json metric; SURVEY.md §5 metrics)
             bases_per_sec=round(stats.bases_per_sec(), 1),
+            degraded=stats.degraded,
             windows_per_sec=round(stats.n_windows / stats.wall_s, 1) if stats.wall_s else 0.0)
+    if ev_log is not log:
+        ev_log.close()
     log.close()
 
 

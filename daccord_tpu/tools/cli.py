@@ -105,6 +105,24 @@ def daccord_main(argv=None) -> int:
                         "(reference --eprofonly role)")
     p.add_argument("--stats", default=None, help="write run stats JSON here")
     p.add_argument("--log", default=None, help="jsonl event log path ('-' = stderr)")
+    p.add_argument("--events", default=None, metavar="PATH",
+                   help="supervisor events jsonl (state transitions, "
+                        "compile heartbeats, retries, failover; schema: "
+                        "tools/eventcheck.py). Default: share --log")
+    p.add_argument("--no-supervise", action="store_true",
+                   help="disable the device supervisor (watchdog deadlines, "
+                        "retry, mid-run failover to the degraded engine)")
+    p.add_argument("--failover-backend", choices=("auto", "native", "cpu"),
+                   default="auto",
+                   help="degraded-mode engine on declared device loss "
+                        "(auto: the byte-exact host JAX ladder on cpu "
+                        "platforms, the native C++ ladder on device "
+                        "platforms — a dead device backend cannot be "
+                        "swapped for cpu in-process, so native must be "
+                        "built there)")
+    p.add_argument("--failback", action="store_true",
+                   help="let a background re-probe route dispatches back to "
+                        "a revived chip (re-compiles every bucket shape)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler device trace into DIR")
     p.add_argument("--no-native", action="store_true", help="disable C++ host path")
@@ -237,7 +255,11 @@ def daccord_main(argv=None) -> int:
     cfg = PipelineConfig(consensus=ccfg, batch_size=args.batch,
                          depth=args.depth, seg_len=args.seg_len,
                          max_kmers=args.max_kmers,
-                         log_path=args.log, use_native=not args.no_native,
+                         log_path=args.log, events_path=args.events,
+                         supervise=not args.no_supervise,
+                         failover_backend=args.failover_backend,
+                         failback=args.failback,
+                         use_native=not args.no_native,
                          feeder_threads=args.threads, use_pallas=args.pallas,
                          end_trim=not args.no_end_trim,
                          qv_track=args.qv_track or None,
@@ -307,7 +329,10 @@ def daccord_main(argv=None) -> int:
         "tier_histogram": stats.tier_histogram,
         "pad_waste": round(stats.pad_waste, 4),
         "native_host": stats.native_host,
+        "degraded": stats.degraded,
     }
+    if stats.degraded:
+        line["fallback_reason"] = stats.fallback_reason
     print(json.dumps(line), file=sys.stderr)
     if args.stats:
         with open(args.stats, "wt") as fh:
@@ -754,6 +779,8 @@ def shard_main(argv=None) -> int:
                    help="piles sampled by the profile estimation pass")
     p.add_argument("--backend", choices=("auto", "cpu", "tpu", "native"),
                    default="auto")
+    p.add_argument("--events", default=None, metavar="PATH",
+                   help="supervisor events jsonl (see daccord --events)")
     args = p.parse_args(argv)
     if args.backend == "auto":
         from ..utils.obs import resolve_auto_backend
@@ -772,7 +799,8 @@ def shard_main(argv=None) -> int:
     from ..parallel.launch import run_shard
 
     scfg = PipelineConfig(batch_size=args.batch,
-                          native_solver=args.backend == "native")
+                          native_solver=args.backend == "native",
+                          events_path=args.events)
     if args.profile_sample is not None:
         scfg.profile_sample_piles = args.profile_sample
     m = run_shard(args.db, args.las, args.outdir, i, n, scfg,
@@ -923,6 +951,15 @@ _TOOLS = {
     "fillfasta": fillfasta_main,
     "qveval": qveval_main,
 }
+
+
+def _eventcheck_main(argv=None) -> int:
+    from .eventcheck import eventcheck_main
+
+    return eventcheck_main(argv)
+
+
+_TOOLS["eventcheck"] = _eventcheck_main
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,147 @@
+"""eventcheck: validate a jsonl events file against the event schema.
+
+The supervisor (``runtime/supervisor.py``) and the pipeline emit structured
+jsonl events so pounce/bench scripts get a machine-readable "compiling vs
+wedged vs dead" signal. This lint keeps that contract honest: tests validate
+the events their runs produce, and ``tools_pounce.sh`` validates every bench
+sidecar before committing it. ``--strict`` additionally checks that the
+supervisor's state transitions follow the legal machine
+(HEALTHY -> SUSPECT -> COMPILING|RETRYING -> LOST -> DEGRADED -> FAILBACK)
+and that relative timestamps are monotonic.
+
+Usage: ``python -m daccord_tpu.tools.cli eventcheck [--strict] FILE...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_NUM = (int, float)
+
+#: required fields (name -> allowed types) per event. Events not listed are
+#: accepted as long as they carry the base fields — the schema constrains the
+#: machine-consumed events, it does not forbid new informational ones.
+BASE_FIELDS = {"t": _NUM, "event": str}
+EVENT_FIELDS: dict[str, dict] = {
+    "sup_init": {"primary": str, "op_deadline_s": _NUM,
+                 "compile_deadline_s": _NUM},
+    "sup_state": {"state_from": str, "state_to": str, "reason": str,
+                  "ts": _NUM},
+    "sup_compile": {"key": str, "expected_wall_s": _NUM},
+    "sup_heartbeat": {"op": str, "key": str, "waited_s": _NUM,
+                      "deadline_s": _NUM},
+    "sup_retry": {"op": str, "attempt": int, "delay_s": _NUM, "reason": str},
+    "sup_probe": {"alive": bool, "wall_s": _NUM},
+    "sup_fault": {"kind": str, "op": str, "n": int},
+    "sup_failover": {"reason": str, "fallback": str},
+    "sup_failback": {"ts": _NUM},
+    "sup_done": {"state": str, "degraded": bool},
+    "batch": {"windows": int, "solved": int},
+    "shard_done": {"reads": int, "windows": int, "solved": int,
+                   "wall_s": _NUM, "degraded": bool},
+    "bench_start": {"batch": int},
+    "bench_compile": {"batch": int, "cached": bool, "expected_wall_s": _NUM},
+    "bench_drain": {"fetched": int, "inflight": int},
+    "bench_done": {"wall_s": _NUM},
+}
+
+_STATES = ("HEALTHY", "COMPILING", "SUSPECT", "RETRYING", "LOST",
+           "DEGRADED", "FAILBACK")
+
+
+def validate_events(path: str, strict: bool = False) -> list[str]:
+    """Errors found in the events file (empty list = valid)."""
+    from ..runtime.supervisor import TRANSITIONS
+
+    errs: list[str] = []
+    state = None
+    last_t = None
+    try:
+        with open(path) as fh:
+            lines = fh.readlines()
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    for ln, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            errs.append(f"line {ln}: not JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            errs.append(f"line {ln}: not an object")
+            continue
+        fields = dict(BASE_FIELDS)
+        fields.update(EVENT_FIELDS.get(rec.get("event", ""), {}))
+        for name, types in fields.items():
+            tt = types if isinstance(types, tuple) else (types,)
+            if name not in rec:
+                errs.append(f"line {ln}: {rec.get('event', '?')} missing "
+                            f"field {name!r}")
+                continue
+            val = rec[name]
+            # bool is an int subclass; only accept it where bool is declared
+            ok = isinstance(val, tt) and (bool in tt
+                                          or not isinstance(val, bool))
+            if not ok:
+                errs.append(f"line {ln}: {rec.get('event', '?')}.{name} has "
+                            f"type {type(val).__name__}")
+        if not strict:
+            continue
+        if rec.get("event") in ("sup_init", "bench_start"):
+            # stream boundary: JsonlLogger appends with a per-process
+            # relative clock, so a rerun against the same --events path (or
+            # a resumed shard) legitimately restarts t and the state chain
+            last_t = None
+            state = None
+        t = rec.get("t")
+        if isinstance(t, _NUM) and not isinstance(t, bool):
+            if last_t is not None and t < last_t:
+                errs.append(f"line {ln}: t went backwards "
+                            f"({t} < {last_t})")
+            last_t = t
+        if rec.get("event") == "sup_state":
+            f, to = rec.get("state_from"), rec.get("state_to")
+            if f not in _STATES or to not in _STATES:
+                errs.append(f"line {ln}: unknown supervisor state "
+                            f"{f!r} -> {to!r}")
+            elif to not in TRANSITIONS.get(f, set()):
+                errs.append(f"line {ln}: illegal transition {f} -> {to}")
+            elif state is not None and f != state:
+                errs.append(f"line {ln}: transition from {f} but supervisor "
+                            f"was {state}")
+            state = to
+    return errs
+
+
+def eventcheck_main(argv=None) -> int:
+    """eventcheck: lint a jsonl events file against the event schema."""
+    p = argparse.ArgumentParser(prog="eventcheck",
+                                description=eventcheck_main.__doc__)
+    p.add_argument("files", nargs="+", help="events jsonl file(s)")
+    p.add_argument("--strict", action="store_true",
+                   help="also enforce supervisor transition legality and "
+                        "monotonic timestamps")
+    p.add_argument("--max-report", type=int, default=20)
+    args = p.parse_args(argv)
+    bad = 0
+    for path in args.files:
+        errs = validate_events(path, strict=args.strict)
+        for e in errs[: args.max_report]:
+            print(f"{path}: {e}", file=sys.stderr)
+        if len(errs) > args.max_report:
+            print(f"{path}: ... {len(errs) - args.max_report} more",
+                  file=sys.stderr)
+        n = sum(1 for ln in open(path) if ln.strip()) if not errs else 0
+        print(f"{path}: {'OK (%d events)' % n if not errs else 'BAD (%d errors)' % len(errs)}",
+              file=sys.stderr)
+        bad += bool(errs)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(eventcheck_main())

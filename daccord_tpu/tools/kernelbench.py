@@ -33,7 +33,9 @@ def main(argv=None) -> int:
 
     import jax
 
-    if args.backend == "cpu" or not round_bench._device_alive():
+    from daccord_tpu.utils.obs import device_alive
+
+    if args.backend == "cpu" or not device_alive():
         jax.config.update("jax_platforms", "cpu")
     from daccord_tpu.utils.obs import enable_compilation_cache
 

@@ -39,15 +39,19 @@ class NullLogger(JsonlLogger):
         super().__init__(None)
 
 
-def probe_default_backend(timeout_s: int | None = None) -> int:
-    """Device count of the default backend, probed from a throwaway
-    subprocess: a dead axon tunnel HANGS forever inside make_c_api_client
-    (it does not error), which would wedge any process that touches the
-    default backend — the subprocess bounds the hang to ``timeout_s``.
-    Returns 0 when the backend is dead/unreachable. The one probe (and one
+def probe_backend_status(timeout_s: int | None = None) -> tuple[int, str]:
+    """(device count, reason) of the default backend, probed from a
+    throwaway subprocess: a dead axon tunnel HANGS forever inside
+    make_c_api_client (it does not error), which would wedge any process
+    that touches the default backend — the subprocess bounds the hang to
+    ``timeout_s``. Count 0 means dead/unreachable; the reason string says
+    *why* (``probe_timeout`` | ``init_error`` | ``no_devices`` |
+    ``probe_error``), the classification bench.py's ``fallback_reason``
+    sidecar field records instead of free text. The one probe (and one
     timeout policy) shared by bench.py, ladderbench, __graft_entry__ and the
-    CLI's ``--backend auto``; the default 150 s can be overridden process-wide
-    via ``DACCORD_PROBE_TIMEOUT_S`` (malformed values fall back to 150)."""
+    CLI's ``--backend auto``; the default 150 s can be overridden
+    process-wide via ``DACCORD_PROBE_TIMEOUT_S`` (malformed values fall
+    back to 150)."""
     import os
     import subprocess
     import sys
@@ -64,12 +68,24 @@ def probe_default_backend(timeout_s: int | None = None) -> int:
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            timeout=timeout_s)
-        for line in r.stdout.decode(errors="replace").splitlines():
-            if line.startswith("ndev="):
-                return int(line.split("=", 1)[1])
+    except subprocess.TimeoutExpired:
+        return 0, "probe_timeout"
     except Exception:
-        pass
-    return 0
+        return 0, "probe_error"
+    for line in r.stdout.decode(errors="replace").splitlines():
+        if line.startswith("ndev="):
+            try:
+                n = int(line.split("=", 1)[1])
+            except ValueError:
+                # partial write from a killed probe: dead, not a crash
+                return 0, "init_error"
+            return n, ("ok" if n > 0 else "no_devices")
+    return 0, "init_error"
+
+
+def probe_default_backend(timeout_s: int | None = None) -> int:
+    """Device count of the default backend (see probe_backend_status)."""
+    return probe_backend_status(timeout_s)[0]
 
 
 def device_alive(timeout_s: int = 150) -> bool:
@@ -143,6 +159,18 @@ def _host_cpu_fingerprint() -> str:
     return hashlib.sha256(flags.encode()).hexdigest()[:10]
 
 
+def compcache_dir() -> str | None:
+    """The persistent-compile-cache directory this host would use (None when
+    opted out via DACCORD_NO_COMPCACHE) — shared by enable_compilation_cache
+    and the compile-fingerprint registry below."""
+    import os
+
+    if os.environ.get("DACCORD_NO_COMPCACHE"):
+        return None
+    return os.environ.get("DACCORD_COMPCACHE") or os.path.expanduser(
+        "~/.cache/daccord_tpu/xla-" + _host_cpu_fingerprint())
+
+
 def enable_compilation_cache() -> str | None:
     """Turn on JAX's persistent compilation cache (opt out:
     DACCORD_NO_COMPCACHE=1; relocate: DACCORD_COMPCACHE=dir).
@@ -153,10 +181,9 @@ def enable_compilation_cache() -> str | None:
     """
     import os
 
-    if os.environ.get("DACCORD_NO_COMPCACHE"):
+    path = compcache_dir()
+    if path is None:
         return None
-    path = os.environ.get("DACCORD_COMPCACHE") or os.path.expanduser(
-        "~/.cache/daccord_tpu/xla-" + _host_cpu_fingerprint())
     try:
         import jax
 
@@ -166,3 +193,105 @@ def enable_compilation_cache() -> str | None:
         return path
     except Exception:
         return None
+
+
+def _fingerprint_path() -> str | None:
+    import os
+
+    d = compcache_dir()
+    return os.path.join(d, "daccord_shapes.json") if d else None
+
+
+def fingerprint_seen(key: str) -> bool:
+    """True when ``key`` (a ladder shape fingerprint like ``tpu:B2048xD32xL64``)
+    was recorded compiled on this host's persistent cache. The supervisor uses
+    this for COMPILING-vs-wedged deadline classification; bench.py uses it to
+    echo the expected cold-compile wall BEFORE going silent, so a long-quiet
+    warmup is not killed as wedged (the r5 failure mode). With the compile
+    cache disabled every shape is cold — always False."""
+    import json
+    import os
+
+    p = _fingerprint_path()
+    if p is None or not os.path.exists(p):
+        return False
+    try:
+        with open(p) as fh:
+            return key in json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def record_fingerprint(key: str) -> None:
+    """Record ``key`` as compiled-and-cached (atomic rewrite; best-effort —
+    a read-only cache dir must never sink a run)."""
+    import json
+    import os
+
+    p = _fingerprint_path()
+    if p is None:
+        return
+    try:
+        seen: list = []
+        if os.path.exists(p):
+            with open(p) as fh:
+                seen = json.load(fh)
+        if key in seen:
+            return
+        seen.append(key)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "wt") as fh:
+            json.dump(seen, fh)
+        os.replace(tmp, p)
+    except (OSError, json.JSONDecodeError):
+        pass
+
+
+def expected_compile_wall_s(batch_rows: int) -> float:
+    """Expected COLD server-side XLA compile wall for a ladder program of
+    ``batch_rows`` windows, from the measured superlinear scaling on the
+    tunneled v5e (2026-08-02: B=256 -> 35 s, 1024 -> 242 s, 2048 -> 925 s;
+    the 8192 point was abandoned after extrapolating to hours). Power-law
+    anchored at the 1024/2048 pair; a patience estimate for humans and
+    deadline classification, not a promise."""
+    if batch_rows <= 0:
+        return 120.0
+    est = 242.0 * (batch_rows / 1024.0) ** 1.93
+    return float(min(max(est, 20.0), 4 * 3600.0))
+
+
+def measure_rtt_s(n: int = 3, timeout_s: float = 30.0) -> float | None:
+    """Median round-trip of a tiny blocking device fetch (the fixed
+    per-device_get cost the pipeline amortizes; ~60-300 ms through the axon
+    tunnel, microseconds locally). None on error OR when the measurement
+    itself exceeds ``timeout_s`` — a tunnel that wedges between backend init
+    and this call must not hang the caller (it runs on a daemon thread; the
+    abandoned thread dies with the process). Only call once a backend is
+    already initialized — this is NOT a liveness probe (see
+    probe_backend_status for that)."""
+    import threading
+    import time as _time
+
+    box: list = []
+
+    def work() -> None:
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            tiny = jax.device_put(jnp.zeros(8, jnp.int32))
+            jax.block_until_ready(tiny)
+            rtts = []
+            for _ in range(n):
+                t0 = _time.perf_counter()
+                jax.device_get(tiny)
+                rtts.append(_time.perf_counter() - t0)
+            box.append(sorted(rtts)[len(rtts) // 2])
+        except Exception:
+            pass
+
+    t = threading.Thread(target=work, daemon=True, name="daccord-rtt-probe")
+    t.start()
+    t.join(timeout_s)
+    return box[0] if box else None

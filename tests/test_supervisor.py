@@ -1,0 +1,500 @@
+"""Device supervisor: fault injection, state machine, failover parity.
+
+The fast-tier matrix runs every injected fault kind on CPU with no XLA
+ladder compiles: the state machine is unit-tested against a stub engine, and
+the end-to-end arms drive the real pipeline with the native C++ solver
+(byte-parity is then exact by construction — the degraded engine IS the
+primary's engine). The JAX-ladder end-to-end arm (compiles the ladder) is
+in the slow tier with the rest of the e2e suite.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch
+from daccord_tpu.runtime.faults import (FaultDeviceLost, FaultDispatchError,
+                                        FaultPlan, InjectedCrash)
+from daccord_tpu.runtime.supervisor import (DEGRADED, FAILBACK, HEALTHY,
+                                            DeviceSupervisor, SupervisorConfig,
+                                            WatchdogTimeout, _Watchdog)
+from daccord_tpu.tools.eventcheck import validate_events
+from daccord_tpu.utils.obs import JsonlLogger
+
+
+# ---------------------------------------------------------------- fault plan
+
+def test_fault_plan_parse_and_semantics():
+    plan = FaultPlan.parse("fetch_hang:3, dispatch_error:2,device_lost:7")
+    assert [(s.kind, s.at) for s in plan.specs] == [
+        ("fetch_hang", 3), ("dispatch_error", 2), ("device_lost", 7)]
+    # default count is 1
+    assert FaultPlan.parse("compile_stall").specs[0].at == 1
+    with pytest.raises(ValueError):
+        FaultPlan.parse("unknown_kind:1")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("fetch_hang:zero")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("fetch_hang:0")
+
+    # dispatch_error fires on the 2nd dispatch, once
+    plan = FaultPlan.parse("dispatch_error:2")
+    plan.op("dispatch")
+    with pytest.raises(FaultDispatchError):
+        plan.op("dispatch")
+    plan.op("dispatch")  # one-shot: no re-fire
+
+    # device_lost marks the device dead for every later primary op + probe
+    plan = FaultPlan.parse("device_lost:1")
+    with pytest.raises(FaultDeviceLost):
+        plan.op("fetch")
+    assert plan.probe_override() is False
+    with pytest.raises(FaultDeviceLost):
+        plan.op("dispatch")
+    # degraded ops never see device faults (only crash)
+    plan.op("dispatch", degraded=True)
+
+    # crash is a BaseException and fires even in degraded mode
+    plan = FaultPlan.parse("crash:2")
+    plan.op("dispatch", degraded=True)
+    with pytest.raises(InjectedCrash):
+        plan.op("fetch", degraded=True)
+
+    assert FaultPlan.from_env(env={}) is None
+    assert FaultPlan.from_env(env={"DACCORD_FAULT": "fetch_hang"}) is not None
+
+
+# ---------------------------------------------------------------- watchdog
+
+def test_watchdog_deadline_and_recovery():
+    import time
+
+    wd = _Watchdog()
+    assert wd.run(lambda x: x + 1, (41,), deadline_s=5.0) == 42
+    with pytest.raises(WatchdogTimeout):
+        wd.run(lambda: time.sleep(5), (), deadline_s=0.1)
+    # a fresh worker replaces the abandoned one; the watchdog still works
+    assert wd.run(lambda: "ok", (), deadline_s=5.0) == "ok"
+    # exceptions relay to the caller
+    with pytest.raises(ZeroDivisionError):
+        wd.run(lambda: 1 / 0, (), deadline_s=5.0)
+
+
+# ---------------------------------------------------------------- stub engine
+
+def _mini_batch(b=4, d=2, l=8):
+    return WindowBatch(seqs=np.zeros((b, d, l), np.int8),
+                       lens=np.zeros((b, d), np.int32),
+                       nsegs=np.zeros(b, np.int32),
+                       shape=BatchShape(depth=d, seg_len=l, wlen=l),
+                       read_ids=np.zeros(b, np.int64),
+                       wstarts=np.zeros(b, np.int64))
+
+
+class StubEngine:
+    """Scripted sync solver: dispatch returns a tagged handle, fetch returns
+    a recognizable result dict. ``fail_dispatches`` makes the first N
+    dispatch calls raise (supervisor-retry exercise without a fault plan)."""
+
+    def __init__(self, fail_dispatches=0):
+        self.n_dispatch = 0
+        self.n_fetch = 0
+        self.fail_dispatches = fail_dispatches
+
+    def dispatch(self, batch):
+        self.n_dispatch += 1
+        if self.n_dispatch <= self.fail_dispatches:
+            raise RuntimeError("stub dispatch failure")
+        return ("stub", self.n_dispatch, batch)
+
+    def fetch(self, h):
+        self.n_fetch += 1
+        return {"engine": "stub", "dispatch_no": h[1]}
+
+
+def _fallback_result(batch):
+    return {"engine": "fallback"}
+
+
+def _sup(engine, tmp_path, name, faults=None, probe=None, fallback=True,
+         **cfg_kw):
+    cfg_kw.setdefault("backoff_base_s", 0.01)
+    cfg_kw.setdefault("op_deadline_s", 10.0)
+    ev = os.path.join(str(tmp_path), f"{name}.events.jsonl")
+    sup = DeviceSupervisor(
+        engine.dispatch, engine.fetch, None,
+        fallback_factory=(lambda: _fallback_result) if fallback else None,
+        log=JsonlLogger(ev), cfg=SupervisorConfig(**cfg_kw),
+        faults=faults, probe_fn=probe, describe="stub")
+    return sup, ev
+
+
+def test_supervisor_dispatch_error_retries(tmp_path, monkeypatch):
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    eng = StubEngine()
+    sup, ev = _sup(eng, tmp_path, "derr",
+                   faults=FaultPlan.parse("dispatch_error:2"),
+                   probe=lambda: True)
+    b = _mini_batch()
+    out = sup.fetch(sup.dispatch(b))
+    assert out["engine"] == "stub"
+    # 2nd dispatch: injected error -> probe alive -> retry succeeds
+    out = sup.fetch(sup.dispatch(b))
+    assert out["engine"] == "stub"
+    assert sup.state == HEALTHY and not sup.failed_over
+    assert sup.counters["retries"] == 1
+    events = [json.loads(x)["event"] for x in open(ev)]
+    assert "sup_retry" in events and "sup_failover" not in events
+    assert validate_events(ev, strict=True) == []
+
+
+def test_supervisor_fetch_hang_redispatches(tmp_path, monkeypatch):
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    eng = StubEngine()
+    sup, ev = _sup(eng, tmp_path, "hang",
+                   faults=FaultPlan.parse("fetch_hang:1"),
+                   probe=lambda: True)
+    out = sup.fetch(sup.dispatch(_mini_batch()))
+    # the hung fetch was abandoned and its batch re-dispatched: exactly one
+    # result reaches the caller (no duplicate, no drop)
+    assert out["engine"] == "stub" and out["dispatch_no"] == 2
+    assert eng.n_dispatch == 2 and eng.n_fetch == 1
+    assert sup.counters["timeouts"] == 1 and sup.state == HEALTHY
+    # sup_fault records the spec's kind and its own-domain index (1st fetch),
+    # not the exception class name or the combined device-op counter
+    faults = [json.loads(x) for x in open(ev)]
+    faults = [r for r in faults if r["event"] == "sup_fault"]
+    assert faults == [{"t": faults[0]["t"], "event": "sup_fault",
+                       "kind": "fetch_hang", "op": "fetch", "n": 1}]
+    assert validate_events(ev, strict=True) == []
+
+
+def test_supervisor_compile_classification(tmp_path, monkeypatch):
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    eng = StubEngine()
+    sup, ev = _sup(eng, tmp_path, "compile",
+                   faults=FaultPlan.parse("compile_stall"))
+    sup.fetch(sup.dispatch(_mini_batch()))     # cold shape
+    sup.fetch(sup.dispatch(_mini_batch()))     # warm now
+    recs = [json.loads(x) for x in open(ev)]
+    compiles = [r for r in recs if r["event"] == "sup_compile"]
+    assert len(compiles) == 1 and compiles[0]["key"].endswith("B4xD2xL8")
+    # the injected stall produced a heartbeat, then the op proceeded
+    assert any(r["event"] == "sup_heartbeat" for r in recs)
+    states = [(r["state_from"], r["state_to"]) for r in recs
+              if r["event"] == "sup_state"]
+    assert ("HEALTHY", "COMPILING") in states and ("COMPILING", "HEALTHY") in states
+    assert validate_events(ev, strict=True) == []
+    # the fingerprint registry made the second dispatch warm — and persists
+    from daccord_tpu.utils.obs import fingerprint_seen
+
+    assert fingerprint_seen("B4xD2xL8")
+
+
+def test_supervisor_device_lost_failover_and_replay(tmp_path, monkeypatch):
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    eng = StubEngine()
+    sup, ev = _sup(eng, tmp_path, "lost",
+                   faults=FaultPlan.parse("device_lost:3"))
+    h1 = sup.dispatch(_mini_batch())           # op 1: ok
+    h2 = sup.dispatch(_mini_batch())           # op 2: ok (in flight)
+    h3 = sup.dispatch(_mini_batch())           # op 3: device lost
+    assert sup.failed_over and sup.state == DEGRADED
+    # the batch whose dispatch died AND the still-in-flight handles all
+    # replay on the fallback engine
+    assert sup.fetch(h3)["engine"] == "fallback"
+    assert sup.fetch(h1)["engine"] == "fallback"
+    assert sup.fetch(h2)["engine"] == "fallback"
+    # later dispatches never touch the dead primary
+    nd = eng.n_dispatch
+    assert sup.fetch(sup.dispatch(_mini_batch()))["engine"] == "fallback"
+    assert eng.n_dispatch == nd
+    recs = [json.loads(x) for x in open(ev)]
+    chain = [(r["state_from"], r["state_to"]) for r in recs
+             if r["event"] == "sup_state"]
+    assert ("SUSPECT", "LOST") in chain and ("LOST", "DEGRADED") in chain
+    assert all("ts" in r for r in recs if r["event"] == "sup_state")
+    assert validate_events(ev, strict=True) == []
+
+
+def test_supervisor_failback(tmp_path, monkeypatch):
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    alive = {"v": False}
+    eng = StubEngine(fail_dispatches=1)
+    sup, ev = _sup(eng, tmp_path, "failback", probe=lambda: alive["v"],
+                   failback=True, failback_probe_s=0.0, max_retries=0)
+    # primary fails, probe says dead -> degraded
+    out = sup.fetch(sup.dispatch(_mini_batch()))
+    assert out["engine"] == "fallback" and sup.state == DEGRADED
+    # chip revives: next dispatch re-probes, fails back to the primary
+    alive["v"] = True
+    out = sup.fetch(sup.dispatch(_mini_batch()))
+    assert out["engine"] == "stub"
+    assert sup.state == HEALTHY
+    recs = [json.loads(x) for x in open(ev)]
+    assert any(r["event"] == "sup_failback" for r in recs)
+    chain = [(r["state_from"], r["state_to"]) for r in recs
+             if r["event"] == "sup_state"]
+    # failback re-compiles shapes, so the path back is FAILBACK -> COMPILING
+    # -> HEALTHY
+    assert ("DEGRADED", "FAILBACK") in chain
+    assert chain[-1][1] == "HEALTHY"
+    assert validate_events(ev, strict=True) == []
+
+
+def test_supervisor_second_loss_after_failback(tmp_path, monkeypatch):
+    """A chip that dies AGAIN after a successful failback must re-enter
+    DEGRADED (cached fallback re-engaged) — not leave the supervisor stuck
+    retrying the dead primary from SUSPECT on every later dispatch."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    alive = {"v": False}
+
+    class FlakyEngine(StubEngine):
+        def __init__(self):
+            super().__init__()
+            self.up = False
+
+        def dispatch(self, batch):
+            self.n_dispatch += 1
+            if not self.up:
+                raise RuntimeError("chip down")
+            return ("stub", self.n_dispatch, batch)
+
+    eng = FlakyEngine()
+    sup, ev = _sup(eng, tmp_path, "reloss", probe=lambda: alive["v"],
+                   failback=True, failback_probe_s=0.0, max_retries=0)
+    assert sup.fetch(sup.dispatch(_mini_batch()))["engine"] == "fallback"
+    # revive -> failback -> healthy primary
+    alive["v"] = True
+    eng.up = True
+    assert sup.fetch(sup.dispatch(_mini_batch()))["engine"] == "stub"
+    assert sup.state == HEALTHY
+    # second death: back to the (cached) fallback, state DEGRADED again
+    alive["v"] = False
+    eng.up = False
+    assert sup.fetch(sup.dispatch(_mini_batch()))["engine"] == "fallback"
+    assert sup.state == DEGRADED
+    # and later dispatches do NOT retry the dead primary
+    nd = eng.n_dispatch
+    assert sup.fetch(sup.dispatch(_mini_batch()))["engine"] == "fallback"
+    assert eng.n_dispatch == nd
+    assert validate_events(ev, strict=True) == []
+
+
+def test_supervisor_no_fallback_raises(tmp_path, monkeypatch):
+    from daccord_tpu.runtime.supervisor import DeviceLostError
+
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    eng = StubEngine()
+    sup, _ = _sup(eng, tmp_path, "nofb",
+                  faults=FaultPlan.parse("device_lost:1"), fallback=False)
+    with pytest.raises(DeviceLostError):
+        sup.dispatch(_mini_batch())
+
+    # a fallback FACTORY that fails (e.g. native library not built on a
+    # device host) surfaces as the same classified loss, not a stray error
+    def broken_factory():
+        raise RuntimeError("native library unavailable")
+
+    sup2 = DeviceSupervisor(
+        eng.dispatch, eng.fetch, None, fallback_factory=broken_factory,
+        log=JsonlLogger(None), cfg=SupervisorConfig(backoff_base_s=0.01),
+        faults=FaultPlan.parse("device_lost:1"))
+    with pytest.raises(DeviceLostError, match="fallback engine"):
+        sup2.dispatch(_mini_batch())
+
+
+# ---------------------------------------------------------------- eventcheck
+
+def test_eventcheck_schema_and_transitions(tmp_path):
+    good = tmp_path / "good.jsonl"
+    good.write_text("\n".join([
+        json.dumps({"t": 0.1, "event": "sup_init", "primary": "x",
+                    "op_deadline_s": 1.0, "compile_deadline_s": 2.0}),
+        json.dumps({"t": 0.2, "event": "sup_state", "state_from": "HEALTHY",
+                    "state_to": "SUSPECT", "reason": "r", "ts": 1.0}),
+        json.dumps({"t": 0.3, "event": "sup_state", "state_from": "SUSPECT",
+                    "state_to": "LOST", "reason": "r", "ts": 1.1}),
+        json.dumps({"t": 0.4, "event": "sup_state", "state_from": "LOST",
+                    "state_to": "DEGRADED", "reason": "r", "ts": 1.2}),
+        json.dumps({"t": 0.5, "event": "custom_info", "anything": 1}),
+    ]) + "\n")
+    assert validate_events(str(good), strict=True) == []
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("\n".join([
+        "not json at all",
+        json.dumps({"event": "sup_retry"}),                      # missing t + fields
+        json.dumps({"t": 1.0, "event": "sup_state", "state_from": "HEALTHY",
+                    "state_to": "DEGRADED", "reason": "r", "ts": 1.0}),
+        json.dumps({"t": 0.5, "event": "batch", "windows": "many",
+                    "solved": 1}),                               # wrong type
+    ]) + "\n")
+    errs = validate_events(str(bad), strict=True)
+    assert len(errs) >= 4
+    assert any("illegal transition" in e for e in errs)
+
+    # two appended supervisor lifecycles (rerun against the same --events
+    # path): sup_init is a stream boundary, so the restarted clock and state
+    # chain are legal under --strict
+    two = tmp_path / "two.jsonl"
+    two.write_text(good.read_text() + good.read_text())
+    assert validate_events(str(two), strict=True) == []
+
+    from daccord_tpu.tools.eventcheck import eventcheck_main
+
+    assert eventcheck_main([str(good), "--strict"]) == 0
+    assert eventcheck_main([str(bad)]) == 1
+
+
+def test_expected_compile_wall_matches_measured_scaling():
+    from daccord_tpu.utils.obs import expected_compile_wall_s
+
+    # anchored on the r5 measurements: 1024 -> 242 s, 2048 -> 925 s
+    assert expected_compile_wall_s(1024) == pytest.approx(242, rel=0.05)
+    assert expected_compile_wall_s(2048) == pytest.approx(925, rel=0.10)
+    assert expected_compile_wall_s(0) > 0
+    assert expected_compile_wall_s(1 << 20) <= 4 * 3600
+
+
+# ------------------------------------------------------------ e2e (native)
+
+@pytest.fixture(scope="module")
+def native_dataset(tmp_path_factory):
+    native = pytest.importorskip("daccord_tpu.native")
+    if not native.available():
+        pytest.skip("native library unavailable")
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path_factory.mktemp("sup_e2e"))
+    cfg = SimConfig(genome_len=1500, coverage=12, read_len_mean=500,
+                    min_overlap=200, seed=7)
+    return make_dataset(d, cfg, name="p"), d
+
+
+def _native_cfg(ev=None, **kw):
+    from daccord_tpu.runtime import PipelineConfig
+
+    return PipelineConfig(batch_size=64, native_solver=True, events_path=ev,
+                          **kw)
+
+
+def _run(out, d, name, ev=None, **kw):
+    from daccord_tpu.runtime import correct_to_fasta
+
+    fasta = os.path.join(d, f"{name}.fasta")
+    stats = correct_to_fasta(out["db"], out["las"], fasta, _native_cfg(ev, **kw))
+    return fasta, stats
+
+
+def test_e2e_device_lost_byte_parity(native_dataset, monkeypatch):
+    """ISSUE acceptance: DACCORD_FAULT=device_lost:N -> the run completes in
+    degraded mode, byte-identical FASTA, and the events file records the
+    HEALTHY->...->LOST->DEGRADED transitions with timestamps."""
+    out, d = native_dataset
+    f0, s0 = _run(out, d, "base")
+    assert not s0.degraded
+
+    monkeypatch.setenv("DACCORD_FAULT", "device_lost:3")
+    ev = os.path.join(d, "lost.events.jsonl")
+    f1, s1 = _run(out, d, "lost", ev=ev)
+    assert s1.degraded and "device_lost" in s1.fallback_reason
+    assert open(f0).read() == open(f1).read()
+
+    assert validate_events(ev, strict=True) == []
+    recs = [json.loads(x) for x in open(ev)]
+    chain = [(r["state_from"], r["state_to"]) for r in recs
+             if r["event"] == "sup_state"]
+    assert ("SUSPECT", "LOST") in chain and ("LOST", "DEGRADED") in chain
+    assert all(r["ts"] > 0 for r in recs if r["event"] == "sup_state")
+    done = [r for r in recs if r["event"] == "sup_done"]
+    assert done and done[0]["degraded"] and done[0]["state"] == "DEGRADED"
+
+
+def test_e2e_fetch_hang_retry_recovers(native_dataset, monkeypatch):
+    """fetch_hang: retry-then-recover with no duplicate/dropped windows
+    (byte-identical output proves both at once)."""
+    out, d = native_dataset
+    f0, _ = _run(out, d, "base2")
+    monkeypatch.setenv("DACCORD_FAULT", "fetch_hang:2")
+    monkeypatch.setenv("DACCORD_SUP_BACKOFF_S", "0.01")
+    ev = os.path.join(d, "hang.events.jsonl")
+    f1, s1 = _run(out, d, "hang", ev=ev)
+    assert not s1.degraded          # recovered, never failed over
+    assert open(f0).read() == open(f1).read()
+    recs = [json.loads(x) for x in open(ev)]
+    assert any(r["event"] == "sup_retry" for r in recs)
+    assert validate_events(ev, strict=True) == []
+
+
+def test_e2e_dispatch_error_retry_recovers(native_dataset, monkeypatch):
+    out, d = native_dataset
+    f0, _ = _run(out, d, "base3")
+    monkeypatch.setenv("DACCORD_FAULT", "dispatch_error:4")
+    monkeypatch.setenv("DACCORD_SUP_BACKOFF_S", "0.01")
+    f1, s1 = _run(out, d, "derr")
+    assert not s1.degraded
+    assert open(f0).read() == open(f1).read()
+
+
+def test_e2e_checkpoint_failover_compose(native_dataset, monkeypatch):
+    """Checkpoint + failover compose: device loss, then a hard crash, then a
+    resume — the resumed run completes and its FASTA is byte-identical to an
+    uninterrupted shard."""
+    from daccord_tpu.parallel.launch import run_shard, shard_paths
+    from daccord_tpu.runtime import PipelineConfig
+
+    out, d = native_dataset
+    # single bucket + small batch: reads finalize (and checkpoint) steadily,
+    # so the injected crash reliably lands after a checkpoint exists
+    cfg = PipelineConfig(batch_size=32, native_solver=True,
+                         depth_buckets=(), bucket_flush_reads=4)
+
+    ref_dir = os.path.join(d, "ref_out")
+    m_ref = run_shard(out["db"], out["las"], ref_dir, 0, 1, cfg,
+                      checkpoint_every=2)
+    assert not m_ref.get("degraded")
+    ref_fasta = open(shard_paths(ref_dir, 0)["fasta"]).read()
+
+    crash_dir = os.path.join(d, "crash_out")
+    monkeypatch.setenv("DACCORD_FAULT", "device_lost:2,crash:14")
+    with pytest.raises(InjectedCrash):
+        run_shard(out["db"], out["las"], crash_dir, 0, 1, cfg,
+                  checkpoint_every=2)
+    paths = shard_paths(crash_dir, 0)
+    assert os.path.exists(paths["progress"])      # died mid-shard, after ckpt
+    assert not os.path.exists(paths["manifest"])
+
+    monkeypatch.delenv("DACCORD_FAULT")
+    m = run_shard(out["db"], out["las"], crash_dir, 0, 1, cfg,
+                  checkpoint_every=2)
+    assert m["resumed_at_read"] > 0
+    assert open(paths["fasta"]).read() == ref_fasta
+    assert not os.path.exists(paths["progress"])  # cleaned after manifest
+
+
+# ------------------------------------------------------------ e2e (JAX ladder)
+
+@pytest.mark.slow
+def test_e2e_jax_ladder_device_lost_byte_parity(native_dataset, monkeypatch):
+    """Default JAX-CPU ladder primary: device loss fails over to the exact
+    same-ladder host fallback (failover_backend auto resolves to 'cpu' on a
+    cpu platform) — byte-identical output through the real device-batch
+    path."""
+    from daccord_tpu.runtime import PipelineConfig, correct_to_fasta
+
+    out, d = native_dataset
+    f0 = os.path.join(d, "jax_base.fasta")
+    s0 = correct_to_fasta(out["db"], out["las"], f0,
+                          PipelineConfig(batch_size=128))
+    assert not s0.degraded
+    monkeypatch.setenv("DACCORD_FAULT", "device_lost:4")
+    ev = os.path.join(d, "jax.events.jsonl")
+    f1 = os.path.join(d, "jax_lost.fasta")
+    s1 = correct_to_fasta(out["db"], out["las"], f1,
+                          PipelineConfig(batch_size=128, events_path=ev))
+    assert s1.degraded
+    assert open(f0).read() == open(f1).read()
+    assert validate_events(ev, strict=True) == []

@@ -4,29 +4,56 @@
 # experiments"), each sidecar committed IMMEDIATELY so a tunnel that dies
 # mid-sequence still leaves evidence. Run the moment TUNNEL_LOG.jsonl
 # records alive:true:   sh tools_pounce.sh
-#
-# EXCLUSIVITY (2026-08-02): stop tools_probe_loop.sh before running this.
-# Each probe opens a fresh axon client; a concurrent client while a bench
-# holds the device can leave the bench's RPC unanswered indefinitely.
-# Probe manually between runs instead.
 set -x
 cd /root/repo || exit 1
+
+# EXCLUSIVITY, enforced in code (ADVICE r5 #1: the comment-only rule let a
+# concurrent probe client wedge a 30-min bench): each probe opens a fresh
+# axon client, and a concurrent client while a bench holds the device can
+# leave the bench's RPC unanswered indefinitely. Kill the probe loop; abort
+# if it will not die. Probe manually between runs instead.
+if pgrep -f tools_probe_loop >/dev/null 2>&1; then
+  echo "tools_pounce: killing running tools_probe_loop (probe/bench exclusivity)" >&2
+  pkill -f tools_probe_loop
+  sleep 3
+  if pgrep -f tools_probe_loop >/dev/null 2>&1; then
+    echo "tools_pounce: probe loop still alive after pkill; aborting" >&2
+    exit 1
+  fi
+fi
+
 stamp=$(date -u +%Y%m%dT%H%M%S)
 
 run() {  # run <name> <cmd...>: capture one experiment, commit its sidecar
   name=$1; shift
   out="POUNCE_${stamp}_${name}.json"
-  "$@" > "$out" 2> "POUNCE_${stamp}_${name}.log"
+  ev="POUNCE_${stamp}_${name}.events.jsonl"
+  # every bench emits its events sidecar (compile expectations, drain
+  # heartbeats, supervisor transitions) — the machine-readable
+  # compiling-vs-wedged-vs-dead signal whose absence killed two r5 benches
+  DACCORD_BENCH_EVENTS="$ev" "$@" > "$out" 2> "POUNCE_${stamp}_${name}.log"
+  if [ -s "$ev" ]; then
+    # schema lint: a malformed events file is a bug worth catching now, but
+    # never worth losing the measurement over
+    python -m daccord_tpu.tools.cli eventcheck "$ev" \
+      >> "POUNCE_${stamp}_${name}.log" 2>&1 || true
+    git add "$ev"
+  fi
   git add "$out" "POUNCE_${stamp}_${name}.log"
   git commit -q -m "pounce: ${name} on live chip (${stamp})"
 }
 
+# 0. warm the persistent XLA cache for the sweep batch sizes FIRST
+# (ADVICE r5 #2): the server-side compile scales superlinearly with B
+# (measured 256->35s, 1024->242s, 2048->925s), so precompile 2048/4096 into
+# the cache where a cold compile is expected and announced (bench echoes the
+# expected wall) instead of surfacing as an unexplained silent bench
+run precompile2048   env DACCORD_BENCH_PRECOMPILE=1 python bench.py
+run precompile4096   env DACCORD_BENCH_PRECOMPILE=1 DACCORD_BENCH_BATCH=4096 python bench.py
 # 1. flagship bench first (pipelined + device_compute + stage breakdown)
 run bench            python bench.py
-# 2. batch sweep (experiment 1). 8192 dropped 2026-08-02: server-side XLA
-# compile scales superlinearly with B (measured 256->35s, 1024->242s,
-# 2048->925s; 8192 extrapolates to 2-4h) — precompile 2048/4096 via the
-# persistent cache first, see BASELINE.md "r5 live-chip" notes.
+# 2. batch sweep (experiment 1). 8192 dropped 2026-08-02: compile
+# extrapolates to 2-4h even warm-cached once; 4096 is precompiled above.
 run batch4096        env DACCORD_BENCH_BATCH=4096 python bench.py
 # 3. esc_cap tail cost (experiment 3)
 run esccap256        env DACCORD_BENCH_ESC_CAP=256 python bench.py
