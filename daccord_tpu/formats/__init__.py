@@ -1,8 +1,13 @@
 from .fasta import read_fasta, write_fasta, FastaRecord
 from .dazzdb import DazzDB, DazzRead, write_db, read_db, write_track, read_track
+from .ingest import IngestError, IngestIssue, LasScanReport, scan_las_range
 from .las import Overlap, LasFile, write_las, read_las, index_las, OVL_COMP
 
 __all__ = [
+    "IngestError",
+    "IngestIssue",
+    "LasScanReport",
+    "scan_las_range",
     "read_fasta",
     "write_fasta",
     "FastaRecord",

@@ -47,6 +47,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..utils.bases import pack_2bit, unpack_2bit
+from .ingest import IngestError, IngestIssue
 
 _HDR_FMT = "<4i4fi4xq5i4x8si4x8s8s8s"  # 112 bytes, pointers as opaque 8-byte pads
 _HDR_SIZE = struct.calcsize(_HDR_FMT)
@@ -82,6 +83,10 @@ class DazzDB:
     reads: list[DazzRead]
     bps: np.ndarray = field(repr=False)  # uint8 packed base store
     names: list[str] = field(default_factory=list, repr=False)
+    # read ids whose .idx record failed validation under read_db(strict=False)
+    # (quarantine policy): their rlen/boff are garbage, so their bases must
+    # never be decoded and piles referencing them quarantine at ingest
+    bad_reads: set = field(default_factory=set, repr=False)
 
     def read_bases(self, i: int) -> np.ndarray:
         """Decode read ``i`` to an int8 array of 0..3."""
@@ -207,28 +212,65 @@ def write_db(path: str, seqs: list[np.ndarray], names: list[str] | None = None, 
                   names=names)
 
 
-def read_db(path: str, load_bases: bool = True) -> DazzDB:
+def read_db(path: str, load_bases: bool = True, strict: bool = True) -> DazzDB:
     """Load a DB triple written by :func:`write_db` (or DAZZ_DB-compatible).
 
     ``load_bases=False`` skips the .bps base store (multi-GB on real DBs) for
     consumers that only need read lengths/metadata — e.g. the track tools'
-    per-block jobs, which must stay O(block) in memory."""
+    per-block jobs, which must stay O(block) in memory.
+
+    Every .idx byte is validated before it steers a decode: a torn header or
+    a read count the file cannot hold raises a structured
+    :class:`~.ingest.IngestError`; a per-read record whose ``rlen``/``boff``
+    would index outside the base store raises under ``strict`` (the default)
+    or — ``strict=False``, the ingest layer's quarantine policy — lands the
+    read id in ``DazzDB.bad_reads`` so piles referencing it can be contained
+    without sinking the run."""
     d, stem = _db_stems(path)
     idx_path = os.path.join(d, f".{stem}.idx")
     bps_path = os.path.join(d, f".{stem}.bps")
 
+    idx_size = os.path.getsize(idx_path)
+    # a missing .bps still loads with load_bases=False (lengths-only
+    # consumers); bounds checks against the base store then cannot apply
+    bps_size = os.path.getsize(bps_path) if os.path.exists(bps_path) else None
     with open(idx_path, "rb") as fh:
         hdr = fh.read(_HDR_SIZE)
+        if len(hdr) < _HDR_SIZE:
+            raise IngestError(IngestIssue(
+                "truncation", idx_path, len(hdr),
+                f"idx holds {len(hdr)} of the {_HDR_SIZE}-byte DB header"))
         (ureads, _treads, cutoff, _allarr,
          _f0, _f1, _f2, _f3,
          maxlen, totlen,
          nreads, _trimmed, _part, _ufirst, _tfirst,
          _p0, _loaded, _p1, _p2, _p3) = struct.unpack(_HDR_FMT, hdr)
+        if ureads < 0 or totlen < 0 or not (0 <= nreads <= ureads):
+            raise IngestError(IngestIssue(
+                "bad_header", idx_path, 0,
+                f"ureads={ureads} nreads={nreads} totlen={totlen} fail sanity"))
+        if idx_size < _HDR_SIZE + _READ_SIZE * ureads:
+            raise IngestError(IngestIssue(
+                "truncation", idx_path, idx_size,
+                f"idx holds {(idx_size - _HDR_SIZE) // _READ_SIZE} of "
+                f"{ureads} read records"))
         reads = []
+        bad: set[int] = set()
+        issues: list[IngestIssue] = []
         raw = fh.read(_READ_SIZE * ureads)
         for i in range(ureads):
             origin, rlen, fpulse, boff, coff, flags = struct.unpack_from(_READ_FMT, raw, i * _READ_SIZE)
+            nbytes = (rlen + 3) // 4
+            if rlen < 0 or boff < 0 or (bps_size is not None
+                                        and boff + nbytes > bps_size):
+                issues.append(IngestIssue(
+                    "db_read", idx_path, _HDR_SIZE + i * _READ_SIZE,
+                    f"read {i}: rlen={rlen} boff={boff} outside the "
+                    f"{bps_size}-byte base store", aread=i, record=i))
+                bad.add(i)
             reads.append(DazzRead(origin, rlen, fpulse, boff, coff, flags))
+        if issues and strict:
+            raise IngestError(issues)
 
     bps = np.fromfile(bps_path, dtype=np.uint8) if load_bases else np.zeros(0, np.uint8)
 
@@ -239,7 +281,8 @@ def read_db(path: str, load_bases: bool = True) -> DazzDB:
             names = [ln.rstrip("\n") for ln in fh]
 
     return DazzDB(path=os.path.join(d, f"{stem}.db"), nreads=nreads, totlen=totlen,
-                  maxlen=maxlen, cutoff=cutoff, reads=reads, bps=bps, names=names)
+                  maxlen=maxlen, cutoff=cutoff, reads=reads, bps=bps, names=names,
+                  bad_reads=bad)
 
 
 def decode_reads_from_bps(db: DazzDB, ids) -> list[np.ndarray]:

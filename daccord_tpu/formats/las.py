@@ -37,6 +37,7 @@ from typing import Iterable, Iterator
 import numpy as np
 
 from ..utils import aio
+from .ingest import IngestError, IngestIssue
 
 TRACE_XOVR = 125
 OVL_COMP = 0x1  # flags bit: B read is complemented
@@ -86,22 +87,37 @@ def _trace_dtype(tspace: int):
     return np.uint8 if tspace <= TRACE_XOVR else np.uint16
 
 
-def write_las(path: str, tspace: int, overlaps: Iterable[Overlap]) -> int:
-    """Write overlaps to a .las path/URL (``mem:`` supported); returns record
-    count."""
+def _write_las_stream(fh, tspace: int, overlaps: Iterable[Overlap]) -> int:
     tdt = _trace_dtype(tspace)
     novl = 0
-    with aio.open_output(path, "wb") as fh:
-        fh.write(struct.pack("<qi4x", 0, tspace))  # novl patched at the end
-        for ovl in overlaps:
-            trace = np.asarray(ovl.trace, dtype=np.int64).reshape(-1)
-            tlen = len(trace)
-            fh.write(struct.pack(_REC_FMT, tlen, ovl.diffs, ovl.abpos, ovl.bbpos,
-                                 ovl.aepos, ovl.bepos, ovl.flags, ovl.aread, ovl.bread))
-            fh.write(trace.astype(tdt).tobytes())
-            novl += 1
-        fh.seek(0)
-        fh.write(struct.pack("<q", novl))
+    fh.write(struct.pack("<qi4x", 0, tspace))  # novl patched at the end
+    for ovl in overlaps:
+        trace = np.asarray(ovl.trace, dtype=np.int64).reshape(-1)
+        tlen = len(trace)
+        fh.write(struct.pack(_REC_FMT, tlen, ovl.diffs, ovl.abpos, ovl.bbpos,
+                             ovl.aepos, ovl.bepos, ovl.flags, ovl.aread, ovl.bread))
+        fh.write(trace.astype(tdt).tobytes())
+        novl += 1
+    fh.seek(0)
+    fh.write(struct.pack("<q", novl))
+    return novl
+
+
+def write_las(path: str, tspace: int, overlaps: Iterable[Overlap]) -> int:
+    """Write overlaps to a .las path/URL (``mem:`` supported); returns record
+    count.
+
+    Real-file outputs commit via tmp + fsync + ``os.replace``: the header's
+    ``novl`` is patched only after every record landed, so a crash mid-write
+    must never leave a valid-looking LAS with ``novl=0`` at the target path
+    that downstream tools would read as legitimately empty. (``mem:`` writes
+    are already atomic — the store commits at close.)"""
+    if aio.is_mem(path):
+        with aio.open_output(path, "wb") as fh:
+            novl = _write_las_stream(fh, tspace, overlaps)
+    else:
+        novl = aio.durable_write(
+            path, lambda fh: _write_las_stream(fh, tspace, overlaps))
     invalidate_index(path)
     return novl
 
@@ -132,7 +148,22 @@ class LasFile:
     def __init__(self, path: str):
         self.path = path
         with aio.open_input(path, "rb") as fh:
-            self.novl, self.tspace = struct.unpack(_HDR_FMT, fh.read(_HDR_SIZE))
+            hdr = fh.read(_HDR_SIZE)
+        if len(hdr) < _HDR_SIZE:
+            raise IngestError(IngestIssue(
+                "truncation", path, len(hdr),
+                f"file holds {len(hdr)} of the {_HDR_SIZE}-byte LAS header"))
+        self.novl, self.tspace = struct.unpack(_HDR_FMT, hdr)
+        if not (1 <= self.tspace <= 1_000_000):
+            raise IngestError(IngestIssue(
+                "bad_header", path, 8, f"tspace={self.tspace} out of range"))
+        if self.novl < 0:
+            # novl merely OVERSTATING the record bytes is NOT rejected here:
+            # that is what a truncated file looks like, and the validating
+            # scan (formats/ingest.py) quarantines truncation per-pile —
+            # the constructor must stay usable on damaged files
+            raise IngestError(IngestIssue(
+                "bad_header", path, 0, f"novl={self.novl} negative"))
         self._tdt = _trace_dtype(self.tspace)
         self._tsize = np.dtype(self._tdt).itemsize
 
@@ -145,11 +176,24 @@ class LasFile:
             fh.seek(start if start is not None else _HDR_SIZE)
             limit = end if end is not None else aio.getsize(self.path)
             while fh.tell() < limit:
+                off = fh.tell()
                 raw = fh.read(_REC_SIZE)
                 if len(raw) < _REC_SIZE:
                     break
                 tlen, diffs, abpos, bbpos, aepos, bepos, flags, aread, bread = struct.unpack(_REC_FMT, raw)
+                if tlen < 0 or tlen % 2:
+                    # validated decode: a corrupt tlen must surface as a
+                    # structured error, never steer fh.read(negative) into
+                    # swallowing the rest of the file
+                    raise IngestError(IngestIssue(
+                        "bad_tlen", self.path, off,
+                        f"tlen={tlen} (negative or odd)", aread=aread))
                 traw = fh.read(tlen * self._tsize)
+                if len(traw) < tlen * self._tsize:
+                    raise IngestError(IngestIssue(
+                        "truncation", self.path, off,
+                        f"trace of tlen={tlen} cut {tlen * self._tsize - len(traw)} "
+                        f"bytes short", aread=aread))
                 trace = np.frombuffer(traw, dtype=self._tdt).astype(np.int32).reshape(-1, 2)
                 yield Overlap(aread=aread, bread=bread, abpos=abpos, aepos=aepos,
                               bbpos=bbpos, bepos=bepos, flags=flags, diffs=diffs,
@@ -218,6 +262,13 @@ def index_las(path: str, use_sidecar: bool = True) -> np.ndarray:
                 break
             tlen = struct.unpack_from("<i", raw)[0]
             aread = struct.unpack_from("<i", raw, 28)[0]
+            if tlen < 0 or off + _REC_SIZE + tlen * f._tsize > size:
+                # a corrupt tlen would steer the seek into garbage and the
+                # indexer would silently emit a wrong index; reject instead
+                raise IngestError(IngestIssue(
+                    "bad_tlen", path, off,
+                    f"tlen={tlen} (negative or past EOF at size {size})",
+                    aread=last))
             if aread != last:
                 rows.append((aread, off))
                 last = aread
@@ -243,10 +294,16 @@ def shard_ranges(path: str, nshards: int) -> list[tuple[int, int]]:
     This is the multi-host data-plane sharding primitive: the reference's
     ``-J i,n`` CLI sharding re-imagined as byte ranges over one file.
     """
-    idx = index_las(path)
     size = aio.getsize(path)
+    if nshards <= 1:
+        # no cut points to choose — skip the index entirely, so single-shard
+        # runs (incl. quarantine-policy runs over a damaged LAS, whose index
+        # build rightly fails) never pay or require the aread scan
+        return [(_HDR_SIZE, size)]
+    idx = index_las(path)
     if len(idx) == 0:
-        return [(_HDR_SIZE, size)] * 1 if nshards <= 1 else [(_HDR_SIZE, size)] + [(size, size)] * (nshards - 1)
+        # nshards >= 2 here (the early return above owns nshards <= 1)
+        return [(_HDR_SIZE, size)] + [(size, size)] * (nshards - 1)
     starts = idx[:, 1]
     # choose cut points at pile boundaries closest to equal byte splits
     cuts = [_HDR_SIZE]

@@ -46,7 +46,17 @@ def shard_paths(outdir: str, shard: int) -> dict:
         "fasta": os.path.join(outdir, f"shard{shard:04d}.fasta"),
         "manifest": os.path.join(outdir, f"shard{shard:04d}.json"),
         "progress": os.path.join(outdir, f"shard{shard:04d}.progress.json"),
+        "quarantine": os.path.join(outdir, f"shard{shard:04d}.quarantine.jsonl"),
     }
+
+
+def _write_manifest_durable(path: str, obj: dict) -> None:
+    """Manifest commit via :func:`aio.durable_write`: a crash can only leave
+    the OLD manifest (or none) — never a torn JSON that wedges every later
+    idempotent rerun, and a failed commit leaves no tmp litter."""
+    from ..utils.aio import durable_write
+
+    durable_write(path, lambda fh: json.dump(obj, fh), mode="wt")
 
 
 def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int,
@@ -68,11 +78,27 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
     os.makedirs(outdir, exist_ok=True)
     paths = shard_paths(outdir, shard)
     if not force and os.path.exists(paths["manifest"]):
-        with open(paths["manifest"]) as fh:
-            return json.load(fh)
-    if force and os.path.exists(paths["progress"]):
-        # --force means recompute from scratch, not resume the old run
-        os.remove(paths["progress"])
+        try:
+            with open(paths["manifest"]) as fh:
+                return json.load(fh)
+        except (json.JSONDecodeError, OSError):
+            # a torn manifest (crash mid-write under the pre-ISSUE-2 plain
+            # write, or disk damage) must not wedge the idempotent rerun:
+            # recompute the shard as if the manifest never existed
+            pass
+    if force:
+        # --force means recompute from scratch, not resume the old run —
+        # the progress manifest AND the quarantine sidecar both reset
+        for key in ("progress", "quarantine"):
+            if os.path.exists(paths[key]):
+                os.remove(paths[key])
+    cfg = cfg or PipelineConfig()
+    if cfg.ingest_policy == "quarantine" and cfg.quarantine_path is None:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, quarantine_path=paths["quarantine"])
+    # shard_ranges skips the aread index for nshards<=1, so single-shard
+    # quarantine runs over a damaged LAS work without a repair pass
     ranges = shard_ranges(las_path, nshards)
     start, end = ranges[shard]
     if not checkpoint_every:
@@ -81,6 +107,8 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
         counters = {"reads": stats.n_reads, "windows": stats.n_windows,
                     "solved": stats.n_solved, "bases_out": stats.bases_out,
                     "wall_s": stats.wall_s,
+                    "quarantined": stats.n_quarantined,
+                    "ingest_issues": stats.n_ingest_issues,
                     # a shard that finished on the fallback engine is still
                     # correct output, but the manifest must say so: reruns
                     # and round reports need the degraded runs enumerable
@@ -93,8 +121,7 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
         "shard": shard, "nshards": nshards, "byte_range": [start, end],
         **counters, "fasta": paths["fasta"],
     }
-    with open(paths["manifest"], "wt") as fh:
-        json.dump(manifest, fh)
+    _write_manifest_durable(paths["manifest"], manifest)
     if os.path.exists(paths["progress"]):
         os.remove(paths["progress"])
     return manifest
@@ -110,11 +137,22 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
 
     from ..formats.fasta import FastaRecord, write_fasta
     from ..oracle.profile import ErrorProfile
+    from ..runtime.faults import maybe_apply_data_faults
     from ..runtime.pipeline import estimate_profile_for_shard
     from ..utils.bases import ints_to_seq
+    from ..utils.obs import JsonlLogger
 
     cfg = cfg or PipelineConfig()
     t0 = time.time()
+    fired = maybe_apply_data_faults(las_path=las_path, db_path=db_path)
+    if fired and cfg.events_path:
+        # short-lived logger: the abort paths below (strict scan failure,
+        # resume refusal) must not leak a held fd per retry attempt
+        _fl = JsonlLogger(cfg.events_path)
+        for f in fired:
+            _fl.log("ingest.fault", kind=f["kind"], path=f["path"],
+                    record=f["record"], offset=f.get("offset", -1))
+        _fl.close()
 
     emitted = 0
     base = {"reads": 0, "windows": 0, "solved": 0, "bases_out": 0, "wall_s": 0.0}
@@ -122,20 +160,56 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
     resumed = None
     prog = None
     if os.path.exists(paths["progress"]):
-        with open(paths["progress"]) as fh:
-            prog = json.load(fh)
+        try:
+            with open(paths["progress"]) as fh:
+                prog = json.load(fh)
+        except (json.JSONDecodeError, OSError):
+            # torn progress manifest (pre-durable-commit crash or disk
+            # damage): fall back to a fresh run of the shard — the FASTA is
+            # rewritten from scratch, never spliced onto an untrusted tail
+            prog = None
         # a progress file is only valid for the same byte range (resharding
         # with a different n would map `emitted` onto different piles) and
         # only while its FASTA prefix still exists
-        if prog.get("byte_range") != [start, end]:
+        if prog is not None and prog.get("byte_range") != [start, end]:
             prog = None
-        elif not os.path.exists(paths["fasta"]):
+        elif prog is not None and not os.path.exists(paths["fasta"]):
             prog = None
         if prog is not None:
             emitted = prog["emitted"]
             base = prog["counters"]
             fasta_bytes = prog["fasta_bytes"]
             resumed = emitted
+    if not emitted and cfg.quarantine_path and os.path.exists(cfg.quarantine_path):
+        # fresh (non-resume) shard run: reset the sidecar so a recompute
+        # (e.g. after a torn manifest) cannot accumulate duplicate rows
+        os.remove(cfg.quarantine_path)
+
+    db = read_db(db_path, strict=cfg.ingest_policy == "strict")
+    las = LasFile(las_path)
+    # pre-flight ingest scan (the pipeline rescans its own byte range — this
+    # header-only pass is cheap): the checkpointed path must know about
+    # corruption BEFORE it samples piles (index_las rightly rejects a
+    # corrupt file) and before it trusts the emitted-pile resume mapping
+    clean_piles = None
+    scan_rep = None
+    if cfg.ingest_policy != "off":
+        from ..formats.ingest import scan_with_db
+
+        rep = scan_rep = scan_with_db(db, las, start, end)
+        if rep.issues:
+            if cfg.ingest_policy == "strict":
+                raise rep.error()
+            if emitted:
+                # quarantine markers need not emit a read, so `emitted`
+                # no longer maps 1:1 onto pile offsets — resuming would
+                # re-emit (duplicate) or skip reads silently
+                raise SystemExit(
+                    f"{paths['progress']}: cannot resume mid-shard over a "
+                    "corrupt LAS under the quarantine policy (contained "
+                    "piles break the emitted-pile offset mapping) — rerun "
+                    "the shard with --force")
+            clean_piles = rep.pile_ranges
     if emitted:
         # pile-aligned offsets are only needed on resume (index_las is a full
         # file scan; a fresh run skips it)
@@ -145,8 +219,6 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
     else:
         resume_off = start
 
-    db = read_db(db_path)
-    las = LasFile(las_path)
     # the error profile is estimated ONCE (from the shard's own start) and
     # persisted, so a resumed run reproduces the uninterrupted run's output
     # byte-for-byte rather than re-estimating from the resume point
@@ -163,7 +235,8 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
                 "reproduce its tables — rerun the shard with --force")
         profile = ErrorProfile(*prog["profile"])
     else:
-        profile = estimate_profile_for_shard(db, las, cfg, start, end)
+        profile = estimate_profile_for_shard(db, las, cfg, start, end,
+                                             pile_ranges=clean_piles)
     prof_row = [float(profile.p_ins), float(profile.p_del), float(profile.p_sub)]
     counters = dict(base)
     # truncate any partial tail past the last checkpoint, then append
@@ -173,8 +246,12 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
         out.truncate(fasta_bytes)
         out.seek(fasta_bytes)
         since = 0
-        for rid, frags, st in correct_shard(db, las, cfg, resume_off, end,
-                                            profile=profile):
+        for rid, frags, st in correct_shard(
+                db, las, cfg, resume_off, end, profile=profile,
+                # reuse the pre-flight scan when it covered the same range
+                # (fresh runs) — the validating walk is the slowest part of
+                # ingesting a damaged multi-GB file, and would run twice
+                ingest_report=scan_rep if resume_off == start else None):
             last_st = st
             write_fasta(out, [FastaRecord(f"read{rid}/{fi}", ints_to_seq(f))
                               for fi, f in enumerate(frags)])
@@ -187,13 +264,27 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
                         "bases_out": base["bases_out"] + st.bases_out,
                         "wall_s": round(base["wall_s"] + (time.time() - t0), 3)}
             if since >= every:
+                # crash-durable commit ordering (ISSUE 2): (1) the FASTA
+                # bytes the manifest will reference reach the platter, (2)
+                # the manifest tmp's content does, (3) the rename publishes
+                # it. A checkpoint can then never point past durable FASTA
+                # bytes — a kill between any two fsync points resumes with
+                # no lost or duplicated reads (the stale manifest's prefix
+                # is durable by step 1; the partial tail truncates on resume)
                 out.flush()
-                tmp = paths["progress"] + ".tmp"
-                with open(tmp, "wt") as fh:
-                    json.dump({"emitted": emitted, "fasta_bytes": out.tell(),
-                               "counters": counters, "profile": prof_row,
-                               "byte_range": [start, end]}, fh)
-                os.replace(tmp, paths["progress"])
+                os.fsync(out.fileno())
+                _write_manifest_durable(
+                    paths["progress"],
+                    {"emitted": emitted, "fasta_bytes": out.tell(),
+                     "counters": counters, "profile": prof_row,
+                     "byte_range": [start, end]})
+                if cfg.events_path:
+                    # short-lived append (noise next to the two fsyncs):
+                    # no held fd to leak when an abort path unwinds
+                    _cl = JsonlLogger(cfg.events_path)
+                    _cl.log("ingest.commit", emitted=emitted,
+                            fasta_bytes=out.tell())
+                    _cl.close()
                 since = 0
     counters["wall_s"] = round(base["wall_s"] + (time.time() - t0), 3)
     if resumed is not None:
@@ -203,6 +294,8 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
         # exhausted (failover can happen in the last drain)
         counters["degraded"] = last_st.degraded
         counters["fallback_reason"] = last_st.fallback_reason
+        counters["quarantined"] = last_st.n_quarantined
+        counters["ingest_issues"] = last_st.n_ingest_issues
     return counters
 
 

@@ -26,6 +26,17 @@ hard process death (SIGKILL-ish) for checkpoint/resume composition tests.
 Counter domains: ``fetch_hang`` counts fetches, ``dispatch_error`` counts
 dispatches, ``device_lost``/``crash`` count device ops (dispatch + fetch,
 interleaved in pipeline order), ``compile_stall`` counts cold-shape ops.
+
+Data-corruption kinds (the ingest-layer twins, ISSUE 2) corrupt input
+artifacts instead of raising at ops — N indexes the corrupted record::
+
+    DACCORD_FAULT=las_bitflip:4           # flip abpos MSB of LAS record 4
+    DACCORD_FAULT=las_truncate:30         # cut the LAS mid-record 30
+    DACCORD_FAULT=db_garbage:2            # 0xFF over DB .idx read record 2
+
+They are applied once by the pipeline entry points via
+:func:`maybe_apply_data_faults` (or directly by tests / the pounce
+corruption-fuzz step via the ``corrupt_*`` helpers).
 """
 
 from __future__ import annotations
@@ -67,7 +78,14 @@ class InjectedCrash(BaseException):
 
 
 _KINDS = ("fetch_hang", "dispatch_error", "device_lost", "compile_stall",
-          "crash")
+          "crash", "las_bitflip", "las_truncate", "db_garbage")
+
+#: data-corruption kinds: they corrupt the INPUT ARTIFACTS (deterministically,
+#: keyed by record index N) instead of raising at a device op, exercising the
+#: ingest integrity layer (formats/ingest.py) the way the device kinds
+#: exercise the supervisor. Applied once per plan by apply_data_faults(),
+#: which the pipeline entry points call before opening the artifacts.
+DATA_KINDS = ("las_bitflip", "las_truncate", "db_garbage")
 
 
 @dataclass
@@ -173,3 +191,150 @@ class FaultPlan:
         """False once device_lost fired (probe must agree the chip is dead);
         None = no opinion, run the real probe."""
         return False if self.device_dead else None
+
+    def has_data_faults(self) -> bool:
+        return any(s.kind in DATA_KINDS and not s.fired for s in self.specs)
+
+    def apply_data_faults(self, las_path: str | None = None,
+                          db_path: str | None = None) -> list[dict]:
+        """Apply every unfired data-corruption spec to the given artifacts
+        (one-shot, like the device kinds). Returns one descriptor dict per
+        applied corruption, for ``ingest.fault`` event logging."""
+        fired: list[dict] = []
+        for s in self.specs:
+            if s.fired or s.kind not in DATA_KINDS:
+                continue
+            if s.kind == "las_bitflip" and las_path is not None:
+                fired.append(corrupt_las_bitflip(las_path, s.at))
+            elif s.kind == "las_truncate" and las_path is not None:
+                fired.append(corrupt_las_truncate(las_path, s.at))
+            elif s.kind == "db_garbage" and db_path is not None:
+                fired.append(corrupt_db_garbage(db_path, s.at))
+            else:
+                continue
+            s.fired = True
+        return fired
+
+
+def maybe_apply_data_faults(las_path: str | None = None,
+                            db_path: str | None = None,
+                            env=None) -> list[dict]:
+    """Entry-point hook: parse ``DACCORD_FAULT`` and apply any data-corruption
+    kinds to the run's input artifacts BEFORE they are opened. Device kinds in
+    the same spec are untouched (the supervisor reads its own plan). Each
+    entry invocation re-parses the env, so a resumed run must clear the var
+    (tests do) or the corruption re-applies."""
+    plan = FaultPlan.from_env(env)
+    if plan is None or not plan.has_data_faults():
+        return []
+    return plan.apply_data_faults(las_path=las_path, db_path=db_path)
+
+
+# ---------------------------------------------------------------------------
+# Deterministic artifact corruption (the data-plane twin of the device kinds;
+# also callable directly by tests and the tools_pounce.sh corruption-fuzz
+# smoke step). All helpers speak aio URLs (mem: fixtures corrupt too).
+# ---------------------------------------------------------------------------
+
+#: byte offset of each fixed-header field inside a 40-byte LAS record
+LAS_FIELD_OFF = {"tlen": 0, "diffs": 4, "abpos": 8, "bbpos": 12, "aepos": 16,
+                 "bepos": 20, "flags": 24, "aread": 28, "bread": 32}
+
+
+def _read_all(path: str) -> bytes:
+    from ..utils import aio
+
+    with aio.open_input(path, "rb") as fh:
+        return fh.read()
+
+
+def _write_all(path: str, data: bytes) -> None:
+    from ..utils import aio
+
+    with aio.open_output(path, "wb") as fh:
+        fh.write(data)
+
+
+def _las_record_offsets(data: bytes) -> list[int]:
+    """Byte offsets of every record in a CLEAN LAS image (corruption helpers
+    run on intact fixtures; a malformed tlen aborts the walk)."""
+    import struct as _struct
+
+    import numpy as np
+
+    from ..formats.las import _HDR_FMT, _HDR_SIZE, _REC_SIZE, _trace_dtype
+
+    _novl, tspace = _struct.unpack(_HDR_FMT, data[:_HDR_SIZE])
+    tsize = np.dtype(_trace_dtype(tspace)).itemsize
+    offs: list[int] = []
+    pos = _HDR_SIZE
+    while pos + _REC_SIZE <= len(data):
+        tlen = _struct.unpack_from("<i", data, pos)[0]
+        if tlen < 0:
+            break
+        offs.append(pos)
+        pos += _REC_SIZE + tlen * tsize
+    return offs
+
+
+def corrupt_las_bitflip(path: str, record: int, field: str = "abpos",
+                        bit: int = 31) -> dict:
+    """Flip one bit in record ``record`` (1-based, clamped). The default —
+    the MSB of ``abpos`` — leaves framing intact but blows the coordinate out
+    of read bounds; ``field='tlen'`` corrupts the framing field instead
+    (absurd trace length), ``field='bread'`` fabricates a read id."""
+    data = bytearray(_read_all(path))
+    offs = _las_record_offsets(bytes(data))
+    if not offs:
+        raise ValueError(f"{path}: no records to corrupt")
+    if record < 1:
+        raise ValueError(f"record index is 1-based, got {record}")
+    off = offs[min(record, len(offs)) - 1] + LAS_FIELD_OFF[field]
+    data[off + bit // 8] ^= 1 << (bit % 8)
+    _write_all(path, bytes(data))
+    from ..formats.las import invalidate_index
+
+    invalidate_index(path)  # writer-path sidecar rule: stale offsets must die
+    return {"kind": "las_bitflip", "path": path, "record": record,
+            "field": field, "bit": bit, "offset": off}
+
+
+def corrupt_las_truncate(path: str, record: int) -> dict:
+    """Cut the file mid-record ``record`` (1-based, clamped): everything from
+    that record's 18th header byte on is gone — the torn-write / torn-copy
+    failure mode."""
+    data = _read_all(path)
+    offs = _las_record_offsets(data)
+    if not offs:
+        raise ValueError(f"{path}: no records to truncate at")
+    if record < 1:
+        raise ValueError(f"record index is 1-based, got {record}")
+    cut = offs[min(record, len(offs)) - 1] + 17
+    _write_all(path, data[:cut])
+    from ..formats.las import invalidate_index
+
+    invalidate_index(path)  # writer-path sidecar rule: stale offsets must die
+    return {"kind": "las_truncate", "path": path, "record": record,
+            "offset": cut}
+
+
+def corrupt_db_garbage(db_path: str, record: int) -> dict:
+    """Overwrite read record ``record`` (1-based, clamped) of the DB's .idx
+    with 0xFF garbage — rlen/boff become absurd, exercising the validated DB
+    decode (``read_db`` strict raise vs ``bad_reads`` quarantine marking)."""
+    import os as _os
+
+    from ..formats.dazzdb import _HDR_SIZE, _READ_SIZE, _db_stems
+
+    d, stem = _db_stems(db_path)
+    idx = _os.path.join(d, f".{stem}.idx")
+    data = bytearray(_read_all(idx))
+    n = (len(data) - _HDR_SIZE) // _READ_SIZE
+    if n <= 0:
+        raise ValueError(f"{idx}: no read records to corrupt")
+    if record < 1:
+        raise ValueError(f"record index is 1-based, got {record}")
+    off = _HDR_SIZE + _READ_SIZE * (min(record, n) - 1)
+    data[off : off + _READ_SIZE] = b"\xff" * _READ_SIZE
+    _write_all(idx, bytes(data))
+    return {"kind": "db_garbage", "path": idx, "record": record, "offset": off}

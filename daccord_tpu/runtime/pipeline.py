@@ -159,6 +159,22 @@ class PipelineConfig:
     failback: bool = False       # background re-probe may route dispatches
                                  # back to a revived chip (opt-in: failback
                                  # re-compiles every bucket shape)
+    ingest_policy: str = "strict"    # validated LAS/DB decode policy
+                                 # (formats/ingest.py): 'strict' aborts the
+                                 # shard with a structured IngestError naming
+                                 # byte offset + pile on the first integrity
+                                 # violation; 'quarantine' contains each
+                                 # corrupt overlap/pile — the pile is skipped,
+                                 # its read emitted uncorrected, the event
+                                 # recorded (sidecar + n_quarantined) — and
+                                 # every unaffected pile corrects normally;
+                                 # 'off' skips the validation scan (trusted
+                                 # input, the pre-ISSUE-2 behavior)
+    quarantine_path: str | None = None   # jsonl sidecar recording each
+                                 # quarantined pile (kind, offset, detail;
+                                 # created lazily, only when something
+                                 # quarantines); launch.py and the CLI
+                                 # default it next to the output
     verbose: bool = False
 
 
@@ -180,6 +196,10 @@ class PipelineStats:
                                  # runs hp in-engine inside its solve call)
     n_end_trimmed: int = 0
     n_fragments: int = 0
+    n_quarantined: int = 0       # piles contained by the quarantine policy
+                                 # (their reads emitted uncorrected)
+    n_ingest_issues: int = 0     # integrity violations the validating scan
+                                 # found in this shard's byte range
     bases_in: int = 0
     bases_out: int = 0
     tier_histogram: dict = field(default_factory=dict)
@@ -344,6 +364,17 @@ def load_qv_ranker(db: DazzDB, las: LasFile, cfg: PipelineConfig) -> QvRanker | 
     return QvRanker(payloads, tspace, db)
 
 
+def _stride_take(n_items: int, n: int, offset: int = 0) -> np.ndarray:
+    """Indices of ``n`` items spread evenly across ``n_items`` (deduped,
+    offset-rotated) — the profile pass's one sampling rule, shared by the
+    sidecar-index stride and the ingest scan's clean-pile path."""
+    if n_items == 0 or n == 0:
+        return np.zeros(0, np.int64)
+    return np.unique((np.linspace(0, n_items - 1,
+                                  min(n, n_items)).astype(int)
+                      + offset) % n_items)
+
+
 def _strided_pile_ranges(las: LasFile, n: int, start: int | None,
                          end: int | None, offset: int = 0) -> list[tuple[int, int]]:
     """Byte ranges of ``n`` piles spread evenly across the shard (via the
@@ -360,9 +391,7 @@ def _strided_pile_ranges(las: LasFile, n: int, start: int | None,
     sel = np.nonzero((idx[:, 1] >= lo) & (idx[:, 1] < hi))[0]
     if len(sel) == 0:
         return [(lo, hi)]
-    take = np.unique((np.linspace(0, len(sel) - 1,
-                                  min(n, len(sel))).astype(int)
-                      + offset) % len(sel))
+    take = _stride_take(len(sel), n, offset)
     out = []
     for t in take:
         j = int(sel[t])
@@ -374,16 +403,28 @@ def _strided_pile_ranges(las: LasFile, n: int, start: int | None,
 
 def estimate_profile_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                start: int | None = None,
-                               end: int | None = None) -> ErrorProfile:
+                               end: int | None = None,
+                               pile_ranges: list | None = None) -> ErrorProfile:
     """Profile pass over ``cfg.profile_sample_piles`` piles strided across the
     shard (oracle path: the sample is tiny and this doubles as a continuous
-    cross-check of the native path)."""
+    cross-check of the native path).
+
+    ``pile_ranges`` overrides the sidecar-index stride with an explicit list
+    of (start, end) pile byte ranges — the quarantine path passes the
+    validating scan's CLEAN piles so estimation never decodes corrupt bytes
+    (index_las would reject the file outright)."""
     from ..oracle.consensus import estimate_profile_two_pass
 
+    if pile_ranges is not None:
+        take = _stride_take(len(pile_ranges), cfg.profile_sample_piles,
+                            cfg.profile_sample_offset)
+        ranges = [pile_ranges[int(t)] for t in take]
+    else:
+        ranges = _strided_pile_ranges(las, cfg.profile_sample_piles, start,
+                                      end, offset=cfg.profile_sample_offset)
     refined_all = []
     windows_all: list[WindowSegments] = []
-    for s, e in _strided_pile_ranges(las, cfg.profile_sample_piles, start, end,
-                                     offset=cfg.profile_sample_offset):
+    for s, e in ranges:
         for aread, pile in las.iter_piles(s, e):
             a_bases = db.read_bases(aread)
             refined = [refine_overlap(o, a_bases, db.read_bases(o.bread), las.tspace)
@@ -557,15 +598,52 @@ def _build_native_fallback(profile: ErrorProfile, cfg: PipelineConfig):
 def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                   start: int | None = None, end: int | None = None,
                   profile: ErrorProfile | None = None,
-                  solver=None):
+                  solver=None, ingest_report=None):
     """Correct every pile in the byte range; yields (aread, fragments, stats).
 
     ``solver`` maps a WindowBatch to a solve_tiered-style output dict; defaults
     to the local single-device ladder. The parallel backend passes the
-    mesh-sharded one.
+    mesh-sharded one. ``ingest_report`` supplies a pre-computed
+    :class:`~..formats.ingest.LasScanReport` covering exactly this byte range
+    (the checkpointed launcher pre-scans; rescanning a damaged multi-GB file
+    would double the slowest ingest step) — None runs the scan here.
     """
     stats = PipelineStats()
     t_start = time.time()
+    from ..utils.obs import JsonlLogger
+
+    log = JsonlLogger(cfg.log_path)
+    ev_log = JsonlLogger(cfg.events_path) if cfg.events_path else log
+
+    # ingest integrity gate (formats/ingest.py, ISSUE 2): validate every
+    # record header in the byte range BEFORE any fast decoder trusts it.
+    # strict -> abort with the structured report; quarantine -> the scan's
+    # segment plan below contains each corrupt pile without sinking the run
+    report = None
+    bad_reads = getattr(db, "bad_reads", None) or set()
+    if cfg.ingest_policy != "off":
+        if ingest_report is not None:
+            report = ingest_report
+        else:
+            from ..formats.ingest import scan_with_db
+
+            report = scan_with_db(db, las, start, end)
+        stats.n_ingest_issues = len(report.issues)
+        ev_log.log("ingest.scan", path=las.path, records=report.n_records,
+                   piles=report.n_piles, issues=len(report.issues),
+                   policy=cfg.ingest_policy)
+        for iss in report.issues[:64]:
+            ev_log.log("ingest.issue", kind=iss.kind, offset=iss.offset,
+                       aread=(-1 if iss.aread is None else int(iss.aread)),
+                       detail=iss.detail)
+        if report.issues and cfg.ingest_policy == "strict":
+            err = report.error()
+            # close what this function opened: a driver loop retrying
+            # corrupt shards must not leak two fds per abort
+            if ev_log is not log:
+                ev_log.close()
+            log.close()
+            raise err
     if cfg.batch_size is None:
         import dataclasses
 
@@ -580,7 +658,12 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             cfg = dataclasses.replace(
                 cfg, batch_size=2048 if jax.default_backend() == "tpu" else 512)
     if profile is None:
-        profile = estimate_profile_for_shard(db, las, cfg, start, end)
+        if report is not None and report.issues:
+            # sample only validated-clean piles: index_las rejects the file
+            profile = estimate_profile_for_shard(
+                db, las, cfg, start, end, pile_ranges=report.pile_ranges)
+        else:
+            profile = estimate_profile_for_shard(db, las, cfg, start, end)
     ladder = None
     if not (solver is None and cfg.native_solver):
         # the native C++ solver builds its own OffsetLikely tables from the
@@ -590,9 +673,6 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                         max_kmers=cfg.max_kmers,
                                         rescue_max_kmers=cfg.rescue_max_kmers,
                                         overflow_rescue=cfg.overflow_rescue)
-    from ..utils.obs import JsonlLogger
-
-    log = JsonlLogger(cfg.log_path)
     fetch_many_fn = None
     native_dispatch = solver is None and cfg.native_solver
     # both votes AND both acceptance objectives are implemented in the C++
@@ -664,7 +744,6 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     # failover to the degraded engine — the robustness layer between the
     # pipeline and whichever dispatch/fetch pair was resolved above
     sup = None
-    ev_log = JsonlLogger(cfg.events_path) if cfg.events_path else log
     if cfg.supervise:
         from .supervisor import DeviceSupervisor, SupervisorConfig
 
@@ -832,6 +911,21 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         rows = [x for x in pr.results if x is not None]
         ready[r] = stitch_results(pr.a_bases, rows, cfg.consensus)
         del pending[r]
+
+    def emit_ready():
+        # in-order drain of finished reads — the one emission/accounting
+        # path shared by the main loop and the quarantine-marker branch
+        nonlocal emit_idx
+        while emit_idx < len(order) and order[emit_idx] in ready:
+            r = order[emit_idx]
+            frags = ready.pop(r)
+            stats.n_fragments += len(frags)
+            stats.bases_out += sum(len(f) for f in frags)
+            # keep wall_s live so mid-stream consumers (progress reporters)
+            # see real bases_per_sec(), not 0 until exhaustion
+            stats.wall_s = time.time() - t_start
+            yield r, frags, stats
+            emit_idx += 1
 
     def hp_pass(out, hp_ctx, take) -> dict[int, np.ndarray]:
         """Homopolymer rescue over one fetched batch (oracle/hp.py).
@@ -1011,16 +1105,72 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     min_depth = cfg.consensus.dbg.min_depth
 
     t_host0 = time.time()
-    if native_ok and cfg.feeder_threads > 0:
-        blocks = _iter_pile_blocks_threaded(db, las, cfg, start, end,
-                                            cfg.feeder_threads, qvr)
+    if cfg.feeder_threads > 0 and not native_ok:
+        print("daccord-tpu: feeder_threads ignored (native host path "
+              "unavailable or disabled)", file=sys.stderr)
+        log.log("warn", msg="feeder_threads ignored: no native host path")
+
+    def _block_iter(s, e):
+        if native_ok and cfg.feeder_threads > 0:
+            return _iter_pile_blocks_threaded(db, las, cfg, s, e,
+                                              cfg.feeder_threads, qvr)
+        return _iter_pile_blocks(db, las, cfg, s, e, native_ok, qvr)
+
+    qfh = None
+
+    def _q_record(**rec):
+        # quarantine sidecar: one jsonl row per contained pile, created
+        # lazily so clean runs never leave an empty sidecar behind
+        nonlocal qfh
+        if cfg.quarantine_path is None:
+            return
+        import json as _json
+
+        if qfh is None:
+            qfh = open(cfg.quarantine_path, "at")
+        qfh.write(_json.dumps(rec) + "\n")
+        qfh.flush()
+
+    if report is not None and report.issues:
+        # quarantine plan: clean byte segments stream through the fast
+        # decoders exactly as before; each contained pile rides along as a
+        # marker in byte order so emission order is preserved. Known trade:
+        # the threaded feeder pool restarts per clean segment — scattered
+        # corruption costs feeder pipelining, but only on damaged inputs
+        # (clean runs take the single-segment path below)
+        def _segmented():
+            for seg in report.segments:
+                if seg[0] == "clean":
+                    yield from _block_iter(seg[1], seg[2])
+                else:
+                    yield seg
+
+        blocks = _segmented()
     else:
-        if cfg.feeder_threads > 0:
-            print("daccord-tpu: feeder_threads ignored (native host path "
-                  "unavailable or disabled)", file=sys.stderr)
-            log.log("warn", msg="feeder_threads ignored: no native host path")
-        blocks = _iter_pile_blocks(db, las, cfg, start, end, native_ok, qvr)
-    for aread, a_bases, seqs, lens, nsegs in blocks:
+        blocks = _block_iter(start, end)
+    for blk in blocks:
+        if blk[0] == "quarantine":
+            _, q_aread, q_off, q_kind, q_detail = blk
+            stats.n_quarantined += 1
+            ev_log.log("ingest.quarantine", kind=q_kind, offset=int(q_off),
+                       aread=(-1 if q_aread is None else int(q_aread)))
+            _q_record(path=las.path, aread=q_aread, offset=int(q_off),
+                      kind=q_kind, detail=q_detail)
+            if (q_aread is not None and 0 <= q_aread < len(db.reads)
+                    and q_aread not in bad_reads):
+                # bound by len(db.reads) (= ureads), matching the scan's
+                # read-id validation — on a trimmed DB len(db) is nreads,
+                # which would silently drop a quarantined tail read
+                # containment contract: the corrupt pile's read is emitted
+                # UNCORRECTED so downstream coverage accounting stays whole
+                a = db.read_bases(int(q_aread))
+                stats.n_reads += 1
+                stats.bases_in += len(a)
+                order.append(int(q_aread))
+                ready[int(q_aread)] = [a]
+            yield from emit_ready()
+            continue
+        aread, a_bases, seqs, lens, nsegs = blk
         stats.n_reads += 1
         stats.bases_in += len(a_bases)
         nwin = len(nsegs)
@@ -1081,16 +1231,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     if first_seen[bi] is None:
                         first_seen[bi] = stats.n_reads
         run_batches(final=False)
-        while emit_idx < len(order) and order[emit_idx] in ready:
-            r = order[emit_idx]
-            frags = ready.pop(r)
-            stats.n_fragments += len(frags)
-            stats.bases_out += sum(len(f) for f in frags)
-            # keep wall_s live so mid-stream consumers (progress reporters)
-            # see real bases_per_sec(), not 0 until exhaustion
-            stats.wall_s = time.time() - t_start
-            yield r, frags, stats
-            emit_idx += 1
+        yield from emit_ready()
 
     run_batches(final=True)
     while emit_idx < len(order):
@@ -1113,12 +1254,16 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             topm_overflow=stats.n_topm_overflow,
             hp_rescued=stats.n_hp_rescued,
             qv_ranked=stats.qv_ranked, bases_out=stats.bases_out,
+            quarantined=stats.n_quarantined,
+            ingest_issues=stats.n_ingest_issues,
             pad_waste=round(stats.pad_waste, 4), wall_s=round(stats.wall_s, 3),
             tiers=stats.tier_histogram, native=stats.native_host,
             # north-star counters (BASELINE.json metric; SURVEY.md §5 metrics)
             bases_per_sec=round(stats.bases_per_sec(), 1),
             degraded=stats.degraded,
             windows_per_sec=round(stats.n_windows / stats.wall_s, 1) if stats.wall_s else 0.0)
+    if qfh is not None:
+        qfh.close()
     if ev_log is not log:
         ev_log.close()
     log.close()
@@ -1133,7 +1278,37 @@ def correct_to_fasta(db_path: str, las_path: str, out_path, cfg: PipelineConfig 
     ``profile`` skips the estimation pass (reference: cached error profile);
     ``solver`` overrides the window solver (e.g. the mesh-sharded ladder)."""
     cfg = cfg or PipelineConfig()
-    db = read_db(db_path)
+    from .faults import maybe_apply_data_faults
+
+    # data-corruption fault injection lands BEFORE the artifacts are opened
+    # (DACCORD_FAULT=las_bitflip:N|las_truncate:N|db_garbage:N)
+    fired = maybe_apply_data_faults(las_path=las_path, db_path=db_path)
+    if fired and cfg.events_path:
+        from ..utils.obs import JsonlLogger
+
+        _fl = JsonlLogger(cfg.events_path)
+        for f in fired:
+            _fl.log("ingest.fault", kind=f["kind"], path=f["path"],
+                    record=f["record"], offset=f.get("offset", -1))
+        _fl.close()
+    if (cfg.ingest_policy == "quarantine" and cfg.quarantine_path is None
+            and isinstance(out_path, str) and out_path != "-"
+            and not out_path.startswith("mem:")):
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg,
+                                  quarantine_path=out_path + ".quarantine.jsonl")
+    if (cfg.ingest_policy == "quarantine" and cfg.quarantine_path
+            and os.path.exists(cfg.quarantine_path)):
+        # a whole-range quarantine run always starts a fresh sidecar; stale
+        # rows would double-count against n_quarantined (mid-shard RESUMES
+        # go through launch.py, which appends deliberately). Other policies
+        # never write the sidecar, so a prior run's record is left alone
+        os.remove(cfg.quarantine_path)
+    # only the strict policy aborts on DB validation failures: quarantine
+    # contains them via bad_reads, and 'off' trusts the input (no raise —
+    # the pre-ISSUE-2 behavior an operator opts back into)
+    db = read_db(db_path, strict=cfg.ingest_policy == "strict")
     las = LasFile(las_path)
     t0 = time.time()
     stats: PipelineStats | None = None

@@ -112,6 +112,18 @@ def daccord_main(argv=None) -> int:
     p.add_argument("--no-supervise", action="store_true",
                    help="disable the device supervisor (watchdog deadlines, "
                         "retry, mid-run failover to the degraded engine)")
+    p.add_argument("--ingest-policy", choices=("strict", "quarantine", "off"),
+                   default="strict",
+                   help="validated LAS/DB decode policy (formats/ingest.py): "
+                        "strict aborts with a structured report naming the "
+                        "corrupt byte offset; quarantine contains each "
+                        "corrupt overlap/pile (skipped, its read emitted "
+                        "uncorrected, recorded in the quarantine sidecar + "
+                        "ingest.* events); off trusts the input (pre-ISSUE-2 "
+                        "behavior)")
+    p.add_argument("--quarantine", default=None, metavar="PATH",
+                   help="quarantine sidecar jsonl (default: <out>."
+                        "quarantine.jsonl next to a file output)")
     p.add_argument("--failover-backend", choices=("auto", "native", "cpu"),
                    default="auto",
                    help="degraded-mode engine on declared device loss "
@@ -217,17 +229,36 @@ def daccord_main(argv=None) -> int:
 
     enable_compilation_cache()
 
-    if args.block is not None:
-        from ..formats.dazzdb import db_blocks
-        from ..formats.las import range_for_areads
+    from ..formats.ingest import IngestError
 
-        blocks = db_blocks(args.db)
-        if not (1 <= args.block <= len(blocks)):
-            raise SystemExit(f"--block {args.block}: DB has {len(blocks)} blocks")
-        lo, hi = blocks[args.block - 1]
-        start, end = range_for_areads(args.las, lo, hi)
-    else:
-        start, end = _resolve_range(args, args.las)
+    def _ingest_exit(ex: IngestError):
+        # integrity failure: exit with the structured report (kind + byte
+        # offset + pile per issue), not a traceback. The hint must match
+        # the situation: under quarantine a surviving failure comes from a
+        # path that NEEDS the aread index (-J/--block sharding), which a
+        # corrupt file cannot provide — suggesting the already-set flag
+        # would be a loop
+        hint = ("(rerun with --ingest-policy quarantine to contain the "
+                "corrupt piles instead)" if args.ingest_policy == "strict"
+                else "(byte-range sharding needs the aread index, which "
+                     "cannot be built over a corrupt LAS — repair the file "
+                     "or run unsharded)")
+        raise SystemExit(f"daccord: {ex}\n{hint}")
+
+    try:
+        if args.block is not None:
+            from ..formats.dazzdb import db_blocks
+            from ..formats.las import range_for_areads
+
+            blocks = db_blocks(args.db)
+            if not (1 <= args.block <= len(blocks)):
+                raise SystemExit(f"--block {args.block}: DB has {len(blocks)} blocks")
+            lo, hi = blocks[args.block - 1]
+            start, end = range_for_areads(args.las, lo, hi)
+        else:
+            start, end = _resolve_range(args, args.las)
+    except IngestError as ex:
+        _ingest_exit(ex)
     tiers = ((k, 2, 2), (k + 2, 2, 2), (k + 4, 2, 2), (k, 1, 1))
     from ..oracle.dbg import DBGParams
 
@@ -269,55 +300,76 @@ def daccord_main(argv=None) -> int:
                              else PipelineConfig().profile_sample_piles),
                          overflow_rescue=args.overflow_rescue,
                          native_solver=args.backend == "native",
-                         native_threads=args.native_threads)
+                         native_threads=args.native_threads,
+                         ingest_policy=args.ingest_policy,
+                         quarantine_path=args.quarantine)
 
     import os
 
     from ..oracle.profile import ErrorProfile
 
-    prof = None
-    if args.eprof and os.path.exists(args.eprof) and not args.eprof_only:
-        prof = ErrorProfile.load(args.eprof)
-    elif args.eprof or args.eprof_only:
-        if not args.eprof:
-            raise SystemExit("--eprof-only requires -E/--eprof PATH")
+    def _estimate_validated():
+        # -E/--mesh pre-estimation under the same ingest policy as the run:
+        # without the scan, a coords-corrupt record sails through index_las
+        # (framing intact) and dies as a raw assertion inside refine_overlap.
+        # Strict -> structured IngestError; quarantine -> sample clean piles
         from ..runtime.pipeline import estimate_profile_for_shard
 
-        # opens db/las a second time (correct_to_fasta reopens from paths);
-        # that is one extra index parse — noise next to the estimation pass
-        prof = estimate_profile_for_shard(read_db(args.db), LasFile(args.las),
-                                          cfg, start, end)
-        prof.save(args.eprof)
-        if args.eprof_only:
-            print(json.dumps({"eprof": args.eprof, "p_ins": prof.p_ins,
-                              "p_del": prof.p_del, "p_sub": prof.p_sub}),
-                  file=sys.stderr)
-            return 0
+        db_ = read_db(args.db, strict=args.ingest_policy == "strict")
+        las_ = LasFile(args.las)
+        clean = None
+        if args.ingest_policy != "off":
+            from ..formats.ingest import scan_with_db
 
-    solver = None
-    if args.mesh > 1:
-        from ..parallel.mesh import build_sharded_solver
-        from ..runtime.pipeline import estimate_profile_for_shard
+            rep = scan_with_db(db_, las_, start, end)
+            if rep.issues:
+                if args.ingest_policy == "strict":
+                    raise rep.error()
+                clean = rep.pile_ranges
+        return estimate_profile_for_shard(db_, las_, cfg, start, end,
+                                          pile_ranges=clean)
 
-        if prof is None:
-            prof = estimate_profile_for_shard(read_db(args.db),
-                                              LasFile(args.las), cfg,
-                                              start, end)
-        solver = build_sharded_solver(args.mesh, prof, cfg.consensus,
-                                      use_pallas=args.pallas,
-                                      max_kmers=cfg.max_kmers,
-                                      rescue_max_kmers=cfg.rescue_max_kmers,
-                                      overflow_rescue=cfg.overflow_rescue)
+    # everything that touches the artifacts — the -E/--mesh pre-estimation
+    # passes included — runs under the IngestError handler so an integrity
+    # failure always exits with the structured report, never a traceback
+    try:
+        prof = None
+        if args.eprof and os.path.exists(args.eprof) and not args.eprof_only:
+            prof = ErrorProfile.load(args.eprof)
+        elif args.eprof or args.eprof_only:
+            if not args.eprof:
+                raise SystemExit("--eprof-only requires -E/--eprof PATH")
+            prof = _estimate_validated()
+            prof.save(args.eprof)
+            if args.eprof_only:
+                print(json.dumps({"eprof": args.eprof, "p_ins": prof.p_ins,
+                                  "p_del": prof.p_del, "p_sub": prof.p_sub}),
+                      file=sys.stderr)
+                return 0
 
-    if args.profile:
-        import jax
+        solver = None
+        if args.mesh > 1:
+            from ..parallel.mesh import build_sharded_solver
 
-        with jax.profiler.trace(args.profile):
+            if prof is None:
+                prof = _estimate_validated()
+            solver = build_sharded_solver(args.mesh, prof, cfg.consensus,
+                                          use_pallas=args.pallas,
+                                          max_kmers=cfg.max_kmers,
+                                          rescue_max_kmers=cfg.rescue_max_kmers,
+                                          overflow_rescue=cfg.overflow_rescue)
+
+        if args.profile:
+            import jax
+
+            with jax.profiler.trace(args.profile):
+                stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
+                                         end=end, profile=prof, solver=solver)
+        else:
             stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
                                      end=end, profile=prof, solver=solver)
-    else:
-        stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
-                                 end=end, profile=prof, solver=solver)
+    except IngestError as ex:
+        _ingest_exit(ex)
     line = {
         "reads": stats.n_reads, "windows": stats.n_windows, "solved": stats.n_solved,
         "skipped_shallow": stats.n_skipped_shallow, "qv_ranked": stats.qv_ranked,
@@ -330,6 +382,8 @@ def daccord_main(argv=None) -> int:
         "pad_waste": round(stats.pad_waste, 4),
         "native_host": stats.native_host,
         "degraded": stats.degraded,
+        "quarantined": stats.n_quarantined,
+        "ingest_issues": stats.n_ingest_issues,
     }
     if stats.degraded:
         line["fallback_reason"] = stats.fallback_reason
@@ -694,7 +748,12 @@ def lascheck_main(argv=None) -> int:
         from ..formats.dazzdb import read_lengths
 
         rlens = read_lengths(args.db)
-    las = LasFile(args.las)
+    try:
+        las = LasFile(args.las)
+    except ValueError as ex:  # IngestError: torn/corrupt header
+        print(f"{args.las}: {ex}", file=sys.stderr)
+        print(f"{args.las}: 0 records BAD", file=sys.stderr)
+        return 1
     errs: list[str] = []
 
     def report(msg: str):
@@ -727,6 +786,12 @@ def lascheck_main(argv=None) -> int:
         report(f"record {n}: file truncated or corrupt mid-record ({ex})")
     if n != las.novl:
         report(f"header novl {las.novl} != {n} records")
+    from ..formats.ingest import sidecar_issues
+
+    for iss in sidecar_issues(args.las):
+        # a torn .idx sidecar silently costs every array job a full rescan;
+        # surface it here (the loader itself rebuilds rather than erroring)
+        report(iss.describe())
     for e in errs:
         print(e, file=sys.stderr)
     print(f"{args.las}: {n} records {'OK' if not errs else 'BAD'}", file=sys.stderr)
@@ -781,6 +846,11 @@ def shard_main(argv=None) -> int:
                    default="auto")
     p.add_argument("--events", default=None, metavar="PATH",
                    help="supervisor events jsonl (see daccord --events)")
+    p.add_argument("--ingest-policy", choices=("strict", "quarantine", "off"),
+                   default="strict",
+                   help="validated LAS/DB decode policy (see daccord "
+                        "--ingest-policy); the quarantine sidecar lands at "
+                        "shardNNNN.quarantine.jsonl in OUTDIR")
     args = p.parse_args(argv)
     if args.backend == "auto":
         from ..utils.obs import resolve_auto_backend
@@ -800,11 +870,22 @@ def shard_main(argv=None) -> int:
 
     scfg = PipelineConfig(batch_size=args.batch,
                           native_solver=args.backend == "native",
-                          events_path=args.events)
+                          events_path=args.events,
+                          ingest_policy=args.ingest_policy)
     if args.profile_sample is not None:
         scfg.profile_sample_piles = args.profile_sample
-    m = run_shard(args.db, args.las, args.outdir, i, n, scfg,
-                  force=args.force, checkpoint_every=args.checkpoint_every)
+    from ..formats.ingest import IngestError
+
+    try:
+        m = run_shard(args.db, args.las, args.outdir, i, n, scfg,
+                      force=args.force, checkpoint_every=args.checkpoint_every)
+    except IngestError as ex:
+        hint = ("(rerun with --ingest-policy quarantine to contain the "
+                "corrupt piles instead)" if args.ingest_policy == "strict"
+                else "(multi-shard splitting needs the aread index, which "
+                     "cannot be built over a corrupt LAS — repair the file "
+                     "or run single-shard: -J 0,1)")
+        raise SystemExit(f"daccord-shard: {ex}\n{hint}")
     print(json.dumps(m), file=sys.stderr)
     return 0
 

@@ -102,6 +102,58 @@ def getsize(url: str) -> int:
     return os.path.getsize(local_path(url))
 
 
+def fsync_dir(path: str) -> None:
+    """Best-effort fsync of the directory holding ``path`` — makes a rename
+    itself durable, not just the renamed bytes. Filesystems that cannot
+    fsync a directory fd are silently tolerated."""
+    d = os.path.dirname(os.path.abspath(local_path(path))) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def durable_replace(tmp: str, dst: str) -> None:
+    """``os.replace`` + directory fsync: the crash-durable commit primitive.
+
+    The caller must have fsynced ``tmp``'s CONTENT already; this makes the
+    rename that publishes it survive power loss too. The ordering contract
+    of the ingest layer (ISSUE 2): data bytes fsync first, then the pointer
+    that references them commits through here — a checkpoint manifest must
+    never point past the durable bytes."""
+    os.replace(local_path(tmp), local_path(dst))
+    fsync_dir(dst)
+
+
+def durable_write(dst: str, write_fn, mode: str = "wb"):
+    """The one crash-durable file-commit sequence: write to a pid-suffixed
+    tmp via ``write_fn(fh)``, fsync its content, publish with
+    :func:`durable_replace` (rename + dir fsync). The tmp is removed on any
+    failure so aborted commits never strand ``.tmp`` litter. Returns
+    ``write_fn``'s return value."""
+    real = local_path(dst)
+    tmp = f"{real}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, mode) as fh:
+            out = write_fn(fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    durable_replace(tmp, real)
+    return out
+
+
 def remove(url: str) -> None:
     """Delete a URL; raises FileNotFoundError when absent (both schemes —
     callers' double-delete handling must not depend on the backend)."""

@@ -1,0 +1,676 @@
+"""Ingest integrity layer: validated decode, quarantine containment, durable
+commit (ISSUE 2).
+
+Fast tier: the scanner/taxonomy units run on tiny synthetic LAS/DB fixtures,
+and the end-to-end corruption matrix drives the real pipeline with the native
+C++ solver (no XLA ladder compiles), asserting the acceptance criteria —
+quarantine-mode completion with byte-identical FASTA for every unaffected
+read, strict-mode structured failure naming the byte offset, and
+kill-between-fsync-points checkpoint resume with no lost or duplicated reads.
+"""
+
+import dataclasses
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from daccord_tpu.formats.dazzdb import read_db
+from daccord_tpu.formats.ingest import (IngestError, IngestIssue,
+                                        scan_las_range, sidecar_issues)
+from daccord_tpu.formats.las import LasFile, index_las, write_las
+from daccord_tpu.runtime import faults
+from daccord_tpu.tools.eventcheck import validate_events
+
+
+# ------------------------------------------------------------------ fixtures
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path_factory.mktemp("ingest"))
+    out = make_dataset(d, SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=500, min_overlap=200,
+                                    seed=7), name="t")
+    return out, d
+
+
+@pytest.fixture(scope="module")
+def rlens(dataset):
+    out, _ = dataset
+    db = read_db(out["db"])
+    return np.fromiter((r.rlen for r in db.reads), np.int64, db.nreads)
+
+
+def _copy_las(dataset, tmp_path, name):
+    out, _ = dataset
+    p = str(tmp_path / name)
+    shutil.copy(out["las"], p)
+    return p
+
+
+# ------------------------------------------------------- scanner / taxonomy
+
+def test_scan_clean_file(dataset, rlens):
+    out, _ = dataset
+    las = LasFile(out["las"])
+    rep = scan_las_range(las, rlens=rlens)
+    assert rep.ok
+    assert rep.n_records == las.novl
+    assert rep.segments == [("clean", 16, os.path.getsize(out["las"]))]
+    assert rep.n_piles == len(rep.pile_ranges) > 0
+
+
+def test_scan_bad_coords_quarantines_one_pile(dataset, rlens, tmp_path):
+    p = _copy_las(dataset, tmp_path, "bf.las")
+    info = faults.corrupt_las_bitflip(p, 5)          # abpos MSB
+    rep = scan_las_range(LasFile(p), rlens=rlens)
+    assert [i.kind for i in rep.issues] == ["bad_coords"]
+    assert rep.issues[0].offset == info["offset"] - faults.LAS_FIELD_OFF["abpos"]
+    quar = [s for s in rep.segments if s[0] == "quarantine"]
+    assert len(quar) == 1 and quar[0][1] == rep.issues[0].aread
+    # every other pile stays clean
+    ref = scan_las_range(LasFile(dataset[0]["las"]), rlens=rlens)
+    assert rep.n_piles == ref.n_piles - 1
+
+
+def test_scan_absurd_tlen_resyncs_to_next_pile(dataset, rlens, tmp_path):
+    p = _copy_las(dataset, tmp_path, "tl.las")
+    faults.corrupt_las_bitflip(p, 5, field="tlen", bit=30)
+    ref = scan_las_range(LasFile(_copy_las(dataset, tmp_path, "clean.las")),
+                         rlens=rlens)
+    rep = scan_las_range(LasFile(p), rlens=rlens)
+    assert rep.issues and rep.issues[0].kind in ("bad_tlen", "truncation")
+    quar = [s for s in rep.segments if s[0] == "quarantine"]
+    # framing loss contains exactly the corrupt pile; resync recovers the rest
+    assert len(quar) == 1
+    assert rep.n_piles == ref.n_piles - 1
+
+
+def test_scan_negative_tlen_and_bread_oob(dataset, rlens, tmp_path):
+    p = _copy_las(dataset, tmp_path, "neg.las")
+    faults.corrupt_las_bitflip(p, 3, field="tlen", bit=31)   # sign bit
+    rep = scan_las_range(LasFile(p), rlens=rlens)
+    assert any(i.kind == "bad_tlen" and "negative" in i.detail
+               for i in rep.issues)
+
+    p2 = _copy_las(dataset, tmp_path, "br.las")
+    faults.corrupt_las_bitflip(p2, 3, field="bread", bit=30)
+    rep2 = scan_las_range(LasFile(p2), rlens=rlens)
+    assert any(i.kind == "bad_read_id" and "bread" in i.detail
+               for i in rep2.issues)
+
+
+def test_scan_pile_boundary_corruption_blames_right_pile(dataset, rlens,
+                                                         tmp_path):
+    """A framing-intact corrupt record that OPENS a pile (trustworthy aread)
+    must quarantine ITS pile — the preceding clean pile stays clean and the
+    corrupt pile never half-corrects from a partial overlap set."""
+    out, _ = dataset
+    p = _copy_las(dataset, tmp_path, "pb.las")
+    idx = index_las(out["las"], use_sidecar=False)
+    data = open(out["las"], "rb").read()
+    offs = faults._las_record_offsets(data)
+    # first record of the SECOND pile (1-based record index)
+    rec = offs.index(int(idx[1, 1])) + 1
+    faults.corrupt_las_bitflip(p, rec)            # abpos MSB, framing intact
+    ref = scan_las_range(LasFile(out["las"]), rlens=rlens)
+    rep = scan_las_range(LasFile(p), rlens=rlens)
+    quar = [s for s in rep.segments if s[0] == "quarantine"]
+    assert [q[1] for q in quar] == [int(idx[1, 0])]   # pile 1, not pile 0
+    assert rep.issues[0].aread == int(idx[1, 0])
+    assert rep.n_piles == ref.n_piles - 1
+    # pile 0 is still part of a clean segment
+    assert any(s[0] == "clean" and s[1] <= int(idx[0, 1]) < s[2]
+               for s in rep.segments)
+
+
+def test_scan_boundary_aread_corruption_taints_both(dataset, rlens, tmp_path):
+    """When the corrupt field IS the aread (membership ambiguous), both
+    candidate piles are contained — over-quarantine beats silently
+    correcting a possibly-incomplete pile."""
+    out, _ = dataset
+    p = _copy_las(dataset, tmp_path, "ta.las")
+    idx = index_las(out["las"], use_sidecar=False)
+    data = open(out["las"], "rb").read()
+    offs = faults._las_record_offsets(data)
+    rec = offs.index(int(idx[1, 1])) + 1
+    faults.corrupt_las_bitflip(p, rec, field="aread", bit=30)
+    ref = scan_las_range(LasFile(out["las"]), rlens=rlens)
+    rep = scan_las_range(LasFile(p), rlens=rlens)
+    quar = {q[1] for q in rep.segments if q[0] == "quarantine"}
+    assert int(idx[0, 0]) in quar and int(idx[1, 0]) in quar
+    assert rep.n_piles == ref.n_piles - 2
+
+
+def test_scan_doubly_corrupt_record_terminates(dataset, rlens, tmp_path):
+    """A record with BOTH a corrupt read id and a negative tlen must route
+    through resync, never advance the walk by the garbage trace length."""
+    p = _copy_las(dataset, tmp_path, "dbl.las")
+    faults.corrupt_las_bitflip(p, 5, field="bread", bit=30)   # id first...
+    faults.corrupt_las_bitflip(p, 5, field="tlen", bit=31)    # ...tlen too
+    rep = scan_las_range(LasFile(p), rlens=rlens)
+    assert rep.issues                       # detected, and the scan returned
+    assert any(s[0] == "quarantine" for s in rep.segments)
+    assert rep.n_piles > 0                  # resync recovered later piles
+
+
+def test_scan_framing_loss_on_opening_record(dataset, rlens, tmp_path):
+    """Framing loss on the very first record of the range: the record's
+    (trusted) aread keys the quarantined pile, and resync must skip to the
+    NEXT pile — never rejoin pile 0 mid-pile and correct it from partial
+    evidence."""
+    out, _ = dataset
+    p = _copy_las(dataset, tmp_path, "open.las")
+    idx = index_las(out["las"], use_sidecar=False)
+    faults.corrupt_las_bitflip(p, 1, field="tlen", bit=30)
+    rep = scan_las_range(LasFile(p), rlens=rlens)
+    quar = [s for s in rep.segments if s[0] == "quarantine"]
+    assert len(quar) == 1 and quar[0][1] == int(idx[0, 0])
+    # no clean range may start inside pile 0's bytes
+    pile1_off = int(idx[1, 1])
+    assert all(s[1] >= pile1_off for s in rep.segments if s[0] == "clean")
+    assert rep.pile_ranges and rep.pile_ranges[0][0] >= pile1_off
+
+
+def test_scan_truncation_mid_file(dataset, rlens, tmp_path):
+    p = _copy_las(dataset, tmp_path, "tr.las")
+    las0 = LasFile(p)
+    faults.corrupt_las_truncate(p, las0.novl - 3)
+    rep = scan_las_range(LasFile(p), rlens=rlens)
+    assert any(i.kind == "truncation" for i in rep.issues)
+    assert rep.segments[-1][0] == "quarantine"
+
+
+def test_scan_header_count_mismatch(dataset, rlens, tmp_path):
+    # cut exactly at a record boundary: only the novl cross-check can see it
+    p = _copy_las(dataset, tmp_path, "cut.las")
+    data = open(p, "rb").read()
+    offs = faults._las_record_offsets(data)
+    open(p, "wb").write(data[: offs[-1]])
+    rep = scan_las_range(LasFile(p), rlens=rlens)
+    assert [i.kind for i in rep.issues] == ["truncation"]
+    assert "promises" in rep.issues[0].detail
+
+
+def test_ingest_error_report_names_offsets():
+    err = IngestError([IngestIssue("bad_tlen", "x.las", 1234, "tlen=-7",
+                                   aread=9)])
+    s = str(err)
+    assert "offset=1234" in s and "bad_tlen" in s and "aread=9" in s
+    assert isinstance(err, ValueError)   # las-check's except clause contract
+    assert err.kind == "bad_tlen" and err.offset == 1234
+
+
+# -------------------------------------------------------------- las hardening
+
+def test_lasfile_rejects_torn_header(tmp_path):
+    p = str(tmp_path / "torn.las")
+    open(p, "wb").write(b"\x01\x02\x03")
+    with pytest.raises(IngestError) as ei:
+        LasFile(p)
+    assert ei.value.kind == "truncation"
+
+
+def test_index_las_rejects_corrupt_tlen(dataset, tmp_path):
+    """Satellite: a corrupt tlen must raise, never seek garbage and silently
+    emit a wrong (short) index."""
+    p = _copy_las(dataset, tmp_path, "idx.las")
+    good = index_las(p, use_sidecar=False)
+    faults.corrupt_las_bitflip(p, 5, field="tlen", bit=30)
+    with pytest.raises(IngestError) as ei:
+        index_las(p, use_sidecar=False)
+    assert ei.value.kind == "bad_tlen"
+    assert len(good) > 0
+
+
+def test_iter_range_structured_errors(dataset, tmp_path):
+    p = _copy_las(dataset, tmp_path, "it.las")
+    faults.corrupt_las_bitflip(p, 5, field="tlen", bit=31)   # negative tlen
+    with pytest.raises(IngestError) as ei:
+        list(LasFile(p))
+    assert ei.value.kind == "bad_tlen" and ei.value.offset > 0
+
+
+def test_write_las_atomic_on_failure(dataset, tmp_path):
+    """Satellite: a crash mid-write must never leave a valid-looking LAS
+    (novl=0) at the target path; pre-existing content survives."""
+    out, _ = dataset
+    tspace, ovls = LasFile(out["las"]).tspace, list(LasFile(out["las"]))
+    p = str(tmp_path / "w.las")
+    write_las(p, tspace, ovls[:4])
+    before = open(p, "rb").read()
+
+    def exploding():
+        yield ovls[0]
+        raise RuntimeError("torn write")
+
+    with pytest.raises(RuntimeError, match="torn write"):
+        write_las(p, tspace, exploding())
+    assert open(p, "rb").read() == before            # target untouched
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]  # tmp cleaned
+
+    # fresh-path crash leaves NO file at all (downstream sees absent, not empty)
+    p2 = str(tmp_path / "fresh.las")
+    with pytest.raises(RuntimeError):
+        write_las(p2, tspace, exploding())
+    assert not os.path.exists(p2)
+
+
+def test_torn_sidecar_rebuilds_and_is_reported(dataset, tmp_path):
+    p = _copy_las(dataset, tmp_path, "sc.las")
+    good = index_las(p)                              # builds sidecar
+    sc = p + ".idx"
+    open(sc, "wb").write(b"JUNKxxxxxxxx")
+    os.utime(sc)                                     # keep it "fresh"
+    issues = sidecar_issues(p)                       # las-check can see it
+    assert issues and issues[0].kind == "bad_magic"
+    again = index_las(p)                             # silent rebuild
+    np.testing.assert_array_equal(good, again)
+    assert sidecar_issues(p) == []                   # rebuilt sidecar healthy
+
+
+# ---------------------------------------------------------------- dazzdb side
+
+def test_read_db_validation(dataset, tmp_path):
+    out, d = dataset
+    dd = str(tmp_path / "dbv")
+    shutil.copytree(d, dd)
+    db_path = os.path.join(dd, "t.db")
+    faults.corrupt_db_garbage(db_path, 3)
+    with pytest.raises(IngestError) as ei:
+        read_db(db_path)
+    assert ei.value.kind == "db_read" and ei.value.offset >= 112
+    db = read_db(db_path, strict=False)
+    assert db.bad_reads == {2}
+    # torn .idx header
+    idx = os.path.join(dd, ".t.idx")
+    open(idx, "wb").write(b"\x00" * 30)
+    with pytest.raises(IngestError) as ei:
+        read_db(db_path)
+    assert ei.value.kind == "truncation"
+
+
+# --------------------------------------------------- fault grammar extension
+
+def test_data_fault_grammar():
+    plan = faults.FaultPlan.parse("las_bitflip:4,db_garbage:2,fetch_hang:1")
+    assert plan.has_data_faults()
+    # data kinds never fire at device ops
+    plan.op("dispatch")
+    with pytest.raises(faults.FaultHang):
+        plan.op("fetch")
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("las_bitflop:1")
+
+
+def test_apply_data_faults_one_shot(dataset, tmp_path):
+    p = _copy_las(dataset, tmp_path, "af.las")
+    before = open(p, "rb").read()
+    plan = faults.FaultPlan.parse("las_bitflip:4")
+    fired = plan.apply_data_faults(las_path=p)
+    assert [f["kind"] for f in fired] == ["las_bitflip"]
+    assert open(p, "rb").read() != before
+    assert plan.apply_data_faults(las_path=p) == []   # one-shot
+    assert not plan.has_data_faults()
+
+
+# ------------------------------------------------- e2e corruption matrix
+
+@pytest.fixture(scope="module")
+def native_ready():
+    native = pytest.importorskip("daccord_tpu.native")
+    if not native.available():
+        pytest.skip("native library unavailable")
+    return True
+
+
+@pytest.fixture(scope="module")
+def e2e(dataset, native_ready, tmp_path_factory):
+    """Reference run + shared profile (explicit, so corrupt-run profile
+    sampling cannot shift the comparison baseline)."""
+    from daccord_tpu.runtime import PipelineConfig, correct_to_fasta
+    from daccord_tpu.runtime.pipeline import estimate_profile_for_shard
+
+    out, _ = dataset
+    d = str(tmp_path_factory.mktemp("ingest_e2e"))
+    db = read_db(out["db"])
+    cfg = PipelineConfig(batch_size=64, native_solver=True)
+    prof = estimate_profile_for_shard(db, LasFile(out["las"]), cfg)
+    ref = os.path.join(d, "ref.fasta")
+    s0 = correct_to_fasta(out["db"], out["las"], ref, cfg, profile=prof)
+    assert s0.n_quarantined == 0 and s0.n_ingest_issues == 0
+    return {"cfg": cfg, "prof": prof, "ref": ref, "d": d, "db": db}
+
+
+def _read_fasta_map(path):
+    from daccord_tpu.formats.fasta import read_fasta
+
+    return {r.name: r.seq for r in read_fasta(path)}
+
+
+def _pile_areads(las_path):
+    return [int(a) for a, _ in index_las(las_path, use_sidecar=False)]
+
+
+def _quarantine_run(e2e, las_path, name, db_path=None, dataset=None):
+    from daccord_tpu.runtime import correct_to_fasta
+
+    cfg = dataclasses.replace(e2e["cfg"], ingest_policy="quarantine",
+                              events_path=os.path.join(e2e["d"],
+                                                       f"{name}.ev.jsonl"))
+    fasta = os.path.join(e2e["d"], f"{name}.fasta")
+    stats = correct_to_fasta(db_path or dataset, las_path, fasta, cfg,
+                             profile=e2e["prof"])
+    assert validate_events(cfg.events_path) == []
+    return fasta, stats, cfg
+
+
+def _assert_contained(e2e, fasta, affected_areads, lost_areads=()):
+    """Unaffected reads byte-identical to the reference; affected reads
+    emitted uncorrected (raw bases); lost reads absent."""
+    from daccord_tpu.utils.bases import ints_to_seq
+
+    ref = _read_fasta_map(e2e["ref"])
+    got = _read_fasta_map(fasta)
+    aff = set(affected_areads) | set(lost_areads)
+    for n2, seq in ref.items():
+        rid = int(n2.removeprefix("read").split("/")[0])
+        if rid in aff:
+            continue
+        assert got.get(n2) == seq, f"unaffected read changed: {n2}"
+    for rid in affected_areads:
+        raw = ints_to_seq(e2e["db"].read_bases(rid))
+        assert got.get(f"read{rid}/0") == raw, f"read{rid} not emitted raw"
+        assert f"read{rid}/1" not in got
+    extra = {n2 for n2 in got if int(n2.removeprefix("read").split("/")[0]) in
+             set(lost_areads)}
+    assert not extra
+
+
+def test_matrix_bitflip_coords(e2e, dataset, tmp_path):
+    out, _ = dataset
+    p = _copy_las(dataset, tmp_path, "m_bf.las")
+    faults.corrupt_las_bitflip(p, 5)
+    fasta, stats, cfg = _quarantine_run(e2e, p, "m_bf", db_path=out["db"])
+    assert stats.n_quarantined == 1 and stats.n_ingest_issues == 1
+    _assert_contained(e2e, fasta, affected_areads=[0])
+    # sidecar records the containment (defaulted next to the output)
+    side = [json.loads(x) for x in open(fasta + ".quarantine.jsonl")]
+    assert side[0]["aread"] == 0 and side[0]["kind"] == "bad_coords"
+
+
+def test_matrix_absurd_tlen(e2e, dataset, tmp_path):
+    out, _ = dataset
+    p = _copy_las(dataset, tmp_path, "m_tl.las")
+    faults.corrupt_las_bitflip(p, 5, field="tlen", bit=30)
+    fasta, stats, _ = _quarantine_run(e2e, p, "m_tl", db_path=out["db"])
+    assert stats.n_quarantined == 1
+    _assert_contained(e2e, fasta, affected_areads=[0])
+
+
+def test_matrix_bread_out_of_bounds(e2e, dataset, tmp_path):
+    out, _ = dataset
+    p = _copy_las(dataset, tmp_path, "m_br.las")
+    faults.corrupt_las_bitflip(p, 5, field="bread", bit=30)
+    fasta, stats, _ = _quarantine_run(e2e, p, "m_br", db_path=out["db"])
+    assert stats.n_quarantined == 1
+    _assert_contained(e2e, fasta, affected_areads=[0])
+
+
+def test_matrix_truncated_las(e2e, dataset, tmp_path):
+    out, _ = dataset
+    p = _copy_las(dataset, tmp_path, "m_tr.las")
+    piles = _pile_areads(out["las"])
+    # cut mid-way: the cut pile quarantines (emitted raw), later piles vanish
+    las0 = LasFile(p)
+    cut_rec = las0.novl * 2 // 3
+    faults.corrupt_las_truncate(p, cut_rec)
+    fasta, stats, _ = _quarantine_run(e2e, p, "m_tr", db_path=out["db"])
+    assert stats.n_quarantined >= 1
+    got = _read_fasta_map(fasta)
+    ref = _read_fasta_map(e2e["ref"])
+    got_rids = {int(n.removeprefix("read").split("/")[0]) for n in got}
+    cut_at = min(r for r in got_rids
+                 if f"read{r}/0" in got and got[f"read{r}/0"] != ref.get(f"read{r}/0"))
+    affected = [r for r in got_rids if r >= cut_at]
+    assert len(affected) <= 2     # cut pile (+ conservatively its neighbor)
+    lost = [r for r in piles if r not in got_rids]
+    _assert_contained(e2e, fasta, affected_areads=affected, lost_areads=lost)
+
+
+def test_matrix_torn_idx_sidecar(e2e, dataset, tmp_path):
+    """A torn .idx sidecar must cost a rescan, never correctness: output is
+    byte-identical to the reference with nothing quarantined."""
+    out, _ = dataset
+    p = _copy_las(dataset, tmp_path, "m_sc.las")
+    index_las(p)
+    open(p + ".idx", "wb").write(b"LIDX\xff\xff\xff\xff short")
+    os.utime(p + ".idx")
+    fasta, stats, _ = _quarantine_run(e2e, p, "m_sc", db_path=out["db"])
+    assert stats.n_quarantined == 0 and stats.n_ingest_issues == 0
+    assert open(fasta).read() == open(e2e["ref"]).read()
+
+
+def test_matrix_db_garbage(e2e, dataset, tmp_path):
+    out, d = dataset
+    dd = str(tmp_path / "m_db")
+    shutil.copytree(d, dd)
+    faults.corrupt_db_garbage(os.path.join(dd, "t.db"), 3)
+    fasta, stats, _ = _quarantine_run(e2e, os.path.join(dd, "t.las"), "m_db",
+                                      db_path=os.path.join(dd, "t.db"))
+    # every pile referencing read 2 (as A or B) is contained
+    assert stats.n_quarantined >= 1
+    got = _read_fasta_map(fasta)
+    ref = _read_fasta_map(e2e["ref"])
+    assert "read2/0" not in got        # its bases are unrecoverable
+    for n2, seq in got.items():
+        assert ref.get(n2) == seq or n2.endswith("/0")
+
+
+def test_matrix_strict_structured_failure(e2e, dataset, tmp_path):
+    from daccord_tpu.runtime import correct_to_fasta
+
+    out, _ = dataset
+    p = _copy_las(dataset, tmp_path, "m_st.las")
+    info = faults.corrupt_las_bitflip(p, 5)
+    cfg = dataclasses.replace(e2e["cfg"], ingest_policy="strict")
+    with pytest.raises(IngestError) as ei:
+        correct_to_fasta(out["db"], p, os.path.join(e2e["d"], "st.fasta"),
+                         cfg, profile=e2e["prof"])
+    rec_off = info["offset"] - faults.LAS_FIELD_OFF["abpos"]
+    assert f"offset={rec_off}" in str(ei.value)
+    assert ei.value.offset == rec_off
+
+
+def test_env_fault_injection_e2e(e2e, dataset, monkeypatch, tmp_path):
+    """DACCORD_FAULT data kinds corrupt the artifacts at entry and the run
+    contains them (the pounce corruption-fuzz path, in-process)."""
+    out, _ = dataset
+    p = _copy_las(dataset, tmp_path, "env.las")
+    monkeypatch.setenv("DACCORD_FAULT", "las_bitflip:5")
+    fasta, stats, cfg = _quarantine_run(e2e, p, "env", db_path=out["db"])
+    assert stats.n_quarantined == 1
+    evs = [json.loads(x)["event"] for x in open(cfg.events_path)]
+    assert "ingest.fault" in evs and "ingest.quarantine" in evs
+    _assert_contained(e2e, fasta, affected_areads=[0])
+
+
+def test_cli_eprof_paths_honor_policy(e2e, dataset, tmp_path):
+    """The -E pre-estimation pass must validate like the run itself: strict
+    exits with the structured report (not a raw assertion from decoding a
+    corrupt pile), quarantine estimates from clean piles and completes."""
+    from daccord_tpu.tools.cli import daccord_main
+
+    out, _ = dataset
+    p = _copy_las(dataset, tmp_path, "ep.las")
+    faults.corrupt_las_bitflip(p, 5)
+    with pytest.raises(SystemExit, match="ingest integrity failure"):
+        daccord_main([out["db"], p, "--backend", "native", "-b", "64",
+                      "-E", str(tmp_path / "p.json"),
+                      "-o", str(tmp_path / "s.fasta")])
+    rc = daccord_main([out["db"], p, "--backend", "native", "-b", "64",
+                       "--ingest-policy", "quarantine",
+                       "-E", str(tmp_path / "p.json"),
+                       "-o", str(tmp_path / "q.fasta")])
+    assert rc == 0 and os.path.exists(tmp_path / "p.json")
+
+
+# ---------------------------------------------- checkpoint / commit durability
+
+def test_checkpoint_kill_between_fsync_points(dataset, native_ready,
+                                              tmp_path, monkeypatch):
+    """Kill after the FASTA fsync but before the manifest rename publishes:
+    the stale manifest points at durable bytes only, so the resume truncates
+    the orphan tail and finishes byte-identical — no lost, no duplicated
+    reads."""
+    from daccord_tpu.parallel.launch import run_shard, shard_paths
+    from daccord_tpu.runtime import PipelineConfig
+    from daccord_tpu.runtime.faults import InjectedCrash
+    from daccord_tpu.utils import aio
+
+    out, _ = dataset
+    cfg = PipelineConfig(batch_size=32, native_solver=True,
+                         depth_buckets=(), bucket_flush_reads=4)
+    ref_dir = str(tmp_path / "ref")
+    m_ref = run_shard(out["db"], out["las"], ref_dir, 0, 1, cfg,
+                      checkpoint_every=2)
+    ref_fasta = open(shard_paths(ref_dir, 0)["fasta"]).read()
+    assert m_ref["reads"] >= 8
+
+    crash_dir = str(tmp_path / "crash")
+    real = aio.durable_replace
+    state = {"commits": 0, "armed": True}
+
+    def killing(tmp, dst):
+        if state["armed"] and dst.endswith(".progress.json"):
+            state["commits"] += 1
+            if state["commits"] == 2:
+                state["armed"] = False
+                raise InjectedCrash("kill between fsync points")
+        real(tmp, dst)
+
+    monkeypatch.setattr(aio, "durable_replace", killing)
+    with pytest.raises(InjectedCrash):
+        run_shard(out["db"], out["las"], crash_dir, 0, 1, cfg,
+                  checkpoint_every=2)
+    paths = shard_paths(crash_dir, 0)
+    prog = json.load(open(paths["progress"]))
+    assert prog["emitted"] == 2          # checkpoint 2 never published
+    # the FASTA holds checkpoint 2's (fsynced) bytes — longer than the
+    # manifest's pointer, exactly the torn state the resume must truncate
+    assert os.path.getsize(paths["fasta"]) > prog["fasta_bytes"]
+
+    m = run_shard(out["db"], out["las"], crash_dir, 0, 1, cfg,
+                  checkpoint_every=2)
+    assert m["resumed_at_read"] == 2
+    assert m["reads"] == m_ref["reads"]
+    assert open(paths["fasta"]).read() == ref_fasta
+
+
+def test_checkpointed_quarantine_run_over_corrupt_las(dataset, native_ready,
+                                                      tmp_path):
+    """A FRESH checkpointed shard run under quarantine completes on a
+    framing-corrupt LAS (profile sampling must use the scan's clean piles,
+    not index_las, which rightly rejects the file) — and a mid-shard RESUME
+    over that file is refused with a structured SystemExit, never a silent
+    duplicate read."""
+    from daccord_tpu.parallel.launch import run_shard, shard_paths
+    from daccord_tpu.runtime import PipelineConfig
+
+    out, _ = dataset
+    p = str(tmp_path / "ck.las")
+    shutil.copy(out["las"], p)
+    faults.corrupt_las_bitflip(p, 5, field="tlen", bit=30)
+    cfg = PipelineConfig(batch_size=64, native_solver=True,
+                         ingest_policy="quarantine")
+    sdir = str(tmp_path / "s")
+    m = run_shard(out["db"], p, sdir, 0, 1, cfg, checkpoint_every=3)
+    assert m["quarantined"] == 1 and m["reads"] > 1
+
+    # fabricate a mid-shard resume state over the same corrupt file
+    paths = shard_paths(sdir, 0)
+    os.remove(paths["manifest"])
+    from daccord_tpu.formats.las import _HDR_SIZE
+    json.dump({"emitted": 2, "fasta_bytes": 10,
+               "counters": {"reads": 2, "windows": 0, "solved": 0,
+                            "bases_out": 4, "wall_s": 0.1},
+               "profile": [0.08, 0.04, 0.015],
+               "byte_range": [_HDR_SIZE, os.path.getsize(p)]},
+              open(paths["progress"], "wt"))
+    with pytest.raises(SystemExit, match="cannot resume"):
+        run_shard(out["db"], p, sdir, 0, 1, cfg, checkpoint_every=3)
+    m2 = run_shard(out["db"], p, sdir, 0, 1, cfg, force=True,
+                   checkpoint_every=3)
+    assert m2["reads"] == m["reads"] and m2["quarantined"] == 1
+
+
+def test_run_shard_torn_manifest_recomputes(dataset, native_ready, tmp_path):
+    """Satellite: a torn shard manifest must not wedge the idempotent rerun."""
+    from daccord_tpu.parallel.launch import run_shard, shard_paths
+    from daccord_tpu.runtime import PipelineConfig
+
+    out, _ = dataset
+    sdir = str(tmp_path / "s")
+    cfg = PipelineConfig(batch_size=64, native_solver=True)
+    m0 = run_shard(out["db"], out["las"], sdir, 0, 1, cfg)
+    paths = shard_paths(sdir, 0)
+    open(paths["manifest"], "wt").write('{"shard": 0, "rea')   # torn JSON
+    m1 = run_shard(out["db"], out["las"], sdir, 0, 1, cfg)
+    assert m1["reads"] == m0["reads"]
+    assert json.load(open(paths["manifest"]))["reads"] == m0["reads"]
+
+
+def test_resume_after_torn_progress_manifest(dataset, native_ready, tmp_path):
+    """Satellite: a torn progress manifest falls back to a fresh shard run
+    (never splices onto an untrusted tail) and still matches the reference."""
+    from daccord_tpu.parallel.launch import run_shard, shard_paths
+    from daccord_tpu.runtime import PipelineConfig
+
+    out, _ = dataset
+    cfg = PipelineConfig(batch_size=64, native_solver=True)
+    ref_dir = str(tmp_path / "ref")
+    run_shard(out["db"], out["las"], ref_dir, 0, 1, cfg, checkpoint_every=3)
+    ref_fasta = open(shard_paths(ref_dir, 0)["fasta"]).read()
+
+    tdir = str(tmp_path / "torn")
+    os.makedirs(tdir)
+    paths = shard_paths(tdir, 0)
+    open(paths["fasta"], "wt").write(">read9999/0\nACGT\n")   # untrusted tail
+    open(paths["progress"], "wt").write('{"emitted": 3, "fasta_by')
+    m = run_shard(out["db"], out["las"], tdir, 0, 1, cfg, checkpoint_every=3)
+    assert "resumed_at_read" not in m
+    assert open(paths["fasta"]).read() == ref_fasta
+
+
+def test_pre_r4_checkpoint_rejection(dataset, native_ready, tmp_path):
+    """Satellite: a pre-r4 checkpoint carrying retired --empirical-ol state
+    must refuse to resume (SystemExit pointing at --force), not silently
+    splice mixed-table output."""
+    from daccord_tpu.formats.las import _HDR_SIZE
+    from daccord_tpu.parallel.launch import run_shard, shard_paths
+    from daccord_tpu.runtime import PipelineConfig
+
+    out, _ = dataset
+    sdir = str(tmp_path / "pre_r4")
+    os.makedirs(sdir)
+    paths = shard_paths(sdir, 0)
+    open(paths["fasta"], "wt").write(">read0/0\nACGT\n")
+    byte_range = [_HDR_SIZE, os.path.getsize(out["las"])]
+    json.dump({"emitted": 2, "fasta_bytes": 5,
+               "counters": {"reads": 2, "windows": 0, "solved": 0,
+                            "bases_out": 4, "wall_s": 0.1},
+               "profile": [0.08, 0.04, 0.015],
+               "ol_counts": [[1, 2, 3]],
+               "byte_range": byte_range},
+              open(paths["progress"], "wt"))
+    cfg = PipelineConfig(batch_size=64, native_solver=True)
+    with pytest.raises(SystemExit, match="empirical-ol"):
+        run_shard(out["db"], out["las"], sdir, 0, 1, cfg, checkpoint_every=2)
+    # --force is the documented escape hatch: recompute from scratch
+    m = run_shard(out["db"], out["las"], sdir, 0, 1, cfg, force=True,
+                  checkpoint_every=2)
+    assert m["reads"] > 0

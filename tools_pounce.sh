@@ -24,6 +24,34 @@ fi
 
 stamp=$(date -u +%Y%m%dT%H%M%S)
 
+# corruption-fuzz smoke (ingest integrity layer, ISSUE 2): synthesize a toy
+# DB/LAS, bit-flip a record and tear the file mid-record, then require a
+# quarantine-mode completion with lint-clean ingest.* events — all CPU-side,
+# BEFORE any chip time is spent. A failure here means the ingest layer
+# regressed; abort the pounce rather than bench on top of it.
+fuzzdir=$(mktemp -d)
+python - "$fuzzdir" <<'EOF' || { echo "tools_pounce: fuzz synth failed" >&2; exit 1; }
+import sys
+from daccord_tpu.sim.synth import SimConfig, make_dataset
+from daccord_tpu.runtime import faults
+d = sys.argv[1]
+out = make_dataset(d, SimConfig(genome_len=1500, coverage=10,
+                                read_len_mean=500, min_overlap=200, seed=5),
+                   name="fuzz")
+print(faults.corrupt_las_bitflip(out["las"], 4))
+print(faults.corrupt_las_truncate(out["las"], 300))
+EOF
+python -m daccord_tpu.tools.cli daccord "$fuzzdir/fuzz.db" "$fuzzdir/fuzz.las" \
+    --backend native -b 64 --ingest-policy quarantine \
+    -o "$fuzzdir/fuzz.fasta" --events "$fuzzdir/fuzz.events.jsonl" \
+  || { echo "tools_pounce: corruption-fuzz run FAILED" >&2; exit 1; }
+python -m daccord_tpu.tools.cli eventcheck "$fuzzdir/fuzz.events.jsonl" \
+  || { echo "tools_pounce: fuzz events failed schema lint" >&2; exit 1; }
+grep -q '"event": "ingest.quarantine"' "$fuzzdir/fuzz.events.jsonl" \
+  || { echo "tools_pounce: fuzz run quarantined nothing" >&2; exit 1; }
+echo "tools_pounce: corruption-fuzz smoke OK" >&2
+rm -rf "$fuzzdir"
+
 run() {  # run <name> <cmd...>: capture one experiment, commit its sidecar
   name=$1; shift
   out="POUNCE_${stamp}_${name}.json"
