@@ -1,4 +1,8 @@
 from .mesh import make_mesh, make_sharded_solver
-from .launch import init_distributed, run_shard, merge_shards
+from .launch import (init_distributed, run_shard, merge_shards,
+                     load_shard_manifest, MergeGateError)
+from .fleet import FleetConfig, run_fleet
 
-__all__ = ["make_mesh", "make_sharded_solver", "init_distributed", "run_shard", "merge_shards"]
+__all__ = ["make_mesh", "make_sharded_solver", "init_distributed",
+           "run_shard", "merge_shards", "load_shard_manifest",
+           "MergeGateError", "FleetConfig", "run_fleet"]

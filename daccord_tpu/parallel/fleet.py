@@ -1,0 +1,599 @@
+"""Shard fleet orchestrator: supervised multi-shard runs (``daccord-fleet``).
+
+PR 1 made a single shard survive device loss and PR 2 made its inputs and
+outputs survive corruption and crashes; this layer supervises the *job*: the
+reference's ``-J i,n`` model (SURVEY.md §2.3) asks a human to submit every
+shard and to notice dead workers, and ``daccord-merge`` concatenated whatever
+it found. Here one orchestrator (or several, on different hosts) drives all N
+shards to completion unattended — the ParaFold supervising-scheduler model
+(PAPERS.md) over the reference's shared-filesystem data plane.
+
+**Work distribution is coordinator-free.** A shard is claimed by atomically
+creating its lease file (``O_CREAT|O_EXCL``, :func:`aio.exclusive_create`) in
+``OUTDIR/leases/``; of N hosts racing, exactly one wins. The holder renews
+the lease by bumping its mtime every ``heartbeat_s``; a lease whose mtime is
+older than ``lease_ttl_s`` is *stale* — its host died or wedged — and any
+orchestrator (including a recovered self) may take the shard over by removing
+the stale file and re-claiming. No coordinator process, no network protocol:
+the shared filesystem the reference already requires IS the control plane,
+so hosts can join or leave an in-flight run freely (elasticity). The TTL
+must exceed a few heartbeats plus worst-case shared-FS mtime propagation and
+host clock skew; takeover is logged with the previous holder's identity.
+
+**Workers are expendable subprocesses** (``daccord-shard``), bounded by a
+local slot pool. Their failure modes are detected, not awaited:
+
+- *crash* — nonzero exit (or exit 0 without a trustworthy manifest);
+- *hang* — no shard-manifest commit and no progress-manifest mtime movement
+  for ``stall_timeout_s`` (the worker is SIGKILLed);
+- *host death* — the lease goes stale and another orchestrator takes over.
+
+A failed shard is requeued with exponential backoff + deterministic jitter
+and bounded attempts. Because shard commits are idempotent and crash-durable
+(PR 2), a requeued worker resumes from the last checkpoint and the final
+FASTA is byte-identical to an unfaulted run.
+
+**Poison-shard quarantine.** A shard that kills ``poison_after`` consecutive
+workers (or exhausts ``max_attempts``) is declared poison and quarantined in
+the fleet manifest — with its last stderr tail and the quarantine-sidecar
+path, mirroring PR 2's per-pile containment one level up — while the rest of
+the fleet continues. The validating merge gate (:func:`launch.merge_shards`)
+then refuses the incomplete fleet unless ``--allow-degraded``.
+
+**Stragglers** are flagged from progress-manifest throughput (reads/s vs the
+fleet median, :func:`flag_stragglers`) and may be speculatively re-executed:
+the lagging worker is killed and the shard requeued immediately — safe
+because the checkpointed commit makes re-execution lossless, and strictly
+serialized per shard so two workers never append to one FASTA.
+
+Fault injection (``runtime/faults.py``): ``worker_crash:N`` sends the Nth
+spawned worker a mid-shard ``crash`` spec, ``worker_hang:N`` replaces the Nth
+spawn with a progress-free sleeper, ``lease_stall`` stops heartbeating the
+Nth claimed lease (backdated so the takeover fires without waiting out the
+TTL) — the whole matrix runs on CPU in CI. Events (``fleet.*``: spawn,
+heartbeat, takeover, retry, poison, speculate, done) are schema-linted by
+``eventcheck``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+
+from ..runtime.faults import FaultPlan, non_fleet_spec
+from ..utils import aio
+from ..utils.obs import JsonlLogger, NullLogger
+from .launch import _write_manifest_durable, load_shard_manifest, shard_paths
+
+#: device-op index of the ``crash`` spec injected into a worker_crash-
+#: sabotaged worker: late enough that the shard is genuinely mid-flight
+#: (batches dispatched, checkpoints possibly committed), so the requeue
+#: exercises resume — not just a failed spawn
+_WORKER_CRASH_OP = 3
+
+#: stderr bytes preserved in the fleet manifest for a poison shard
+_STDERR_TAIL_BYTES = 4000
+
+
+def lease_path(outdir: str, shard: int) -> str:
+    return os.path.join(outdir, "leases", f"shard{shard:04d}.lease")
+
+
+def claim_lease(outdir: str, shard: int, host: str,
+                ttl_s: float) -> tuple[bool, dict | None]:
+    """Try to claim ``shard``'s lease for ``host``.
+
+    Returns ``(claimed, takeover)``: ``takeover`` carries the previous
+    holder's identity and the lease's staleness when the claim displaced a
+    stale lease. A fresh (live) lease loses the race: ``(False, None)``.
+    Takeover is race-safe on a POSIX shared FS: ``os.replace`` of the stale
+    file succeeds for exactly one taker (the loser's replace raises), and
+    the subsequent ``O_EXCL`` create arbitrates any claim/claim race.
+    """
+    path = lease_path(outdir, shard)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = json.dumps({"host": host, "pid": os.getpid(), "shard": shard,
+                          "claimed_t": time.time()}).encode()
+    if aio.exclusive_create(path, payload):
+        return True, None
+    try:
+        stale_s = time.time() - os.path.getmtime(path)
+    except OSError:
+        # holder released between our create and stat: claim the vacancy
+        return aio.exclusive_create(path, payload), None
+    if stale_s <= ttl_s:
+        return False, None
+    prev = {}
+    try:
+        with open(path) as fh:
+            prev = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        pass  # torn lease from a killed claimer: still takeover-able
+    grave = f"{path}.stale.{os.getpid()}"
+    try:
+        os.replace(path, grave)
+    except FileNotFoundError:
+        return False, None  # another taker won the replace race
+    try:
+        os.remove(grave)
+    except OSError:
+        pass
+    if not aio.exclusive_create(path, payload):
+        return False, None
+    return True, {"prev_host": str(prev.get("host", "?")),
+                  "stale_s": round(stale_s, 3)}
+
+
+def read_lease(outdir: str, shard: int) -> dict | None:
+    """The lease's payload, or None when absent/torn."""
+    try:
+        with open(lease_path(outdir, shard)) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def renew_lease(outdir: str, shard: int) -> None:
+    """Heartbeat: bump the lease mtime (the staleness clock other hosts read)."""
+    try:
+        os.utime(lease_path(outdir, shard), None)
+    except OSError:
+        pass  # taken over / released: the reaper will notice soon enough
+
+
+def release_lease(outdir: str, shard: int, host: str | None = None) -> None:
+    """Remove the lease; with ``host`` given, only while it still names that
+    host — a holder that was taken over must not delete the taker's live
+    lease (the read/remove race that remains is the fencing-free protocol's
+    inherent window, bounded by the heartbeat ownership re-check)."""
+    if host is not None:
+        prev = read_lease(outdir, shard)
+        if prev is not None and prev.get("host") != host:
+            return
+    try:
+        os.remove(lease_path(outdir, shard))
+    except OSError:
+        pass
+
+
+def backdate_lease(outdir: str, shard: int, age_s: float) -> None:
+    """Set the lease's mtime ``age_s`` into the past — how ``lease_stall``
+    makes a wedged host's lease stale deterministically instead of burning
+    ``lease_ttl_s`` of CI wall-clock (also the test hook for simulating a
+    host that died right after claiming)."""
+    t = time.time() - age_s
+    try:
+        os.utime(lease_path(outdir, shard), (t, t))
+    except OSError:
+        pass
+
+
+def flag_stragglers(throughputs: dict[int, float],
+                    factor: float) -> list[int]:
+    """Shard ids whose reads/s lag the fleet median by ``factor``×.
+
+    Pure policy, unit-testable: with fewer than 2 measurable shards or a
+    zero median (nobody has emitted yet) nothing is flagged — speculation
+    must never trigger on startup noise."""
+    if factor <= 0 or len(throughputs) < 2:
+        return []
+    vals = sorted(throughputs.values())
+    median = vals[len(vals) // 2]
+    if median <= 0:
+        return []
+    return sorted(s for s, v in throughputs.items() if v * factor < median)
+
+
+@dataclass
+class FleetConfig:
+    nshards: int
+    workers: int = 2                  # local worker subprocess slots
+    max_attempts: int = 5             # worker spawns per shard before poison
+    poison_after: int = 3             # consecutive failures => poison
+    heartbeat_s: float = 1.0          # lease mtime renewal period
+    lease_ttl_s: float = 15.0         # older lease is stale (takeover)
+    stall_timeout_s: float = 600.0    # no progress movement => hung worker
+    backoff_base_s: float = 0.25
+    backoff_cap_s: float = 8.0
+    jitter: float = 0.25              # +[0, jitter) fraction, deterministic RNG
+    speculate_factor: float = 4.0     # straggler threshold vs median (0 = off)
+    speculate_min_runtime_s: float = 60.0
+    poll_s: float = 0.05
+    host: str = ""                    # lease identity; default hostname:pid
+    events_path: str | None = None    # fleet.* jsonl sidecar
+    # worker knobs (forwarded to daccord-shard)
+    backend: str = "auto"
+    batch: int | None = None
+    checkpoint_every: int = 16        # >0: progress manifests drive hang
+                                      # detection and lossless requeue
+    ingest_policy: str = "strict"
+
+
+@dataclass
+class _Shard:
+    shard: int
+    status: str = "pending"           # pending|foreign|running|done|poison
+    attempts: int = 0
+    consec_fail: int = 0
+    next_try_t: float = 0.0
+    proc: subprocess.Popen | None = None
+    spawn_t: float = 0.0
+    stderr_path: str | None = None
+    kill_reason: str | None = None
+    last_emitted: int = 0
+    last_beat: float = 0.0
+    speculated: bool = False
+    manifest: dict | None = None
+    poison_reason: str | None = None
+
+
+def _stderr_tail(path: str | None) -> str:
+    if not path or not os.path.exists(path):
+        return ""
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(max(0, os.path.getsize(path) - _STDERR_TAIL_BYTES))
+            return fh.read().decode(errors="replace")
+    except OSError:
+        return ""
+
+
+class Fleet:
+    """One orchestrator instance; :func:`run_fleet` is the entry point."""
+
+    def __init__(self, db: str, las: str, outdir: str, cfg: FleetConfig,
+                 faults: FaultPlan | None = None):
+        self.db, self.las, self.outdir, self.cfg = db, las, outdir, cfg
+        self.faults = faults
+        self.host = cfg.host or f"{socket.gethostname()}:{os.getpid()}"
+        os.makedirs(outdir, exist_ok=True)  # the events sidecar lands here
+        self.log = JsonlLogger(cfg.events_path) if cfg.events_path \
+            else NullLogger()
+        self._rng = random.Random(0xF1EE7)  # deterministic backoff jitter
+        self.shards = {s: _Shard(s) for s in range(cfg.nshards)}
+        self.poison: list[dict] = []
+        self._t0 = time.time()
+
+    # -- worker process management ------------------------------------------
+
+    def _worker_argv(self, shard: int) -> list[str]:
+        cfg = self.cfg
+        argv = [sys.executable, "-m", "daccord_tpu.tools.cli", "shard",
+                self.db, self.las, self.outdir,
+                "-J", f"{shard},{cfg.nshards}",
+                "--backend", cfg.backend,
+                "--checkpoint-every", str(cfg.checkpoint_every),
+                "--ingest-policy", cfg.ingest_policy]
+        if cfg.batch:
+            argv += ["-b", str(cfg.batch)]
+        return argv
+
+    def _worker_env(self, sabotage: str | None) -> dict:
+        env = dict(os.environ)
+        # the worker must import daccord_tpu regardless of its cwd or an
+        # uninstalled checkout: prepend the package's parent directory
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        # fleet kinds describe THIS orchestrator; only device/data kinds
+        # pass through to the worker
+        spec = non_fleet_spec(env.pop("DACCORD_FAULT", None))
+        if sabotage == "worker_crash":
+            spec = ",".join(x for x in (spec, f"crash:{_WORKER_CRASH_OP}") if x)
+        if spec:
+            env["DACCORD_FAULT"] = spec
+        return env
+
+    def _spawn(self, st: _Shard) -> None:
+        cfg, s = self.cfg, st.shard
+        sabotage = self.faults.fleet_spawn() if self.faults else None
+        st.attempts += 1
+        argv = self._worker_argv(s)
+        if sabotage == "worker_hang":
+            # a wedged worker: alive pid, no progress manifest ever — only
+            # the stall watchdog can reclaim its slot
+            argv = [sys.executable, "-c", "import time; time.sleep(600)"]
+        if sabotage:
+            self.log.log("fleet.fault", kind=sabotage, shard=s)
+        st.stderr_path = os.path.join(
+            self.outdir, f"shard{s:04d}.a{st.attempts}.stderr")
+        with open(st.stderr_path, "wb") as errfh:
+            st.proc = subprocess.Popen(argv, env=self._worker_env(sabotage),
+                                       stdout=errfh,
+                                       stderr=subprocess.STDOUT)
+        st.status = "running"
+        st.spawn_t = st.last_beat = time.time()
+        st.kill_reason = None
+        st.last_emitted = 0
+        self.log.log("fleet.spawn", shard=s, attempt=st.attempts,
+                     pid=st.proc.pid)
+
+    def _progress(self, st: _Shard) -> tuple[float, int]:
+        """(mtime, emitted) of the shard's progress manifest; the spawn time
+        and 0 when none exists yet (startup / non-checkpointed worker)."""
+        p = shard_paths(self.outdir, st.shard)["progress"]
+        try:
+            mtime = os.path.getmtime(p)
+        except OSError:
+            return st.spawn_t, st.last_emitted
+        emitted = st.last_emitted
+        try:
+            with open(p) as fh:
+                emitted = int(json.load(fh).get("emitted", emitted))
+        except (OSError, json.JSONDecodeError, ValueError, TypeError):
+            pass  # torn mid-commit read: keep the last good value
+        return mtime, emitted
+
+    # -- failure / completion handling --------------------------------------
+
+    def _mark_done(self, st: _Shard, m: dict) -> None:
+        st.status, st.manifest = "done", m
+        release_lease(self.outdir, st.shard, host=self.host)
+        self.log.log("fleet.done", shard=st.shard,
+                     reads=int(m.get("reads", 0)),
+                     degraded=bool(m.get("degraded")))
+
+    def _fail(self, st: _Shard, reason: str) -> None:
+        cfg = self.cfg
+        release_lease(self.outdir, st.shard, host=self.host)
+        if reason == "speculate":
+            # a speculative kill is not a shard failure: requeue immediately,
+            # no backoff, no poison-streak credit (attempts stay bounded)
+            st.status, st.next_try_t = "pending", 0.0
+            self.log.log("fleet.retry", shard=st.shard, attempt=st.attempts,
+                         delay_s=0.0, reason=reason)
+            return
+        st.consec_fail += 1
+        if st.consec_fail >= cfg.poison_after or st.attempts >= cfg.max_attempts:
+            why = (f"{st.consec_fail} consecutive worker failures"
+                   if st.consec_fail >= cfg.poison_after
+                   else f"attempts exhausted ({st.attempts})")
+            st.status, st.poison_reason = "poison", f"{why}; last: {reason}"
+            qpath = shard_paths(self.outdir, st.shard)["quarantine"]
+            self.poison.append({
+                "shard": st.shard, "attempts": st.attempts,
+                "reason": st.poison_reason,
+                "stderr_tail": _stderr_tail(st.stderr_path),
+                "quarantine": qpath if os.path.exists(qpath) else None,
+            })
+            self.log.log("fleet.poison", shard=st.shard, attempts=st.attempts,
+                         reason=st.poison_reason)
+            return
+        delay = min(cfg.backoff_cap_s,
+                    cfg.backoff_base_s * (2 ** (st.consec_fail - 1)))
+        delay *= 1.0 + cfg.jitter * self._rng.random()
+        st.status, st.next_try_t = "pending", time.time() + delay
+        self.log.log("fleet.retry", shard=st.shard, attempt=st.attempts,
+                     delay_s=round(delay, 3), reason=reason)
+
+    # -- supervision loop ----------------------------------------------------
+
+    def _reap(self) -> None:
+        for st in self.shards.values():
+            if st.status != "running" or st.proc is None:
+                continue
+            rc = st.proc.poll()
+            if rc is None:
+                continue
+            st.proc = None
+            m, why = load_shard_manifest(self.outdir, st.shard)
+            if rc == 0 and m is not None:
+                st.consec_fail = 0
+                self._mark_done(st, m)
+            elif st.kill_reason == "ownership_lost":
+                # the taker's worker owns the shard; watch it like any
+                # foreign shard (done when its manifest lands, reclaimable
+                # when its lease goes stale). Not a failure of the shard.
+                st.status = "foreign"
+            elif st.kill_reason == "speculate":
+                self._fail(st, "speculate")
+            else:
+                reason = st.kill_reason or f"exit:{rc}"
+                if rc == 0:
+                    reason = f"exit:0 without a valid manifest" \
+                             + (f" ({why})" if why else "")
+                self._fail(st, reason)
+
+    def _watchdog(self, now: float) -> None:
+        cfg = self.cfg
+        for st in self.shards.values():
+            if st.status != "running" or st.proc is None or st.kill_reason:
+                continue
+            # a manifest committed DURING this attempt means the worker is in
+            # its final moments — never classify that as a hang. A manifest
+            # predating the spawn is the stale one this attempt exists to
+            # recompute; it must not mute the watchdog.
+            try:
+                committed = os.path.getmtime(
+                    shard_paths(self.outdir, st.shard)["manifest"])
+            except OSError:
+                committed = None
+            if committed is not None and committed >= st.spawn_t:
+                continue
+            mtime, emitted = self._progress(st)
+            st.last_emitted = emitted
+            if now - max(st.spawn_t, mtime) > cfg.stall_timeout_s:
+                st.kill_reason = "hang"
+                st.proc.kill()
+
+    def _heartbeat(self, now: float) -> None:
+        for st in self.shards.values():
+            if st.status != "running" or st.kill_reason:
+                continue
+            if now - st.last_beat < self.cfg.heartbeat_s:
+                continue
+            st.last_beat = now
+            # ownership re-check before renewal: if our lease went stale
+            # (host pause, FS stall) and another orchestrator took the shard
+            # over, renewing would keep THE TAKER'S lease fresh while two
+            # workers append to one FASTA. Kill ours instead and treat the
+            # shard as foreign — the taker owns it now.
+            lease = read_lease(self.outdir, st.shard)
+            if lease is not None and lease.get("host") != self.host:
+                st.kill_reason = "ownership_lost"
+                st.proc.kill()
+                self.log.log("fleet.demote", shard=st.shard,
+                             new_host=str(lease.get("host", "?")))
+                continue
+            renew_lease(self.outdir, st.shard)
+            self.log.log("fleet.heartbeat", shard=st.shard,
+                         emitted=st.last_emitted)
+
+    def _recheck_foreign(self) -> None:
+        """Shards another (live) host holds: done when their manifest lands,
+        back to pending when their lease goes stale or vanishes."""
+        for st in self.shards.values():
+            if st.status != "foreign":
+                continue
+            m, _ = load_shard_manifest(self.outdir, st.shard)
+            if m is not None:
+                st.status, st.manifest = "done", m
+                self.log.log("fleet.done", shard=st.shard,
+                             reads=int(m.get("reads", 0)),
+                             degraded=bool(m.get("degraded")))
+                continue
+            path = lease_path(self.outdir, st.shard)
+            try:
+                stale = time.time() - os.path.getmtime(path) > self.cfg.lease_ttl_s
+            except OSError:
+                stale = True  # released without output: reclaimable
+            if stale:
+                st.status, st.next_try_t = "pending", 0.0
+
+    def _claim_and_spawn(self, now: float) -> None:
+        cfg = self.cfg
+        slots = cfg.workers - sum(1 for st in self.shards.values()
+                                  if st.status == "running")
+        for st in sorted(self.shards.values(), key=lambda s: s.shard):
+            if slots <= 0:
+                break
+            if st.status != "pending" or st.next_try_t > now:
+                continue
+            claimed, takeover = claim_lease(self.outdir, st.shard, self.host,
+                                            cfg.lease_ttl_s)
+            if not claimed:
+                st.status = "foreign"
+                continue
+            if takeover:
+                self.log.log("fleet.takeover", shard=st.shard, **takeover)
+            if self.faults and self.faults.fleet_claim_stall():
+                # the host wedges right after claiming: heartbeats never
+                # start, and the backdate makes the stale-lease takeover
+                # (by any orchestrator, this one included) fire immediately
+                backdate_lease(self.outdir, st.shard, cfg.lease_ttl_s + 1.0)
+                self.log.log("fleet.fault", kind="lease_stall", shard=st.shard)
+                continue
+            self._spawn(st)
+            slots -= 1
+
+    def _maybe_speculate(self, now: float) -> None:
+        cfg = self.cfg
+        if cfg.speculate_factor <= 0:
+            return
+        if any(st.status == "pending" for st in self.shards.values()):
+            return  # real work queued: never burn a slot on speculation
+        if sum(1 for st in self.shards.values()
+               if st.status == "running") >= cfg.workers:
+            return
+        # kill_reason guards the race with _watchdog in the same iteration
+        # (a hang kill must keep its classification — and its poison-streak
+        # credit); zero-emitted workers are the watchdog's problem, never
+        # speculation's
+        thr = {st.shard: st.last_emitted / max(now - st.spawn_t, 1e-9)
+               for st in self.shards.values()
+               if st.status == "running" and not st.speculated
+               and not st.kill_reason and st.last_emitted > 0
+               and now - st.spawn_t > cfg.speculate_min_runtime_s}
+        for s in flag_stragglers(thr, cfg.speculate_factor):
+            st = self.shards[s]
+            vals = sorted(thr.values())
+            self.log.log("fleet.speculate", shard=s,
+                         throughput=round(thr[s], 6),
+                         median=round(vals[len(vals) // 2], 6))
+            st.speculated, st.kill_reason = True, "speculate"
+            st.proc.kill()
+
+    def run(self) -> dict:
+        cfg = self.cfg
+        os.makedirs(self.outdir, exist_ok=True)
+        self.log.log("fleet.init", nshards=cfg.nshards, workers=cfg.workers,
+                     host=self.host)
+        # idempotent rerun: shards that already committed need no worker
+        for st in self.shards.values():
+            m, _ = load_shard_manifest(self.outdir, st.shard)
+            if m is not None:
+                self._mark_done(st, m)
+        try:
+            # process reaping and claim/spawn run at poll_s (local, cheap);
+            # everything that stats/reads the shared filesystem (progress
+            # manifests, foreign manifests/leases) runs at heartbeat cadence
+            # — that state only changes on a heartbeat timescale, and a
+            # 20 Hz metadata storm per orchestrator is what kills shared-FS
+            # deployments
+            scan_every = min(cfg.heartbeat_s, 1.0)
+            last_scan = 0.0
+            while any(st.status not in ("done", "poison")
+                      for st in self.shards.values()):
+                now = time.time()
+                self._reap()
+                if now - last_scan >= scan_every:
+                    last_scan = now
+                    self._watchdog(now)
+                    self._recheck_foreign()
+                    self._maybe_speculate(now)
+                self._heartbeat(now)
+                self._claim_and_spawn(now)
+                time.sleep(cfg.poll_s)
+            manifest = {
+                "nshards": cfg.nshards, "host": self.host,
+                "wall_s": round(time.time() - self._t0, 3),
+                "done": sorted(s for s, st in self.shards.items()
+                               if st.status == "done"),
+                "poison": self.poison,
+                "degraded": sorted(s for s, st in self.shards.items()
+                                   if st.manifest
+                                   and (st.manifest.get("degraded")
+                                        or st.manifest.get("quarantined"))),
+                "attempts": {str(s): st.attempts
+                             for s, st in self.shards.items()},
+            }
+            _write_manifest_durable(os.path.join(self.outdir, "fleet.json"),
+                                    manifest)
+            self.log.log("fleet.finish", done=len(manifest["done"]),
+                         poison=len(manifest["poison"]),
+                         wall_s=manifest["wall_s"])
+            return manifest
+        finally:
+            # an exception (or KeyboardInterrupt) must not strand worker
+            # processes; released/stale leases let another host take over
+            for st in self.shards.values():
+                if st.proc is not None and st.proc.poll() is None:
+                    st.proc.kill()
+                    st.proc.wait()
+                if st.status == "running":
+                    release_lease(self.outdir, st.shard, host=self.host)
+            self.log.close()
+
+
+def run_fleet(db: str, las: str, outdir: str, cfg: FleetConfig,
+              faults: FaultPlan | str | None = "env") -> dict:
+    """Run all ``cfg.nshards`` shards to completion under supervision;
+    returns (and durably writes, as ``OUTDIR/fleet.json``) the fleet
+    manifest. ``faults`` defaults to the process ``DACCORD_FAULT`` plan
+    (fleet kinds only — device/data kinds pass through to workers); pass
+    ``None`` for an explicitly clean run or a :class:`FaultPlan` directly.
+
+    The final `fleet.finish` event and the manifest enumerate done vs poison
+    shards; completion of the *fleet* means every shard is terminal — a
+    poison shard is quarantined, not blocking.
+    """
+    if faults == "env":
+        faults = FaultPlan.from_env()
+    return Fleet(db, las, outdir, cfg, faults=faults).run()

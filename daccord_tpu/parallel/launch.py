@@ -59,6 +59,40 @@ def _write_manifest_durable(path: str, obj: dict) -> None:
     durable_write(path, lambda fh: json.dump(obj, fh), mode="wt")
 
 
+def load_shard_manifest(outdir: str, shard: int) -> tuple[dict | None, str | None]:
+    """``(manifest, issue)`` — the shard's manifest iff it is trustworthy.
+
+    A manifest only counts when the FASTA it references still exists and (for
+    manifests new enough to record ``fasta_bytes``) still has the committed
+    byte size; a deleted or truncated FASTA under a valid-looking manifest
+    must trigger recomputation (``run_shard``) or a merge-gate refusal, never
+    a silent short-circuit over missing output. Returns ``(None, None)`` when
+    the manifest is absent or torn (PR 2 doctrine: torn JSON == never
+    written), ``(None, reason)`` when it is present but belied by the FASTA.
+    """
+    paths = shard_paths(outdir, shard)
+    if not os.path.exists(paths["manifest"]):
+        return None, None
+    try:
+        with open(paths["manifest"]) as fh:
+            m = json.load(fh)
+    except (json.JSONDecodeError, OSError):
+        # torn manifest (crash mid-write under the pre-ISSUE-2 plain write,
+        # or disk damage) must not wedge the idempotent rerun: treat as absent
+        return None, None
+    if not isinstance(m, dict):
+        return None, None
+    if not os.path.exists(paths["fasta"]):
+        return None, "manifest present but its FASTA is missing"
+    fb = m.get("fasta_bytes")
+    if fb is not None:
+        size = os.path.getsize(paths["fasta"])
+        if size != fb:
+            return None, (f"FASTA is {size} bytes, manifest committed {fb} "
+                          "(truncated or tampered)")
+    return m, None
+
+
 def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int,
               cfg: PipelineConfig | None = None, force: bool = False,
               checkpoint_every: int = 0) -> dict:
@@ -77,15 +111,13 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
     """
     os.makedirs(outdir, exist_ok=True)
     paths = shard_paths(outdir, shard)
-    if not force and os.path.exists(paths["manifest"]):
-        try:
-            with open(paths["manifest"]) as fh:
-                return json.load(fh)
-        except (json.JSONDecodeError, OSError):
-            # a torn manifest (crash mid-write under the pre-ISSUE-2 plain
-            # write, or disk damage) must not wedge the idempotent rerun:
-            # recompute the shard as if the manifest never existed
-            pass
+    if not force:
+        # the short-circuit must validate, not just exist: a cached manifest
+        # whose FASTA was deleted (or truncated — fasta_bytes catches that)
+        # would otherwise satisfy the rerun while the merge reads nothing
+        cached, _ = load_shard_manifest(outdir, shard)
+        if cached is not None:
+            return cached
     if force:
         # --force means recompute from scratch, not resume the old run —
         # the progress manifest AND the quarantine sidecar both reset
@@ -106,6 +138,10 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
                                  start=start, end=end)
         counters = {"reads": stats.n_reads, "windows": stats.n_windows,
                     "solved": stats.n_solved, "bases_out": stats.bases_out,
+                    # FASTA-record count: `reads` counts emitted piles, which
+                    # the merge gate cannot reconcile with the file (a pile
+                    # may legitimately emit zero fragments)
+                    "fragments": stats.n_fragments,
                     "wall_s": stats.wall_s,
                     "quarantined": stats.n_quarantined,
                     "ingest_issues": stats.n_ingest_issues,
@@ -120,6 +156,9 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
     manifest = {
         "shard": shard, "nshards": nshards, "byte_range": [start, end],
         **counters, "fasta": paths["fasta"],
+        # committed output size: lets the stale-manifest short-circuit and
+        # the merge gate catch a truncated FASTA, not just a missing one
+        "fasta_bytes": os.path.getsize(paths["fasta"]),
     }
     _write_manifest_durable(paths["manifest"], manifest)
     if os.path.exists(paths["progress"]):
@@ -148,14 +187,14 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
     if fired and cfg.events_path:
         # short-lived logger: the abort paths below (strict scan failure,
         # resume refusal) must not leak a held fd per retry attempt
-        _fl = JsonlLogger(cfg.events_path)
-        for f in fired:
-            _fl.log("ingest.fault", kind=f["kind"], path=f["path"],
-                    record=f["record"], offset=f.get("offset", -1))
-        _fl.close()
+        with JsonlLogger(cfg.events_path) as _fl:
+            for f in fired:
+                _fl.log("ingest.fault", kind=f["kind"], path=f["path"],
+                        record=f["record"], offset=f.get("offset", -1))
 
     emitted = 0
-    base = {"reads": 0, "windows": 0, "solved": 0, "bases_out": 0, "wall_s": 0.0}
+    base = {"reads": 0, "windows": 0, "solved": 0, "bases_out": 0,
+            "fragments": 0, "wall_s": 0.0}
     fasta_bytes = 0
     resumed = None
     prog = None
@@ -174,6 +213,12 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
         if prog is not None and prog.get("byte_range") != [start, end]:
             prog = None
         elif prog is not None and not os.path.exists(paths["fasta"]):
+            prog = None
+        elif prog is not None and \
+                os.path.getsize(paths["fasta"]) < prog.get("fasta_bytes", 0):
+            # a FASTA shorter than the checkpoint claims cannot be resumed:
+            # truncate(fasta_bytes) on the shorter file would zero-fill the
+            # hole and splice new output onto NULs — recompute instead
             prog = None
         if prog is not None:
             emitted = prog["emitted"]
@@ -239,6 +284,10 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
                                              pile_ranges=clean_piles)
     prof_row = [float(profile.p_ins), float(profile.p_del), float(profile.p_sub)]
     counters = dict(base)
+    # fragments resumed from a pre-fleet progress file are uncountable (the
+    # field did not exist); omit the counter rather than commit a wrong one
+    frag_base = base.get("fragments")
+    nfrag = 0
     # truncate any partial tail past the last checkpoint, then append
     mode = "r+t" if emitted else "wt"
     last_st = None
@@ -257,12 +306,15 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
                               for fi, f in enumerate(frags)])
             emitted += 1
             since += 1
+            nfrag += len(frags)
             # st counters are cumulative over this run; add the pre-resume base
             counters = {"reads": base["reads"] + emitted - (resumed or 0),
                         "windows": base["windows"] + st.n_windows,
                         "solved": base["solved"] + st.n_solved,
                         "bases_out": base["bases_out"] + st.bases_out,
                         "wall_s": round(base["wall_s"] + (time.time() - t0), 3)}
+            if frag_base is not None:
+                counters["fragments"] = frag_base + nfrag
             if since >= every:
                 # crash-durable commit ordering (ISSUE 2): (1) the FASTA
                 # bytes the manifest will reference reach the platter, (2)
@@ -281,10 +333,9 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
                 if cfg.events_path:
                     # short-lived append (noise next to the two fsyncs):
                     # no held fd to leak when an abort path unwinds
-                    _cl = JsonlLogger(cfg.events_path)
-                    _cl.log("ingest.commit", emitted=emitted,
-                            fasta_bytes=out.tell())
-                    _cl.close()
+                    with JsonlLogger(cfg.events_path) as _cl:
+                        _cl.log("ingest.commit", emitted=emitted,
+                                fasta_bytes=out.tell())
                 since = 0
     counters["wall_s"] = round(base["wall_s"] + (time.time() - t0), 3)
     if resumed is not None:
@@ -299,17 +350,112 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
     return counters
 
 
-def merge_shards(outdir: str, nshards: int, out_fasta: str) -> int:
-    """Concatenate shard FASTAs in shard order (the reference's merge step)."""
-    n = 0
-    with open(out_fasta, "wt") as out:
-        for s in range(nshards):
-            paths = shard_paths(outdir, s)
-            if not os.path.exists(paths["fasta"]):
-                raise FileNotFoundError(f"missing shard output {paths['fasta']}")
-            with open(paths["fasta"]) as fh:
+class MergeGateError(ValueError):
+    """The merge gate refused to concatenate: one message per violation in
+    ``issues`` (missing/stale manifests, coverage gaps, count mismatches,
+    degraded shards without ``allow_degraded``)."""
+
+    def __init__(self, issues: list):
+        self.issues = list(issues)
+        super().__init__("; ".join(self.issues))
+
+
+def merge_shards(outdir: str, nshards: int, out_fasta: str,
+                 allow_degraded: bool = False) -> int:
+    """Validating merge gate + crash-durable concatenation (the reference's
+    merge step, which happily concatenated whatever it found).
+
+    Before a single byte is written every shard manifest is checked: present
+    and trustworthy (:func:`load_shard_manifest` — FASTA exists with the
+    committed ``fasta_bytes``), indexed consistently (``shard``/``nshards``
+    fields), and byte-range coverage is gapless across the fleet. Shards that
+    finished degraded (failover engine) or with quarantined piles are refused
+    unless ``allow_degraded``; with it, MISSING shards (poison-quarantined by
+    the fleet) are also skipped rather than fatal — the merge then covers the
+    surviving byte ranges only. While concatenating, each healthy shard's
+    emitted read and base counts are cross-checked against its manifest
+    instead of silently trusting the files. The output commits through
+    :func:`aio.durable_write` (tmp + fsync + rename): a crash mid-merge can
+    never leave a valid-looking truncated FASTA, and a failed count check
+    aborts before publishing anything. Returns the fragment count.
+    """
+    from ..utils.aio import durable_write
+
+    manifests: dict[int, dict] = {}
+    missing: list[int] = []
+    degraded: list[int] = []
+    issues: list[str] = []
+    for s in range(nshards):
+        m, why = load_shard_manifest(outdir, s)
+        if m is None:
+            if why:
+                # present-but-belied manifests are corruption, never skippable
+                issues.append(f"shard {s}: {why}")
+            else:
+                missing.append(s)
+            continue
+        if m.get("shard") not in (None, s):
+            issues.append(f"shard {s}: manifest claims shard {m.get('shard')}")
+        if m.get("nshards") not in (None, nshards):
+            issues.append(f"shard {s}: manifest was written for a "
+                          f"{m.get('nshards')}-way split, merging {nshards}")
+        if m.get("degraded") or m.get("quarantined"):
+            degraded.append(s)
+        manifests[s] = m
+    if missing and not allow_degraded:
+        issues.append(f"missing shard output(s) {missing} — rerun them or "
+                      "pass --allow-degraded to merge without them")
+    if degraded and not allow_degraded:
+        issues.append(f"shard(s) {degraded} completed degraded/quarantined — "
+                      "pass --allow-degraded to merge anyway")
+    if not missing:
+        # byte-range coverage: gapless, non-overlapping, in shard order.
+        # (With explicitly allowed missing shards the gaps are the point.)
+        for a, b in zip(sorted(manifests), sorted(manifests)[1:]):
+            ra, rb = manifests[a].get("byte_range"), manifests[b].get("byte_range")
+            if ra and rb and ra[1] != rb[0]:
+                issues.append(f"byte-range gap between shard {a} (ends {ra[1]}) "
+                              f"and shard {b} (starts {rb[0]})")
+    if issues:
+        raise MergeGateError(issues)
+
+    def _concat(out) -> int:
+        frags = 0
+        for s in sorted(manifests):
+            m = manifests[s]
+            reads: set[str] = set()
+            bases = 0
+            frag_count = 0
+            with open(shard_paths(outdir, s)["fasta"]) as fh:
                 for line in fh:
                     out.write(line)
                     if line.startswith(">"):
-                        n += 1
-    return n
+                        frag_count += 1
+                        reads.add(line[1:].split("/", 1)[0].strip())
+                    else:
+                        bases += len(line.rstrip("\n"))
+            # count cross-check (healthy shards only: quarantined piles may
+            # legitimately emit no read, so their counters do not reconcile)
+            if not m.get("quarantined"):
+                errs = []
+                if (m.get("fragments") is not None
+                        and frag_count != m["fragments"]):
+                    errs.append(f"shard {s}: FASTA holds {frag_count} "
+                                f"fragments, manifest says {m['fragments']}")
+                # a pile may legitimately emit zero fragments, so distinct
+                # read ids can run BELOW the manifest's pile count — but
+                # never above it
+                if m.get("reads") is not None and len(reads) > m["reads"]:
+                    errs.append(f"shard {s}: FASTA holds {len(reads)} reads, "
+                                f"manifest says {m['reads']}")
+                if m.get("bases_out") is not None and bases != m["bases_out"]:
+                    errs.append(f"shard {s}: FASTA holds {bases} bases, "
+                                f"manifest says {m['bases_out']}")
+                if errs:
+                    # raising here aborts durable_write BEFORE the rename —
+                    # no partial merged FASTA is ever published
+                    raise MergeGateError(errs)
+            frags += frag_count
+        return frags
+
+    return durable_write(out_fasta, _concat, mode="wt")

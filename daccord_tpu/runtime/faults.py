@@ -37,6 +37,21 @@ artifacts instead of raising at ops — N indexes the corrupted record::
 They are applied once by the pipeline entry points via
 :func:`maybe_apply_data_faults` (or directly by tests / the pounce
 corruption-fuzz step via the ``corrupt_*`` helpers).
+
+Fleet kinds (the orchestrator-level twins, ``parallel/fleet.py``) sabotage
+worker processes / lease renewal instead of device ops or artifacts::
+
+    DACCORD_FAULT=worker_crash:2          # 2nd spawned worker dies mid-shard
+    DACCORD_FAULT=worker_hang:3           # 3rd spawned worker wedges (no progress)
+    DACCORD_FAULT=lease_stall             # 1st claimed lease stops heartbeating
+
+Counter domains: ``worker_crash``/``worker_hang`` count worker spawns
+(fleet-wide, in spawn order), ``lease_stall`` counts successful lease
+claims. The orchestrator consumes them via :meth:`FaultPlan.fleet_spawn` /
+:meth:`FaultPlan.fleet_claim_stall`; worker subprocesses never see the
+fleet kinds (the fleet strips them from the inherited ``DACCORD_FAULT``),
+so a composed spec like ``worker_crash:1,las_bitflip:3`` sends only the
+data kind down to the workers.
 """
 
 from __future__ import annotations
@@ -78,7 +93,14 @@ class InjectedCrash(BaseException):
 
 
 _KINDS = ("fetch_hang", "dispatch_error", "device_lost", "compile_stall",
-          "crash", "las_bitflip", "las_truncate", "db_garbage")
+          "crash", "las_bitflip", "las_truncate", "db_garbage",
+          "worker_crash", "worker_hang", "lease_stall")
+
+#: fleet-orchestrator kinds: they sabotage worker spawns / lease renewal at
+#: the fleet layer (parallel/fleet.py) and are stripped from the worker
+#: subprocesses' environment — a worker must never fail to parse the spec
+#: that describes how its own orchestrator is being tested.
+FLEET_KINDS = ("worker_crash", "worker_hang", "lease_stall")
 
 #: data-corruption kinds: they corrupt the INPUT ARTIFACTS (deterministically,
 #: keyed by record index N) instead of raising at a device op, exercising the
@@ -104,6 +126,9 @@ class FaultPlan:
     n_fetch: int = 0
     n_device: int = 0
     n_compile: int = 0
+    # fleet counters (advance once per worker spawn / successful lease claim)
+    n_spawn: int = 0
+    n_claim: int = 0
 
     @classmethod
     def parse(cls, text: str) -> "FaultPlan":
@@ -187,6 +212,25 @@ class FaultPlan:
                    f"injected compile_stall at cold-shape op "
                    f"#{self.n_compile}")
 
+    def fleet_spawn(self) -> str | None:
+        """Advance the fleet's worker-spawn counter and return the sabotage
+        kind for this spawn (``worker_crash`` | ``worker_hang``), or None.
+        One-shot like every device kind: a requeued attempt of the same
+        shard is a NEW spawn, so it runs clean and the retry path is
+        exercised, not an infinite crash loop."""
+        self.n_spawn += 1
+        for kind in ("worker_crash", "worker_hang"):
+            if self._take(kind, self.n_spawn) is not None:
+                return kind
+        return None
+
+    def fleet_claim_stall(self) -> bool:
+        """Advance the fleet's lease-claim counter; True when this claim's
+        heartbeat renewal must stall (the host wedged right after claiming —
+        the lease goes stale and any orchestrator may take the shard over)."""
+        self.n_claim += 1
+        return self._take("lease_stall", self.n_claim) is not None
+
     def probe_override(self) -> bool | None:
         """False once device_lost fired (probe must agree the chip is dead);
         None = no opinion, run the real probe."""
@@ -228,6 +272,16 @@ def maybe_apply_data_faults(las_path: str | None = None,
     if plan is None or not plan.has_data_faults():
         return []
     return plan.apply_data_faults(las_path=las_path, db_path=db_path)
+
+
+def non_fleet_spec(text: str | None) -> str:
+    """``text`` with every fleet kind removed — the ``DACCORD_FAULT`` value a
+    fleet orchestrator forwards to its worker subprocesses (device and data
+    kinds pass through; the fleet kinds describe the orchestrator itself)."""
+    if not text:
+        return ""
+    return ",".join(p.strip() for p in text.split(",") if p.strip()
+                    and p.strip().partition(":")[0] not in FLEET_KINDS)
 
 
 # ---------------------------------------------------------------------------

@@ -1286,11 +1286,10 @@ def correct_to_fasta(db_path: str, las_path: str, out_path, cfg: PipelineConfig 
     if fired and cfg.events_path:
         from ..utils.obs import JsonlLogger
 
-        _fl = JsonlLogger(cfg.events_path)
-        for f in fired:
-            _fl.log("ingest.fault", kind=f["kind"], path=f["path"],
-                    record=f["record"], offset=f.get("offset", -1))
-        _fl.close()
+        with JsonlLogger(cfg.events_path) as _fl:
+            for f in fired:
+                _fl.log("ingest.fault", kind=f["kind"], path=f["path"],
+                        record=f["record"], offset=f.get("offset", -1))
     if (cfg.ingest_policy == "quarantine" and cfg.quarantine_path is None
             and isinstance(out_path, str) and out_path != "-"
             and not out_path.startswith("mem:")):

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import struct
 import sys
 
@@ -891,17 +892,108 @@ def shard_main(argv=None) -> int:
 
 
 def merge_main(argv=None) -> int:
-    """daccord-merge: concatenate shard FASTAs in order (reference merge step)."""
+    """daccord-merge: validating merge gate + crash-durable concatenation of
+    shard FASTAs (reference merge step, minus its trust in whatever it finds):
+    manifests are checked for presence, byte-range coverage, and read/base
+    counts before the output commits via tmp+fsync+rename."""
     p = argparse.ArgumentParser(prog="daccord-merge", description=merge_main.__doc__)
     p.add_argument("outdir")
     p.add_argument("n", type=int, help="number of shards")
     p.add_argument("out_fasta")
+    p.add_argument("--allow-degraded", action="store_true",
+                   help="merge even when shards completed degraded/quarantined "
+                        "— and skip shards with no output at all (poison-"
+                        "quarantined by daccord-fleet) instead of refusing")
     args = p.parse_args(argv)
-    from ..parallel.launch import merge_shards
+    from ..parallel.launch import MergeGateError, merge_shards
 
-    n = merge_shards(args.outdir, args.n, args.out_fasta)
+    try:
+        n = merge_shards(args.outdir, args.n, args.out_fasta,
+                         allow_degraded=args.allow_degraded)
+    except MergeGateError as ex:
+        raise SystemExit("daccord-merge: refusing to merge:\n  "
+                         + "\n  ".join(ex.issues))
     print(f"merged {n} fragments", file=sys.stderr)
     return 0
+
+
+def fleet_main(argv=None) -> int:
+    """daccord-fleet: run all N shards to completion under supervision — a
+    bounded local worker pool plus shared-FS lease takeover for multi-host
+    elasticity; crashed/hung workers are requeued with backoff, a shard that
+    kills K consecutive workers is poison-quarantined while the rest of the
+    fleet continues, and --merge ends in the validating merge gate."""
+    p = argparse.ArgumentParser(prog="daccord-fleet", description=fleet_main.__doc__)
+    p.add_argument("db")
+    p.add_argument("las")
+    p.add_argument("outdir")
+    p.add_argument("-n", "--nshards", type=int, required=True)
+    p.add_argument("--workers", type=int, default=2,
+                   help="local worker subprocess slots")
+    p.add_argument("--max-attempts", type=int, default=5,
+                   help="worker spawns per shard before it is quarantined")
+    p.add_argument("--poison-after", type=int, default=3,
+                   help="consecutive worker failures that declare a shard poison")
+    p.add_argument("--heartbeat", type=float, default=1.0, metavar="S",
+                   help="lease mtime renewal period")
+    p.add_argument("--lease-ttl", type=float, default=15.0, metavar="S",
+                   help="a lease older than this is stale: any host may take "
+                        "the shard over (must exceed a few heartbeats plus "
+                        "shared-FS mtime lag and host clock skew)")
+    p.add_argument("--stall-timeout", type=float, default=600.0, metavar="S",
+                   help="a worker whose progress manifest has not moved for "
+                        "this long is declared hung and requeued")
+    p.add_argument("--speculate-factor", type=float, default=4.0,
+                   help="re-execute a shard lagging the fleet median "
+                        "throughput by this factor once slots are idle "
+                        "(0 = off)")
+    p.add_argument("--checkpoint-every", type=int, default=16,
+                   help="worker checkpoint cadence (reads); progress "
+                        "manifests also drive hang detection")
+    p.add_argument("-b", "--batch", type=int, default=None)
+    p.add_argument("--backend", choices=("auto", "cpu", "tpu", "native"),
+                   default="auto")
+    p.add_argument("--ingest-policy", choices=("strict", "quarantine", "off"),
+                   default="strict")
+    p.add_argument("--events", default=None, metavar="PATH",
+                   help="fleet events jsonl (spawn/heartbeat/takeover/retry/"
+                        "poison/speculate/done; schema: tools/eventcheck.py). "
+                        "Default: OUTDIR/fleet.events.jsonl")
+    p.add_argument("--merge", default=None, metavar="FASTA",
+                   help="after the fleet finishes, run the validating merge "
+                        "gate into this file")
+    p.add_argument("--allow-degraded", action="store_true",
+                   help="let --merge proceed over degraded/quarantined/"
+                        "missing shards, and exit 0 even when shards were "
+                        "poisoned")
+    args = p.parse_args(argv)
+    from ..parallel.fleet import FleetConfig, run_fleet
+    from ..parallel.launch import MergeGateError, merge_shards
+
+    cfg = FleetConfig(nshards=args.nshards, workers=args.workers,
+                      max_attempts=args.max_attempts,
+                      poison_after=args.poison_after,
+                      heartbeat_s=args.heartbeat, lease_ttl_s=args.lease_ttl,
+                      stall_timeout_s=args.stall_timeout,
+                      speculate_factor=args.speculate_factor,
+                      checkpoint_every=args.checkpoint_every,
+                      batch=args.batch, backend=args.backend,
+                      ingest_policy=args.ingest_policy,
+                      events_path=args.events if args.events is not None
+                      else os.path.join(args.outdir, "fleet.events.jsonl"))
+    manifest = run_fleet(args.db, args.las, args.outdir, cfg)
+    print(json.dumps({k: manifest[k] for k in
+                      ("nshards", "done", "poison", "degraded", "wall_s")}),
+          file=sys.stderr)
+    if args.merge:
+        try:
+            n = merge_shards(args.outdir, args.nshards, args.merge,
+                             allow_degraded=args.allow_degraded)
+        except MergeGateError as ex:
+            raise SystemExit("daccord-fleet: merge gate refused:\n  "
+                             + "\n  ".join(ex.issues))
+        print(f"merged {n} fragments -> {args.merge}", file=sys.stderr)
+    return 0 if (not manifest["poison"] or args.allow_degraded) else 1
 
 
 def fillfasta_main(argv=None) -> int:
@@ -1012,6 +1104,7 @@ def qveval_main(argv=None) -> int:
 _TOOLS = {
     "daccord": daccord_main,
     "shard": shard_main,
+    "fleet": fleet_main,
     "merge": merge_main,
     "inqual": intrinsicqv_main,
     "repeats": detectrepeats_main,

@@ -48,6 +48,19 @@ EVENT_FIELDS: dict[str, dict] = {
     "ingest.quarantine": {"kind": str, "offset": int, "aread": int},
     "ingest.commit": {"emitted": int, "fasta_bytes": int},
     "ingest.fault": {"kind": str, "path": str, "record": int},
+    # shard fleet orchestrator (parallel/fleet.py, ISSUE 3)
+    "fleet.init": {"nshards": int, "workers": int, "host": str},
+    "fleet.spawn": {"shard": int, "attempt": int, "pid": int},
+    "fleet.heartbeat": {"shard": int, "emitted": int},
+    "fleet.takeover": {"shard": int, "prev_host": str, "stale_s": _NUM},
+    "fleet.retry": {"shard": int, "attempt": int, "delay_s": _NUM,
+                    "reason": str},
+    "fleet.poison": {"shard": int, "attempts": int, "reason": str},
+    "fleet.speculate": {"shard": int, "throughput": _NUM, "median": _NUM},
+    "fleet.done": {"shard": int, "reads": int, "degraded": bool},
+    "fleet.fault": {"kind": str, "shard": int},
+    "fleet.demote": {"shard": int, "new_host": str},
+    "fleet.finish": {"done": int, "poison": int, "wall_s": _NUM},
     "bench_start": {"batch": int},
     "bench_compile": {"batch": int, "cached": bool, "expected_wall_s": _NUM},
     "bench_drain": {"fetched": int, "inflight": int},

@@ -154,6 +154,33 @@ def durable_write(dst: str, write_fn, mode: str = "wb"):
     return out
 
 
+def exclusive_create(url: str, data: bytes) -> bool:
+    """Atomically create ``url`` with ``data`` iff it does not exist —
+    the ``O_CREAT|O_EXCL`` claim primitive of the shared-FS lease protocol
+    (``parallel/fleet.py``): of N hosts racing to claim a shard, exactly one
+    sees True. Content and the containing directory are fsynced so a claim
+    survives power loss (a lost claim file would let two hosts run the same
+    shard after a crash+restart). False when the file already exists."""
+    if is_mem(url):
+        with _LOCK:
+            if url in _MEM:
+                return False
+            _MEM[url] = data
+        return True
+    try:
+        fd = os.open(local_path(url), os.O_WRONLY | os.O_CREAT | os.O_EXCL,
+                     0o644)
+    except FileExistsError:
+        return False
+    try:
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    fsync_dir(url)
+    return True
+
+
 def remove(url: str) -> None:
     """Delete a URL; raises FileNotFoundError when absent (both schemes —
     callers' double-delete handling must not depend on the backend)."""

@@ -33,6 +33,16 @@ class JsonlLogger:
         if self._fh is not None and self._fh is not sys.stderr:
             self._fh.close()
 
+    # context manager: the short-lived open/log/close triplets (checkpoint
+    # commits, fault events) must not leak the fd when an abort path unwinds
+    # between open and close
+    def __enter__(self) -> "JsonlLogger":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
 
 class NullLogger(JsonlLogger):
     def __init__(self):

@@ -1,0 +1,445 @@
+"""Shard fleet orchestrator: supervised multi-shard runs (ISSUE 3).
+
+The crash matrix runs on CPU with real ``daccord-shard`` worker subprocesses
+on the native backend (no XLA compiles, ~seconds per tiny shard): injected
+``worker_crash`` / ``worker_hang`` / ``lease_stall`` faults must not change a
+single output byte, a poison shard must quarantine without blocking the
+fleet, and the merge gate must refuse anything degraded or inconsistent.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from daccord_tpu.parallel import fleet as fleet_mod
+from daccord_tpu.parallel.fleet import FleetConfig, flag_stragglers, run_fleet
+from daccord_tpu.parallel.launch import (
+    MergeGateError,
+    load_shard_manifest,
+    merge_shards,
+    run_shard,
+    shard_paths,
+)
+from daccord_tpu.runtime.faults import FaultPlan, non_fleet_spec
+from daccord_tpu.runtime.pipeline import PipelineConfig
+from daccord_tpu.sim import SimConfig, make_dataset
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("fleetdata"))
+    return make_dataset(d, SimConfig(genome_len=1200, coverage=10,
+                                     read_len_mean=400, min_overlap=150,
+                                     seed=7), name="fl")
+
+
+def _fleet_cfg(tmp_path, nshards=4, **kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("backend", "native")
+    kw.setdefault("checkpoint_every", 2)
+    kw.setdefault("backoff_base_s", 0.05)
+    kw.setdefault("backoff_cap_s", 0.5)
+    kw.setdefault("speculate_min_runtime_s", 300.0)  # never in these tests
+    return FleetConfig(nshards=nshards,
+                       events_path=os.path.join(str(tmp_path), "fleet.events.jsonl"),
+                       **kw)
+
+
+def _events(cfg):
+    with open(cfg.events_path) as fh:
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+def _lint(cfg):
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    assert validate_events(cfg.events_path, strict=True) == []
+
+
+# ---------------------------------------------------------------------------
+# acceptance matrix: crash + hang + lease stall in ONE unattended run
+# ---------------------------------------------------------------------------
+
+def test_fleet_fault_matrix_byte_parity(dataset, tmp_path):
+    """A 4-shard fleet with injected worker_crash, worker_hang and
+    lease_stall completes unattended and merges byte-identically to a
+    fault-free fleet run; the event sidecar lints clean and records the
+    takeover and the retries."""
+    ref_dir = str(tmp_path / "ref")
+    cfg_ref = _fleet_cfg(ref_dir)
+    m_ref = run_fleet(dataset["db"], dataset["las"], ref_dir, cfg_ref,
+                      faults=None)
+    assert m_ref["done"] == [0, 1, 2, 3] and not m_ref["poison"]
+    ref_fasta = str(tmp_path / "ref.fasta")
+    merge_shards(ref_dir, 4, ref_fasta)
+
+    flt_dir = str(tmp_path / "faulted")
+    cfg = _fleet_cfg(flt_dir, stall_timeout_s=10.0, max_attempts=6)
+    plan = FaultPlan.parse("worker_crash:1,worker_hang:2,lease_stall:1")
+    m = run_fleet(dataset["db"], dataset["las"], flt_dir, cfg, faults=plan)
+    assert m["done"] == [0, 1, 2, 3] and not m["poison"], m
+    out_fasta = str(tmp_path / "faulted.fasta")
+    merge_shards(flt_dir, 4, out_fasta)
+    assert open(out_fasta).read() == open(ref_fasta).read()
+
+    _lint(cfg)
+    ev = _events(cfg)
+    kinds = {e["kind"] for e in ev if e["event"] == "fleet.fault"}
+    assert kinds == {"worker_crash", "worker_hang", "lease_stall"}
+    assert any(e["event"] == "fleet.takeover" for e in ev)  # stalled lease
+    retries = [e for e in ev if e["event"] == "fleet.retry"]
+    assert {e["reason"] for e in retries} >= {"hang"}  # hung worker requeued
+    assert sum(e["event"] == "fleet.done" for e in ev) == 4
+    assert any(e["event"] == "fleet.heartbeat" for e in ev)
+
+
+def test_fleet_idempotent_rerun(dataset, tmp_path):
+    """Re-running a finished fleet spawns no workers (every manifest is
+    trusted via the validating short-circuit)."""
+    d = str(tmp_path / "once")
+    cfg = _fleet_cfg(d, nshards=2)
+    m = run_fleet(dataset["db"], dataset["las"], d, cfg, faults=None)
+    assert m["done"] == [0, 1]
+    cfg2 = _fleet_cfg(d, nshards=2)
+    cfg2.events_path = os.path.join(d, "rerun.events.jsonl")
+    m2 = run_fleet(dataset["db"], dataset["las"], d, cfg2, faults=None)
+    assert m2["done"] == [0, 1]
+    assert all(a == 0 for a in m2["attempts"].values())
+    with open(cfg2.events_path) as fh:
+        assert not any(json.loads(ln)["event"] == "fleet.spawn" for ln in fh)
+
+
+# ---------------------------------------------------------------------------
+# lease protocol
+# ---------------------------------------------------------------------------
+
+def test_lease_claim_renew_takeover_units(tmp_path):
+    d = str(tmp_path)
+    ok, takeover = fleet_mod.claim_lease(d, 0, "hostA", ttl_s=60.0)
+    assert ok and takeover is None
+    # a live lease loses the race
+    ok, takeover = fleet_mod.claim_lease(d, 0, "hostB", ttl_s=60.0)
+    assert not ok and takeover is None
+    # a stale lease is taken over, reporting the previous holder
+    fleet_mod.backdate_lease(d, 0, age_s=120.0)
+    ok, takeover = fleet_mod.claim_lease(d, 0, "hostB", ttl_s=60.0)
+    assert ok and takeover["prev_host"] == "hostA"
+    assert takeover["stale_s"] > 60.0
+    fleet_mod.release_lease(d, 0)
+    ok, _ = fleet_mod.claim_lease(d, 0, "hostC", ttl_s=60.0)
+    assert ok
+
+
+def test_lease_takeover_by_second_orchestrator(dataset, tmp_path):
+    """Orchestrator A (a real second OS process) claims shard 0 and dies
+    without heartbeating — the wedged-host scenario lease_stall injects.
+    Orchestrator B takes the stale lease over and completes the fleet."""
+    d = str(tmp_path / "shards")
+    os.makedirs(d)
+    wedged = f"""
+import sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from daccord_tpu.parallel import fleet
+ok, _ = fleet.claim_lease({d!r}, 0, "orchA", ttl_s=60.0)
+assert ok
+fleet.backdate_lease({d!r}, 0, age_s=120.0)  # died right after claiming
+"""
+    subprocess.run([sys.executable, "-c", wedged], check=True)
+    assert os.path.exists(fleet_mod.lease_path(d, 0))
+
+    cfg = _fleet_cfg(d, nshards=2, host="orchB", lease_ttl_s=60.0)
+    m = run_fleet(dataset["db"], dataset["las"], d, cfg, faults=None)
+    assert m["done"] == [0, 1]
+    takeovers = [e for e in _events(cfg) if e["event"] == "fleet.takeover"]
+    assert takeovers and takeovers[0]["prev_host"] == "orchA"
+    assert takeovers[0]["shard"] == 0
+    _lint(cfg)
+
+
+# ---------------------------------------------------------------------------
+# poison-shard quarantine
+# ---------------------------------------------------------------------------
+
+def test_poison_shard_quarantined_fleet_continues(dataset, tmp_path):
+    """A shard whose input kills every worker (corrupt LAS under strict
+    ingest) is declared poison after K consecutive failures — with the
+    structured ingest report in its stderr tail — while the other shards
+    complete; the merge gate then refuses without --allow-degraded and
+    merges exactly the survivors with it."""
+    from daccord_tpu.formats.las import shard_ranges
+    from daccord_tpu.runtime.faults import (
+        _las_record_offsets,
+        _read_all,
+        corrupt_las_bitflip,
+    )
+
+    las = str(tmp_path / "poison.las")
+    shutil.copy(dataset["las"], las)
+    offs = _las_record_offsets(_read_all(las))
+    start, end = shard_ranges(las, 4)[2]
+    rec = next(i for i, o in enumerate(offs, start=1) if start <= o < end)
+    corrupt_las_bitflip(las, rec)
+
+    d = str(tmp_path / "shards")
+    cfg = _fleet_cfg(d, poison_after=2, ingest_policy="strict")
+    m = run_fleet(dataset["db"], las, d, cfg, faults=None)
+    assert m["done"] == [0, 1, 3]
+    assert [p["shard"] for p in m["poison"]] == [2]
+    p = m["poison"][0]
+    assert p["attempts"] == 2 and "consecutive" in p["reason"]
+    assert "bad_coords" in p["stderr_tail"]  # the ingest report is preserved
+    # the durable fleet manifest says the same thing
+    disk = json.load(open(os.path.join(d, "fleet.json")))
+    assert [q["shard"] for q in disk["poison"]] == [2]
+    _lint(cfg)
+    assert any(e["event"] == "fleet.poison" for e in _events(cfg))
+
+    out = str(tmp_path / "merged.fasta")
+    with pytest.raises(MergeGateError, match="missing shard output"):
+        merge_shards(d, 4, out)
+    assert not os.path.exists(out)
+    merge_shards(d, 4, out, allow_degraded=True)
+    survivors = "".join(open(shard_paths(d, s)["fasta"]).read()
+                        for s in (0, 1, 3))
+    assert open(out).read() == survivors
+
+
+# ---------------------------------------------------------------------------
+# merge gate
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def two_shards(dataset, tmp_path_factory):
+    """Two in-process shard runs (native engine) used by the gate tests —
+    each test copies the directory before tampering."""
+    d = str(tmp_path_factory.mktemp("gate"))
+    cfg = PipelineConfig(native_solver=True, batch_size=128)
+    for s in (0, 1):
+        run_shard(dataset["db"], dataset["las"], d, s, 2, cfg)
+    return d
+
+
+def _copy(two_shards, tmp_path):
+    d = str(tmp_path / "shards")
+    shutil.copytree(two_shards, d)
+    return d
+
+
+def test_merge_gate_ok_and_durable(two_shards, tmp_path):
+    out = str(tmp_path / "all.fasta")
+    n = merge_shards(two_shards, 2, out)
+    concat = "".join(open(shard_paths(two_shards, s)["fasta"]).read()
+                     for s in (0, 1))
+    assert open(out).read() == concat
+    assert n == concat.count(">")
+    # no tmp litter from the durable commit
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+
+def test_merge_gate_refuses_degraded_shard(two_shards, tmp_path):
+    d = _copy(two_shards, tmp_path)
+    mpath = shard_paths(d, 1)["manifest"]
+    m = json.load(open(mpath))
+    m["degraded"], m["fallback_reason"] = True, "device_lost"
+    json.dump(m, open(mpath, "wt"))
+    with pytest.raises(MergeGateError, match="degraded"):
+        merge_shards(d, 2, str(tmp_path / "out.fasta"))
+    assert not os.path.exists(tmp_path / "out.fasta")
+    # explicit override merges it (the output is still byte-exact)
+    merge_shards(d, 2, str(tmp_path / "out.fasta"), allow_degraded=True)
+    assert os.path.exists(tmp_path / "out.fasta")
+
+
+def test_merge_gate_catches_truncated_fasta(two_shards, tmp_path):
+    d = _copy(two_shards, tmp_path)
+    fasta = shard_paths(d, 0)["fasta"]
+    with open(fasta, "r+") as fh:
+        fh.truncate(os.path.getsize(fasta) - 10)
+    # truncation is corruption, NOT a skippable degraded state
+    for allow in (False, True):
+        with pytest.raises(MergeGateError, match="truncated"):
+            merge_shards(d, 2, str(tmp_path / "out.fasta"),
+                         allow_degraded=allow)
+
+
+def test_merge_gate_cross_checks_read_counts(two_shards, tmp_path):
+    d = _copy(two_shards, tmp_path)
+    fasta = shard_paths(d, 0)["fasta"]
+    with open(fasta, "at") as fh:
+        fh.write(">read99999/0\nACGT\n")
+    mpath = shard_paths(d, 0)["manifest"]
+    m = json.load(open(mpath))
+    m["fasta_bytes"] = os.path.getsize(fasta)  # size agrees; counts cannot
+    json.dump(m, open(mpath, "wt"))
+    out = str(tmp_path / "out.fasta")
+    with pytest.raises(MergeGateError, match="fragments|reads"):
+        merge_shards(d, 2, out)
+    assert not os.path.exists(out)  # aborted before the durable rename
+
+
+def test_merge_gate_refuses_wrong_split(two_shards, tmp_path):
+    with pytest.raises(MergeGateError, match="missing shard"):
+        merge_shards(two_shards, 3, str(tmp_path / "out.fasta"))
+
+
+# ---------------------------------------------------------------------------
+# satellite: run_shard stale-manifest short-circuit
+# ---------------------------------------------------------------------------
+
+def test_run_shard_recomputes_when_fasta_missing(dataset, tmp_path):
+    d = str(tmp_path)
+    cfg = PipelineConfig(native_solver=True, batch_size=128)
+    m = run_shard(dataset["db"], dataset["las"], d, 0, 2, cfg)
+    fasta = shard_paths(d, 0)["fasta"]
+    ref = open(fasta).read()
+    assert m["fasta_bytes"] == os.path.getsize(fasta)
+
+    os.remove(fasta)
+    got, why = load_shard_manifest(d, 0)
+    assert got is None and "missing" in why
+    m2 = run_shard(dataset["db"], dataset["las"], d, 0, 2, cfg)
+    assert open(fasta).read() == ref and m2["reads"] == m["reads"]
+
+    with open(fasta, "r+") as fh:  # truncation must also void the manifest
+        fh.truncate(10)
+    got, why = load_shard_manifest(d, 0)
+    assert got is None and "truncated" in why
+    m3 = run_shard(dataset["db"], dataset["las"], d, 0, 2, cfg)
+    assert open(fasta).read() == ref and m3["reads"] == m["reads"]
+
+    # intact manifest still short-circuits (idempotence preserved)
+    m4 = run_shard(dataset["db"], dataset["las"], d, 0, 2, cfg)
+    assert m4 == m3
+
+
+def test_run_shard_refuses_short_fasta_resume(dataset, tmp_path):
+    """A progress manifest claiming more durable FASTA bytes than the file
+    holds (torn/damaged FASTA) must trigger a fresh recompute — resuming
+    would zero-fill the hole via truncate() and splice output onto NULs."""
+    cfg = PipelineConfig(native_solver=True, batch_size=128)
+    ref_dir = str(tmp_path / "ref")
+    m_ref = run_shard(dataset["db"], dataset["las"], ref_dir, 0, 1, cfg,
+                      checkpoint_every=2)
+    ref = open(shard_paths(ref_dir, 0)["fasta"]).read()
+
+    d = str(tmp_path / "torn")
+    os.makedirs(d)
+    paths = shard_paths(d, 0)
+    with open(paths["fasta"], "wt") as fh:
+        fh.write(ref[:40])  # 40 durable bytes on disk...
+    from daccord_tpu.formats.las import shard_ranges
+
+    start, end = shard_ranges(dataset["las"], 1)[0]
+    json.dump({"emitted": 2, "fasta_bytes": 4096,  # ...checkpoint claims 4096
+               "counters": {"reads": 2, "windows": 0, "solved": 0,
+                            "bases_out": 0, "fragments": 2, "wall_s": 0.0},
+               "profile": [0.05, 0.05, 0.05], "byte_range": [start, end]},
+              open(paths["progress"], "wt"))
+    m = run_shard(dataset["db"], dataset["las"], d, 0, 1, cfg,
+                  checkpoint_every=2)
+    got = open(paths["fasta"]).read()
+    assert "\x00" not in got
+    assert got == ref and m["reads"] == m_ref["reads"]
+    assert "resumed_at_read" not in m  # fresh run, not a resume
+
+
+# ---------------------------------------------------------------------------
+# supervision-loop units (stub workers — no subprocesses)
+# ---------------------------------------------------------------------------
+
+class _StubProc:
+    def __init__(self):
+        self.killed = False
+
+    def poll(self):
+        return -9 if self.killed else None
+
+    def kill(self):
+        self.killed = True
+
+
+def _stub_fleet(dataset, outdir, **kw):
+    cfg = _fleet_cfg(outdir, nshards=1, **kw)
+    cfg.events_path = None
+    return fleet_mod.Fleet(dataset["db"], dataset["las"], str(outdir), cfg)
+
+
+def test_watchdog_not_muted_by_stale_manifest(dataset, tmp_path):
+    """A manifest predating the current attempt (the stale artifact this
+    attempt exists to recompute) must not suppress hang detection."""
+    import time
+
+    f = _stub_fleet(dataset, tmp_path, stall_timeout_s=5.0)
+    st = f.shards[0]
+    st.status, st.proc, st.spawn_t = "running", _StubProc(), time.time() - 60
+    mpath = shard_paths(str(tmp_path), 0)["manifest"]
+    json.dump({"shard": 0}, open(mpath, "wt"))
+    old = st.spawn_t - 100
+    os.utime(mpath, (old, old))  # stale: committed long before this spawn
+    f._watchdog(time.time())
+    assert st.proc.killed and st.kill_reason == "hang"
+
+    # a manifest committed during the attempt (worker finishing) DOES mute it
+    st2_proc = _StubProc()
+    st.proc, st.kill_reason, st.spawn_t = st2_proc, None, time.time() - 60
+    os.utime(mpath, None)
+    f._watchdog(time.time())
+    assert not st2_proc.killed
+
+
+def test_heartbeat_detects_ownership_loss(dataset, tmp_path):
+    """If another orchestrator took the shard over (our lease went stale
+    during a host pause), the heartbeat must kill our worker and demote the
+    shard to foreign instead of renewing the taker's lease."""
+    f = _stub_fleet(dataset, tmp_path)
+    st = f.shards[0]
+    st.status, st.proc, st.last_beat = "running", _StubProc(), 0.0
+    ok, _ = fleet_mod.claim_lease(str(tmp_path), 0, "taker-host", ttl_s=60.0)
+    assert ok  # the taker's lease, not ours
+    import time
+
+    f._heartbeat(time.time())
+    assert st.proc.killed and st.kill_reason == "ownership_lost"
+    f._reap()
+    assert st.status == "foreign"
+    # the taker's lease must survive our exit paths
+    fleet_mod.release_lease(str(tmp_path), 0, host=f.host)
+    assert fleet_mod.read_lease(str(tmp_path), 0)["host"] == "taker-host"
+
+
+# ---------------------------------------------------------------------------
+# small units
+# ---------------------------------------------------------------------------
+
+def test_flag_stragglers():
+    assert flag_stragglers({}, 4.0) == []
+    assert flag_stragglers({0: 1.0}, 4.0) == []            # nothing to compare
+    assert flag_stragglers({0: 1.0, 1: 0.9, 2: 0.1}, 4.0) == [2]
+    assert flag_stragglers({0: 1.0, 1: 0.9, 2: 0.5}, 4.0) == []
+    assert flag_stragglers({0: 0.0, 1: 0.0}, 4.0) == []    # startup noise
+    assert flag_stragglers({0: 1.0, 1: 0.0}, 0.0) == []    # disabled
+
+
+def test_non_fleet_spec_strips_only_fleet_kinds():
+    assert non_fleet_spec("worker_crash:1,las_bitflip:3") == "las_bitflip:3"
+    assert non_fleet_spec("worker_hang:2,lease_stall") == ""
+    assert non_fleet_spec("device_lost:2,crash:9") == "device_lost:2,crash:9"
+    assert non_fleet_spec(None) == ""
+
+
+def test_jsonl_logger_context_manager(tmp_path):
+    from daccord_tpu.utils.obs import JsonlLogger
+
+    p = str(tmp_path / "ev.jsonl")
+    with JsonlLogger(p) as log:
+        log.log("fleet.fault", kind="worker_hang", shard=1)
+        fh = log._fh
+    assert fh.closed
+    rec = json.loads(open(p).read())
+    assert rec["event"] == "fleet.fault" and rec["shard"] == 1
+    with JsonlLogger(None) as log:  # disabled logger is ctx-safe too
+        log.log("noop")

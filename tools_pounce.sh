@@ -52,6 +52,39 @@ grep -q '"event": "ingest.quarantine"' "$fuzzdir/fuzz.events.jsonl" \
 echo "tools_pounce: corruption-fuzz smoke OK" >&2
 rm -rf "$fuzzdir"
 
+# fleet smoke (shard fleet orchestrator, ISSUE 3): synth a toy dataset, run a
+# 4-shard supervised fleet with an injected worker crash, lint the fleet
+# event sidecar, and require the merged FASTA to be byte-identical to an
+# unfaulted fleet run — all CPU-side, before any chip time. A failure here
+# means the orchestrator/requeue/merge-gate layer regressed; abort the
+# pounce rather than bench on top of it.
+fleetdir=$(mktemp -d)
+python - "$fleetdir" <<'EOF' || { echo "tools_pounce: fleet synth failed" >&2; exit 1; }
+import sys
+from daccord_tpu.sim.synth import SimConfig, make_dataset
+make_dataset(sys.argv[1], SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=500, min_overlap=200,
+                                    seed=5), name="fleet")
+EOF
+python -m daccord_tpu.tools.cli fleet "$fleetdir/fleet.db" "$fleetdir/fleet.las" \
+    "$fleetdir/ref" -n 4 --workers 2 --backend native --checkpoint-every 4 \
+    --merge "$fleetdir/ref.fasta" \
+  || { echo "tools_pounce: clean fleet run FAILED" >&2; exit 1; }
+DACCORD_FAULT=worker_crash:1 python -m daccord_tpu.tools.cli fleet \
+    "$fleetdir/fleet.db" "$fleetdir/fleet.las" \
+    "$fleetdir/crash" -n 4 --workers 2 --backend native --checkpoint-every 4 \
+    --merge "$fleetdir/crash.fasta" \
+  || { echo "tools_pounce: crash-injected fleet run FAILED" >&2; exit 1; }
+python -m daccord_tpu.tools.cli eventcheck --strict \
+    "$fleetdir/ref/fleet.events.jsonl" "$fleetdir/crash/fleet.events.jsonl" \
+  || { echo "tools_pounce: fleet events failed schema lint" >&2; exit 1; }
+grep -q '"event": "fleet.retry"' "$fleetdir/crash/fleet.events.jsonl" \
+  || { echo "tools_pounce: injected worker crash was never requeued" >&2; exit 1; }
+cmp -s "$fleetdir/ref.fasta" "$fleetdir/crash.fasta" \
+  || { echo "tools_pounce: crash-requeued fleet FASTA diverged from clean run" >&2; exit 1; }
+echo "tools_pounce: fleet smoke OK" >&2
+rm -rf "$fleetdir"
+
 run() {  # run <name> <cmd...>: capture one experiment, commit its sidecar
   name=$1; shift
   out="POUNCE_${stamp}_${name}.json"
