@@ -57,6 +57,29 @@ BENCH_HP = os.environ.get("DACCORD_BENCH_HP") == "1"
 # B=2048/4096 BEFORE the batch sweep so no timed bench sits behind a silent
 # multi-minute server-side compile
 BENCH_PRECOMPILE = os.environ.get("DACCORD_BENCH_PRECOMPILE") == "1"
+# self-staging batch ladder (VERDICT r5 next-round #1 — the fifth consecutive
+# ask for an on-chip number): DACCORD_BENCH_LADDER=1 runs rungs
+# B=64 -> 256 -> 1024 -> 2048 and commits one sidecar + one stdout line the
+# MOMENT each rung completes (B=256 cold-compiles in ~35 s, so minute two of
+# any live chip window already holds a fallback:false number), while the
+# B=2048 compile warms in a background subprocess through the persistent
+# cache. A comma list ("64,256") overrides the rungs; every rung must divide
+# N_BENCH_WINDOWS. DACCORD_BENCH_LADDER_MAX_BATCHES caps batches per rung
+# (local verification / CPU smoke).
+_ladder_env = os.environ.get("DACCORD_BENCH_LADDER", "")
+if _ladder_env and _ladder_env != "1":
+    BENCH_LADDER: tuple | None = tuple(int(x) for x in _ladder_env.split(","))
+elif _ladder_env == "1":
+    BENCH_LADDER = (64, 256, 1024, 2048)
+else:
+    BENCH_LADDER = None
+if BENCH_LADDER is not None:
+    for _b in BENCH_LADDER:
+        if not (0 < _b <= N_BENCH_WINDOWS and N_BENCH_WINDOWS % _b == 0):
+            raise SystemExit(f"DACCORD_BENCH_LADDER rung {_b} must divide "
+                             f"N_BENCH_WINDOWS={N_BENCH_WINDOWS}")
+_lmb = os.environ.get("DACCORD_BENCH_LADDER_MAX_BATCHES")
+LADDER_MAX_BATCHES = int(_lmb) if _lmb else None
 
 
 def _bench_consensus_config():
@@ -159,13 +182,24 @@ def oracle_baseline(data: dict, n: int = 48) -> float:
     return bases / dt if dt > 0 else 0.0
 
 
-def _ladder_fingerprint() -> str:
+def _ladder_fingerprint(batch: int = BATCH) -> str:
     import jax
 
-    return f"{jax.default_backend()}:B{BATCH}xD{DEPTH}xL{SEG_LEN}"
+    fp = f"{jax.default_backend()}:B{batch}xD{DEPTH}xL{SEG_LEN}"
+    # esc_cap and n_candidates are STATIC jit args — a different value is a
+    # different XLA program, so the esccap256/cand5 pounce steps must not be
+    # announced as warm off the default program's fingerprint (the silent
+    # cold compile that ambiguity caused killed two healthy r5 benches).
+    # ESC_CAP == batch is the same program the default (None -> full batch)
+    # compiles.
+    if ESC_CAP is not None and ESC_CAP != batch:
+        fp += f":esc{ESC_CAP}"
+    if N_CANDIDATES is not None:
+        fp += f":c{N_CANDIDATES}"
+    return fp
 
 
-def _announce_compile(ev) -> bool:
+def _announce_compile(ev, batch: int = BATCH) -> bool:
     """Echo the expected cold-compile wall BEFORE the warmup goes silent
     (ADVICE r5 #2: two healthy benches were killed because a multi-minute
     server-side compile is indistinguishable from a wedge). Returns whether
@@ -174,24 +208,26 @@ def _announce_compile(ev) -> bool:
 
     from daccord_tpu.utils.obs import expected_compile_wall_s, fingerprint_seen
 
-    fp = _ladder_fingerprint()
+    fp = _ladder_fingerprint(batch)
     cached = fingerprint_seen(fp)
-    exp = 0.0 if cached else expected_compile_wall_s(BATCH)
+    exp = 0.0 if cached else expected_compile_wall_s(batch)
     if ev:
-        ev.log("bench_compile", batch=BATCH, cached=cached,
+        ev.log("bench_compile", batch=batch, cached=cached,
                expected_wall_s=round(exp, 1))
     if not cached:
-        print(f"bench: cold ladder compile for B={BATCH} "
+        print(f"bench: cold ladder compile for B={batch} "
               f"(fingerprint {fp} not in cache registry) — expect up to "
               f"~{int(exp)}s of silence before the first batch; do NOT "
               "kill the run", file=sys.stderr)
     return cached
 
 
-def precompile_ladder(data: dict, ev=None) -> dict:
-    """Compile the ladder at BATCH into the persistent XLA cache and exit-
-    style report (DACCORD_BENCH_PRECOMPILE=1): the pounce sequence runs this
-    for B=2048/4096 first so the timed benches start solving in seconds."""
+def precompile_ladder(data: dict, ev=None, batch: int = BATCH) -> dict:
+    """Compile the ladder at ``batch`` into the persistent XLA cache and
+    exit-style report (DACCORD_BENCH_PRECOMPILE=1): the pounce sequence runs
+    this for B=2048/4096 first (and the rung ladder runs it in a background
+    subprocess for its top rung) so the timed benches start solving in
+    seconds."""
     import jax
 
     from daccord_tpu.kernels.tensorize import BatchShape
@@ -202,19 +238,20 @@ def precompile_ladder(data: dict, ev=None) -> dict:
     prof = ErrorProfile(float(data["p_ins"]), float(data["p_del"]), float(data["p_sub"]))
     ladder = TierLadder.from_config(prof, _bench_consensus_config())
     shape = BatchShape(depth=DEPTH, seg_len=SEG_LEN, wlen=WLEN)
-    cached = _announce_compile(ev)
+    cached = _announce_compile(ev, batch)
     t0 = time.perf_counter()
-    fetch(solve_ladder_async(_make_batch(data, 0, BATCH, shape), ladder,
+    fetch(solve_ladder_async(_make_batch(data, 0, batch, shape), ladder,
                              esc_cap=ESC_CAP))
     wall = time.perf_counter() - t0
-    record_fingerprint(_ladder_fingerprint())
-    return {"precompile": True, "batch": BATCH,
+    record_fingerprint(_ladder_fingerprint(batch))
+    return {"precompile": True, "batch": batch,
             "compile_wall_s": round(wall, 3), "was_cached": cached,
             "device": str(jax.devices()[0]).replace(" ", "")}
 
 
 def device_throughput(data: dict, max_batches: int | None = None,
-                      max_inflight: int = 8, ev=None) -> tuple[float, dict]:
+                      max_inflight: int = 8, ev=None,
+                      batch: int = BATCH) -> tuple[float, dict]:
     """Pipelined-dispatch throughput (the pipeline's own dispatch discipline).
 
     A blocking fetch per batch would measure the axon tunnel's per-call
@@ -237,20 +274,20 @@ def device_throughput(data: dict, max_batches: int | None = None,
     shape = BatchShape(depth=DEPTH, seg_len=SEG_LEN, wlen=WLEN)
 
     N = len(data["nsegs"])
-    nb = N // BATCH
+    nb = N // batch
     if max_batches is not None:
         nb = min(nb, max_batches)
 
     def make_batch(i):
-        return _make_batch(data, i, BATCH, shape)
+        return _make_batch(data, i, batch, shape)
 
     # warmup / compile all tier shapes (with the expected-wall echo so a
     # long-silent cold compile is not mistaken for a wedge)
-    _announce_compile(ev)
+    _announce_compile(ev, batch)
     fetch(solve_ladder_async(make_batch(0), ladder, esc_cap=ESC_CAP))
     from daccord_tpu.utils.obs import record_fingerprint
 
-    record_fingerprint(_ladder_fingerprint())
+    record_fingerprint(_ladder_fingerprint(batch))
 
     # tunnel RTT estimate (sidecar provenance): median of 3 tiny blocking
     # fetches — the fixed per-device_get cost the pipelined dispatch amortizes
@@ -301,15 +338,15 @@ def device_throughput(data: dict, max_batches: int | None = None,
                 # hp_pass C++ branch) on this batch's host-side tensors
                 from types import SimpleNamespace
 
-                sl = slice(bi * BATCH, (bi + 1) * BATCH)
+                sl = slice(bi * batch, (bi + 1) * batch)
                 shim = SimpleNamespace(seqs=data["seqs"][sl],
                                        lens=data["lens"][sl],
                                        nsegs=data["nsegs"][sl])
-                sub = {"cons": np.array(out["cons"][:BATCH], dtype=np.int8),
-                       "cons_len": np.array(out["cons_len"][:BATCH],
+                sub = {"cons": np.array(out["cons"][:batch], dtype=np.int8),
+                       "cons_len": np.array(out["cons_len"][:batch],
                                             dtype=np.int32),
-                       "err": np.array(out["err"][:BATCH], dtype=np.float32),
-                       "tier": np.array(out["tier"][:BATCH], dtype=np.int32)}
+                       "err": np.array(out["err"][:batch], dtype=np.float32),
+                       "tier": np.array(out["tier"][:batch], dtype=np.int32)}
                 n_hp += nladder.hp_rescue(shim, sub, n_threads=1)
             bases += int(out["cons_len"].sum())
             solved += int(out["solved"].sum())
@@ -321,10 +358,10 @@ def device_throughput(data: dict, max_batches: int | None = None,
             drain(max_inflight // 2)
     drain(0)
     dt = time.perf_counter() - t0
-    info = dict(windows=nb * BATCH, solved=solved, wall_s=round(dt, 3),
+    info = dict(windows=nb * batch, solved=solved, wall_s=round(dt, 3),
                 device=str(jax.devices()[0]).replace(" ", ""),
-                solve_rate=round(solved / (nb * BATCH), 4),
-                batch=BATCH, rtt_ms=rtt_ms)
+                solve_rate=round(solved / (nb * batch), 4),
+                batch=batch, rtt_ms=rtt_ms)
     if ESC_CAP is not None:
         info["esc_cap"] = ESC_CAP
     if N_CANDIDATES is not None:
@@ -335,8 +372,8 @@ def device_throughput(data: dict, max_batches: int | None = None,
     return bases / dt, info
 
 
-def device_compute_throughput(data: dict, max_batches: int | None = None
-                              ) -> tuple[float, dict]:
+def device_compute_throughput(data: dict, max_batches: int | None = None,
+                              batch: int = BATCH) -> tuple[float, dict]:
     """Compute-bound ceiling: all batches pre-staged on device, every ladder
     program enqueued back-to-back, ONE terminal block — no per-batch fetch,
     no H2D inside the timed region. The gap between this number and the
@@ -359,20 +396,20 @@ def device_compute_throughput(data: dict, max_batches: int | None = None
     cl = ladder.params[0].cons_len
 
     N = len(data["nsegs"])
-    nb = N // BATCH
+    nb = N // batch
     if max_batches is not None:
         nb = min(nb, max_batches)
 
     def run(staged):
         return _ladder_packed_jit(*staged, tables, params,
                                   esc_cap=ESC_CAP if ESC_CAP is not None
-                                  else BATCH)
+                                  else batch)
 
     # H2D: stage every batch's inputs as committed device arrays
     t0 = time.perf_counter()
     staged = []
     for i in range(nb):
-        sl = slice(i * BATCH, (i + 1) * BATCH)
+        sl = slice(i * batch, (i + 1) * batch)
         staged.append((jax.device_put(jnp.asarray(data["seqs"][sl])),
                        jax.device_put(jnp.asarray(data["lens"][sl])),
                        jax.device_put(jnp.asarray(data["nsegs"][sl]))))
@@ -399,7 +436,7 @@ def device_compute_throughput(data: dict, max_batches: int | None = None
         out = unpack_result(np.asarray(a), cl)
         bases += int(out["cons_len"].sum())
         solved += int(out["solved"].sum())
-    info = dict(compute_windows=nb * BATCH, compute_solved=solved,
+    info = dict(compute_windows=nb * batch, compute_solved=solved,
                 compute_wall_s=round(t_total, 3),
                 stage_h2d_s=round(t_h2d, 3),
                 stage_dispatch_s=round(t_dispatch, 3),
@@ -487,6 +524,138 @@ def _slice_batch(batch, n: int):
     return batch_slice(batch, n)
 
 
+def _commit_sidecar(path: str, payload: dict) -> None:
+    """Crash-durable rung sidecar via the repo's one durable-commit
+    primitive (content fsync + rename + dir fsync): a tunnel or machine
+    death mid-ladder can never tear — or un-publish — the evidence."""
+    from daccord_tpu.utils.aio import durable_write
+
+    durable_write(path, lambda fh: json.dump(payload, fh), mode="wt")
+
+
+def _measure_device(data: dict, ev, batch: int,
+                    max_batches: int | None = None) -> tuple[float, dict]:
+    """Pipelined throughput + compute ceiling + efficiency ratio at one
+    batch size — the ONE metric-assembly block shared by the flagship bench
+    line and every ladder rung, so their sidecar fields cannot drift."""
+    dev_bps, info = device_throughput(data, max_batches=max_batches, ev=ev,
+                                      batch=batch)
+    comp_bps, comp_info = device_compute_throughput(data,
+                                                    max_batches=max_batches,
+                                                    batch=batch)
+    info["device_compute_bases_per_sec"] = round(comp_bps, 1)
+    info.update(comp_info)
+    info["pipeline_efficiency"] = (round(dev_bps / comp_bps, 3)
+                                   if comp_bps else None)
+    return dev_bps, info
+
+
+def run_ladder(data: dict, ev, orc_bps: float) -> int:
+    """Self-staging batch ladder (VERDICT r5 next-round #1): measure rungs
+    small-to-large, COMMITTING one sidecar (BENCH_LADDER_B*.json, atomic)
+    and printing one stdout line the moment each rung completes — so a chip
+    window that dies after two minutes still leaves a fallback:false number
+    on disk. The top rung's multi-minute server-side compile warms in a
+    background subprocess (persistent XLA cache) while the small rungs
+    measure; the ladder joins it before the top rung so the timed run loads
+    the warm program instead of sitting silent. Returns the count of rungs
+    that landed."""
+    import subprocess
+    import sys as _sys
+
+    import jax
+
+    from daccord_tpu.utils.obs import fingerprint_seen, probe_backend_status
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    warm = None
+    top = BENCH_LADDER[-1]
+    if (len(BENCH_LADDER) > 1 and jax.default_backend() == "tpu"
+            and not fingerprint_seen(_ladder_fingerprint(top))):
+        # background warm of the top rung. Known trade (accepted by VERDICT
+        # r5 #1's design): a second tunnel client runs concurrently with the
+        # small-rung benches; the compile is server-side and the subprocess
+        # commits only cache artifacts, so a conflict costs the warm, not
+        # the measurement.
+        env = dict(os.environ, DACCORD_BENCH_PRECOMPILE="1",
+                   DACCORD_BENCH_BATCH=str(top),
+                   DACCORD_BENCH_LADDER="")
+        ev_path = os.path.join(here, f"BENCH_LADDER_B{top:04d}.warm.events.jsonl")
+        env["DACCORD_BENCH_EVENTS"] = ev_path
+        warm_log = open(os.path.join(here,
+                                     f"BENCH_LADDER_B{top:04d}.warm.log"), "wt")
+        warm = subprocess.Popen(
+            [_sys.executable, os.path.abspath(__file__)],
+            stdout=warm_log, stderr=subprocess.STDOUT, env=env)
+        warm_log.close()   # the child holds its own descriptor
+        print(f"bench: warming B={top} compile in background "
+              f"(pid {warm.pid})", file=_sys.stderr)
+    landed = 0
+    try:
+        for rung in BENCH_LADDER:
+            mb = LADDER_MAX_BATCHES
+            if mb is None and rung != top:
+                # small rungs need a fast honest number, not the full window
+                # set: ~16k windows bounds the B=64 rung to ~256 dispatches.
+                # The TOP rung stays uncapped — it replaces the flagship
+                # bench as the round's headline artifact, and truncating it
+                # to one inflight-fill would bias it low vs every r1-r8
+                # baseline
+                mb = max(2, 16384 // rung)
+            if warm is not None and rung == top:
+                t_w = time.perf_counter()
+                try:
+                    # bounded: a warm child wedged on a dying tunnel must not
+                    # hold the whole ladder hostage — the rung then announces
+                    # and pays its own cold compile (or fails its probe)
+                    warm.wait(timeout=2 * 3600)
+                except subprocess.TimeoutExpired:
+                    warm.kill()
+                    warm.wait()   # reap: rc recorded for real, no zombie
+                ev.log("bench_warm_join", batch=top, rc=warm.returncode,
+                       waited_s=round(time.perf_counter() - t_w, 3))
+                warm = None
+            try:
+                dev_bps, info = _measure_device(data, ev, rung, max_batches=mb)
+            except Exception as e:
+                if probe_backend_status()[0] > 0:
+                    raise   # host-side bug, not a chip death — surface it
+                reason = f"device_loss_mid_run:{type(e).__name__}"
+                line = {"metric": "consensus_bases_per_sec_per_chip",
+                        "rung": True, "batch": rung, "fallback": True,
+                        "fallback_reason": reason}
+                _commit_sidecar(os.path.join(here,
+                                             f"BENCH_LADDER_B{rung:04d}.json"),
+                                line)
+                print(json.dumps(line), flush=True)
+                ev.log("bench_rung", batch=rung, bases_per_sec=0.0, fallback=True)
+                break
+            line = {"metric": "consensus_bases_per_sec_per_chip",
+                    "value": round(dev_bps, 1), "unit": "bases/s", "rung": True,
+                    "vs_baseline": round(dev_bps / orc_bps, 2) if orc_bps else None,
+                    "oracle_bases_per_sec": round(orc_bps, 1),
+                    "fallback": False, "fallback_reason": None,
+                    "ts": round(time.time(), 1), **info}
+            _commit_sidecar(os.path.join(here, f"BENCH_LADDER_B{rung:04d}.json"),
+                            line)
+            print(json.dumps(line), flush=True)
+            ev.log("bench_rung", batch=rung, bases_per_sec=round(dev_bps, 1),
+                   fallback=False)
+            landed += 1
+    finally:
+        if warm is not None and warm.poll() is None:
+            # ladder ended early (rung failure, dead chip, host bug): reap
+            # the warm child so it neither zombies nor keeps an orphan
+            # tunnel client racing the next pounce step
+            warm.terminate()
+            try:
+                warm.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                warm.kill()
+                warm.wait()
+    return landed
+
+
 def main() -> None:
     import argparse
 
@@ -531,19 +700,36 @@ def main() -> None:
         ev.log("bench_done", wall_s=round(time.perf_counter() - t_main0, 3))
         print(json.dumps(line))
         return
+    if BENCH_LADDER is not None:
+        # self-staging rung ladder: each rung commits its own sidecar the
+        # moment it lands (see run_ladder); the final stdout line is only a
+        # table of contents
+        if fallback:
+            # no chip: the rung ladder exists to capture a live window, and
+            # a CPU run of B=1024/2048 rungs would wall for hours — record
+            # the dated probe verdict instead and leave the evidence to
+            # TUNNEL_LOG.jsonl
+            line = {"ladder": True, "skipped": True, "fallback": True,
+                    "fallback_reason": fallback_reason,
+                    "rungs": list(BENCH_LADDER)}
+        else:
+            orc_bps = oracle_baseline(data)
+            landed = run_ladder(data, ev, orc_bps)
+            line = {"ladder": True, "rungs": list(BENCH_LADDER),
+                    "landed": landed, "fallback": False,
+                    "fallback_reason": None}
+        ev.log("bench_done", wall_s=round(time.perf_counter() - t_main0, 3))
+        print(json.dumps(line))
+        return
     if fallback:
         dev_bps, info = cpu_fallback_throughput(data)
         info["device"] = fallback
     else:
         try:
-            dev_bps, info = device_throughput(data, ev=ev)
-            # the compute-bound ceiling + stage breakdown next to the
-            # pipelined number: their ratio is the dispatch-overhead gap
-            # being attacked
-            comp_bps, comp_info = device_compute_throughput(data)
-            info["device_compute_bases_per_sec"] = round(comp_bps, 1)
-            info.update(comp_info)
-            info["pipeline_efficiency"] = round(dev_bps / comp_bps, 3) if comp_bps else None
+            # pipelined number + compute-bound ceiling + stage breakdown
+            # (their ratio is the dispatch-overhead gap being attacked) —
+            # one assembly block shared with the ladder rungs
+            dev_bps, info = _measure_device(data, ev, BATCH)
         except Exception as e:
             # possibly the chip died mid-bench (the r5 failure mode) — but a
             # plain host-side bug raises here too, and relabeling THAT as

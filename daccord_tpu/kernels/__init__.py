@@ -1,6 +1,9 @@
 from .tensorize import BatchShape, WindowBatch, tensorize_windows, pad_batch
 from .window_kernel import KernelParams, solve_window_batch
-from .tiers import TierLadder, solve_tiered, solve_ladder
+from .tiers import (TierLadder, rescue_candidates, solve_ladder,
+                    solve_ladder_split, solve_tier0_async, solve_tiered)
 
 __all__ = ["BatchShape", "WindowBatch", "tensorize_windows", "pad_batch",
-           "KernelParams", "solve_window_batch", "TierLadder", "solve_tiered", "solve_ladder"]
+           "KernelParams", "solve_window_batch", "TierLadder", "solve_tiered",
+           "solve_ladder", "solve_ladder_split", "solve_tier0_async",
+           "rescue_candidates"]

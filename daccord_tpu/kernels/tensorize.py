@@ -36,6 +36,12 @@ class WindowBatch:
     # bookkeeping for scatter-back (parallel lists, length B)
     read_ids: np.ndarray  # int64 [B]
     wstarts: np.ndarray   # int64 [B]
+    stream: str = "full"  # which ladder program solves this batch: "full"
+                          # (fused ladder — the default), "tier0" (two-stream
+                          # Stream A), "rescue" (Stream B dense rescue; same
+                          # program as "full", tagged for routing/replay —
+                          # the supervisor keys compile classification and
+                          # failover replay on it, kernels/tiers.py)
 
     @property
     def size(self) -> int:
@@ -89,4 +95,5 @@ def pad_batch(batch: WindowBatch, target: int) -> WindowBatch:
         shape=batch.shape,
         read_ids=np.concatenate([batch.read_ids, np.full(pad, -1, dtype=np.int64)]),
         wstarts=np.concatenate([batch.wstarts, np.zeros(pad, dtype=np.int64)]),
+        stream=batch.stream,
     )

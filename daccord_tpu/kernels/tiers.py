@@ -212,6 +212,31 @@ def _ladder_jit(seqs, lens, nsegs, tables, params, esc_cap, use_pallas=False,
                        pallas_interpret, wide_p0)
 
 
+def tier0_core(seqs, lens, nsegs, table0, p0: KernelParams,
+               use_pallas: bool = False, pallas_interpret: bool = False):
+    """Stream A of the two-stream ladder: tier 0 ONLY (the cheap M=64
+    kernel), shaped exactly like :func:`ladder_core` output so the packed
+    wire format and the pipeline's scatter path are shared. No wide rescue
+    and no escalation run here — failures and top-M-overflow windows are
+    pooled on host (:func:`rescue_candidates`) and re-solved in a dense
+    Stream B batch (:func:`ladder_core` at the pool size)."""
+    out0 = solve_batch_core(seqs, lens, nsegs, table0, p0, use_pallas,
+                            pallas_interpret)
+    solved = out0["solved"]
+    return dict(cons=out0["cons"], cons_len=out0["cons_len"], err=out0["err"],
+                solved=solved,
+                tier=jnp.where(solved, 0, -1).astype(jnp.int32),
+                m_ovf=out0["m_overflow"], esc_overflow=jnp.int32(0))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("p0", "use_pallas", "pallas_interpret"))
+def _tier0_packed_jit(seqs, lens, nsegs, table0, p0, use_pallas=False,
+                      pallas_interpret=False):
+    return pack_result(tier0_core(seqs, lens, nsegs, table0, p0, use_pallas,
+                                  pallas_interpret))
+
+
 def pack_result(out: dict) -> jnp.ndarray:
     """Pack a ladder result dict into ONE int32 array [B, words+3].
 
@@ -342,6 +367,81 @@ def solve_ladder(batch: WindowBatch, ladder: TierLadder,
     """Single-dispatch full-ladder solve; host numpy results."""
     return fetch(solve_ladder_async(batch, ladder, esc_cap, use_pallas,
                                     pallas_interpret))
+
+
+def solve_tier0_async(batch: WindowBatch, ladder: TierLadder,
+                      use_pallas: bool = False,
+                      pallas_interpret: bool = False):
+    """Dispatch Stream A (tier 0 only) of the two-stream ladder; returns a
+    packed handle exactly like :func:`solve_ladder_async` — one fetch, same
+    wire format — but the program never carries the rescue tiers, so a
+    tier-0 failure costs nothing here (the window pools for Stream B)."""
+    p0 = ladder.params[0]
+    arr = _tier0_packed_jit(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
+                            jnp.asarray(batch.nsegs), ladder.tables[p0.k],
+                            p0, use_pallas, pallas_interpret)
+    return _PackedHandle(arr, p0.cons_len)
+
+
+def rescue_candidates(out: dict, nsegs: np.ndarray,
+                      ladder: TierLadder) -> np.ndarray:
+    """Bool mask of batch rows that the fused ladder would have routed
+    through its rescue lanes — the two-stream pool-membership rule.
+
+    Mirrors :func:`ladder_core` exactly: windows whose top-M cap bound
+    (only when the overflow rescue is configured) and windows tier 0 failed
+    at adequate depth (only when escalation tiers exist). Applied to a
+    tier0-only result this selects Stream B's input; applied to a FULL
+    ladder result (a supervisor-degraded Stream A batch replays on the
+    full-ladder fallback engine) it still composes byte-identically — every
+    pooled window re-solves to the same per-window result, and un-pooled
+    windows already carry their final bytes."""
+    nsegs = np.asarray(nsegs)
+    deep = nsegs >= ladder.params[0].min_depth
+    need = np.zeros(len(nsegs), dtype=bool)
+    if len(ladder.params) > 1:
+        need |= ~np.asarray(out["solved"]) & deep
+    if ladder.wide_p0 is not None:
+        need |= np.asarray(out["m_ovf"]) & deep
+    return need
+
+
+def solve_ladder_split(batch: WindowBatch, ladder: TierLadder,
+                       rescue_batch: int | None = None,
+                       use_pallas: bool = False,
+                       pallas_interpret: bool = False) -> dict:
+    """Two-stream solve of ONE batch (the kernel-level unit behind the
+    pipeline's cross-batch pool): Stream A tier0 over the full batch, then
+    Stream B (the full ladder, compacted) over the rescue candidates only,
+    scattered back. Byte-identical to :func:`solve_ladder` by construction —
+    every window is solved independently, so re-batching cannot change its
+    bytes (enforced by tests/test_split_ladder.py).
+
+    ``rescue_batch`` fixes Stream B's static shape (padded); None solves
+    the candidates in one right-sized batch."""
+    import dataclasses
+
+    from .tensorize import pad_batch as _pad
+
+    out = fetch(solve_tier0_async(batch, ladder, use_pallas,
+                                  pallas_interpret))
+    out = {k: (np.array(v) if isinstance(v, np.ndarray) else v)
+           for k, v in out.items()}
+    idx = np.nonzero(rescue_candidates(out, batch.nsegs, ladder))[0]
+    step = rescue_batch if rescue_batch else max(len(idx), 1)
+    for c0 in range(0, len(idx), step):
+        sub = idx[c0 : c0 + step]
+        sb = dataclasses.replace(
+            batch, seqs=batch.seqs[sub], lens=batch.lens[sub],
+            nsegs=batch.nsegs[sub], read_ids=batch.read_ids[sub],
+            wstarts=batch.wstarts[sub], stream="rescue")
+        r = fetch(solve_ladder_async(_pad(sb, step), ladder,
+                                     use_pallas=use_pallas,
+                                     pallas_interpret=pallas_interpret))
+        n = len(sub)
+        for key in ("cons", "cons_len", "err", "solved", "tier", "m_ovf"):
+            out[key][sub] = r[key][:n]
+    return out
 
 
 def _solve_compact(batch: WindowBatch, idx: np.ndarray, table, p: KernelParams,

@@ -111,6 +111,27 @@ class PipelineConfig:
                                  # row has waited this many reads — bounds the
                                  # in-order emission lag (and therefore the
                                  # pending/ready memory) under bucket skew
+    ladder_mode: str = "fused"   # "fused" = one jitted program per batch
+                                 # (tier 0 + every rescue tier at esc_cap =
+                                 # full batch width — the r1-r8 behavior);
+                                 # "split" = the two-stream ladder: Stream A
+                                 # dispatches tier0-only batches, rescue
+                                 # candidates (tier-0 failures + top-M
+                                 # overflow when --overflow-rescue) pool on
+                                 # host and flush as DENSE full-ladder
+                                 # Stream B batches — the M=256 quadratic
+                                 # rescue DP then only ever runs over
+                                 # saturated batches (ISSUE 4; byte-identical
+                                 # to fused by per-window independence,
+                                 # tests/test_split_ladder.py). Applies to
+                                 # the JAX ladder paths only; the native
+                                 # engine escalates per-window on host and
+                                 # mesh solvers bring their own programs
+    rescue_flush_reads: int = 128    # split mode: flush a partial rescue pool
+                                 # once its oldest row has waited this many
+                                 # reads (the bucket_flush_reads rule applied
+                                 # to Stream B) — bounds the in-order
+                                 # emission lag a pooled window can add
     seg_len_buckets: tuple = ()  # optional second-level routing by max segment
                                  # length (e.g. (48,)): windows whose segments
                                  # all fit go to a narrower batch — exact, like
@@ -200,6 +221,19 @@ class PipelineStats:
                                  # (their reads emitted uncorrected)
     n_ingest_issues: int = 0     # integrity violations the validating scan
                                  # found in this shard's byte range
+    # two-stream ladder accounting (ISSUE 4). rescue_slots_executed counts
+    # the rescue-lane batch slots the device program ran: in fused mode the
+    # whole esc_cap (= padded batch) every time the lax.cond fired (any
+    # rescue candidate in the batch); in split mode the padded width of each
+    # Stream B dispatch. Host-side, so the fused-vs-split tail-cost ratio is
+    # measurable with no chip.
+    n_rescue_windows: int = 0    # live windows that went through a rescue lane
+    rescue_slots_executed: int = 0
+    n_dispatch_tier0: int = 0    # Stream A dispatches (split mode)
+    n_dispatch_rescue: int = 0   # Stream B dispatches (split mode)
+    rescue_dispatches: list = field(default_factory=list)
+                                 # split mode: one {rows, slots, reason} per
+                                 # Stream B dispatch (reason: full|lag|final)
     bases_in: int = 0
     bases_out: int = 0
     tier_histogram: dict = field(default_factory=dict)
@@ -216,6 +250,14 @@ class PipelineStats:
     @property
     def pad_waste(self) -> float:
         return 1.0 - self.used_cells / self.pad_cells if self.pad_cells else 0.0
+
+    @property
+    def rescue_density(self) -> float:
+        """Live rows per executed rescue slot (1.0 = every rescue slot the
+        quadratic DP paid for held a real window; fused mode at production
+        failure rates sits near the failure rate itself)."""
+        return (self.n_rescue_windows / self.rescue_slots_executed
+                if self.rescue_slots_executed else 0.0)
 
     def bases_per_sec(self) -> float:
         return self.bases_out / self.wall_s if self.wall_s > 0 else 0.0
@@ -702,6 +744,14 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             return out
 
         solver = _native_solver
+    # two-stream ladder (ISSUE 4): only the local JAX ladder paths split —
+    # the native engine already escalates per-window on host, and a custom
+    # solver (mesh) brings its own programs
+    split_ladder = (cfg.ladder_mode == "split" and solver is None
+                    and not native_dispatch)
+    if cfg.ladder_mode == "split" and not split_ladder:
+        log.log("info", msg="ladder_mode=split inapplicable here "
+                            "(native engine or custom solver); running fused")
     if solver is not None:
         if hasattr(solver, "dispatch") and hasattr(solver, "fetch"):
             # async solver (e.g. the mesh-sharded ladder): pipeline batches
@@ -713,7 +763,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     else:
         import jax
 
-        if jax.default_backend() == "cpu":
+        if jax.default_backend() == "cpu" and not split_ladder:
             # host-routed ladder: skips escalation tiers when nothing failed
             # (cheap syncs; right trade-off for local CPU execution)
             from ..kernels.tiers import solve_tiered
@@ -727,15 +777,29 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             # async device ladder: one dispatch per batch, fetched a batch
             # later so host windowing overlaps device compute + tunnel RTT
             # (default esc_cap sizes escalation to the full batch: overflow
-            # is structurally impossible)
+            # is structurally impossible). In split mode Stream A dispatches
+            # the tier0-only program and Stream B (pool flushes, routed by
+            # batch.stream) the full rescue ladder — the same jitted program
+            # a fused dispatch uses, now only ever fed dense batches.
             from ..kernels.tiers import fetch as _fetch, solve_ladder_async
 
             from ..kernels.tiers import fetch_many as _fetch_many
+            from ..kernels.tiers import solve_tier0_async
             from ..kernels.window_kernel import pallas_needs_interpret
 
             interp = cfg.use_pallas and pallas_needs_interpret()
-            dispatch_fn = (lambda b: solve_ladder_async(
-                b, ladder, use_pallas=cfg.use_pallas, pallas_interpret=interp))
+            if split_ladder:
+                def dispatch_fn(b):
+                    if b.stream == "tier0":
+                        return solve_tier0_async(
+                            b, ladder, use_pallas=cfg.use_pallas,
+                            pallas_interpret=interp)
+                    return solve_ladder_async(
+                        b, ladder, use_pallas=cfg.use_pallas,
+                        pallas_interpret=interp)
+            else:
+                dispatch_fn = (lambda b: solve_ladder_async(
+                    b, ladder, use_pallas=cfg.use_pallas, pallas_interpret=interp))
             fetch_fn = _fetch
             fetch_many_fn = _fetch_many
 
@@ -764,13 +828,15 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             else:
                 import jax
 
-                desc = ("cpu-ladder" if jax.default_backend() == "cpu"
-                        else "device-ladder")
+                is_cpu = jax.default_backend() == "cpu"
+                desc = ("cpu-ladder" if is_cpu else "device-ladder")
+                if split_ladder:
+                    desc += "-split"
                 # a host-local ladder cannot hang the way a tunnel can;
                 # skip the watchdog thread (its hand-off is the only
                 # measurable supervisor cost on the hot path)
-                inline = desc == "cpu-ladder"
-                if desc == "device-ladder":
+                inline = is_cpu
+                if not is_cpu:
                     # RTT-scaled fetch deadline (the tunnel's fixed
                     # per-device_get cost is the natural time unit here)
                     from ..utils.obs import measure_rtt_s
@@ -896,7 +962,23 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
     from collections import deque
 
-    inflight: deque = deque()    # (handle, rid, widx, take, t_dispatch, hp_ctx)
+    # (handle, rid, widx, take, t_dispatch, rows_ctx, bucket, stream) —
+    # rows_ctx retains the dispatched (seqs, lens, nsegs) so the hp pass can
+    # reconstruct segments and the split ladder can pool rescue rows (the
+    # supervisor's handles already retain the whole batch for replay, so
+    # this costs nothing extra under the default supervised config)
+    inflight: deque = deque()
+
+    # split-ladder rescue pools, one per bucket shape (Stream B inputs):
+    # tier-0 failures and top-M-overflow windows accumulate here until a
+    # full dense batch (or the flush deadline / final drain) dispatches them
+    r_seqs: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    r_lens: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    r_nsegs: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    r_rid: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    r_widx: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    r_nrows = [0] * nb
+    r_first_seen = [None] * nb   # read counter when the pool got its oldest row
 
     # rescue tiers = frequency filter effectively off (min_count <= 1);
     # their end-of-read solutions get trimmed (see PipelineConfig.end_trim).
@@ -927,16 +1009,24 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             yield r, frags, stats
             emit_idx += 1
 
-    def hp_pass(out, hp_ctx, take) -> dict[int, np.ndarray]:
+    def hp_pass(out, hp_ctx, take, skip=None) -> dict[int, np.ndarray]:
         """Homopolymer rescue over one fetched batch (oracle/hp.py).
 
         Routes windows that failed or solved with err > hp_err through the
         run-length-compressed solver; accepted candidates override the
         result row (their sequence may exceed the packed cons capacity, so
-        they travel as a side dict consumed by scatter)."""
+        they travel as a side dict consumed by scatter). ``skip`` masks rows
+        whose ladder result is NOT final yet — split-mode Stream A rows
+        headed for the rescue pool; hp runs on them when their Stream B
+        result lands, exactly where the fused ladder would have run it."""
         from ..oracle.hp import HP_TIER, hp_candidate
 
         seqs_b, lens_b, nsegs_b = hp_ctx
+        if skip is not None:
+            # masked rows drop below min_depth (nseg 0), which both engines
+            # treat as "no candidate" — alignment of rows to `out` indices
+            # is preserved for the writeback scan
+            nsegs_b = np.where(skip, 0, nsegs_b[:take])
         ccfg = cfg.consensus
         overrides: dict[int, np.ndarray] = {}
         if hp_nladder is not None:
@@ -992,11 +1082,19 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             stats.n_hp_rescued += 1
         return overrides
 
-    def scatter(out, rid, widx, take, hp_over=None):
+    def scatter(out, rid, widx, take, hp_over=None, keep=None):
+        """Scatter one fetched batch's rows into their pending reads.
+        ``keep`` (split mode) masks out rows whose windows went to the
+        rescue pool instead — they scatter exactly once, when their Stream B
+        result lands, so per-window accounting never double-counts."""
         n_batch_solved = 0
         if "m_ovf" in out:
-            stats.n_topm_overflow += int(np.sum(out["m_ovf"][:take]))
+            mv = np.asarray(out["m_ovf"][:take])
+            stats.n_topm_overflow += int(np.sum(mv if keep is None
+                                                else mv[keep]))
         for i in range(take):
+            if keep is not None and not keep[i]:
+                continue
             r = int(rid[i])
             pr = pending[r]
             if hp_over is not None and i in hp_over:
@@ -1018,6 +1116,41 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 finalize_read(r, pr)
         return n_batch_solved
 
+    def _pop_rows(pools, counts, seen, bi: int, take: int):
+        """Concatenate bucket ``bi``'s buffered row arrays, requeue the
+        remainder past ``take``, and maintain the count + oldest-row stamp —
+        the ONE buffer-pop shared by the window buckets (run_batches) and
+        the rescue pools (flush_rescues), so their leftover/stale rules
+        cannot drift apart. Leftover rows keep the pre-pop stamp
+        (conservative: may flush early, never lets a row wait past its
+        deadline)."""
+        arrs = []
+        for lst in pools:
+            a = np.concatenate(lst[bi]) if len(lst[bi]) > 1 else lst[bi][0]
+            lst[bi].clear()
+            arrs.append(a)
+        n = len(arrs[2])     # nsegs column carries the row count
+        if n > take:
+            for lst, a in zip(pools, arrs):
+                lst[bi].append(a[take:])
+        counts[bi] = n - take
+        if not counts[bi]:
+            seen[bi] = None
+        return arrs
+
+    def _pool_rescue(bi: int, rows_ctx, sel, rid, widx) -> None:
+        """Append the selected rows of a fetched Stream A batch to bucket
+        ``bi``'s rescue pool (Stream B input)."""
+        seqs_b, lens_b, nsegs_b = rows_ctx
+        r_seqs[bi].append(seqs_b[sel])
+        r_lens[bi].append(lens_b[sel])
+        r_nsegs[bi].append(nsegs_b[sel])
+        r_rid[bi].append(rid[sel])
+        r_widx[bi].append(widx[sel])
+        r_nrows[bi] += len(sel)
+        if r_first_seen[bi] is None:
+            r_first_seen[bi] = stats.n_reads
+
     def drain(to_depth: int):
         # drain in ONE grouped fetch: the tunnel charges its ~100 ms RTT per
         # device_get CALL, not per array, so fetching k batches together
@@ -1036,17 +1169,97 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         # (in-flight batches overlap, so summing dispatch->fetch spans
         # would double-count and can exceed wall time)
         stats.device_s += now - t_f
-        for (handle, rid, widx, take, t0, hp_ctx), out in zip(entries, outs):
-            if hp_ctx is not None:
+        for (handle, rid, widx, take, t0, rows_ctx, bi, stream), out \
+                in zip(entries, outs):
+            keep = pool_mask = None
+            if split_ladder and stream == "tier0":
+                # pool-membership rule shared with the kernel-level unit
+                # (kernels.tiers.rescue_candidates): rows the fused ladder
+                # would have rescued defer to Stream B; the rest are final.
+                # A supervisor-degraded entry carries FULL results here —
+                # still correct: its pooled rows re-solve to the same bytes
+                from ..kernels.tiers import rescue_candidates
+
+                # out arrays carry the PADDED batch length; pad rows have
+                # nsegs 0 so they can never be candidates — slice to live
+                need = rescue_candidates(out, rows_ctx[2], ladder)[:take]
+                if need.any():
+                    _pool_rescue(bi, rows_ctx, np.nonzero(need)[0], rid, widx)
+                    keep, pool_mask = ~need, need
+            elif not split_ladder and ladder is not None and "m_ovf" in out:
+                # fused-mode comparator for the split decision row: ANY
+                # rescue candidate means the lax.cond ran the rescue lanes
+                # at full esc_cap (= padded batch) width. Candidates are
+                # reconstructed post-hoc from FINAL results — escalation-
+                # solved windows show tier >= 1, still-failed deep windows
+                # show unsolved — so only a tier-0 failure the wide rescue
+                # solved is missed: a slight undercount, never an overcount
+                deep = rows_ctx[2][:take] >= min_depth
+                tierv = np.asarray(out["tier"][:take])
+                need_f = (tierv >= 1) | (~np.asarray(out["solved"][:take])
+                                         & deep)
+                if ladder.wide_p0 is not None:
+                    need_f |= np.asarray(out["m_ovf"][:take]) & deep
+                n_need = int(np.sum(need_f))
+                if n_need:
+                    stats.n_rescue_windows += n_need
+                    stats.rescue_slots_executed += len(rows_ctx[2])
+            if hp_ols is not None:
                 t_hp = time.time()
-                hp_over = hp_pass(out, hp_ctx, take)
+                hp_over = hp_pass(out, rows_ctx, take, skip=pool_mask)
                 stats.hp_wall_s += time.time() - t_hp
             else:
                 hp_over = None
-            n_s = scatter(out, rid, widx, take, hp_over)
-            log.log("batch", windows=take, solved=n_s,
+            n_s = scatter(out, rid, widx, take, hp_over, keep)
+            log.log("batch", windows=take, solved=n_s, stream=stream,
                     overflow=int(out.get("esc_overflow", 0)),
+                    # live rescue-pool gauge: lets a log reader (and the
+                    # checkpoint/resume test) see pooled rows pending at any
+                    # point in the run
+                    pool=int(sum(r_nrows)) if split_ladder else 0,
                     inflight=len(inflight), t_turnaround=round(now - t0, 4))
+
+    def flush_rescues(final: bool):
+        """Dispatch Stream B: drain each bucket's rescue pool as DENSE
+        full-ladder batches. A pool flushes when it holds a full batch, when
+        its oldest row has waited ``rescue_flush_reads`` reads (the
+        bucket_flush_reads rule applied to Stream B — bounds the in-order
+        emission lag a pooled window can add), or at final drain."""
+        if not split_ladder:
+            return
+        for bi in range(nb):
+            stale = (r_first_seen[bi] is not None
+                     and stats.n_reads - r_first_seen[bi] >= cfg.rescue_flush_reads)
+            while r_nrows[bi] >= cfg.batch_size or ((final or stale)
+                                                    and r_nrows[bi] > 0):
+                reason = ("full" if r_nrows[bi] >= cfg.batch_size
+                          else ("final" if final else "lag"))
+                stale = False
+                take = min(cfg.batch_size, r_nrows[bi])
+                seqs, lens, nsg, rid, widx = _pop_rows(
+                    (r_seqs, r_lens, r_nsegs, r_rid, r_widx),
+                    r_nrows, r_first_seen, bi, take)
+                batch = WindowBatch(seqs=seqs[:take], lens=lens[:take],
+                                    nsegs=nsg[:take], shape=shapes[bi],
+                                    read_ids=rid[:take],
+                                    wstarts=widx[:take].astype(np.int64) * adv,
+                                    stream="rescue")
+                batch = pad_batch(batch, cfg.batch_size)
+                stats.pad_cells += batch.seqs.size
+                stats.used_cells += int(batch.lens.sum())
+                handle = dispatch_fn(batch)
+                stats.n_dispatch_rescue += 1
+                stats.n_rescue_windows += take
+                stats.rescue_slots_executed += batch.size
+                stats.rescue_dispatches.append(
+                    {"rows": take, "slots": int(batch.size), "reason": reason})
+                ev_log.log("ladder.flush", rows=take, slots=int(batch.size),
+                           reason=reason, bucket=bi)
+                rows_ctx = (batch.seqs, batch.lens, batch.nsegs)
+                inflight.append((handle, rid, widx, take, time.time(),
+                                 rows_ctx, bi, "rescue"))
+                if len(inflight) >= cfg.max_inflight:
+                    drain(cfg.max_inflight // 2)
 
     def run_batches(final: bool):
         for bi in range(nb):
@@ -1057,26 +1270,13 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             while nrows[bi] >= cfg.batch_size or ((final or stale) and nrows[bi] > 0):
                 stale = False
                 take = min(cfg.batch_size, nrows[bi])
-                bs, bl, bn = blk_seqs[bi], blk_lens[bi], blk_nsegs[bi]
-                br, bw = blk_rid[bi], blk_widx[bi]
-                seqs = np.concatenate(bs) if len(bs) > 1 else bs[0]
-                lens = np.concatenate(bl) if len(bl) > 1 else bl[0]
-                nsg = np.concatenate(bn) if len(bn) > 1 else bn[0]
-                rid = np.concatenate(br) if len(br) > 1 else br[0]
-                widx = np.concatenate(bw) if len(bw) > 1 else bw[0]
-                bs.clear(); bl.clear(); bn.clear(); br.clear(); bw.clear()
-                if len(nsg) > take:
-                    bs.append(seqs[take:]); bl.append(lens[take:])
-                    bn.append(nsg[take:]); br.append(rid[take:])
-                    bw.append(widx[take:])
-                nrows[bi] = len(nsg) - take
-                # leftover rows keep the pre-dispatch stamp (conservative: may
-                # flush early, never lets a row wait past bucket_flush_reads)
-                if not nrows[bi]:
-                    first_seen[bi] = None
+                seqs, lens, nsg, rid, widx = _pop_rows(
+                    (blk_seqs, blk_lens, blk_nsegs, blk_rid, blk_widx),
+                    nrows, first_seen, bi, take)
                 batch = WindowBatch(seqs=seqs[:take], lens=lens[:take], nsegs=nsg[:take],
                                     shape=shapes[bi], read_ids=rid[:take],
-                                    wstarts=widx[:take].astype(np.int64) * adv)
+                                    wstarts=widx[:take].astype(np.int64) * adv,
+                                    stream="tier0" if split_ladder else "full")
                 if not native_dispatch:
                     # padding exists only for jit static shapes; the native
                     # engine iterates real rows and would just walk PAD
@@ -1084,18 +1284,29 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 stats.pad_cells += batch.seqs.size
                 stats.used_cells += int(batch.lens.sum())
                 handle = dispatch_fn(batch)
-                # hp rescue reconstructs segments from the dispatched rows, so
-                # keep them alive until the fetch (a few MB per in-flight batch)
-                hp_ctx = ((batch.seqs, batch.lens, batch.nsegs)
-                          if hp_ols is not None else None)
-                inflight.append((handle, rid, widx, take, time.time(), hp_ctx))
+                if split_ladder:
+                    stats.n_dispatch_tier0 += 1
+                # hp rescue reconstructs segments, and the split ladder pools
+                # rescue rows, from the dispatched arrays — keep them alive
+                # until the fetch (the supervisor's replay handles retain the
+                # whole batch anyway)
+                rows_ctx = (batch.seqs, batch.lens, batch.nsegs)
+                inflight.append((handle, rid, widx, take, time.time(),
+                                 rows_ctx, bi, batch.stream))
                 # let the in-flight window FILL, then drain half of it in one
                 # grouped fetch — steady state pays one tunnel RTT per
                 # max_inflight/2 batches instead of one per batch
                 if len(inflight) >= cfg.max_inflight:
                     drain(cfg.max_inflight // 2)
+        flush_rescues(final)
         if final:
             drain(0)
+            # draining Stream A pools fresh rescue rows; alternate flush and
+            # drain until both are empty (Stream B results never pool, so
+            # this terminates after at most one extra round)
+            while inflight or (split_ladder and any(r_nrows)):
+                flush_rescues(True)
+                drain(0)
 
     qvr = load_qv_ranker(db, las, cfg)
     stats.qv_ranked = qvr is not None
@@ -1258,6 +1469,12 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             ingest_issues=stats.n_ingest_issues,
             pad_waste=round(stats.pad_waste, 4), wall_s=round(stats.wall_s, 3),
             tiers=stats.tier_histogram, native=stats.native_host,
+            # two-stream ladder decision counters (ISSUE 4): fused-vs-split
+            # rescue tail cost is measurable from these with no chip
+            ladder=cfg.ladder_mode,
+            rescue_slots=stats.rescue_slots_executed,
+            rescue_windows=stats.n_rescue_windows,
+            rescue_density=round(stats.rescue_density, 4),
             # north-star counters (BASELINE.json metric; SURVEY.md §5 metrics)
             bases_per_sec=round(stats.bases_per_sec(), 1),
             degraded=stats.degraded,

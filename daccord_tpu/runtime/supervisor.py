@@ -34,7 +34,13 @@ retained batch) and LOST.
 C++ ladder in production — oracle parity; or the same CPU-routed JAX ladder
 for exact-byte arms) and re-solves every in-flight batch on it. Dispatch
 handles retain their ``WindowBatch`` precisely so this replay is possible —
-no window is dropped or duplicated. With ``failback`` enabled a background
+no window is dropped or duplicated. Under the two-stream ladder
+(``--ladder split``) BOTH streams' in-flight batches replay this way: a
+Stream B rescue batch replays to its exact result (the fallback IS a full
+ladder), and a Stream A tier0 batch replays to full-ladder results — which
+composes byte-identically, because the pipeline's pool rule
+(``kernels.tiers.rescue_candidates``) re-solves every still-pooled window
+to the same per-window bytes while already-final windows scatter directly. With ``failback`` enabled a background
 re-probe can route new dispatches back to the revived primary.
 
 Every transition emits a structured event through ``utils.obs.JsonlLogger``
@@ -296,7 +302,16 @@ class DeviceSupervisor:
         if seqs is None:
             return self._fp_prefix + "opaque"
         b, d, l = seqs.shape
-        return f"{self._fp_prefix}B{b}xD{d}xL{l}"
+        key = f"{self._fp_prefix}B{b}xD{d}xL{l}"
+        # the two-stream ladder dispatches TWO distinct programs at the same
+        # batch shape: tier0-only (Stream A, cheap compile) and the full
+        # rescue ladder (Stream B — same program as a fused dispatch, so
+        # "rescue"/"full" share a fingerprint). Without the suffix the first
+        # program's warm fingerprint would rob the second cold compile of
+        # its long deadline and heartbeats.
+        if getattr(batch, "stream", "full") == "tier0":
+            key += ":t0"
+        return key
 
     def _is_fresh(self, key: str) -> bool:
         """Cold-compile classification: not yet dispatched this process AND

@@ -39,6 +39,10 @@ EVENT_FIELDS: dict[str, dict] = {
     "sup_failback": {"ts": _NUM},
     "sup_done": {"state": str, "degraded": bool},
     "batch": {"windows": int, "solved": int},
+    # two-stream tier ladder (ISSUE 4): one row per Stream B rescue dispatch
+    # (rows = live rescue windows, slots = padded batch width, reason =
+    # full | lag | final)
+    "ladder.flush": {"rows": int, "slots": int, "reason": str},
     "shard_done": {"reads": int, "windows": int, "solved": int,
                    "wall_s": _NUM, "degraded": bool},
     # ingest integrity layer (formats/ingest.py, ISSUE 2)
@@ -63,6 +67,9 @@ EVENT_FIELDS: dict[str, dict] = {
     "fleet.finish": {"done": int, "poison": int, "wall_s": _NUM},
     "bench_start": {"batch": int},
     "bench_compile": {"batch": int, "cached": bool, "expected_wall_s": _NUM},
+    # self-staging bench ladder: one row per completed rung (sidecar
+    # committed the moment the rung lands — see bench.py ladder mode)
+    "bench_rung": {"batch": int, "bases_per_sec": _NUM, "fallback": bool},
     "bench_drain": {"fetched": int, "inflight": int},
     "bench_done": {"wall_s": _NUM},
 }

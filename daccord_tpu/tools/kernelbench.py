@@ -1,9 +1,13 @@
 """Kernel-stage breakdown bench: where does a ladder batch spend its time?
 
-Usage: ``python -m daccord_tpu.tools.kernelbench [--batch 1024] [--reps 4]``
-Prints one JSON line per timing (full ladder, tier0, and cumulative stage
-prefixes of the window kernel), so kernel optimizations can be attributed to
-stages. Uses the same cached window set as bench.py.
+Usage: ``python -m daccord_tpu.tools.kernelbench [--batch 1024] [--reps 4]
+[--stages ladder_full,ladder_split]``
+Prints one JSON line per timing (full ladder, two-stream split ladder, tier0,
+and cumulative stage prefixes of the window kernel), so kernel optimizations
+can be attributed to stages. ``--stages ladder_full,ladder_split``
+additionally emits the fused-vs-split decision row (ISSUE 4: does paying the
+rescue tiers only over dense pooled batches beat the fused single-dispatch
+program?). Uses the same cached window set as bench.py.
 
 Not run by the driver (bench.py remains the single-line round artifact).
 """
@@ -15,13 +19,25 @@ import functools
 import json
 import time
 
+#: stages in run order; --stages picks a comma-separated subset
+STAGES = ("ladder_full", "ladder_pallas", "ladder_split", "tier0", "prefixes")
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--batch", type=int, default=1024)
     p.add_argument("--reps", type=int, default=4)
     p.add_argument("--backend", choices=("auto", "cpu"), default="auto")
+    p.add_argument("--stages", default=",".join(STAGES), metavar="LIST",
+                   help="comma-separated subset of: " + ", ".join(STAGES)
+                        + " (ladder_pallas is TPU-only and auto-skipped "
+                          "elsewhere)")
     args = p.parse_args(argv)
+    stages = [s.strip() for s in args.stages.split(",") if s.strip()]
+    bad = [s for s in stages if s not in STAGES]
+    if bad:
+        raise SystemExit(f"kernelbench: unknown stage(s) {bad}; "
+                         f"known: {', '.join(STAGES)}")
 
     import os
     import sys
@@ -42,7 +58,11 @@ def main(argv=None) -> int:
     enable_compilation_cache()
     import jax.numpy as jnp
     import numpy as np
-    from daccord_tpu.kernels.tiers import TierLadder, fetch, solve_ladder_async
+    from daccord_tpu.kernels.tiers import (TierLadder, fetch,
+                                           rescue_candidates,
+                                           solve_ladder_async,
+                                           solve_ladder_split,
+                                           solve_tier0_async)
     from daccord_tpu.kernels.window_kernel import _solve_one
     from daccord_tpu.oracle.consensus import ConsensusConfig
     from daccord_tpu.oracle.profile import ErrorProfile
@@ -57,81 +77,122 @@ def main(argv=None) -> int:
     p0 = ladder.params[0]
     ol = ladder.tables[p0.k]
 
-    def timed(label, fn, *a):
+    def timed(label, fn, *a, extra=None):
         out = fn(*a)
         jax.block_until_ready(out)
         t0 = time.perf_counter()
         for _ in range(args.reps):
             jax.block_until_ready(fn(*a))
         ms = (time.perf_counter() - t0) / args.reps * 1e3
-        print(json.dumps({"stage": label, "ms_per_batch": round(ms, 2),
-                          "batch": B, "device": str(jax.devices()[0]).replace(" ", "")}))
+        line = {"stage": label, "ms_per_batch": round(ms, 2), "batch": B,
+                "device": str(jax.devices()[0]).replace(" ", "")}
+        if extra:
+            line.update(extra)
+        print(json.dumps(line))
         return ms
 
-    # full ladder (what the pipeline dispatches)
+    # full ladder (what the fused pipeline dispatches)
     from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch
     shape = BatchShape(depth=seqs.shape[1], seg_len=seqs.shape[2], wlen=p0.wlen)
     wb = WindowBatch(seqs=data["seqs"][:B], lens=data["lens"][:B],
                      nsegs=data["nsegs"][:B], shape=shape,
                      read_ids=np.zeros(B, np.int64), wstarts=np.zeros(B, np.int64))
-    timed("ladder_full", lambda: fetch(solve_ladder_async(wb, ladder)))
+    ms_full = None
+    if "ladder_full" in stages:
+        ms_full = timed("ladder_full",
+                        lambda: fetch(solve_ladder_async(wb, ladder)))
 
     # full ladder with the fused Pallas kernel (DP+selection+backtrack in one
     # pallas_call, pallas_window.py) — the on-chip fused-vs-scan decision row
     # (VERDICT r3 item 4); interpret mode off-TPU is parity-only, not a perf
     # signal, so the arm is TPU-gated
-    if jax.default_backend() == "tpu":
+    if "ladder_pallas" in stages and jax.default_backend() == "tpu":
         timed("ladder_pallas",
               lambda: fetch(solve_ladder_async(wb, ladder, use_pallas=True)))
 
-    # tier0 alone
-    f_t0 = jax.jit(jax.vmap(functools.partial(_solve_one, p=p0),
-                            in_axes=(0, 0, 0, None)))
-    timed("tier0", f_t0, seqs, lens, nsegs, ol)
+    if "ladder_split" in stages:
+        # two-stream ladder (ISSUE 4): tier0 over the full batch + the full
+        # rescue ladder over the compacted candidates only. The rescue
+        # sub-batch shape is fixed ONCE (candidate count rounded up to a
+        # power of two) so the timed loop re-runs one compiled program pair
+        # rather than compiling per candidate count.
+        out0 = fetch(solve_tier0_async(wb, ladder))
+        n_resc = int(np.sum(rescue_candidates(out0, wb.nsegs, ladder)))
+        rb = 1
+        while rb < max(n_resc, 1):
+            rb *= 2
+        rb = min(rb, B)
+        ms_split = timed(
+            "ladder_split",
+            lambda: solve_ladder_split(wb, ladder, rescue_batch=rb),
+            extra={"rescue_rows": n_resc, "rescue_batch": rb,
+                   "rescue_fraction": round(n_resc / B, 4)})
+        if ms_full is not None:
+            # the decision row: fused vs two-stream on identical inputs.
+            # split_speedup > 1 means Stream A + dense Stream B beat the
+            # fused program; on a tunneled chip weigh the extra dispatch
+            # RTT (split pays two fetches per rescue-bearing batch here,
+            # while the production pipeline amortizes Stream B across many
+            # Stream A batches — this row is the kernel-cost bound)
+            print(json.dumps({
+                "stage": "decision:ladder_split", "batch": B,
+                "fused_ms": round(ms_full, 2), "split_ms": round(ms_split, 2),
+                "split_speedup": round(ms_full / ms_split, 3) if ms_split else None,
+                "rescue_rows": n_resc,
+                "rescue_fraction": round(n_resc / B, 4),
+                "device": str(jax.devices()[0]).replace(" ", "")}))
 
-    # cumulative stage prefixes of the tier0 kernel (deltas attribute time to
-    # each stage; the final prefix differs from tier0 only by fusion effects)
-    from daccord_tpu.kernels.window_kernel import _kmer_ids
+    if "tier0" in stages:
+        # tier0 alone
+        f_t0 = jax.jit(jax.vmap(functools.partial(_solve_one, p=p0),
+                                in_axes=(0, 0, 0, None)))
+        timed("tier0", f_t0, seqs, lens, nsegs, ol)
 
-    k, M = p0.k, p0.max_kmers
-    SENT = jnp.int32(4 ** k)
-    P, O = ol.shape
+    if "prefixes" in stages:
+        # cumulative stage prefixes of the tier0 kernel (deltas attribute
+        # time to each stage; the final prefix differs from tier0 only by
+        # fusion effects)
+        from daccord_tpu.kernels.window_kernel import _kmer_ids
 
-    def stage_counts(seqs, lens, nsegs):
-        ids = _kmer_ids(seqs, lens, k)
-        flat = ids.reshape(-1)
-        N = flat.shape[0]
-        si = jnp.sort(flat)
-        newrun = jnp.concatenate([jnp.array([True]), si[1:] != si[:-1]])
-        is_start = newrun & (si < SENT)
-        ar_n = jnp.arange(N, dtype=jnp.int32)
-        starts = jnp.where(newrun, ar_n, jnp.int32(N))
-        nxt = jnp.concatenate([starts[1:], jnp.array([N], jnp.int32)])
-        nxt = jax.lax.associative_scan(jnp.minimum, nxt, reverse=True)
-        sc = jnp.where(is_start, nxt - ar_n, 0)
-        thresh = jnp.maximum(jnp.int32(p0.min_count),
-                             jnp.ceil(p0.count_frac * nsegs).astype(jnp.int32))
-        sc = jnp.where(sc >= thresh, sc, 0)
-        topv, topi = jax.lax.top_k(sc, M)
-        sel = jnp.sort(jnp.where(topv > 0, si[topi], SENT))
-        return ids, sel
+        k, M = p0.k, p0.max_kmers
+        SENT = jnp.int32(4 ** k)
+        P, O = ol.shape
 
-    def stage_eq(seqs, lens, nsegs):
-        ids, sel = stage_counts(seqs, lens, nsegs)
-        npos = ids.shape[1]
-        eq = (ids[:, :, None] == sel[None, None, :]) & (ids < SENT)[:, :, None]
-        occ_pos = jnp.sum(eq, axis=0).astype(jnp.float32)
-        o_idx = jnp.minimum(jnp.arange(npos), O - 1)
-        occ = jax.ops.segment_sum(occ_pos, o_idx, num_segments=O).T
-        eqh = eq.astype(jnp.bfloat16)
-        support = jnp.einsum("diu,div->uv", eqh[:, :-1, :], eqh[:, 1:, :],
-                             preferred_element_type=jnp.float32)
-        return occ @ ol.T, support, sel
+        def stage_counts(seqs, lens, nsegs):
+            ids = _kmer_ids(seqs, lens, k)
+            flat = ids.reshape(-1)
+            N = flat.shape[0]
+            si = jnp.sort(flat)
+            newrun = jnp.concatenate([jnp.array([True]), si[1:] != si[:-1]])
+            is_start = newrun & (si < SENT)
+            ar_n = jnp.arange(N, dtype=jnp.int32)
+            starts = jnp.where(newrun, ar_n, jnp.int32(N))
+            nxt = jnp.concatenate([starts[1:], jnp.array([N], jnp.int32)])
+            nxt = jax.lax.associative_scan(jnp.minimum, nxt, reverse=True)
+            sc = jnp.where(is_start, nxt - ar_n, 0)
+            thresh = jnp.maximum(jnp.int32(p0.min_count),
+                                 jnp.ceil(p0.count_frac * nsegs).astype(jnp.int32))
+            sc = jnp.where(sc >= thresh, sc, 0)
+            topv, topi = jax.lax.top_k(sc, M)
+            sel = jnp.sort(jnp.where(topv > 0, si[topi], SENT))
+            return ids, sel
 
-    for label, fn in (("prefix:counts+topk", stage_counts),
-                      ("prefix:+eq/occ/einsum", stage_eq)):
-        f = jax.jit(jax.vmap(fn, in_axes=(0, 0, 0)))
-        timed(label, f, seqs, lens, nsegs)
+        def stage_eq(seqs, lens, nsegs):
+            ids, sel = stage_counts(seqs, lens, nsegs)
+            npos = ids.shape[1]
+            eq = (ids[:, :, None] == sel[None, None, :]) & (ids < SENT)[:, :, None]
+            occ_pos = jnp.sum(eq, axis=0).astype(jnp.float32)
+            o_idx = jnp.minimum(jnp.arange(npos), O - 1)
+            occ = jax.ops.segment_sum(occ_pos, o_idx, num_segments=O).T
+            eqh = eq.astype(jnp.bfloat16)
+            support = jnp.einsum("diu,div->uv", eqh[:, :-1, :], eqh[:, 1:, :],
+                                 preferred_element_type=jnp.float32)
+            return occ @ ol.T, support, sel
+
+        for label, fn in (("prefix:counts+topk", stage_counts),
+                          ("prefix:+eq/occ/einsum", stage_eq)):
+            f = jax.jit(jax.vmap(fn, in_axes=(0, 0, 0)))
+            timed(label, f, seqs, lens, nsegs)
     return 0
 
 
