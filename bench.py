@@ -533,6 +533,34 @@ def _commit_sidecar(path: str, payload: dict) -> None:
     durable_write(path, lambda fh: json.dump(payload, fh), mode="wt")
 
 
+def _memory_telemetry() -> dict:
+    """Peak-memory provenance for a bench sidecar (ISSUE 5): device
+    ``memory_stats()`` peak bytes when the backend exposes it (TPU does;
+    CPU returns None) and the host's peak RSS. Committed per rung, the
+    B->HBM curve rides alongside the B->wall curve — the max-safe-B decision
+    row then needs no second chip window."""
+    out: dict = {"device_peak_bytes": None, "host_peak_rss_mb": None}
+    try:
+        import resource
+
+        # ru_maxrss is KB on Linux (the only platform this repo targets)
+        out["host_peak_rss_mb"] = round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)
+    except Exception:
+        pass
+    try:
+        import jax
+
+        ms = jax.devices()[0].memory_stats()
+        if ms:
+            peak = ms.get("peak_bytes_in_use", ms.get("bytes_in_use"))
+            # 0 is a real reading; only a missing stat means "unavailable"
+            out["device_peak_bytes"] = int(peak) if peak is not None else None
+    except Exception:
+        pass
+    return out
+
+
 def _measure_device(data: dict, ev, batch: int,
                     max_batches: int | None = None) -> tuple[float, dict]:
     """Pipelined throughput + compute ceiling + efficiency ratio at one
@@ -547,6 +575,9 @@ def _measure_device(data: dict, ev, batch: int,
     info.update(comp_info)
     info["pipeline_efficiency"] = (round(dev_bps / comp_bps, 3)
                                    if comp_bps else None)
+    # peak-memory telemetry AFTER both passes: the rung's sidecar commits
+    # the B->HBM point next to its B->wall point
+    info.update(_memory_telemetry())
     return dev_bps, info
 
 

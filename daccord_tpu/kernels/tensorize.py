@@ -80,6 +80,21 @@ def tensorize_windows(items: list[tuple[int, WindowSegments]],
                        read_ids=read_ids, wstarts=wstarts)
 
 
+def slice_batch(batch: WindowBatch, lo: int, hi: int) -> WindowBatch:
+    """Row slice [lo, hi) of a batch — views, no copies; only the per-row
+    arrays are replaced, so shape/stream (and any future non-row field)
+    carry over untouched — a bisected Stream B rescue batch must keep
+    routing to the rescue program. The capacity governor's bisect rung is
+    this plus :func:`pad_batch`: by per-window independence the re-batched
+    windows solve to identical bytes at any width."""
+    import dataclasses
+
+    return dataclasses.replace(
+        batch, seqs=batch.seqs[lo:hi], lens=batch.lens[lo:hi],
+        nsegs=batch.nsegs[lo:hi], read_ids=batch.read_ids[lo:hi],
+        wstarts=batch.wstarts[lo:hi])
+
+
 def pad_batch(batch: WindowBatch, target: int) -> WindowBatch:
     """Pad a batch to ``target`` windows (static batch shapes for jit)."""
     B = batch.size

@@ -46,13 +46,24 @@ the lagging worker is killed and the shard requeued immediately — safe
 because the checkpointed commit makes re-execution lossless, and strictly
 serialized per shard so two workers never append to one FASTA.
 
+**Capacity awareness** (ISSUE 5). A worker that exits 137 / SIGKILL without
+a watchdog kill of our own is the kernel OOM-killer's work, not a crash and
+not poison: the shard is requeued ONCE at a reduced batch (threaded through
+the worker's ``-b``), logged as ``fleet.capacity``; the checkpointed resume
+keeps the merged output byte-identical. A second OOM at the reduced batch
+falls through to the normal failure ladder. Shards whose workers ratcheted
+their dispatch width (capacity governor, ``runtime/governor.py``) commit
+``batch_effective``/``governor`` manifest state and pass the merge gate
+WITHOUT ``--allow-degraded`` — capacity degrades speed, never bytes.
+
 Fault injection (``runtime/faults.py``): ``worker_crash:N`` sends the Nth
 spawned worker a mid-shard ``crash`` spec, ``worker_hang:N`` replaces the Nth
-spawn with a progress-free sleeper, ``lease_stall`` stops heartbeating the
-Nth claimed lease (backdated so the takeover fires without waiting out the
+spawn with a progress-free sleeper, ``worker_oom:N`` replaces it with an
+exit-137 OOM-kill stand-in, ``lease_stall`` stops heartbeating the Nth
+claimed lease (backdated so the takeover fires without waiting out the
 TTL) — the whole matrix runs on CPU in CI. Events (``fleet.*``: spawn,
-heartbeat, takeover, retry, poison, speculate, done) are schema-linted by
-``eventcheck``.
+heartbeat, takeover, retry, capacity, poison, speculate, done) are
+schema-linted by ``eventcheck``.
 """
 
 from __future__ import annotations
@@ -63,6 +74,7 @@ import random
 import socket
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass
 
@@ -213,6 +225,8 @@ class FleetConfig:
     checkpoint_every: int = 16        # >0: progress manifests drive hang
                                       # detection and lossless requeue
     ingest_policy: str = "strict"
+    max_pile_overlaps: int | None = None  # monster-pile budget (None = the
+                                          # pipeline default; 0 disables)
 
 
 @dataclass
@@ -231,6 +245,11 @@ class _Shard:
     speculated: bool = False
     manifest: dict | None = None
     poison_reason: str | None = None
+    # capacity awareness (ISSUE 5): a worker the kernel OOM-killed is a
+    # resource-fit problem, not a poison input — requeued ONCE at a reduced
+    # batch (threaded to the worker) before the normal failure ladder applies
+    oom_requeued: bool = False
+    batch_override: int | None = None
 
 
 def _stderr_tail(path: str | None) -> str:
@@ -259,6 +278,26 @@ class Fleet:
         self.shards = {s: _Shard(s) for s in range(cfg.nshards)}
         self.poison: list[dict] = []
         self._t0 = time.time()
+        # pre-resolve the auto-backend batch off the heartbeat path: the
+        # capacity requeue needs it, and resolving lazily would block the
+        # single-threaded fleet loop on the bounded backend probe (up to
+        # DACCORD_PROBE_TIMEOUT_S) — long enough to stale every lease this
+        # host holds and hand its healthy shards to other hosts
+        self._auto_batch: int | None = None
+        self._auto_batch_thread: threading.Thread | None = None
+        if cfg.backend == "auto" and not cfg.batch:
+            self._auto_batch_thread = threading.Thread(
+                target=self._resolve_auto_batch, daemon=True)
+            self._auto_batch_thread.start()
+
+    def _resolve_auto_batch(self) -> None:
+        from ..utils.obs import auto_batch_size, resolve_auto_backend
+
+        try:
+            backend = resolve_auto_backend()
+        except Exception:
+            backend = "cpu"
+        self._auto_batch = auto_batch_size(backend == "native", backend)
 
     # -- worker process management ------------------------------------------
 
@@ -270,9 +309,36 @@ class Fleet:
                 "--backend", cfg.backend,
                 "--checkpoint-every", str(cfg.checkpoint_every),
                 "--ingest-policy", cfg.ingest_policy]
-        if cfg.batch:
-            argv += ["-b", str(cfg.batch)]
+        if cfg.max_pile_overlaps is not None:
+            argv += ["--max-pile-overlaps", str(cfg.max_pile_overlaps)]
+        # a capacity-requeued shard re-runs at its reduced batch (the env-
+        # derived override threaded through the worker's own -b knob); the
+        # checkpointed resume keeps the output byte-identical regardless —
+        # batch size never reaches the per-window math
+        batch = self.shards[shard].batch_override or cfg.batch
+        if batch:
+            argv += ["-b", str(batch)]
         return argv
+
+    def _worker_batch(self) -> int:
+        """The batch a worker actually runs: cfg.batch when -b was given,
+        else the pipeline's auto-selection for this backend (native 4096;
+        JAX 2048 on TPU, 512 elsewhere). The capacity requeue halves THIS
+        number — halving a hardcoded guess instead would cut an auto-batch
+        native worker 16x, not 2x."""
+        from ..utils.obs import auto_batch_size
+
+        if self.cfg.batch:
+            return self.cfg.batch
+        if self.cfg.backend == "auto":
+            # resolved exactly as the worker CLI will (bounded probe, native
+            # preferred on a dead tunnel) by the thread started at init —
+            # by the time a worker has run long enough to OOM, the probe is
+            # long done and this join is instant
+            if self._auto_batch_thread is not None:
+                self._auto_batch_thread.join()
+            return self._auto_batch or auto_batch_size(False)
+        return auto_batch_size(self.cfg.backend == "native", self.cfg.backend)
 
     def _worker_env(self, sabotage: str | None) -> dict:
         env = dict(os.environ)
@@ -300,6 +366,11 @@ class Fleet:
             # a wedged worker: alive pid, no progress manifest ever — only
             # the stall watchdog can reclaim its slot
             argv = [sys.executable, "-c", "import time; time.sleep(600)"]
+        elif sabotage == "worker_oom":
+            # an OOM-killed worker: the kernel's SIGKILL surfaces as exit
+            # status 137 with no manifest — the capacity-requeue path's
+            # deterministic stand-in
+            argv = [sys.executable, "-c", "import os; os._exit(137)"]
         if sabotage:
             self.log.log("fleet.fault", kind=sabotage, shard=s)
         st.stderr_path = os.path.join(
@@ -343,9 +414,11 @@ class Fleet:
     def _fail(self, st: _Shard, reason: str) -> None:
         cfg = self.cfg
         release_lease(self.outdir, st.shard, host=self.host)
-        if reason == "speculate":
-            # a speculative kill is not a shard failure: requeue immediately,
-            # no backoff, no poison-streak credit (attempts stay bounded)
+        if reason in ("speculate", "capacity"):
+            # not shard failures: a speculative kill is the fleet's own
+            # doing, and an OOM-killed worker is a resource-fit problem the
+            # reduced-batch requeue remedies — neither earns poison-streak
+            # credit (attempts stay bounded either way)
             st.status, st.next_try_t = "pending", 0.0
             self.log.log("fleet.retry", shard=st.shard, attempt=st.attempts,
                          delay_s=0.0, reason=reason)
@@ -394,6 +467,19 @@ class Fleet:
                 st.status = "foreign"
             elif st.kill_reason == "speculate":
                 self._fail(st, "speculate")
+            elif rc in (137, -9) and st.kill_reason is None \
+                    and not st.oom_requeued:
+                # exit 137 / SIGKILL without a watchdog kill of our own: the
+                # kernel OOM-killer (or the injected worker_oom stand-in).
+                # A capacity-degraded exit is NOT a crash: requeue ONCE at a
+                # reduced batch — the checkpointed resume keeps the bytes —
+                # instead of counting it toward poison. A second OOM at the
+                # reduced batch falls through to the normal failure ladder.
+                st.oom_requeued = True
+                st.batch_override = max(16, self._worker_batch() // 2)
+                self.log.log("fleet.capacity", shard=st.shard,
+                             batch=st.batch_override)
+                self._fail(st, "capacity")
             else:
                 reason = st.kill_reason or f"exit:{rc}"
                 if rc == 0:
@@ -563,6 +649,12 @@ class Fleet:
                                         or st.manifest.get("quarantined"))),
                 "attempts": {str(s): st.attempts
                              for s, st in self.shards.items()},
+                # capacity awareness (ISSUE 5): OOM-killed workers requeued
+                # at a reduced batch — enumerated (with the shard manifests'
+                # batch_effective/governor state) so a round report can tell
+                # capacity-degraded speed from degraded output
+                "capacity_requeued": sorted(
+                    s for s, st in self.shards.items() if st.oom_requeued),
             }
             _write_manifest_durable(os.path.join(self.outdir, "fleet.json"),
                                     manifest)

@@ -149,7 +149,14 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
                     # correct output, but the manifest must say so: reruns
                     # and round reports need the degraded runs enumerable
                     "degraded": stats.degraded,
-                    "fallback_reason": stats.fallback_reason}
+                    "fallback_reason": stats.fallback_reason,
+                    # capacity-governor state (ISSUE 5): a ratcheted shard is
+                    # degraded SPEED, not output (byte-identical), so it is
+                    # deliberately NOT `degraded` — the merge gate accepts it
+                    # without --allow-degraded
+                    "batch_effective": stats.batch_effective,
+                    "capacity_events": stats.n_capacity_events,
+                    "governor": stats.governor_ratchet or None}
     else:
         counters = _run_shard_checkpointed(db_path, las_path, paths, start, end,
                                            cfg, checkpoint_every)
@@ -347,6 +354,11 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
         counters["fallback_reason"] = last_st.fallback_reason
         counters["quarantined"] = last_st.n_quarantined
         counters["ingest_issues"] = last_st.n_ingest_issues
+        # capacity-governor state: degraded speed, not output — the merge
+        # gate accepts these without --allow-degraded
+        counters["batch_effective"] = last_st.batch_effective
+        counters["capacity_events"] = last_st.n_capacity_events
+        counters["governor"] = last_st.governor_ratchet or None
     return counters
 
 
@@ -399,6 +411,11 @@ def merge_shards(outdir: str, nshards: int, out_fasta: str,
         if m.get("nshards") not in (None, nshards):
             issues.append(f"shard {s}: manifest was written for a "
                           f"{m.get('nshards')}-way split, merging {nshards}")
+        # capacity-degraded shards (manifest `batch_effective` below the
+        # configured batch / a non-empty `governor` ratchet) pass WITHOUT
+        # --allow-degraded by design: the governor degrades dispatch width,
+        # never bytes — unlike engine failover (`degraded`) or quarantined
+        # piles, whose output genuinely differs from the healthy run
         if m.get("degraded") or m.get("quarantined"):
             degraded.append(s)
         manifests[s] = m

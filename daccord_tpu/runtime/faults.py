@@ -44,14 +44,40 @@ worker processes / lease renewal instead of device ops or artifacts::
     DACCORD_FAULT=worker_crash:2          # 2nd spawned worker dies mid-shard
     DACCORD_FAULT=worker_hang:3           # 3rd spawned worker wedges (no progress)
     DACCORD_FAULT=lease_stall             # 1st claimed lease stops heartbeating
+    DACCORD_FAULT=worker_oom:2            # 2nd spawned worker exits like an
+                                          # OOM-killed process (status 137)
 
-Counter domains: ``worker_crash``/``worker_hang`` count worker spawns
-(fleet-wide, in spawn order), ``lease_stall`` counts successful lease
-claims. The orchestrator consumes them via :meth:`FaultPlan.fleet_spawn` /
-:meth:`FaultPlan.fleet_claim_stall`; worker subprocesses never see the
-fleet kinds (the fleet strips them from the inherited ``DACCORD_FAULT``),
-so a composed spec like ``worker_crash:1,las_bitflip:3`` sends only the
-data kind down to the workers.
+Counter domains: ``worker_crash``/``worker_hang``/``worker_oom`` count
+worker spawns (fleet-wide, in spawn order), ``lease_stall`` counts
+successful lease claims. The orchestrator consumes them via
+:meth:`FaultPlan.fleet_spawn` / :meth:`FaultPlan.fleet_claim_stall`; worker
+subprocesses never see the fleet kinds (the fleet strips them from the
+inherited ``DACCORD_FAULT``), so a composed spec like
+``worker_crash:1,las_bitflip:3`` sends only the data kind down to the
+workers.
+
+Capacity kinds (the memory-exhaustion twins, ISSUE 5) make the capacity
+governor (``runtime/governor.py``) deterministically testable on CPU::
+
+    DACCORD_FAULT=device_oom:3            # 3rd device op: allocator OOM, and
+                                          # a virtual HBM ceiling is set to
+                                          # HALF that op's batch width — every
+                                          # later primary op wider than the
+                                          # ceiling OOMs too, so the governor's
+                                          # bisect walk terminates exactly when
+                                          # the shape genuinely fits
+    DACCORD_FAULT=host_rss:2              # 2nd host-watermark check reports
+                                          # hard memory pressure once
+    DACCORD_FAULT=monster_pile:4          # 4th pile inspected by the monster
+                                          # guard busts the budget once
+
+Counter domains: ``device_oom`` counts device ops (dispatch + fetch, like
+``device_lost``); ``host_rss`` counts watermark checks (one per pile block,
+:meth:`FaultPlan.host_rss_check`); ``monster_pile`` counts piles inspected
+before tensorization (:meth:`FaultPlan.monster_check`). The ceiling left by
+``device_oom`` is deliberately NOT one-shot: re-dispatching the identical
+doomed shape must keep failing (that is the failure mode under test), while
+a bisected one fits.
 """
 
 from __future__ import annotations
@@ -87,6 +113,15 @@ class FaultCompileStall(FaultInjected):
     path; the op then proceeds normally)."""
 
 
+class FaultDeviceOOM(FaultInjected):
+    """Injected capacity fault (allocator OOM / XLA RESOURCE_EXHAUSTED).
+
+    Deterministic — the message carries the RESOURCE_EXHAUSTED marker so the
+    supervisor's classifier treats it exactly like a real XLA capacity
+    abort: no transient retry ladder, straight to the governor's
+    degradation ladder."""
+
+
 class InjectedCrash(BaseException):
     """Test-only hard crash: BaseException so no supervisor/pipeline
     ``except Exception`` can swallow it — it must unwind like a kill."""
@@ -94,13 +129,14 @@ class InjectedCrash(BaseException):
 
 _KINDS = ("fetch_hang", "dispatch_error", "device_lost", "compile_stall",
           "crash", "las_bitflip", "las_truncate", "db_garbage",
-          "worker_crash", "worker_hang", "lease_stall")
+          "worker_crash", "worker_hang", "lease_stall",
+          "device_oom", "host_rss", "monster_pile", "worker_oom")
 
 #: fleet-orchestrator kinds: they sabotage worker spawns / lease renewal at
 #: the fleet layer (parallel/fleet.py) and are stripped from the worker
 #: subprocesses' environment — a worker must never fail to parse the spec
 #: that describes how its own orchestrator is being tested.
-FLEET_KINDS = ("worker_crash", "worker_hang", "lease_stall")
+FLEET_KINDS = ("worker_crash", "worker_hang", "lease_stall", "worker_oom")
 
 #: data-corruption kinds: they corrupt the INPUT ARTIFACTS (deterministically,
 #: keyed by record index N) instead of raising at a device op, exercising the
@@ -121,6 +157,11 @@ class FaultSpec:
 class FaultPlan:
     specs: list = field(default_factory=list)
     device_dead: bool = False
+    # virtual HBM ceiling left by a fired device_oom spec: every later
+    # primary op wider than this raises (None = no ceiling). Not one-shot by
+    # design — the doomed shape must keep failing until it is bisected small
+    # enough, which is exactly the real allocator's behavior.
+    oom_max_width: int | None = None
     # logical-operation counters (advance once per op, not per retry)
     n_dispatch: int = 0
     n_fetch: int = 0
@@ -129,6 +170,9 @@ class FaultPlan:
     # fleet counters (advance once per worker spawn / successful lease claim)
     n_spawn: int = 0
     n_claim: int = 0
+    # capacity counters (advance once per watermark check / inspected pile)
+    n_rss: int = 0
+    n_pile: int = 0
 
     @classmethod
     def parse(cls, text: str) -> "FaultPlan":
@@ -167,11 +211,13 @@ class FaultPlan:
         return None
 
     def op(self, domain: str, compiling: bool = False,
-           degraded: bool = False) -> None:
+           degraded: bool = False, width: int | None = None) -> None:
         """Advance counters for one logical ``dispatch``/``fetch`` op and
         raise the matching injected fault, if any. ``degraded`` ops (already
         failed over; no device involved) only ever raise ``crash`` — the
-        device-fault kinds describe the primary engine."""
+        device-fault kinds describe the primary engine. ``width`` is the
+        op's batch width (rows), consulted by the ``device_oom`` virtual
+        HBM ceiling."""
         if domain == "dispatch":
             self.n_dispatch += 1
         elif domain == "fetch":
@@ -198,6 +244,20 @@ class FaultPlan:
             self.device_dead = True
             _raise(FaultDeviceLost, "device_lost", self.n_device,
                    f"injected device_lost at {domain} #{self.n_device}")
+        if self._take("device_oom", self.n_device) is not None:
+            # the triggering op sets the ceiling to half its own width, so
+            # one bisect step deterministically fits; compose multiple
+            # device_oom specs to force a deeper walk
+            if width:
+                self.oom_max_width = max(1, int(width) // 2)
+            _raise(FaultDeviceOOM, "device_oom", self.n_device,
+                   f"RESOURCE_EXHAUSTED: injected device_oom at {domain} "
+                   f"#{self.n_device} (width {width})")
+        if (self.oom_max_width is not None and width
+                and int(width) > self.oom_max_width):
+            _raise(FaultDeviceOOM, "device_oom", self.n_device,
+                   f"RESOURCE_EXHAUSTED: width {width} exceeds injected "
+                   f"capacity ceiling {self.oom_max_width} at {domain}")
         if domain == "fetch" and self._take("fetch_hang",
                                             self.n_fetch) is not None:
             _raise(FaultHang, "fetch_hang", self.n_fetch,
@@ -219,7 +279,7 @@ class FaultPlan:
         shard is a NEW spawn, so it runs clean and the retry path is
         exercised, not an infinite crash loop."""
         self.n_spawn += 1
-        for kind in ("worker_crash", "worker_hang"):
+        for kind in ("worker_crash", "worker_hang", "worker_oom"):
             if self._take(kind, self.n_spawn) is not None:
                 return kind
         return None
@@ -230,6 +290,21 @@ class FaultPlan:
         the lease goes stale and any orchestrator may take the shard over)."""
         self.n_claim += 1
         return self._take("lease_stall", self.n_claim) is not None
+
+    def host_rss_check(self) -> bool:
+        """Advance the host-watermark counter (the pipeline checks once per
+        pile block); True when this check must report hard memory pressure
+        (``host_rss:N`` — exercises the backpressure flush without actually
+        ballooning the test process)."""
+        self.n_rss += 1
+        return self._take("host_rss", self.n_rss) is not None
+
+    def monster_check(self) -> bool:
+        """Advance the inspected-pile counter (the monster guard runs once
+        per pile, BEFORE the quadratic windowing spend); True when this pile
+        must bust the budget (``monster_pile:N``)."""
+        self.n_pile += 1
+        return self._take("monster_pile", self.n_pile) is not None
 
     def probe_override(self) -> bool | None:
         """False once device_lost fired (probe must agree the chip is dead);

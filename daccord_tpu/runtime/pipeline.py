@@ -196,6 +196,15 @@ class PipelineConfig:
                                  # created lazily, only when something
                                  # quarantines); launch.py and the CLI
                                  # default it next to the output
+    max_pile_overlaps: int = 100_000     # monster-pile guard (ISSUE 5): a
+                                 # pile holding more overlaps than this is
+                                 # contained through the quarantine machinery
+                                 # (read emitted uncorrected) BEFORE the
+                                 # quadratic windowing/realignment spend can
+                                 # OOM-kill the worker. Production piles run
+                                 # ~2x coverage; only ultra-deep repeat piles
+                                 # approach this. 0 disables the budget (the
+                                 # injected monster_pile fault still fires)
     verbose: bool = False
 
 
@@ -241,6 +250,24 @@ class PipelineStats:
     degraded: bool = False       # supervisor failed over mid-run (the shard
                                  # completed on the fallback engine)
     fallback_reason: str | None = None
+    # capacity governor (ISSUE 5). Capacity degradation is degraded SPEED,
+    # not degraded OUTPUT (byte-identical by per-window independence), so it
+    # is deliberately NOT folded into `degraded` — the merge gate accepts
+    # capacity-degraded shards without --allow-degraded.
+    n_capacity_events: int = 0   # capacity-classified device ops (governor
+                                 # ladder engagements)
+    n_backpressure: int = 0      # host-watermark force-flushes
+    n_monster_piles: int = 0     # piles contained by the monster guard
+                                 # (subset of n_quarantined)
+    batch_effective: int | None = None   # dispatch width the shard ran at:
+                                 # the smallest ratcheted width when the
+                                 # governor engaged, else the configured
+                                 # batch (None = unsupervised run; compare
+                                 # against governor_ratchet to tell
+                                 # configured from ratcheted)
+    governor_ratchet: dict = field(default_factory=dict)
+                                 # shape fingerprint -> ratcheted width,
+                                 # entries touched this run (manifest state)
     pad_cells: int = 0
     used_cells: int = 0
     wall_s: float = 0.0
@@ -506,9 +533,23 @@ def _window_one_pile(db: DazzDB, col, cfg: PipelineConfig, aread: int, s: int, e
     return aread, a, seqs, lens, nsegs
 
 
+def _monster_marker(aread: int, n_overlaps: int):
+    """Quarantine-style block marker for a budget-busting pile: rides the
+    same byte-ordered containment path the ingest layer uses (read emitted
+    UNCORRECTED, sidecar row, n_quarantined), so a monster pile degrades one
+    read instead of OOM-killing the worker."""
+    return ("quarantine", int(aread), -1, "monster_pile",
+            f"pile busts the capacity budget ({n_overlaps} overlaps)")
+
+
 def _iter_pile_blocks(db: DazzDB, las: LasFile, cfg: PipelineConfig,
-                      start, end, native_ok: bool, qvr: QvRanker | None = None):
-    """Yield (aread, a_bases, seqs [nwin,D,L], lens [nwin,D], nsegs [nwin])."""
+                      start, end, native_ok: bool, qvr: QvRanker | None = None,
+                      monster=None):
+    """Yield (aread, a_bases, seqs [nwin,D,L], lens [nwin,D], nsegs [nwin]).
+
+    ``monster(aread, n_overlaps) -> bool`` is the capacity governor's
+    monster-pile guard, consulted per pile BEFORE the quadratic windowing/
+    realignment spend; a busted pile yields a quarantine marker instead."""
     w, adv = cfg.consensus.w, cfg.consensus.adv
     D, L = cfg.depth, cfg.seg_len
     if native_ok:
@@ -516,10 +557,16 @@ def _iter_pile_blocks(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
         col = ColumnarLas(las.path, start, end)
         for aread, s, e in col.piles():
+            if monster is not None and monster(aread, e - s):
+                yield _monster_marker(aread, e - s)
+                continue
             yield _window_one_pile(db, col, cfg, aread, s, e, qvr)
     else:
         shape = BatchShape(depth=D, seg_len=L, wlen=w)
         for aread, pile in las.iter_piles(start, end):
+            if monster is not None and monster(aread, len(pile)):
+                yield _monster_marker(aread, len(pile))
+                continue
             a = db.read_bases(aread)
             if cfg.depth_rank and pile:
                 diffs = np.asarray([o.diffs for o in pile])
@@ -542,13 +589,27 @@ def _iter_pile_blocks(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 yield aread, a, np.zeros((0, D, L), np.int8), np.zeros((0, D), np.int32), np.zeros(0, np.int32)
 
 
+class _Ready:
+    """Pre-resolved stand-in for a Future (monster-pile markers interleave
+    with real windowing jobs in input order)."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def result(self):
+        return self.v
+
+
 def _iter_pile_blocks_threaded(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                start, end, nthreads: int,
-                               qvr: QvRanker | None = None):
+                               qvr: QvRanker | None = None, monster=None):
     """Same stream as :func:`_iter_pile_blocks` (native path), but piles are
     windowed by a thread pool with bounded in-order prefetch. Output order —
     and therefore every downstream byte — is identical to the synchronous
-    path; only wall-clock changes."""
+    path; only wall-clock changes. The monster guard runs in the (ordered)
+    submission loop, so its fault counter stays deterministic."""
     from collections import deque
     from concurrent.futures import ThreadPoolExecutor
 
@@ -564,17 +625,23 @@ def _iter_pile_blocks_threaded(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         return _window_one_pile(db, col, cfg, aread, s, e, qvr)
 
     with ThreadPoolExecutor(max_workers=nthreads) as ex:
+        def submit(item):
+            aread, s, e = item
+            if monster is not None and monster(aread, e - s):
+                return _Ready(_monster_marker(aread, e - s))
+            return ex.submit(job, item)
+
         inflight: deque = deque()
         it = iter(piles)
         budget = nthreads + 2
         for item in it:
-            inflight.append(ex.submit(job, item))
+            inflight.append(submit(item))
             if len(inflight) >= budget:
                 break
         while inflight:
             yield inflight.popleft().result()
             for item in it:
-                inflight.append(ex.submit(job, item))
+                inflight.append(submit(item))
                 break
 
 
@@ -637,6 +704,50 @@ def _build_native_fallback(profile: ErrorProfile, cfg: PipelineConfig):
     return solve
 
 
+def _make_clamp_solve(ladder: TierLadder, use_pallas: bool, interp: bool,
+                      esc_clamp: int):
+    """The governor's esc-cap-clamp rung for the JAX ladder paths: the same
+    ladder program with its rescue lanes clamped to ``esc_clamp`` slots (the
+    M=256 quadratic DP over the rescue lanes dominates the program's HBM),
+    plus host-routed completion of any rows the clamp overflowed — the
+    split-ladder argument again, so the rung stays byte-identical to the
+    full program."""
+    import dataclasses
+
+    from ..kernels.tiers import fetch as _fetch
+    from ..kernels.tiers import solve_ladder_async, solve_tiered
+
+    min_depth = ladder.params[0].min_depth
+
+    def clamp_solve(b):
+        out = _fetch(solve_ladder_async(b, ladder,
+                                        esc_cap=min(esc_clamp, b.size),
+                                        use_pallas=use_pallas,
+                                        pallas_interpret=interp))
+        out = {k: (np.array(v) if isinstance(v, np.ndarray) else v)
+               for k, v in out.items()}
+        if int(np.asarray(out.get("esc_overflow", 0))) > 0:
+            # rows past the clamp stayed unsolved on device: complete them
+            # in compact host-routed sub-batches (bounded memory) so the
+            # clamp degrades speed, never bytes
+            need = (~np.asarray(out["solved"])
+                    & (np.asarray(b.nsegs) >= min_depth))
+            idx = np.nonzero(need)[0]
+            if len(idx):
+                sub = dataclasses.replace(
+                    b, seqs=b.seqs[idx], lens=b.lens[idx],
+                    nsegs=b.nsegs[idx], read_ids=b.read_ids[idx],
+                    wstarts=b.wstarts[idx])
+                r = solve_tiered(sub, ladder)
+                for kk in ("cons", "cons_len", "err", "solved", "tier",
+                           "m_ovf"):
+                    out[kk][idx] = r[kk]
+            out["esc_overflow"] = 0
+        return out
+
+    return clamp_solve
+
+
 def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                   start: int | None = None, end: int | None = None,
                   profile: ErrorProfile | None = None,
@@ -656,6 +767,15 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
     log = JsonlLogger(cfg.log_path)
     ev_log = JsonlLogger(cfg.events_path) if cfg.events_path else log
+
+    # ONE fault plan for the whole shard (ISSUE 5): the supervisor consumes
+    # the device kinds, the capacity guards below consume host_rss /
+    # monster_pile — separate counter domains, shared spec state
+    from .faults import FaultPlan
+    from .governor import GovernorConfig, check_host_pressure
+
+    plan = FaultPlan.from_env()
+    gov_cfg = GovernorConfig.from_env()
 
     # ingest integrity gate (formats/ingest.py, ISSUE 2): validate every
     # record header in the byte range BEFORE any fast decoder trusts it.
@@ -689,16 +809,15 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     if cfg.batch_size is None:
         import dataclasses
 
+        from ..utils.obs import auto_batch_size
+
         if cfg.native_solver and solver is None:
-            # no compile cost scales with the batch shape here, and each
-            # solve call pays fixed Python/ctypes overhead — bigger is
-            # strictly better until accumulation latency matters
-            cfg = dataclasses.replace(cfg, batch_size=4096)
+            cfg = dataclasses.replace(cfg, batch_size=auto_batch_size(True))
         else:
             import jax
 
-            cfg = dataclasses.replace(
-                cfg, batch_size=2048 if jax.default_backend() == "tpu" else 512)
+            cfg = dataclasses.replace(cfg, batch_size=auto_batch_size(
+                False, jax.default_backend()))
     if profile is None:
         if report is not None and report.issues:
             # sample only validated-clean piles: index_las rejects the file
@@ -752,6 +871,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     if cfg.ladder_mode == "split" and not split_ladder:
         log.log("info", msg="ladder_mode=split inapplicable here "
                             "(native engine or custom solver); running fused")
+    clamp_solve = None   # governor esc-cap-clamp rung (JAX async ladder only)
     if solver is not None:
         if hasattr(solver, "dispatch") and hasattr(solver, "fetch"):
             # async solver (e.g. the mesh-sharded ladder): pipeline batches
@@ -802,6 +922,8 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     b, ladder, use_pallas=cfg.use_pallas, pallas_interpret=interp))
             fetch_fn = _fetch
             fetch_many_fn = _fetch_many
+            clamp_solve = _make_clamp_solve(ladder, cfg.use_pallas, interp,
+                                            gov_cfg.esc_clamp)
 
     # device supervisor (runtime/supervisor.py): watchdog deadlines with
     # compiling-vs-wedged classification, retry with backoff, and mid-run
@@ -892,8 +1014,9 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             # --failback forces it on; otherwise DACCORD_SUP_FAILBACK decides
             cfg=SupervisorConfig.from_env(
                 **({"failback": True} if cfg.failback else {})),
-            rtt_s=rtt_s, describe=desc, fingerprint_prefix=fp_prefix,
-            inline=inline)
+            faults=plan, rtt_s=rtt_s, describe=desc,
+            fingerprint_prefix=fp_prefix, inline=inline,
+            clamp_solve=clamp_solve, governor_cfg=gov_cfg)
         dispatch_fn, fetch_fn = sup.dispatch, sup.fetch
         if fetch_many_fn is not None:
             fetch_many_fn = sup.fetch_many
@@ -1219,12 +1342,14 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     pool=int(sum(r_nrows)) if split_ladder else 0,
                     inflight=len(inflight), t_turnaround=round(now - t0, 4))
 
-    def flush_rescues(final: bool):
+    def flush_rescues(final: bool, pressure: bool = False):
         """Dispatch Stream B: drain each bucket's rescue pool as DENSE
         full-ladder batches. A pool flushes when it holds a full batch, when
         its oldest row has waited ``rescue_flush_reads`` reads (the
         bucket_flush_reads rule applied to Stream B — bounds the in-order
-        emission lag a pooled window can add), or at final drain."""
+        emission lag a pooled window can add), at final drain, or under a
+        host-watermark force-flush (``pressure`` — its own reason, so flush
+        analyses keyed on 'final' see only the real end-of-shard drain)."""
         if not split_ladder:
             return
         for bi in range(nb):
@@ -1233,7 +1358,8 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             while r_nrows[bi] >= cfg.batch_size or ((final or stale)
                                                     and r_nrows[bi] > 0):
                 reason = ("full" if r_nrows[bi] >= cfg.batch_size
-                          else ("final" if final else "lag"))
+                          else ("pressure" if pressure
+                                else ("final" if final else "lag")))
                 stale = False
                 take = min(cfg.batch_size, r_nrows[bi])
                 seqs, lens, nsg, rid, widx = _pop_rows(
@@ -1261,7 +1387,13 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 if len(inflight) >= cfg.max_inflight:
                     drain(cfg.max_inflight // 2)
 
-    def run_batches(final: bool):
+    def run_batches(final: bool, drain_inflight: bool | None = None,
+                    pressure: bool = False):
+        # drain_inflight=False is the soft-watermark flush: partial buckets
+        # and rescue pools force through the device, but the in-flight
+        # window keeps pipelining (hard pressure drains it too)
+        if drain_inflight is None:
+            drain_inflight = final
         for bi in range(nb):
             # partial flush once the bucket's oldest row has waited too long:
             # bounds the in-order emission lag under bucket skew
@@ -1298,14 +1430,14 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 # max_inflight/2 batches instead of one per batch
                 if len(inflight) >= cfg.max_inflight:
                     drain(cfg.max_inflight // 2)
-        flush_rescues(final)
-        if final:
+        flush_rescues(final, pressure)
+        if drain_inflight:
             drain(0)
             # draining Stream A pools fresh rescue rows; alternate flush and
             # drain until both are empty (Stream B results never pool, so
             # this terminates after at most one extra round)
             while inflight or (split_ladder and any(r_nrows)):
-                flush_rescues(True)
+                flush_rescues(True, pressure)
                 drain(0)
 
     qvr = load_qv_ranker(db, las, cfg)
@@ -1321,11 +1453,28 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
               "unavailable or disabled)", file=sys.stderr)
         log.log("warn", msg="feeder_threads ignored: no native host path")
 
+    def monster_guard(aread, n_overlaps) -> bool:
+        """Capacity governor's monster-pile budget, consulted once per pile
+        BEFORE the quadratic windowing/realignment spend (the memory that
+        actually kills a worker on an ultra-deep repeat pile). True = bust:
+        the pile is contained through the quarantine machinery instead."""
+        injected = plan is not None and plan.monster_check()
+        budget = cfg.max_pile_overlaps
+        if not injected and not (budget and n_overlaps > budget):
+            return False
+        stats.n_monster_piles += 1
+        ev_log.log("governor.monster", aread=int(aread),
+                   overlaps=int(n_overlaps), budget=int(budget or 0),
+                   injected=injected)
+        return True
+
     def _block_iter(s, e):
         if native_ok and cfg.feeder_threads > 0:
             return _iter_pile_blocks_threaded(db, las, cfg, s, e,
-                                              cfg.feeder_threads, qvr)
-        return _iter_pile_blocks(db, las, cfg, s, e, native_ok, qvr)
+                                              cfg.feeder_threads, qvr,
+                                              monster=monster_guard)
+        return _iter_pile_blocks(db, las, cfg, s, e, native_ok, qvr,
+                                 monster=monster_guard)
 
     qfh = None
 
@@ -1359,7 +1508,41 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         blocks = _segmented()
     else:
         blocks = _block_iter(start, end)
+    bp_latched = None
     for blk in blocks:
+        # host watermark (capacity governor, one check per pile block): under
+        # memory pressure the feeder pauses here while the buffered rows —
+        # partial buckets and split-ladder rescue pools (soft), plus the
+        # in-flight window (hard) — force-flush through the device, and
+        # finished reads emit. Frees the pending/ready/pool memory without
+        # changing any window's bytes (flush cadence is not part of the
+        # output contract). Real pressure LATCHES per level: allocators
+        # rarely return freed heap to the OS, so RSS can sit above the
+        # watermark long after a drain — re-arm only once it drops below
+        # rather than collapsing batching on every subsequent pile block.
+        level, rss_mb, injected = check_host_pressure(plan, gov_cfg)
+        if not injected:
+            if level is None:
+                bp_latched = None
+            elif level == "soft" and bp_latched == "hard":
+                # RSS fell back below the hard watermark: renewed growth past
+                # it is new pressure, not retained heap — keep suppressing
+                # soft, but re-arm the hard level so a second crossing flushes
+                bp_latched = "soft"
+                level = None
+            elif bp_latched == level:
+                level = None
+        if level is not None:
+            stats.n_backpressure += 1
+            ev_log.log("governor.backpressure", level=level,
+                       rss_mb=round(rss_mb, 1), injected=injected,
+                       pool=int(sum(r_nrows)) if split_ladder else 0,
+                       inflight=len(inflight))
+            run_batches(final=True, drain_inflight=level == "hard",
+                        pressure=True)
+            yield from emit_ready()
+            if not injected:
+                bp_latched = level
         if blk[0] == "quarantine":
             _, q_aread, q_off, q_kind, q_detail = blk
             stats.n_quarantined += 1
@@ -1454,12 +1637,23 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         yield r, frags, stats
         emit_idx += 1
     stats.wall_s = time.time() - t_start
+    if sup is not None:
+        # governor ladder solves block the host at dispatch time, outside
+        # the drain loop's fetch timer — device time, not feeder time
+        stats.device_s += sup.gov_device_s
     stats.host_s = stats.wall_s - stats.device_s
     if sup is not None:
         stats.degraded = sup.failed_over
         stats.fallback_reason = sup.fail_reason
+        gov = sup.governor
+        stats.n_capacity_events = gov.counters["classify"]
+        stats.governor_ratchet = gov.active_state()
+        stats.batch_effective = (min(stats.governor_ratchet.values())
+                                 if stats.governor_ratchet
+                                 else cfg.batch_size)
         ev_log.log("sup_done", state=sup.state, degraded=sup.failed_over,
-                   **sup.counters)
+                   **sup.counters,
+                   **{f"gov_{k}": v for k, v in gov.counters.items()})
     log.log("shard_done", reads=stats.n_reads, windows=stats.n_windows,
             solved=stats.n_solved, skipped_shallow=stats.n_skipped_shallow,
             topm_overflow=stats.n_topm_overflow,
@@ -1475,6 +1669,11 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             rescue_slots=stats.rescue_slots_executed,
             rescue_windows=stats.n_rescue_windows,
             rescue_density=round(stats.rescue_density, 4),
+            # capacity governor (ISSUE 5): degraded speed, never bytes
+            capacity_events=stats.n_capacity_events,
+            backpressure=stats.n_backpressure,
+            monster_piles=stats.n_monster_piles,
+            batch_effective=stats.batch_effective,
             # north-star counters (BASELINE.json metric; SURVEY.md §5 metrics)
             bases_per_sec=round(stats.bases_per_sec(), 1),
             degraded=stats.degraded,

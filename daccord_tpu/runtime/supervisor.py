@@ -62,8 +62,10 @@ import threading
 import time
 from dataclasses import dataclass
 
-from .faults import (FaultCompileStall, FaultDeviceLost, FaultDispatchError,
-                     FaultHang, FaultPlan)
+from .faults import (FaultCompileStall, FaultDeviceLost, FaultDeviceOOM,
+                     FaultDispatchError, FaultHang, FaultPlan)
+from .governor import (CapacityError, CapacityGovernor, GovernorConfig,
+                       is_capacity_error)
 
 # states (strings, not an enum: they go straight into JSON events)
 HEALTHY = "HEALTHY"
@@ -96,13 +98,7 @@ class WatchdogTimeout(RuntimeError):
     """A guarded op exceeded its deadline."""
 
 
-def _env_float(name: str, default: float) -> float:
-    import os
-
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
+from ..utils.obs import env_float as _env_float
 
 
 @dataclass
@@ -202,15 +198,20 @@ class _Watchdog:
 
 class _SupHandle:
     """In-flight op handle: retains the dispatched batch so a retry can
-    re-dispatch it and a failover can replay it on the degraded engine."""
+    re-dispatch it and a failover can replay it on the degraded engine.
+    ``result`` is set when the batch was already solved synchronously (the
+    governor's degradation ladder solves at dispatch time) — fetch then
+    returns it directly, even after a later failover."""
 
-    __slots__ = ("inner", "batch", "key", "degraded")
+    __slots__ = ("inner", "batch", "key", "degraded", "result")
 
-    def __init__(self, inner, batch, key: str, degraded: bool = False):
+    def __init__(self, inner, batch, key: str, degraded: bool = False,
+                 result=None):
         self.inner = inner
         self.batch = batch
         self.key = key
         self.degraded = degraded
+        self.result = result
 
 
 class DeviceSupervisor:
@@ -224,7 +225,8 @@ class DeviceSupervisor:
                  fallback_factory=None, log=None, cfg: SupervisorConfig | None = None,
                  faults: FaultPlan | None = None, probe_fn=None,
                  rtt_s: float | None = None, describe: str = "",
-                 fingerprint_prefix: str = "", inline: bool = False):
+                 fingerprint_prefix: str = "", inline: bool = False,
+                 clamp_solve=None, governor_cfg: GovernorConfig | None = None):
         import random
 
         from ..utils.obs import NullLogger
@@ -259,6 +261,18 @@ class DeviceSupervisor:
         self.counters = {"dispatch": 0, "fetch": 0, "retries": 0,
                          "timeouts": 0, "probes": 0, "degraded_solves": 0,
                          "heartbeats": 0}
+        # host-blocking wall spent inside governor ladder solves (they run
+        # synchronously at dispatch time, so the pipeline's fetch timer
+        # never sees them) — folded into stats.device_s at shard end
+        self.gov_device_s = 0.0
+        # capacity governor (runtime/governor.py): memory faults walk a
+        # byte-identical degradation ladder instead of the transient retry
+        # ladder; native failover is demoted to its last rung
+        self._clamp_solve = clamp_solve
+        self.governor = CapacityGovernor(
+            self._gov_solve_width, log=self.log,
+            cfg=governor_cfg or GovernorConfig.from_env(),
+            clamp_solve_fn=self._gov_clamp if clamp_solve is not None else None)
         if rtt_s:
             self.op_deadline_s = max(self.cfg.min_op_deadline_s,
                                      rtt_s * self.cfg.rtt_mult)
@@ -329,22 +343,28 @@ class DeviceSupervisor:
 
     # ---- guarded op core -----------------------------------------------
 
-    def _guarded(self, op: str, fn, make_args, key: str, fresh: bool):
+    def _guarded(self, op: str, fn, make_args, key: str, fresh: bool,
+                 width: int | None = None):
         """Run one logical op with deadline classification + retry/probe.
         ``make_args(attempt)`` builds the argument tuple per attempt — a
         retried fetch re-dispatches its retained batch rather than trusting
-        an abandoned/broken handle. Raises :class:`DeviceLostError` when the
-        op cannot be salvaged."""
+        an abandoned/broken handle. ``width`` is the op's batch width,
+        consulted by capacity fault injection and carried on classified
+        capacity errors. Raises :class:`DeviceLostError` when the op cannot
+        be salvaged, :class:`CapacityError` when it is memory-classified
+        (deterministic — the caller routes it to the governor, never the
+        transient retry ladder)."""
         cfg = self.cfg
         injected: BaseException | None = None
         if self.faults is not None:
             try:
-                self.faults.op(op, compiling=fresh)
+                self.faults.op(op, compiling=fresh, width=width)
             except FaultDeviceLost as e:
                 self.log.log("sup_fault", kind=e.kind, op=op, n=e.n)
                 self._transition(SUSPECT, reason=str(e))
                 raise DeviceLostError(str(e)) from e
-            except (FaultHang, FaultDispatchError, FaultCompileStall) as e:
+            except (FaultHang, FaultDispatchError, FaultCompileStall,
+                    FaultDeviceOOM) as e:
                 self.log.log("sup_fault", kind=e.kind, op=op, n=e.n)
                 injected = e
         if fresh:
@@ -363,6 +383,11 @@ class DeviceSupervisor:
                          state=self.state)
 
         attempt = 0
+        # retry budget applies PER CLASS (ISSUE 5 satellite): a run that eats
+        # two timeouts must still have its transient-error budget intact, and
+        # a deterministic class (capacity; the compile-stall misfire already
+        # short-circuits below) must never consume either ladder
+        n_retry = {"timeout": 0, "transient": 0}
         while True:
             attempt += 1
             err: BaseException | None = None
@@ -393,26 +418,40 @@ class DeviceSupervisor:
             except (WatchdogTimeout, FaultHang) as e:
                 self.counters["timeouts"] += 1
                 err = e
+                cls = "timeout"
                 reason = f"{op} timeout: {e}"
             except DeviceLostError:
+                raise
+            except CapacityError:
                 raise
             except FaultDeviceLost as e:
                 self._transition(SUSPECT, reason=str(e))
                 raise DeviceLostError(str(e)) from e
             except Exception as e:  # dead-tunnel RPC errors, XLA aborts, ...
+                if is_capacity_error(e):
+                    # deterministic class: re-dispatching the identical shape
+                    # would OOM identically — no backoff, no probe, no retry
+                    # budget spent; the governor's ladder is the remedy (and
+                    # the chip stays HEALTHY: it is full, not dead)
+                    if self.state in (COMPILING, RETRYING, FAILBACK, SUSPECT):
+                        self._transition(HEALTHY, reason="capacity classified")
+                    raise CapacityError(f"{op}: {e}",
+                                        width=int(width or 0)) from e
                 err = e
+                cls = "transient"
                 reason = f"{op} error: {type(e).__name__}: {e}"
             self._transition(SUSPECT, reason=reason[:200])
             if not self._probe():
                 raise DeviceLostError(reason) from err
-            if attempt > cfg.max_retries:
+            n_retry[cls] += 1
+            if n_retry[cls] > cfg.max_retries:
                 raise DeviceLostError(
-                    f"{op}: {cfg.max_retries} retries exhausted") from err
+                    f"{op}: {cfg.max_retries} {cls} retries exhausted") from err
             delay = min(cfg.backoff_cap_s,
-                        cfg.backoff_base_s * (2 ** (attempt - 1)))
+                        cfg.backoff_base_s * (2 ** (n_retry[cls] - 1)))
             delay *= 1.0 + cfg.jitter * self._rng.random()
             self.counters["retries"] += 1
-            self.log.log("sup_retry", op=op, attempt=attempt,
+            self.log.log("sup_retry", op=op, attempt=attempt, cls=cls,
                          delay_s=round(delay, 3), reason=reason[:200])
             time.sleep(delay)
             self._transition(RETRYING, reason=f"{op} attempt {attempt + 1}")
@@ -481,23 +520,112 @@ class DeviceSupervisor:
         self.log.log("sup_failback", ts=round(now, 3))
         return True
 
+    # ---- capacity governor hooks ---------------------------------------
+
+    @staticmethod
+    def _width_of(batch) -> int | None:
+        w = getattr(batch, "size", None)
+        return int(w) if w is not None else None
+
+    def _gov_solve_width(self, batch):
+        """One guarded dispatch+fetch of ``batch`` at its own (reduced)
+        width — the governor's ladder rung executor. Shapes are keyed
+        normally, so a shrunken width gets real cold-compile classification
+        and records its fingerprint; transient faults still retry; a
+        capacity fault propagates as CapacityError for the governor to
+        shrink further."""
+        key = self._shape_key(batch)
+        w = self._width_of(batch)
+        fresh = self._is_fresh(key)
+        self.counters["dispatch"] += 1
+        inner = self._guarded("dispatch", self._dispatch_fn,
+                              lambda attempt: (batch,), key, fresh, width=w)
+        self._seen_shapes.add(key)
+        if fresh:
+            from ..utils.obs import record_fingerprint
+
+            record_fingerprint(key)
+        h = _SupHandle(inner, batch, key)
+        self.counters["fetch"] += 1
+        return self._guarded("fetch", self._fetch_fn,
+                             lambda attempt: self._refetch_args(h, attempt),
+                             key, fresh=False, width=w)
+
+    def _gov_clamp(self, batch):
+        """The esc-cap-clamp rung: solve on the clamped ladder program. Its
+        effective width for capacity purposes is the clamp itself — the
+        M=256 quadratic rescue DP over the esc_cap lanes dominates the
+        program's memory, not the tier-0 rows."""
+        eff = min(int(self.governor.cfg.esc_clamp),
+                  self._width_of(batch) or self.governor.cfg.esc_clamp)
+        key = self._shape_key(batch) + ":clamp"
+        fresh = self._is_fresh(key)
+        self.counters["dispatch"] += 1
+        out = self._guarded("dispatch", self._clamp_solve,
+                            lambda attempt: (batch,), key, fresh, width=eff)
+        self._seen_shapes.add(key)
+        if fresh:
+            from ..utils.obs import record_fingerprint
+
+            record_fingerprint(key)
+        return out
+
+    def _gov_dispatch(self, batch, key: str, reason: str | None) -> _SupHandle:
+        """Route ``batch`` through the governor's degradation ladder;
+        returns a handle carrying the solved result. A ladder exhausted all
+        the way down demotes to native failover (the last rung); a device
+        loss mid-walk fails over normally."""
+        t0 = time.time()
+        try:
+            out = self.governor.solve(batch, key, reason=reason)
+        except CapacityError as e:
+            # last rung: native failover. Walk the legal state chain — the
+            # device is declared unusable (for this workload), not merely
+            # busy, so SUSPECT precedes LOST exactly like a probe-dead path
+            self._transition(SUSPECT, reason=f"capacity: {e}"[:200])
+            self._engage_fallback(f"capacity ladder exhausted: {e}")
+            return _SupHandle(None, batch, key, degraded=True)
+        except DeviceLostError as e:
+            self._engage_fallback(str(e))
+            return _SupHandle(None, batch, key, degraded=True)
+        finally:
+            if not self._inline:
+                # host-local (inline) engines are host time everywhere —
+                # only a real device/tunnel solve belongs in device_s
+                self.gov_device_s += time.time() - t0
+        return _SupHandle(None, batch, key, result=out)
+
     # ---- solver interface ----------------------------------------------
 
     def dispatch(self, batch) -> _SupHandle:
-        self.counters["dispatch"] += 1
         key = self._shape_key(batch)
         if self.state == DEGRADED:
             self._maybe_failback()
         if self.state in (LOST, DEGRADED):
             # degraded dispatch is lazy: the batch solves at fetch time, so
             # the pipeline's dispatch/drain cadence is preserved
+            self.counters["dispatch"] += 1
             if self.faults is not None:
                 self.faults.op("dispatch", degraded=True)
             return _SupHandle(None, batch, key, degraded=True)
+        w = self._width_of(batch)
+        if w is not None:
+            planned = self.governor.planned_width(key, w)
+            if planned is not None:
+                # ratcheted shape: dispatch at the known-good width directly
+                # — never re-try the full width (that is the retry-storm this
+                # module exists to kill); opt-in probation restores it. Not
+                # counted here: no op runs at this width — the governor's
+                # own guarded ops count themselves
+                return self._gov_dispatch(batch, key, reason=None)
+        self.counters["dispatch"] += 1
         fresh = self._is_fresh(key)
         try:
             inner = self._guarded("dispatch", self._dispatch_fn,
-                                  lambda attempt: (batch,), key, fresh)
+                                  lambda attempt: (batch,), key, fresh,
+                                  width=w)
+        except CapacityError as e:
+            return self._gov_dispatch(batch, key, reason=str(e))
         except DeviceLostError as e:
             self._engage_fallback(str(e))
             return _SupHandle(None, batch, key, degraded=True)
@@ -518,14 +646,27 @@ class DeviceSupervisor:
         return (h.inner,)
 
     def fetch(self, handle: _SupHandle):
-        self.counters["fetch"] += 1
         h = handle
+        if h.result is not None:
+            # governor-solved at dispatch time: the result is already host-
+            # side and final — valid even after a later failover (replay
+            # must not re-solve it on the degraded engine). Not counted: the
+            # governor's own guarded ops already were.
+            return h.result
+        self.counters["fetch"] += 1
         if h.degraded or self.state in (LOST, DEGRADED):
             return self._degraded_solve(h.batch, "fetch")
         try:
             return self._guarded("fetch", self._fetch_fn,
                                  lambda attempt: self._refetch_args(h, attempt),
-                                 h.key, fresh=False)
+                                 h.key, fresh=False, width=self._width_of(h.batch))
+        except CapacityError as e:
+            # the OOM surfaced at materialization (async dispatch): the
+            # retained batch re-solves down the ladder, never verbatim
+            gh = self._gov_dispatch(h.batch, h.key, reason=str(e))
+            if gh.result is not None:
+                return gh.result
+            return self._degraded_solve(h.batch, "fetch")
         except DeviceLostError as e:
             self._engage_fallback(str(e))
             return self._degraded_solve(h.batch, "fetch")
@@ -535,10 +676,12 @@ class DeviceSupervisor:
         logical fetch op; on declared loss every batch in the group replays
         on the degraded engine."""
         if self._fetch_many_fn is None or len(handles) == 1 or \
-                any(h.degraded for h in handles) or \
+                any(h.degraded or h.result is not None for h in handles) or \
                 self.state in (LOST, DEGRADED):
             return [self.fetch(h) for h in handles]
         self.counters["fetch"] += 1
+        widths = [self._width_of(h.batch) for h in handles]
+        width = max((w for w in widths if w is not None), default=None)
 
         def make_args(attempt):
             # a retried group re-dispatches every batch (see _refetch_args)
@@ -551,7 +694,14 @@ class DeviceSupervisor:
 
         try:
             return self._guarded("fetch", self._fetch_many_fn, make_args,
-                                 handles[0].key, fresh=False)
+                                 handles[0].key, fresh=False, width=width)
+        except CapacityError:
+            # per-handle fallback: each batch classifies (and degrades)
+            # against its OWN width — a group is not a capacity unit. The
+            # per-handle fetches count themselves; un-count the abandoned
+            # group op so ratios stay one-count-per-result.
+            self.counters["fetch"] -= 1
+            return [self.fetch(h) for h in handles]
         except DeviceLostError as e:
             self._engage_fallback(str(e))
             return [self._degraded_solve(h.batch, "fetch") for h in handles]

@@ -127,6 +127,17 @@ def daccord_main(argv=None) -> int:
     p.add_argument("--quarantine", default=None, metavar="PATH",
                    help="quarantine sidecar jsonl (default: <out>."
                         "quarantine.jsonl next to a file output)")
+    p.add_argument("--max-pile-overlaps", type=int,
+                   default=PipelineConfig().max_pile_overlaps, metavar="N",
+                   help="monster-pile budget (capacity governor): a pile "
+                        "holding more overlaps than this is contained "
+                        "through the quarantine machinery (read emitted "
+                        "uncorrected) BEFORE the quadratic windowing spend "
+                        "can OOM-kill the worker; 0 disables (default: "
+                        f"{PipelineConfig().max_pile_overlaps}). Device-OOM "
+                        "and host-RSS degradation are governed automatically "
+                        "(DACCORD_GOV_* env knobs: MIN_WIDTH, ESC_CLAMP, "
+                        "PROBATION, RSS_SOFT_MB, RSS_HARD_MB)")
     p.add_argument("--failover-backend", choices=("auto", "native", "cpu"),
                    default="auto",
                    help="degraded-mode engine on declared device loss "
@@ -327,7 +338,8 @@ def daccord_main(argv=None) -> int:
                          native_threads=args.native_threads,
                          ingest_policy=args.ingest_policy,
                          quarantine_path=args.quarantine,
-                         ladder_mode=args.ladder)
+                         ladder_mode=args.ladder,
+                         max_pile_overlaps=args.max_pile_overlaps)
 
     import os
 
@@ -881,6 +893,11 @@ def shard_main(argv=None) -> int:
                    help="validated LAS/DB decode policy (see daccord "
                         "--ingest-policy); the quarantine sidecar lands at "
                         "shardNNNN.quarantine.jsonl in OUTDIR")
+    p.add_argument("--max-pile-overlaps", type=int,
+                   default=PipelineConfig().max_pile_overlaps, metavar="N",
+                   help="monster-pile budget (see daccord "
+                        "--max-pile-overlaps); 0 disables (default: "
+                        f"{PipelineConfig().max_pile_overlaps})")
     args = p.parse_args(argv)
     if args.backend == "auto":
         from ..utils.obs import resolve_auto_backend
@@ -901,7 +918,8 @@ def shard_main(argv=None) -> int:
     scfg = PipelineConfig(batch_size=args.batch,
                           native_solver=args.backend == "native",
                           events_path=args.events,
-                          ingest_policy=args.ingest_policy)
+                          ingest_policy=args.ingest_policy,
+                          max_pile_overlaps=args.max_pile_overlaps)
     if args.profile_sample is not None:
         scfg.profile_sample_piles = args.profile_sample
     from ..formats.ingest import IngestError
@@ -984,6 +1002,9 @@ def fleet_main(argv=None) -> int:
                    default="auto")
     p.add_argument("--ingest-policy", choices=("strict", "quarantine", "off"),
                    default="strict")
+    p.add_argument("--max-pile-overlaps", type=int, default=None, metavar="N",
+                   help="monster-pile budget forwarded to every worker (see "
+                        "daccord --max-pile-overlaps); 0 disables")
     p.add_argument("--events", default=None, metavar="PATH",
                    help="fleet events jsonl (spawn/heartbeat/takeover/retry/"
                         "poison/speculate/done; schema: tools/eventcheck.py). "
@@ -1008,6 +1029,7 @@ def fleet_main(argv=None) -> int:
                       checkpoint_every=args.checkpoint_every,
                       batch=args.batch, backend=args.backend,
                       ingest_policy=args.ingest_policy,
+                      max_pile_overlaps=args.max_pile_overlaps,
                       events_path=args.events if args.events is not None
                       else os.path.join(args.outdir, "fleet.events.jsonl"))
     manifest = run_fleet(args.db, args.las, args.outdir, cfg)

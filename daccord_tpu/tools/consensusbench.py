@@ -120,14 +120,10 @@ def main(argv=None) -> int:
 
 
 def batch_slice(batch, n: int):
-    """First-n-windows view of a WindowBatch (warmup helper); slices the
-    bookkeeping arrays too so the batch's parallel-lists invariant holds."""
-    import dataclasses
+    """First-n-windows view of a WindowBatch (warmup helper)."""
+    from ..kernels.tensorize import slice_batch
 
-    return dataclasses.replace(
-        batch, seqs=batch.seqs[:n], lens=batch.lens[:n],
-        nsegs=batch.nsegs[:n], read_ids=batch.read_ids[:n],
-        wstarts=batch.wstarts[:n])
+    return slice_batch(batch, 0, n)
 
 
 if __name__ == "__main__":

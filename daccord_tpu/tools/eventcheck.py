@@ -32,7 +32,11 @@ EVENT_FIELDS: dict[str, dict] = {
     "sup_compile": {"key": str, "expected_wall_s": _NUM},
     "sup_heartbeat": {"op": str, "key": str, "waited_s": _NUM,
                       "deadline_s": _NUM},
-    "sup_retry": {"op": str, "attempt": int, "delay_s": _NUM, "reason": str},
+    # cls = retry class (timeout | transient): budgets apply per class, and
+    # deterministic classes (capacity) never appear here at all — they skip
+    # straight to their remedy (governor ladder / failover)
+    "sup_retry": {"op": str, "attempt": int, "cls": str, "delay_s": _NUM,
+                  "reason": str},
     "sup_probe": {"alive": bool, "wall_s": _NUM},
     "sup_fault": {"kind": str, "op": str, "n": int},
     "sup_failover": {"reason": str, "fallback": str},
@@ -41,8 +45,18 @@ EVENT_FIELDS: dict[str, dict] = {
     "batch": {"windows": int, "solved": int},
     # two-stream tier ladder (ISSUE 4): one row per Stream B rescue dispatch
     # (rows = live rescue windows, slots = padded batch width, reason =
-    # full | lag | final)
+    # full | lag | final | pressure — the last is a host-watermark
+    # force-flush, ISSUE 5)
     "ladder.flush": {"rows": int, "slots": int, "reason": str},
+    # capacity governor (runtime/governor.py, ISSUE 5): memory faults walk a
+    # byte-identical degradation ladder instead of the transient retry ladder
+    "governor.classify": {"key": str, "width": int, "reason": str},
+    "governor.shrink": {"key": str, "width_from": int, "width_to": int},
+    "governor.clamp": {"key": str, "width": int, "esc_cap": int},
+    "governor.ratchet": {"key": str, "width": int},
+    "governor.restore": {"key": str, "width": int, "ok": bool},
+    "governor.backpressure": {"level": str, "rss_mb": _NUM},
+    "governor.monster": {"aread": int, "overlaps": int, "budget": int},
     "shard_done": {"reads": int, "windows": int, "solved": int,
                    "wall_s": _NUM, "degraded": bool},
     # ingest integrity layer (formats/ingest.py, ISSUE 2)
@@ -62,6 +76,8 @@ EVENT_FIELDS: dict[str, dict] = {
     "fleet.poison": {"shard": int, "attempts": int, "reason": str},
     "fleet.speculate": {"shard": int, "throughput": _NUM, "median": _NUM},
     "fleet.done": {"shard": int, "reads": int, "degraded": bool},
+    # OOM-killed worker requeued once at a reduced batch (not poison credit)
+    "fleet.capacity": {"shard": int, "batch": int},
     "fleet.fault": {"kind": str, "shard": int},
     "fleet.demote": {"shard": int, "new_host": str},
     "fleet.finish": {"done": int, "poison": int, "wall_s": _NUM},

@@ -136,6 +136,29 @@ def resolve_auto_backend(prefer_native: bool = True) -> str:
     return "cpu"
 
 
+def auto_batch_size(native: bool, jax_backend: str | None = None) -> int:
+    """Batch auto-selection when ``-b`` is not given: the native C++ engine
+    pays no shape-scaled compile cost so bigger is strictly better (4096);
+    the JAX ladder runs 2048 on TPU, 512 elsewhere. The single source for
+    this mapping — ``correct_shard`` sizes its batches with it and the
+    fleet's capacity requeue halves it, so the two can never disagree on
+    what a worker's effective batch was."""
+    if native:
+        return 4096
+    return 2048 if jax_backend == "tpu" else 512
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env knob with a silent fall-back on unparseable values (the
+    runtime config pattern shared by the supervisor and the governor)."""
+    import os
+
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def _host_cpu_fingerprint() -> str:
     """Short stable hash of this host's CPU feature flags.
 

@@ -1,0 +1,753 @@
+"""Capacity governor (ISSUE 5): adaptive degradation under device OOM, host
+memory pressure, and monster piles.
+
+Fast tier: the fault-plan capacity kinds, governor ladder units (bisect /
+merge / ratchet / probation restore / clamp rung) against stub engines,
+per-class retry budgets, ratchet persistence, the native-backend e2e matrix
+(device_oom bisect parity, host_rss backpressure, monster-pile quarantine
+parity, OOM-then-device-loss failover replay), shard-manifest/merge-gate
+state, and the fleet capacity-requeue — no XLA ladder compiles. Slow tier:
+the JAX ladder arms (fused bisect parity; an OOM landing mid-split-ladder
+on a Stream B rescue batch; host-RSS force-flush of a live rescue pool).
+
+The acceptance bar everywhere: FASTA byte-identical to the unfaulted run,
+with ZERO full-width re-dispatches of a shape already classified as
+capacity-faulted (asserted from governor.*/sup_retry events and engine-side
+width logs).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from daccord_tpu.kernels.tensorize import (BatchShape, WindowBatch, pad_batch,
+                                           slice_batch)
+from daccord_tpu.runtime.faults import (FLEET_KINDS, FaultDeviceOOM, FaultPlan,
+                                        non_fleet_spec)
+from daccord_tpu.runtime.governor import (CapacityError, GovernorConfig,
+                                          is_capacity_error, load_ratchets,
+                                          merge_results)
+from daccord_tpu.runtime.supervisor import (DEGRADED, HEALTHY,
+                                            DeviceSupervisor,
+                                            SupervisorConfig)
+from daccord_tpu.tools.eventcheck import validate_events
+from daccord_tpu.utils.obs import JsonlLogger
+
+
+# ------------------------------------------------------------- fault plan
+
+def test_fault_plan_capacity_kinds():
+    plan = FaultPlan.parse("device_oom:3,host_rss:2,monster_pile:4,worker_oom:2")
+    assert [s.kind for s in plan.specs] == ["device_oom", "host_rss",
+                                           "monster_pile", "worker_oom"]
+
+    # device_oom: fires at device op 3, leaves a HALF-width virtual ceiling
+    plan = FaultPlan.parse("device_oom:2")
+    plan.op("dispatch", width=64)
+    with pytest.raises(FaultDeviceOOM, match="RESOURCE_EXHAUSTED"):
+        plan.op("fetch", width=64)
+    assert plan.oom_max_width == 32
+    # the ceiling is NOT one-shot: the identical doomed width keeps failing
+    with pytest.raises(FaultDeviceOOM):
+        plan.op("dispatch", width=64)
+    with pytest.raises(FaultDeviceOOM):
+        plan.op("dispatch", width=33)
+    # ...while a bisected width fits
+    plan.op("dispatch", width=32)
+    plan.op("fetch", width=16)
+    # composing specs forces a deeper walk (each fire halves again)
+    plan2 = FaultPlan.parse("device_oom:1,device_oom:2")
+    with pytest.raises(FaultDeviceOOM):
+        plan2.op("dispatch", width=64)
+    assert plan2.oom_max_width == 32
+    with pytest.raises(FaultDeviceOOM):
+        plan2.op("dispatch", width=32)
+    assert plan2.oom_max_width == 16
+
+    # host_rss / monster_pile counters are their own domains
+    plan = FaultPlan.parse("host_rss:2,monster_pile:3")
+    assert [plan.host_rss_check() for _ in range(3)] == [False, True, False]
+    assert [plan.monster_check() for _ in range(4)] == [False, False, True,
+                                                        False]
+
+    # worker_oom is a fleet kind: stripped from worker env, spawn-counted
+    assert "worker_oom" in FLEET_KINDS
+    assert non_fleet_spec("worker_oom:2,device_oom:3") == "device_oom:3"
+    plan = FaultPlan.parse("worker_oom:2")
+    assert plan.fleet_spawn() is None
+    assert plan.fleet_spawn() == "worker_oom"
+    assert plan.fleet_spawn() is None
+
+
+def test_is_capacity_error_classification():
+    assert is_capacity_error(FaultDeviceOOM("RESOURCE_EXHAUSTED: injected"))
+    assert is_capacity_error(MemoryError())
+    assert is_capacity_error(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "8589934592 bytes"))
+    assert is_capacity_error(RuntimeError("Failed to allocate request"))
+    assert not is_capacity_error(RuntimeError("socket closed"))
+    assert not is_capacity_error(TimeoutError("deadline"))
+
+
+def test_merge_results_and_slice_batch():
+    b = _mini_batch(b=6)
+    b.read_ids[:] = np.arange(6)
+    s = slice_batch(b, 2, 5)
+    assert s.size == 3 and list(s.read_ids) == [2, 3, 4]
+    assert s.stream == b.stream
+    p = pad_batch(slice_batch(b, 4, 6), 4)
+    assert p.size == 4 and list(p.read_ids[:2]) == [4, 5]
+
+    parts = [(3, {"val": np.arange(4), "esc_overflow": np.int32(1),
+                  "name": "x"}),
+             (2, {"val": np.arange(4) + 10, "esc_overflow": np.int32(2),
+                  "name": "x"})]
+    m = merge_results(parts)
+    np.testing.assert_array_equal(m["val"], [0, 1, 2, 10, 11])
+    assert m["esc_overflow"] == 3 and m["name"] == "x"
+    # single exact part passes through untouched
+    one = {"val": np.arange(3)}
+    assert merge_results([(3, one)]) is one
+
+
+# ------------------------------------------------------------- stub engine
+
+def _mini_batch(b=8, d=2, l=8, stream="full"):
+    return WindowBatch(seqs=np.zeros((b, d, l), np.int8),
+                       lens=np.zeros((b, d), np.int32),
+                       nsegs=np.zeros(b, np.int32),
+                       shape=BatchShape(depth=d, seg_len=l, wlen=l),
+                       read_ids=np.arange(b, dtype=np.int64),
+                       wstarts=np.zeros(b, np.int64), stream=stream)
+
+
+class WidthLogEngine:
+    """Sync stub whose fetch returns each row's read_id — so a bisected,
+    merged result is checkable row-for-row — and which logs every dispatch
+    width (the zero-full-width-re-dispatch assertion)."""
+
+    def __init__(self):
+        self.widths: list[int] = []
+
+    def dispatch(self, batch):
+        self.widths.append(batch.size)
+        return batch
+
+    def fetch(self, batch):
+        return {"val": batch.read_ids.copy(),
+                "esc_overflow": np.int32(0)}
+
+
+def _sup(tmp_path, name, faults=None, gov=None, clamp=None, **cfg_kw):
+    cfg_kw.setdefault("backoff_base_s", 0.01)
+    eng = WidthLogEngine()
+    ev = os.path.join(str(tmp_path), f"{name}.events.jsonl")
+    sup = DeviceSupervisor(
+        eng.dispatch, eng.fetch, None,
+        fallback_factory=lambda: (lambda b: {"val": b.read_ids.copy(),
+                                             "esc_overflow": np.int32(0),
+                                             "engine": "fallback"}),
+        log=JsonlLogger(ev), cfg=SupervisorConfig(**cfg_kw),
+        faults=faults, probe_fn=lambda: True, describe="stub",
+        clamp_solve=clamp, governor_cfg=gov)
+    return sup, eng, ev
+
+
+def _events(ev):
+    return [json.loads(x) for x in open(ev)]
+
+
+def test_governor_bisect_merge_ratchet(tmp_path, monkeypatch):
+    """A classified OOM bisects the retained batch, merges the halves
+    byte-exactly, ratchets the shape — and the engine NEVER sees the doomed
+    full width again (later batches dispatch at the known-good size)."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    sup, eng, ev = _sup(tmp_path, "bisect",
+                        faults=FaultPlan.parse("device_oom:1"),
+                        gov=GovernorConfig(min_width=2, persist=True))
+    h = sup.dispatch(_mini_batch(b=8))
+    out = sup.fetch(h)
+    np.testing.assert_array_equal(out["val"], np.arange(8))
+    assert sup.state == HEALTHY and not sup.failed_over
+    # the injected OOM fired BEFORE the engine ran: it never saw width 8
+    assert eng.widths == [4, 4]
+    # second batch of the same shape: straight to the ratcheted width
+    out2 = sup.fetch(sup.dispatch(_mini_batch(b=8)))
+    np.testing.assert_array_equal(out2["val"], np.arange(8))
+    assert eng.widths == [4, 4, 4, 4]
+    recs = _events(ev)
+    evs = [r["event"] for r in recs]
+    assert evs.count("governor.classify") == 1
+    assert {(r["width_from"], r["width_to"]) for r in recs
+            if r["event"] == "governor.shrink"} == {(8, 4)}
+    assert [r["width"] for r in recs
+            if r["event"] == "governor.ratchet"] == [4]
+    # capacity never consumes the transient retry ladder
+    assert "sup_retry" not in evs
+    assert validate_events(ev, strict=True) == []
+    assert sup.governor.active_state() == {"B8xD2xL8": 4}
+
+
+def test_governor_deep_walk(tmp_path, monkeypatch):
+    """Composed device_oom specs force the walk down multiple rungs."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    sup, eng, ev = _sup(tmp_path, "deep",
+                        faults=FaultPlan.parse("device_oom:1,device_oom:2"),
+                        gov=GovernorConfig(min_width=1))
+    out = sup.fetch(sup.dispatch(_mini_batch(b=8)))
+    np.testing.assert_array_equal(out["val"], np.arange(8))
+    # first fire: ceiling 4; governor tries 4, second fire: ceiling 2 ->
+    # chunks of 2 succeed
+    assert eng.widths == [2, 2, 2, 2]
+    shrinks = [(r["width_from"], r["width_to"]) for r in _events(ev)
+               if r["event"] == "governor.shrink"]
+    assert shrinks == [(8, 4), (4, 2)]
+
+
+def test_governor_probation_restore(tmp_path, monkeypatch):
+    """Opt-in probation: after N clean reduced solves, one full-width
+    re-probe; restore on success (ratchet cleared), re-ratchet on failure —
+    mirrors supervisor failback."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    plan = FaultPlan.parse("device_oom:1")
+    sup, eng, ev = _sup(tmp_path, "probe", faults=plan,
+                        gov=GovernorConfig(min_width=2, probation=2))
+    sup.fetch(sup.dispatch(_mini_batch(b=8)))     # classify -> ratchet 4
+    sup.fetch(sup.dispatch(_mini_batch(b=8)))     # reduced solve 1
+    sup.fetch(sup.dispatch(_mini_batch(b=8)))     # reduced solve 2
+    # probation due; the ceiling still stands -> restore probe fails,
+    # dispatching stays reduced
+    out = sup.fetch(sup.dispatch(_mini_batch(b=8)))
+    np.testing.assert_array_equal(out["val"], np.arange(8))
+    recs = _events(ev)
+    rest = [r for r in recs if r["event"] == "governor.restore"]
+    assert rest and rest[0]["ok"] is False
+    assert sup.governor.planned_width("B8xD2xL8", 8) == 4
+    # the chip frees memory (ceiling lifted): next probe restores full width
+    plan.oom_max_width = None
+    sup.fetch(sup.dispatch(_mini_batch(b=8)))     # reduced solve (count 1)
+    sup.fetch(sup.dispatch(_mini_batch(b=8)))     # reduced solve (count 2)
+    out = sup.fetch(sup.dispatch(_mini_batch(b=8)))   # probe -> restore
+    np.testing.assert_array_equal(out["val"], np.arange(8))
+    rest = [r for r in _events(ev) if r["event"] == "governor.restore"]
+    assert rest[-1]["ok"] is True
+    assert sup.governor.planned_width("B8xD2xL8", 8) is None
+    assert 8 in eng.widths[-1:]     # the restore probe ran full width
+    assert validate_events(ev, strict=True) == []
+
+
+def test_governor_clamp_rung_and_exhaustion(tmp_path, monkeypatch):
+    """Bisect floor exhausted -> the esc-cap clamp rung solves at its
+    smaller effective width; without a clamp the ladder exhausts and native
+    failover (demoted last resort) takes the batch."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    clamped = []
+
+    def clamp(b):
+        clamped.append(b.size)
+        return {"val": b.read_ids.copy(), "esc_overflow": np.int32(0)}
+
+    # min_width = 8 = full width: the bisect cannot shrink at all, and the
+    # composed ceiling (4) fails width 8 -> clamp (effective width 2) fits
+    sup, eng, ev = _sup(tmp_path, "clamp",
+                        faults=FaultPlan.parse("device_oom:1"),
+                        gov=GovernorConfig(min_width=8, esc_clamp=2),
+                        clamp=clamp)
+    out = sup.fetch(sup.dispatch(_mini_batch(b=8)))
+    np.testing.assert_array_equal(out["val"], np.arange(8))
+    assert clamped == [8] and not sup.failed_over
+    recs = _events(ev)
+    assert [r["esc_cap"] for r in recs
+            if r["event"] == "governor.clamp"] == [2]
+    # same shape again: the clamp rung is the sticky working rung
+    sup.fetch(sup.dispatch(_mini_batch(b=8)))
+    assert clamped == [8, 8]
+
+    # no clamp configured: ladder exhausted -> native failover last resort
+    sup2, eng2, ev2 = _sup(tmp_path, "exhaust",
+                           faults=FaultPlan.parse("device_oom:1"),
+                           gov=GovernorConfig(min_width=8))
+    out = sup2.fetch(sup2.dispatch(_mini_batch(b=8)))
+    assert out["engine"] == "fallback" and sup2.failed_over
+    assert "capacity ladder exhausted" in sup2.fail_reason
+    assert validate_events(ev2, strict=True) == []
+
+    # clamp membership PERSISTS (negative width in the registry): a NEW
+    # supervisor re-engages the clamped program directly — never the
+    # unclamped program at a width known to OOM
+    assert load_ratchets()["B8xD2xL8"] == -8
+    clamped3 = []
+
+    def clamp3(b):
+        clamped3.append(b.size)
+        return {"val": b.read_ids.copy(), "esc_overflow": np.int32(0)}
+
+    sup3, eng3, ev3 = _sup(tmp_path, "clamp_persist", clamp=clamp3)
+    out = sup3.fetch(sup3.dispatch(_mini_batch(b=8)))
+    np.testing.assert_array_equal(out["val"], np.arange(8))
+    assert eng3.widths == [] and clamped3 == [8]
+    assert not any(r["event"] == "governor.classify" for r in _events(ev3))
+
+
+def test_ratchet_persistence_across_supervisors(tmp_path, monkeypatch):
+    """The working rung is recorded beside the compile-fingerprint registry:
+    a NEW supervisor (new process, same host cache) dispatches the shape at
+    the known-good width directly — no classify, no full-width attempt."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    sup, eng, _ = _sup(tmp_path, "persist1",
+                       faults=FaultPlan.parse("device_oom:1"),
+                       gov=GovernorConfig(min_width=2))
+    sup.fetch(sup.dispatch(_mini_batch(b=8)))
+    assert load_ratchets() == {"B8xD2xL8": 4}
+
+    sup2, eng2, ev2 = _sup(tmp_path, "persist2", faults=None)
+    out = sup2.fetch(sup2.dispatch(_mini_batch(b=8)))
+    np.testing.assert_array_equal(out["val"], np.arange(8))
+    assert eng2.widths == [4, 4]
+    assert not any(r["event"] == "governor.classify" for r in _events(ev2))
+
+
+def test_per_class_retry_budget(tmp_path, monkeypatch):
+    """A timeout retry must not consume the transient budget (and vice
+    versa): one injected hang + one transient error on the same logical op
+    both recover under max_retries=1, with sup_retry carrying the class."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    calls = {"fetch": 0}
+
+    class Eng:
+        def dispatch(self, batch):
+            return batch
+
+        def fetch(self, batch):
+            calls["fetch"] += 1
+            if calls["fetch"] == 1:
+                raise RuntimeError("transient socket wobble")
+            return {"ok": True}
+
+    eng = Eng()
+    ev = os.path.join(str(tmp_path), "cls.events.jsonl")
+    sup = DeviceSupervisor(
+        eng.dispatch, eng.fetch, None, fallback_factory=None,
+        log=JsonlLogger(ev),
+        cfg=SupervisorConfig(backoff_base_s=0.01, max_retries=1),
+        faults=FaultPlan.parse("fetch_hang:1"), probe_fn=lambda: True)
+    out = sup.fetch(sup.dispatch(_mini_batch(b=4)))
+    assert out == {"ok": True}
+    retries = [r for r in _events(ev) if r["event"] == "sup_retry"]
+    assert [r["cls"] for r in retries] == ["timeout", "transient"]
+    assert validate_events(ev, strict=True) == []
+
+
+def test_eventcheck_governor_schema(tmp_path):
+    good = tmp_path / "gov.jsonl"
+    good.write_text("\n".join([
+        json.dumps({"t": 0.1, "event": "governor.classify", "key": "B8",
+                    "width": 8, "reason": "RESOURCE_EXHAUSTED"}),
+        json.dumps({"t": 0.2, "event": "governor.shrink", "key": "B8",
+                    "width_from": 8, "width_to": 4}),
+        json.dumps({"t": 0.3, "event": "governor.clamp", "key": "B8",
+                    "width": 4, "esc_cap": 2}),
+        json.dumps({"t": 0.4, "event": "governor.ratchet", "key": "B8",
+                    "width": 4}),
+        json.dumps({"t": 0.5, "event": "governor.restore", "key": "B8",
+                    "width": 8, "ok": True}),
+        json.dumps({"t": 0.6, "event": "governor.backpressure",
+                    "level": "hard", "rss_mb": 123.4}),
+        json.dumps({"t": 0.7, "event": "governor.monster", "aread": 3,
+                    "overlaps": 120000, "budget": 100000}),
+        json.dumps({"t": 0.8, "event": "fleet.capacity", "shard": 1,
+                    "batch": 256}),
+    ]) + "\n")
+    assert validate_events(str(good), strict=True) == []
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"t": 0.1, "event": "governor.shrink",
+                               "key": "B8", "width_from": "big"}) + "\n")
+    errs = validate_events(str(bad))
+    assert errs and any("width_to" in e for e in errs)
+
+
+# ------------------------------------------------------------ e2e (native)
+
+@pytest.fixture(scope="module")
+def native_dataset(tmp_path_factory):
+    native = pytest.importorskip("daccord_tpu.native")
+    if not native.available():
+        pytest.skip("native library unavailable")
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path_factory.mktemp("gov_e2e"))
+    cfg = SimConfig(genome_len=1500, coverage=12, read_len_mean=500,
+                    min_overlap=200, seed=7)
+    return make_dataset(d, cfg, name="g"), d
+
+
+def _run(out, d, name, ev=None, **kw):
+    from daccord_tpu.runtime import PipelineConfig, correct_to_fasta
+
+    kw.setdefault("batch_size", 64)
+    kw.setdefault("depth_buckets", ())
+    fasta = os.path.join(d, f"{name}.fasta")
+    stats = correct_to_fasta(out["db"], out["las"], fasta,
+                             PipelineConfig(native_solver=True,
+                                            events_path=ev, **kw))
+    return fasta, stats
+
+
+def test_e2e_device_oom_byte_parity(native_dataset, monkeypatch, tmp_path):
+    """ISSUE 5 acceptance (bisect rung): DACCORD_FAULT=device_oom:N -> the
+    run completes HEALTHY (no failover), byte-identical FASTA, the shape
+    ratchets, and the event stream shows zero transient retries and zero
+    re-classifications after the ratchet engages."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    out, d = native_dataset
+    f0, s0 = _run(out, d, "base")
+    assert not s0.degraded and s0.batch_effective == 64
+
+    monkeypatch.setenv("DACCORD_FAULT", "device_oom:3")
+    ev = os.path.join(d, "oom.events.jsonl")
+    f1, s1 = _run(out, d, "oom", ev=ev)
+    assert open(f0).read() == open(f1).read()
+    assert not s1.degraded                      # the chip is full, not dead
+    assert s1.n_capacity_events >= 1
+    assert s1.batch_effective == 32
+    assert s1.governor_ratchet == {"native:B64xD32xL64": 32}
+    recs = [json.loads(x) for x in open(ev)]
+    evs = [r["event"] for r in recs]
+    assert "governor.classify" in evs and "governor.ratchet" in evs
+    assert "sup_retry" not in evs and "sup_failover" not in evs
+    # in-flight full-width handles dispatched BEFORE the classification may
+    # classify once each; none classifies twice (no full-width re-dispatch)
+    assert evs.count("governor.classify") <= evs.count("governor.shrink") + 1
+    assert validate_events(ev, strict=True) == []
+
+
+def test_e2e_oom_during_failover_replay(native_dataset, monkeypatch, tmp_path):
+    """OOM then device loss: capacity-solved handles survive the failover
+    replay (their results are final), the rest replays on the fallback —
+    byte-identical output, degraded=True from the loss (not the OOM)."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    out, d = native_dataset
+    f0, _ = _run(out, d, "base2")
+    monkeypatch.setenv("DACCORD_FAULT", "device_oom:2,device_lost:8")
+    ev = os.path.join(d, "mix.events.jsonl")
+    f1, s1 = _run(out, d, "mix", ev=ev)
+    assert open(f0).read() == open(f1).read()
+    assert s1.degraded and s1.n_capacity_events >= 1
+    assert validate_events(ev, strict=True) == []
+
+
+def test_e2e_host_rss_backpressure(native_dataset, monkeypatch, tmp_path):
+    """host_rss:N forces a hard-watermark flush mid-run: buffered rows and
+    the in-flight window all drain, output stays byte-identical."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    out, d = native_dataset
+    f0, _ = _run(out, d, "base3")
+    monkeypatch.setenv("DACCORD_FAULT", "host_rss:2")
+    ev = os.path.join(d, "rss.events.jsonl")
+    f1, s1 = _run(out, d, "rss", ev=ev)
+    assert open(f0).read() == open(f1).read()
+    assert s1.n_backpressure == 1
+    bp = [json.loads(x) for x in open(ev)
+          if '"governor.backpressure"' in x]
+    assert bp and bp[0]["level"] == "hard" and bp[0]["injected"]
+    assert validate_events(ev, strict=True) == []
+
+
+def test_e2e_rss_latch_rearms_after_hard(native_dataset, monkeypatch,
+                                         tmp_path):
+    """Real-pressure latch semantics: retained-heap readings in the soft zone
+    after a hard flush stay suppressed, but RSS dropping below the hard
+    watermark re-arms it — renewed growth past hard flushes again instead of
+    riding a dead guard into the OOM killer. Soft-zone readings after a
+    plain soft flush stay suppressed until RSS clears the soft watermark."""
+    import daccord_tpu.runtime.governor as govmod
+
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    monkeypatch.setenv("DACCORD_GOV_RSS_SOFT_MB", "100")
+    monkeypatch.setenv("DACCORD_GOV_RSS_HARD_MB", "200")
+    out, d = native_dataset
+    # per-block readings: hard trip; two retained-heap soft-zone readings
+    # (suppressed, but the second arrives with the latch downgraded); a
+    # SECOND hard crossing (must flush again); full drop; a fresh soft trip;
+    # a suppressed repeat; then quiet
+    readings = iter([50.0, 250.0, 150.0, 150.0, 250.0, 50.0, 150.0, 150.0])
+    monkeypatch.setattr(govmod, "host_rss_mb",
+                        lambda: next(readings, 10.0))
+    ev = os.path.join(d, "latch.events.jsonl")
+    f1, s1 = _run(out, d, "latch", ev=ev)
+    f0, _ = _run(out, d, "base_latch")
+    assert open(f0).read() == open(f1).read()
+    levels = [json.loads(x)["level"] for x in open(ev)
+              if '"governor.backpressure"' in x]
+    assert levels == ["hard", "hard", "soft"]
+    assert s1.n_backpressure == 3
+    assert validate_events(ev, strict=True) == []
+
+
+def test_e2e_monster_pile_quarantine_parity(native_dataset, monkeypatch,
+                                            tmp_path):
+    """monster_pile:N contains the pile through the quarantine machinery:
+    its read is emitted UNCORRECTED (raw bases), every other read is
+    byte-identical, and the sidecar + stats record the containment."""
+    from daccord_tpu.formats.dazzdb import read_db
+    from daccord_tpu.formats.fasta import read_fasta
+    from daccord_tpu.utils.bases import ints_to_seq
+
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    out, d = native_dataset
+    f0, _ = _run(out, d, "base4")
+    monkeypatch.setenv("DACCORD_FAULT", "monster_pile:2")
+    ev = os.path.join(d, "mon.events.jsonl")
+    qpath = os.path.join(d, "mon.q.jsonl")
+    f1, s1 = _run(out, d, "mon", ev=ev, quarantine_path=qpath)
+    assert s1.n_monster_piles == 1 and s1.n_quarantined == 1
+    mon = [json.loads(x) for x in open(ev) if '"governor.monster"' in x]
+    assert len(mon) == 1 and mon[0]["injected"]
+    aread = mon[0]["aread"]
+    q = [json.loads(x) for x in open(qpath)]
+    assert len(q) == 1 and q[0]["kind"] == "monster_pile" \
+        and q[0]["aread"] == aread
+
+    def by_read(p):
+        m = {}
+        for rec in read_fasta(p):
+            m.setdefault(rec.name.split("/")[0], []).append(rec.seq)
+        return m
+
+    r0, r1 = by_read(f0), by_read(f1)
+    bad = f"read{aread}"
+    assert all(r0.get(k) == r1.get(k)
+               for k in (set(r0) | set(r1)) - {bad})
+    # containment contract: the busted pile's read is the RAW read
+    db = read_db(out["db"])
+    assert r1[bad] == [ints_to_seq(db.read_bases(aread))]
+    assert validate_events(ev, strict=True) == []
+
+
+def test_shard_manifest_and_merge_gate(native_dataset, monkeypatch, tmp_path):
+    """Manifests record batch_effective + governor ratchet state, and the
+    merge gate accepts a capacity-degraded shard WITHOUT --allow-degraded
+    (degraded speed, byte-identical output) — while a monster-quarantined
+    shard still needs it (degraded output)."""
+    from daccord_tpu.parallel.launch import (MergeGateError, merge_shards,
+                                             run_shard)
+    from daccord_tpu.runtime import PipelineConfig
+
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    out, d = native_dataset
+    cfg = PipelineConfig(batch_size=64, native_solver=True, depth_buckets=())
+
+    cap_dir = os.path.join(d, "cap_out")
+    monkeypatch.setenv("DACCORD_FAULT", "device_oom:3")
+    m = run_shard(out["db"], out["las"], cap_dir, 0, 1, cfg)
+    monkeypatch.delenv("DACCORD_FAULT")
+    assert m["batch_effective"] == 32 and m["capacity_events"] >= 1
+    assert m["governor"] == {"native:B64xD32xL64": 32}
+    assert not m["degraded"]
+    # capacity-degraded shard merges WITHOUT --allow-degraded
+    merged = os.path.join(d, "cap.fasta")
+    merge_shards(cap_dir, 1, merged)
+    ref_dir = os.path.join(d, "gate_ref_out")
+    run_shard(out["db"], out["las"], ref_dir, 0, 1, cfg)
+    from daccord_tpu.parallel.launch import shard_paths
+
+    assert open(merged).read() == open(shard_paths(ref_dir, 0)["fasta"]).read()
+
+    # a monster-quarantined shard is degraded OUTPUT: gate still refuses
+    mon_dir = os.path.join(d, "mon_out")
+    monkeypatch.setenv("DACCORD_FAULT", "monster_pile:2")
+    m2 = run_shard(out["db"], out["las"], mon_dir, 0, 1, cfg)
+    monkeypatch.delenv("DACCORD_FAULT")
+    assert m2["quarantined"] == 1
+    with pytest.raises(MergeGateError, match="degraded/quarantined"):
+        merge_shards(mon_dir, 1, os.path.join(d, "mon_merge.fasta"))
+    merge_shards(mon_dir, 1, os.path.join(d, "mon_merge.fasta"),
+                 allow_degraded=True)
+
+
+def test_checkpointed_shard_records_governor(native_dataset, monkeypatch,
+                                             tmp_path):
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    from daccord_tpu.parallel.launch import run_shard
+    from daccord_tpu.runtime import PipelineConfig
+
+    out, d = native_dataset
+    cfg = PipelineConfig(batch_size=64, native_solver=True, depth_buckets=())
+    monkeypatch.setenv("DACCORD_FAULT", "device_oom:3")
+    m = run_shard(out["db"], out["las"], os.path.join(d, "ckpt_out"), 0, 1,
+                  cfg, checkpoint_every=4)
+    assert m["batch_effective"] == 32
+    assert m["governor"] == {"native:B64xD32xL64": 32}
+
+
+# ------------------------------------------------------------ fleet
+
+def test_fleet_worker_oom_requeue_not_poison(tmp_path, monkeypatch):
+    """An OOM-killed worker (exit 137) is requeued once at a reduced batch —
+    no poison credit, fleet completes, merged output byte-identical."""
+    native = pytest.importorskip("daccord_tpu.native")
+    if not native.available():
+        pytest.skip("native library unavailable")
+    from daccord_tpu.parallel.fleet import FleetConfig, run_fleet
+    from daccord_tpu.parallel.launch import merge_shards
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    d = str(tmp_path / "data")
+    ds = make_dataset(d, SimConfig(genome_len=1200, coverage=10,
+                                   read_len_mean=400, min_overlap=150,
+                                   seed=7), name="fo")
+
+    def fleet_cfg(out_dir, **kw):
+        return FleetConfig(nshards=2, workers=2, backend="native",
+                           checkpoint_every=2, backoff_base_s=0.05,
+                           backoff_cap_s=0.5, batch=64,
+                           speculate_min_runtime_s=300.0,
+                           events_path=os.path.join(out_dir,
+                                                    "fleet.events.jsonl"),
+                           **kw)
+
+    ref_dir = str(tmp_path / "ref")
+    m_ref = run_fleet(ds["db"], ds["las"], ref_dir, fleet_cfg(ref_dir),
+                      faults=None)
+    assert m_ref["done"] == [0, 1] and not m_ref["poison"]
+    ref_fasta = str(tmp_path / "ref.fasta")
+    merge_shards(ref_dir, 2, ref_fasta)
+
+    oom_dir = str(tmp_path / "oom")
+    cfg = fleet_cfg(oom_dir, poison_after=1)   # ONE real failure would poison
+    m = run_fleet(ds["db"], ds["las"], oom_dir, cfg,
+                  faults=FaultPlan.parse("worker_oom:1"))
+    assert m["done"] == [0, 1] and not m["poison"], m
+    assert m["capacity_requeued"] == [0]
+    out_fasta = str(tmp_path / "oom.fasta")
+    merge_shards(oom_dir, 2, out_fasta)
+    assert open(out_fasta).read() == open(ref_fasta).read()
+
+    ev = [json.loads(x) for x in open(cfg.events_path)]
+    cap = [e for e in ev if e["event"] == "fleet.capacity"]
+    assert len(cap) == 1 and cap[0]["batch"] == 32
+    retries = [e for e in ev if e["event"] == "fleet.retry"]
+    assert {e["reason"] for e in retries} == {"capacity"}
+    from daccord_tpu.tools.eventcheck import validate_events as _ve
+
+    assert _ve(cfg.events_path, strict=True) == []
+
+
+# ------------------------------------------------------------ bench
+
+def test_bench_memory_telemetry():
+    """The rung sidecar's memory fields: host peak RSS always (Linux), the
+    device peak only when the backend exposes memory_stats (CPU: None)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_test",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    t = bench._memory_telemetry()
+    assert set(t) == {"device_peak_bytes", "host_peak_rss_mb"}
+    assert t["host_peak_rss_mb"] and t["host_peak_rss_mb"] > 10
+
+
+# ------------------------------------------------------------ e2e (JAX)
+
+@pytest.fixture(scope="module")
+def jax_dataset(tmp_path_factory):
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path_factory.mktemp("gov_jax"))
+    cfg = SimConfig(genome_len=1200, coverage=10, read_len_mean=400,
+                    min_overlap=150, seed=7)
+    return make_dataset(d, cfg, name="gj"), d
+
+
+def _jax_run(out, d, name, ev=None, **kw):
+    from daccord_tpu.runtime import PipelineConfig, correct_to_fasta
+
+    kw.setdefault("batch_size", 32)
+    kw.setdefault("depth_buckets", ())
+    fasta = os.path.join(d, f"{name}.fasta")
+    stats = correct_to_fasta(out["db"], out["las"], fasta,
+                             PipelineConfig(events_path=ev, **kw))
+    return fasta, stats
+
+
+@pytest.mark.slow
+def test_e2e_jax_ladder_oom_parity(jax_dataset, monkeypatch, tmp_path):
+    """The JAX ladder arm: a device OOM bisects through real (shrunken)
+    ladder programs — shape-keyed compiles — and the FASTA stays
+    byte-identical."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    out, d = jax_dataset
+    f0, _ = _jax_run(out, d, "jbase")
+    monkeypatch.setenv("DACCORD_FAULT", "device_oom:4")
+    ev = os.path.join(d, "joom.events.jsonl")
+    f1, s1 = _jax_run(out, d, "joom", ev=ev)
+    assert open(f0).read() == open(f1).read()
+    assert not s1.degraded and s1.batch_effective == 16
+    assert validate_events(ev, strict=True) == []
+
+
+@pytest.mark.slow
+def test_e2e_split_ladder_stream_b_oom(jax_dataset, monkeypatch, tmp_path):
+    """An OOM landing mid-split-ladder on a Stream B rescue batch: the
+    bisected rescue halves keep the stream tag (they re-route to the rescue
+    program) and output parity holds. The op index is scanned until a
+    classification hits a non-tier0 program (the deterministic corpus makes
+    the scan reproducible)."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    out, d = jax_dataset
+    kw = dict(ladder_mode="split", rescue_flush_reads=4)
+    f0, s0 = _jax_run(out, d, "sbase", **kw)
+    assert s0.n_dispatch_rescue > 0    # Stream B actually ran
+    hit = None
+    for n in (3, 5, 7, 9, 11, 13, 15):
+        monkeypatch.setenv("DACCORD_FAULT", f"device_oom:{n}")
+        ev = os.path.join(d, f"soom{n}.events.jsonl")
+        f1, _ = _jax_run(out, d, f"soom{n}", ev=ev, **kw)
+        assert open(f0).read() == open(f1).read(), n
+        assert validate_events(ev, strict=True) == []
+        keys = [json.loads(x)["key"] for x in open(ev)
+                if '"governor.classify"' in x]
+        if any(not k.endswith(":t0") for k in keys):
+            hit = n
+            break
+    assert hit is not None, "no op index classified a Stream B batch"
+
+
+@pytest.mark.slow
+def test_e2e_split_host_rss_flushes_pool(jax_dataset, monkeypatch, tmp_path):
+    """Hard host pressure force-flushes a LIVE rescue pool (a mid-run
+    ladder.flush with its own reason 'pressure' — the 'final' label stays
+    reserved for the real end-of-shard drain) and bounds the buffered state
+    — with byte-identical output."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    out, d = jax_dataset
+    kw = dict(ladder_mode="split", rescue_flush_reads=10 ** 6)
+    f0, s0 = _jax_run(out, d, "rbase", **kw)
+    # with a deadline that can never expire, the unfaulted run only flushes
+    # rescue rows at the end-of-shard drain
+    assert s0.n_dispatch_rescue > 0
+    base_final = sum(di["reason"] == "final" for di in s0.rescue_dispatches)
+    monkeypatch.setenv("DACCORD_FAULT", "host_rss:8")
+    ev = os.path.join(d, "rss.events.jsonl")
+    f1, s1 = _jax_run(out, d, "rssflush", ev=ev, **kw)
+    assert open(f0).read() == open(f1).read()
+    assert s1.n_backpressure == 1
+    recs = [json.loads(x) for x in open(ev)]
+    assert any(r["event"] == "governor.backpressure" and r["level"] == "hard"
+               for r in recs)
+    # the forced mid-run drain dispatches Stream B under its own 'pressure'
+    # reason; the base run (which never saw pressure) has none, and its
+    # end-of-shard 'final' flushes keep their label
+    assert base_final > 0 and not any(
+        di["reason"] == "pressure" for di in s0.rescue_dispatches)
+    got_pressure = sum(di["reason"] == "pressure"
+                       for di in s1.rescue_dispatches)
+    assert got_pressure > 0, (s1.rescue_dispatches, s0.rescue_dispatches)
+    assert validate_events(ev, strict=True) == []

@@ -113,6 +113,72 @@ cmp -s "$fleetdir/ref.fasta" "$fleetdir/crash.fasta" \
 echo "tools_pounce: fleet smoke OK" >&2
 rm -rf "$fleetdir"
 
+# capacity-governor smoke (ISSUE 5): synth a toy dataset, then (a) an
+# injected device OOM must complete HEALTHY through the bisect ladder with
+# lint-clean governor.* events and a byte-identical FASTA, and (b) an
+# injected monster pile must quarantine exactly its own read (emitted raw)
+# with every other read byte-identical — all CPU-side, before any chip
+# minute is spent. A failure here means the degradation path regressed;
+# abort the pounce rather than OOM a live window. The injected runs get a
+# throwaway compcache dir: the OOM ratchet they record must not land in the
+# host's real registry (a real run would then dispatch at the shrunken
+# width), and a persisted ratchet would short-circuit classification on the
+# next pounce, failing the governor.classify check below.
+govdir=$(mktemp -d)
+govcc="DACCORD_COMPCACHE=$govdir/cc"
+python - "$govdir" <<'EOF' || { echo "tools_pounce: governor synth failed" >&2; exit 1; }
+import sys
+from daccord_tpu.sim.synth import SimConfig, make_dataset
+make_dataset(sys.argv[1], SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=500, min_overlap=200,
+                                    seed=5), name="gov")
+EOF
+env "$govcc" python -m daccord_tpu.tools.cli daccord "$govdir/gov.db" "$govdir/gov.las" \
+    --backend native -b 64 -o "$govdir/ref.fasta" \
+  || { echo "tools_pounce: governor reference run FAILED" >&2; exit 1; }
+env "$govcc" DACCORD_FAULT=device_oom:2 python -m daccord_tpu.tools.cli daccord \
+    "$govdir/gov.db" "$govdir/gov.las" --backend native -b 64 \
+    -o "$govdir/oom.fasta" --events "$govdir/oom.events.jsonl" \
+  || { echo "tools_pounce: device_oom-injected run FAILED" >&2; exit 1; }
+python -m daccord_tpu.tools.cli eventcheck --strict "$govdir/oom.events.jsonl" \
+  || { echo "tools_pounce: governor events failed schema lint" >&2; exit 1; }
+grep -q '"event": "governor.classify"' "$govdir/oom.events.jsonl" \
+  || { echo "tools_pounce: injected OOM was never classified" >&2; exit 1; }
+grep -q '"event": "sup_failover"' "$govdir/oom.events.jsonl" \
+  && { echo "tools_pounce: OOM run failed over instead of degrading" >&2; exit 1; }
+cmp -s "$govdir/ref.fasta" "$govdir/oom.fasta" \
+  || { echo "tools_pounce: OOM-degraded FASTA diverged from clean run" >&2; exit 1; }
+env "$govcc" DACCORD_FAULT=monster_pile:2 python -m daccord_tpu.tools.cli daccord \
+    "$govdir/gov.db" "$govdir/gov.las" --backend native -b 64 \
+    -o "$govdir/mon.fasta" --events "$govdir/mon.events.jsonl" \
+    --quarantine "$govdir/mon.quarantine.jsonl" \
+  || { echo "tools_pounce: monster_pile-injected run FAILED" >&2; exit 1; }
+python -m daccord_tpu.tools.cli eventcheck --strict "$govdir/mon.events.jsonl" \
+  || { echo "tools_pounce: monster events failed schema lint" >&2; exit 1; }
+python - "$govdir" <<'EOF' || { echo "tools_pounce: monster quarantine parity FAILED" >&2; exit 1; }
+import json, sys
+from daccord_tpu.formats.fasta import read_fasta
+d = sys.argv[1]
+mon = [json.loads(x) for x in open(f"{d}/mon.events.jsonl")
+       if '"governor.monster"' in x]
+assert len(mon) == 1, mon
+bad = f"read{mon[0]['aread']}"
+q = [json.loads(x) for x in open(f"{d}/mon.quarantine.jsonl")]
+assert q and q[0]["kind"] == "monster_pile", q
+def by_read(p):
+    m = {}
+    for rec in read_fasta(p):
+        m.setdefault(rec.name.split("/")[0], []).append(rec.seq)
+    return m
+r0, r1 = by_read(f"{d}/ref.fasta"), by_read(f"{d}/mon.fasta")
+assert all(r0.get(k) == r1.get(k) for k in (set(r0) | set(r1)) - {bad}), \
+    "a read outside the quarantined pile changed"
+assert r0.get(bad) != r1.get(bad), "the monster pile's read was not contained"
+print(f"governor smoke: {bad} contained, all other reads byte-identical")
+EOF
+echo "tools_pounce: capacity-governor smoke OK" >&2
+rm -rf "$govdir"
+
 run() {  # run <name> <cmd...>: capture one experiment, commit its sidecar
   name=$1; shift
   out="POUNCE_${stamp}_${name}.json"
