@@ -409,7 +409,7 @@ def rescue_candidates(out: dict, nsegs: np.ndarray,
 def solve_ladder_split(batch: WindowBatch, ladder: TierLadder,
                        rescue_batch: int | None = None,
                        use_pallas: bool = False,
-                       pallas_interpret: bool = False) -> dict:
+                       pallas_interpret: bool = False, tracer=None) -> dict:
     """Two-stream solve of ONE batch (the kernel-level unit behind the
     pipeline's cross-batch pool): Stream A tier0 over the full batch, then
     Stream B (the full ladder, compacted) over the rescue candidates only,
@@ -418,13 +418,19 @@ def solve_ladder_split(batch: WindowBatch, ladder: TierLadder,
     bytes (enforced by tests/test_split_ladder.py).
 
     ``rescue_batch`` fixes Stream B's static shape (padded); None solves
-    the candidates in one right-sized batch."""
+    the candidates in one right-sized batch. ``tracer`` (a
+    :class:`~..utils.obs.Tracer`) brackets the two streams in
+    ``kernel.tier0``/``kernel.rescue`` spans so a trace attributes the
+    cheap-vs-quadratic split of this unit's wall."""
     import dataclasses
 
+    from ..utils.obs import Tracer
     from .tensorize import pad_batch as _pad
 
-    out = fetch(solve_tier0_async(batch, ladder, use_pallas,
-                                  pallas_interpret))
+    tr = tracer if tracer is not None else Tracer(None)
+    with tr.span("kernel.tier0", rows=int(batch.size)):
+        out = fetch(solve_tier0_async(batch, ladder, use_pallas,
+                                      pallas_interpret))
     out = {k: (np.array(v) if isinstance(v, np.ndarray) else v)
            for k, v in out.items()}
     idx = np.nonzero(rescue_candidates(out, batch.nsegs, ladder))[0]
@@ -435,9 +441,10 @@ def solve_ladder_split(batch: WindowBatch, ladder: TierLadder,
             batch, seqs=batch.seqs[sub], lens=batch.lens[sub],
             nsegs=batch.nsegs[sub], read_ids=batch.read_ids[sub],
             wstarts=batch.wstarts[sub], stream="rescue")
-        r = fetch(solve_ladder_async(_pad(sb, step), ladder,
-                                     use_pallas=use_pallas,
-                                     pallas_interpret=pallas_interpret))
+        with tr.span("kernel.rescue", rows=int(len(sub)), slots=int(step)):
+            r = fetch(solve_ladder_async(_pad(sb, step), ladder,
+                                         use_pallas=use_pallas,
+                                         pallas_interpret=pallas_interpret))
         n = len(sub)
         for key in ("cons", "cons_len", "err", "solved", "tier", "m_ovf"):
             out[key][sub] = r[key][:n]

@@ -227,6 +227,13 @@ class FleetConfig:
     ingest_policy: str = "strict"
     max_pile_overlaps: int | None = None  # monster-pile budget (None = the
                                           # pipeline default; 0 disables)
+    worker_telemetry: bool = True     # thread per-worker telemetry sidecars
+                                      # (ISSUE 6): every daccord-shard worker
+                                      # writes shardNNNN.events.jsonl (trace
+                                      # spans + supervisor/governor events,
+                                      # absolute-ts merge-able by
+                                      # daccord-trace) and the per-window
+                                      # outcome ledger shardNNNN.ledger.jsonl
 
 
 @dataclass
@@ -250,6 +257,7 @@ class _Shard:
     # batch (threaded to the worker) before the normal failure ladder applies
     oom_requeued: bool = False
     batch_override: int | None = None
+    span: str | None = None           # open worker-attempt trace span id
 
 
 def _stderr_tail(path: str | None) -> str:
@@ -274,6 +282,13 @@ class Fleet:
         os.makedirs(outdir, exist_ok=True)  # the events sidecar lands here
         self.log = JsonlLogger(cfg.events_path) if cfg.events_path \
             else NullLogger()
+        # trace spans (ISSUE 6): one span per worker attempt (spawn → reap)
+        # under a fleet-run root, so daccord-trace can draw the fleet
+        # timeline straight from the orchestrator's own sidecar
+        from ..utils.obs import Tracer
+
+        self.tracer = Tracer(self.log)
+        self._run_span: str | None = None
         self._rng = random.Random(0xF1EE7)  # deterministic backoff jitter
         self.shards = {s: _Shard(s) for s in range(cfg.nshards)}
         self.poison: list[dict] = []
@@ -309,6 +324,16 @@ class Fleet:
                 "--backend", cfg.backend,
                 "--checkpoint-every", str(cfg.checkpoint_every),
                 "--ingest-policy", cfg.ingest_policy]
+        if cfg.worker_telemetry:
+            # per-worker sidecars land beside the shard outputs; attempts
+            # append (shard_start is the eventcheck stream boundary) and
+            # daccord-trace merges them with the fleet's own file on ts
+            p = shard_paths(self.outdir, shard)
+            argv += ["--events", p["events"], "--ledger", p["ledger"]]
+        else:
+            # daccord-shard's own --ledger default is 'auto': an opted-out
+            # fleet must say so explicitly or workers write ledgers anyway
+            argv += ["--ledger", "none"]
         if cfg.max_pile_overlaps is not None:
             argv += ["--max-pile-overlaps", str(cfg.max_pile_overlaps)]
         # a capacity-requeued shard re-runs at its reduced batch (the env-
@@ -383,6 +408,10 @@ class Fleet:
         st.spawn_t = st.last_beat = time.time()
         st.kill_reason = None
         st.last_emitted = 0
+        st.span = self.tracer.open("worker", attach=False,
+                                   parent=self._run_span or "",
+                                   shard=s, attempt=st.attempts,
+                                   pid=st.proc.pid)
         self.log.log("fleet.spawn", shard=s, attempt=st.attempts,
                      pid=st.proc.pid)
 
@@ -456,6 +485,9 @@ class Fleet:
             if rc is None:
                 continue
             st.proc = None
+            self.tracer.close(st.span, rc=int(rc),
+                              reason=st.kill_reason or "")
+            st.span = None
             m, why = load_shard_manifest(self.outdir, st.shard)
             if rc == 0 and m is not None:
                 st.consec_fail = 0
@@ -611,6 +643,7 @@ class Fleet:
         os.makedirs(self.outdir, exist_ok=True)
         self.log.log("fleet.init", nshards=cfg.nshards, workers=cfg.workers,
                      host=self.host)
+        self._run_span = self.tracer.open("fleet.run", nshards=cfg.nshards)
         # idempotent rerun: shards that already committed need no worker
         for st in self.shards.values():
             m, _ = load_shard_manifest(self.outdir, st.shard)
@@ -661,6 +694,7 @@ class Fleet:
             self.log.log("fleet.finish", done=len(manifest["done"]),
                          poison=len(manifest["poison"]),
                          wall_s=manifest["wall_s"])
+            self.tracer.close(self._run_span, status="done")
             return manifest
         finally:
             # an exception (or KeyboardInterrupt) must not strand worker
@@ -671,6 +705,9 @@ class Fleet:
                     st.proc.wait()
                 if st.status == "running":
                     release_lease(self.outdir, st.shard, host=self.host)
+            # abort unwind: any spans still open (stranded workers, the
+            # fleet-run root on an exception path) close with status=abort
+            self.tracer.unwind()
             self.log.close()
 
 

@@ -47,6 +47,12 @@ def shard_paths(outdir: str, shard: int) -> dict:
         "manifest": os.path.join(outdir, f"shard{shard:04d}.json"),
         "progress": os.path.join(outdir, f"shard{shard:04d}.progress.json"),
         "quarantine": os.path.join(outdir, f"shard{shard:04d}.quarantine.jsonl"),
+        # telemetry spine sidecars (ISSUE 6): structured events (+ trace
+        # spans), the per-window outcome ledger, and the end-of-run metrics
+        # rollup committed beside the manifest
+        "events": os.path.join(outdir, f"shard{shard:04d}.events.jsonl"),
+        "ledger": os.path.join(outdir, f"shard{shard:04d}.ledger.jsonl"),
+        "metrics": os.path.join(outdir, f"shard{shard:04d}.metrics.json"),
     }
 
 
@@ -120,8 +126,9 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
             return cached
     if force:
         # --force means recompute from scratch, not resume the old run —
-        # the progress manifest AND the quarantine sidecar both reset
-        for key in ("progress", "quarantine"):
+        # the progress manifest, the quarantine sidecar, and the outcome
+        # ledger all reset
+        for key in ("progress", "quarantine", "ledger"):
             if os.path.exists(paths[key]):
                 os.remove(paths[key])
     cfg = cfg or PipelineConfig()
@@ -134,6 +141,8 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
     ranges = shard_ranges(las_path, nshards)
     start, end = ranges[shard]
     if not checkpoint_every:
+        # (correct_to_fasta starts a fresh ledger sidecar itself: whole-range
+        # runs never append)
         stats = correct_to_fasta(db_path, las_path, paths["fasta"], cfg,
                                  start=start, end=end)
         counters = {"reads": stats.n_reads, "windows": stats.n_windows,
@@ -156,10 +165,16 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
                     # without --allow-degraded
                     "batch_effective": stats.batch_effective,
                     "capacity_events": stats.n_capacity_events,
-                    "governor": stats.governor_ratchet or None}
+                    "governor": stats.governor_ratchet or None,
+                    "device_s": round(stats.device_s, 4),
+                    "host_s": round(stats.host_s, 4),
+                    "_metrics": stats.metrics}
     else:
         counters = _run_shard_checkpointed(db_path, las_path, paths, start, end,
                                            cfg, checkpoint_every)
+    # metrics rollup (ISSUE 6): committed durably BESIDE the manifest, not
+    # inside it — the merge gate and idempotent-rerun logic stay metric-blind
+    metrics_rollup = counters.pop("_metrics", None)
     manifest = {
         "shard": shard, "nshards": nshards, "byte_range": [start, end],
         **counters, "fasta": paths["fasta"],
@@ -168,6 +183,11 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
         "fasta_bytes": os.path.getsize(paths["fasta"]),
     }
     _write_manifest_durable(paths["manifest"], manifest)
+    if metrics_rollup:
+        _write_manifest_durable(paths["metrics"], {
+            "shard": shard, "wall_s": counters.get("wall_s"),
+            "device_s": counters.get("device_s"),
+            "host_s": counters.get("host_s"), **metrics_rollup})
     if os.path.exists(paths["progress"]):
         os.remove(paths["progress"])
     return manifest
@@ -232,10 +252,13 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
             base = prog["counters"]
             fasta_bytes = prog["fasta_bytes"]
             resumed = emitted
-    if not emitted and cfg.quarantine_path and os.path.exists(cfg.quarantine_path):
-        # fresh (non-resume) shard run: reset the sidecar so a recompute
-        # (e.g. after a torn manifest) cannot accumulate duplicate rows
-        os.remove(cfg.quarantine_path)
+    if not emitted:
+        # fresh (non-resume) shard run: reset the sidecars so a recompute
+        # (e.g. after a torn manifest) cannot accumulate duplicate rows —
+        # resumes append deliberately (ledger dedupe key: aread+widx)
+        for p in (cfg.quarantine_path, cfg.ledger_path):
+            if p and os.path.exists(p):
+                os.remove(p)
 
     db = read_db(db_path, strict=cfg.ingest_policy == "strict")
     las = LasFile(las_path)
@@ -359,6 +382,11 @@ def _run_shard_checkpointed(db_path: str, las_path: str, paths: dict,
         counters["batch_effective"] = last_st.batch_effective
         counters["capacity_events"] = last_st.n_capacity_events
         counters["governor"] = last_st.governor_ratchet or None
+        # decomposition anchors + metrics rollup (ISSUE 6). On a resume
+        # these cover the resumed run only — wall_s alone is cumulative
+        counters["device_s"] = round(last_st.device_s, 4)
+        counters["host_s"] = round(last_st.host_s, 4)
+        counters["_metrics"] = last_st.metrics
     return counters
 
 

@@ -258,13 +258,17 @@ class CapacityGovernor:
     """
 
     def __init__(self, solve_width_fn, *, log=None,
-                 cfg: GovernorConfig | None = None, clamp_solve_fn=None):
-        from ..utils.obs import NullLogger
+                 cfg: GovernorConfig | None = None, clamp_solve_fn=None,
+                 tracer=None):
+        from ..utils.obs import NullLogger, Tracer
 
         self._solve = solve_width_fn
         self._clamp = clamp_solve_fn
         self.cfg = cfg or GovernorConfig.from_env()
         self.log = log if log is not None else NullLogger()
+        # governor-rung trace spans (ISSUE 6): each ladder-rung chunk solve
+        # is bracketed so daccord-trace can attribute the degraded wall
+        self.tracer = tracer if tracer is not None else Tracer(None)
         self.ratchet: dict[str, int] = {}
         self._loaded = False
         self._touched: set[str] = set()       # keys ratcheted/applied THIS run
@@ -398,9 +402,12 @@ class CapacityGovernor:
             sub = slice_batch(batch, pos, pos + take)
             if sub.size < width:
                 sub = pad_batch(sub, width)
+            rung_sp = self.tracer.open("governor.rung", key=key,
+                                       width=int(width), clamped=clamped)
             try:
                 out = self._clamp(sub) if clamped else self._solve(sub)
             except CapacityError as e:
+                self.tracer.close(rung_sp, status="capacity")
                 if not clamped and width > floor:
                     new = max(width // 2, floor)
                     self.counters["shrink"] += 1
@@ -417,6 +424,14 @@ class CapacityGovernor:
                 raise CapacityError(
                     f"degradation ladder exhausted for {key} at width "
                     f"{width}: {e}", width=width) from e
+            except BaseException:
+                # device loss (or anything else) mid-rung: close the span
+                # here — the run continues after failover, so leaving it to
+                # the end-of-run unwind would book the rest of the shard's
+                # wall against this rung
+                self.tracer.close(rung_sp, status="error")
+                raise
+            self.tracer.close(rung_sp)
             self.counters["chunks"] += 1
             parts.append((take, out))
             pos += take

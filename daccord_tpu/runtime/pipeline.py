@@ -158,6 +158,19 @@ class PipelineConfig:
                                  # interior rescue windows keep the read
                                  # contiguous and are left alone
     log_path: str | None = None  # jsonl event log ('-' = stderr)
+    ledger_path: str | None = None   # per-window outcome ledger jsonl
+                                 # (ISSUE 6): one `window` row per window —
+                                 # identity, length, depth, tier reached,
+                                 # rescue membership, batch solve wall — the
+                                 # training set the learned window router
+                                 # (ROADMAP 5) needs. Buffered writer; None
+                                 # = off (daccord-shard defaults it next to
+                                 # the shard manifest)
+    metrics_snapshot_s: float = 30.0  # cadence of periodic `metrics` events
+                                 # (registry snapshot: windows/sec,
+                                 # bases/sec, pad waste, rescue density,
+                                 # RSS, device_peak_bytes); 0 disables —
+                                 # the end-of-run rollup still lands
     supervise: bool = True       # wrap dispatch/fetch in the device
                                  # supervisor (runtime/supervisor.py):
                                  # watchdog deadlines with compiling-vs-wedged
@@ -273,6 +286,10 @@ class PipelineStats:
     wall_s: float = 0.0
     device_s: float = 0.0
     host_s: float = 0.0
+    metrics: dict = field(default_factory=dict)
+                                 # end-of-run MetricsRegistry rollup
+                                 # (ISSUE 6); launch.run_shard commits it
+                                 # durably beside the shard manifest
 
     @property
     def pad_waste(self) -> float:
@@ -748,6 +765,48 @@ def _make_clamp_solve(ladder: TierLadder, use_pallas: bool, interp: bool,
     return clamp_solve
 
 
+class _Telemetry:
+    """Per-shard telemetry bundle (ISSUE 6): buffered event/log writers, the
+    trace-span tracer, the per-window outcome ledger, and the metrics
+    registry. Created before the pipeline body and closed in
+    :func:`correct_shard`'s ``finally``, so abort/failover unwind paths flush
+    buffered tails and close every open span (the pairing invariant
+    ``daccord-trace --check`` enforces)."""
+
+    def __init__(self, cfg: PipelineConfig, start, end):
+        from ..utils.obs import (JsonlLogger, MetricsRegistry, Tracer,
+                                 WindowLedger)
+
+        # file-backed streams buffer (hot-path budget); '-' streams stay
+        # line-flushed — stderr exists for LIVE monitoring, and a buffered
+        # tail would go silent exactly when an operator watches for a wedge
+        def _mk(path):
+            kw = ({"buffer_lines": 64, "flush_s": 2.0}
+                  if path and path != "-" else {})
+            return JsonlLogger(path, **kw)
+
+        self.log = _mk(cfg.log_path)
+        self.ev_log = _mk(cfg.events_path) if cfg.events_path else self.log
+        # stream boundary FIRST: a requeued/resumed worker appends to the
+        # same sidecar with a fresh relative clock — eventcheck --strict
+        # resets its t/state/span tracking here
+        self.ev_log.log("shard_start", start=int(start or 0),
+                        end=int(-1 if end is None else end), pid=os.getpid())
+        self.tracer = Tracer(self.ev_log)
+        self.ledger = (WindowLedger(cfg.ledger_path) if cfg.ledger_path
+                       else None)
+        self.metrics = MetricsRegistry()
+        self.run_span = self.tracer.open("run")
+
+    def close(self) -> None:
+        self.tracer.unwind()
+        if self.ledger is not None:
+            self.ledger.close()
+        if self.ev_log is not self.log:
+            self.ev_log.close()
+        self.log.close()
+
+
 def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                   start: int | None = None, end: int | None = None,
                   profile: ErrorProfile | None = None,
@@ -761,18 +820,30 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     (the checkpointed launcher pre-scans; rescanning a damaged multi-GB file
     would double the slowest ingest step) — None runs the scan here.
     """
+    tel = _Telemetry(cfg, start, end)
+    try:
+        yield from _correct_shard_impl(db, las, cfg, start, end, profile,
+                                       solver, ingest_report, tel)
+    finally:
+        # one exit path for every outcome — normal exhaustion, strict-scan
+        # abort, injected crash, abandoned generator: buffered telemetry
+        # flushes and open spans close (status=abort when not already closed)
+        tel.close()
+
+
+def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
+                        start, end, profile, solver, ingest_report,
+                        tel: _Telemetry):
     stats = PipelineStats()
     t_start = time.time()
-    from ..utils.obs import JsonlLogger
-
-    log = JsonlLogger(cfg.log_path)
-    ev_log = JsonlLogger(cfg.events_path) if cfg.events_path else log
+    log, ev_log = tel.log, tel.ev_log
+    tracer, ledger, metrics = tel.tracer, tel.ledger, tel.metrics
 
     # ONE fault plan for the whole shard (ISSUE 5): the supervisor consumes
     # the device kinds, the capacity guards below consume host_rss /
     # monster_pile — separate counter domains, shared spec state
     from .faults import FaultPlan
-    from .governor import GovernorConfig, check_host_pressure
+    from .governor import GovernorConfig, check_host_pressure, host_rss_mb
 
     plan = FaultPlan.from_env()
     gov_cfg = GovernorConfig.from_env()
@@ -789,7 +860,8 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         else:
             from ..formats.ingest import scan_with_db
 
-            report = scan_with_db(db, las, start, end)
+            with tracer.span("scan"):
+                report = scan_with_db(db, las, start, end)
         stats.n_ingest_issues = len(report.issues)
         ev_log.log("ingest.scan", path=las.path, records=report.n_records,
                    piles=report.n_piles, issues=len(report.issues),
@@ -799,13 +871,9 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                        aread=(-1 if iss.aread is None else int(iss.aread)),
                        detail=iss.detail)
         if report.issues and cfg.ingest_policy == "strict":
-            err = report.error()
-            # close what this function opened: a driver loop retrying
-            # corrupt shards must not leak two fds per abort
-            if ev_log is not log:
-                ev_log.close()
-            log.close()
-            raise err
+            # correct_shard's finally closes the telemetry bundle: a driver
+            # loop retrying corrupt shards must not leak two fds per abort
+            raise report.error()
     if cfg.batch_size is None:
         import dataclasses
 
@@ -819,21 +887,23 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             cfg = dataclasses.replace(cfg, batch_size=auto_batch_size(
                 False, jax.default_backend()))
     if profile is None:
-        if report is not None and report.issues:
-            # sample only validated-clean piles: index_las rejects the file
-            profile = estimate_profile_for_shard(
-                db, las, cfg, start, end, pile_ranges=report.pile_ranges)
-        else:
-            profile = estimate_profile_for_shard(db, las, cfg, start, end)
+        with tracer.span("profile"):
+            if report is not None and report.issues:
+                # sample only validated-clean piles: index_las rejects the file
+                profile = estimate_profile_for_shard(
+                    db, las, cfg, start, end, pile_ranges=report.pile_ranges)
+            else:
+                profile = estimate_profile_for_shard(db, las, cfg, start, end)
     ladder = None
     if not (solver is None and cfg.native_solver):
         # the native C++ solver builds its own OffsetLikely tables from the
         # same make_offset_likely call — constructing the (unused) device
         # ladder too would do that work twice
-        ladder = TierLadder.from_config(profile, cfg.consensus,
-                                        max_kmers=cfg.max_kmers,
-                                        rescue_max_kmers=cfg.rescue_max_kmers,
-                                        overflow_rescue=cfg.overflow_rescue)
+        with tracer.span("ladder.build"):
+            ladder = TierLadder.from_config(profile, cfg.consensus,
+                                            max_kmers=cfg.max_kmers,
+                                            rescue_max_kmers=cfg.rescue_max_kmers,
+                                            overflow_rescue=cfg.overflow_rescue)
     fetch_many_fn = None
     native_dispatch = solver is None and cfg.native_solver
     # both votes AND both acceptance objectives are implemented in the C++
@@ -1016,7 +1086,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 **({"failback": True} if cfg.failback else {})),
             faults=plan, rtt_s=rtt_s, describe=desc,
             fingerprint_prefix=fp_prefix, inline=inline,
-            clamp_solve=clamp_solve, governor_cfg=gov_cfg)
+            clamp_solve=clamp_solve, governor_cfg=gov_cfg, tracer=tracer)
         dispatch_fn, fetch_fn = sup.dispatch, sup.fetch
         if fetch_many_fn is not None:
             fetch_many_fn = sup.fetch_many
@@ -1205,11 +1275,19 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             stats.n_hp_rescued += 1
         return overrides
 
-    def scatter(out, rid, widx, take, hp_over=None, keep=None):
+    # tier index -> k of the solving tier (ledger rows record both; out-of-
+    # range tiers — hp rescue, unsolved — map to -1)
+    tier_ks = [tt[0] for tt in cfg.consensus.tiers]
+
+    def scatter(out, rid, widx, take, hp_over=None, keep=None,
+                nsegs_b=None, stream="full", wall=0.0):
         """Scatter one fetched batch's rows into their pending reads.
         ``keep`` (split mode) masks out rows whose windows went to the
         rescue pool instead — they scatter exactly once, when their Stream B
-        result lands, so per-window accounting never double-counts."""
+        result lands, so per-window accounting never double-counts (and the
+        outcome ledger gets exactly one row per window). ``nsegs_b``/
+        ``stream``/``wall`` carry the ledger row context: depth column,
+        stream tag, and the batch's dispatch→scatter turnaround."""
         n_batch_solved = 0
         if "m_ovf" in out:
             mv = np.asarray(out["m_ovf"][:take])
@@ -1229,12 +1307,23 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             wj = int(widx[i])
             pr.results[wj] = (wj * adv, w, seq)
             pr.n_done += 1
-            if out["solved"][i]:
+            solved_i = bool(out["solved"][i])
+            t = int(out["tier"][i]) if solved_i else -1
+            if solved_i:
                 stats.n_solved += 1
                 n_batch_solved += 1
-                t = int(out["tier"][i])
                 pr.tiers[wj] = t
                 stats.tier_histogram[t] = stats.tier_histogram.get(t, 0) + 1
+            if ledger is not None:
+                ledger.record(
+                    r, wj, w,
+                    int(nsegs_b[i]) if nsegs_b is not None else -1,
+                    t, tier_ks[t] if 0 <= t < len(tier_ks) else -1,
+                    solved_i, stream,
+                    # rescue membership: the window rode a rescue lane —
+                    # a Stream B dispatch in split mode, or (fused) any
+                    # escalation-tier solve
+                    rescued=(stream == "rescue" or t >= 1), wall_s=wall)
             if pr.n_done == pr.n_windows:
                 finalize_read(r, pr)
         return n_batch_solved
@@ -1283,17 +1372,24 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             return
         entries = [inflight.popleft() for _ in range(n_pop)]
         t_f = time.time()
+        # the device.fetch span wraps EXACTLY the region the device_s timer
+        # measures, so daccord-trace's device-stage sum reconciles with
+        # stats.device_s by construction
+        f_sp = tracer.open("device.fetch", n=len(entries))
         if fetch_many_fn is not None and len(entries) > 1:
             outs = fetch_many_fn([e[0] for e in entries])
         else:
             outs = [fetch_fn(e[0]) for e in entries]
         now = time.time()
+        tracer.close(f_sp)
         # device_s = time the host actually BLOCKED on the device/tunnel
         # (in-flight batches overlap, so summing dispatch->fetch spans
         # would double-count and can exceed wall time)
         stats.device_s += now - t_f
-        for (handle, rid, widx, take, t0, rows_ctx, bi, stream), out \
+        metrics.counter("fetch_calls").inc()
+        for (handle, rid, widx, take, t0, rows_ctx, bi, stream, b_sp), out \
                 in zip(entries, outs):
+            metrics.histogram("batch_turnaround_s").observe(now - t0)
             keep = pool_mask = None
             if split_ladder and stream == "tier0":
                 # pool-membership rule shared with the kernel-level unit
@@ -1329,11 +1425,15 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     stats.rescue_slots_executed += len(rows_ctx[2])
             if hp_ols is not None:
                 t_hp = time.time()
+                hp_sp = tracer.open("hp", parent=b_sp, attach=False)
                 hp_over = hp_pass(out, rows_ctx, take, skip=pool_mask)
+                tracer.close(hp_sp)
                 stats.hp_wall_s += time.time() - t_hp
             else:
                 hp_over = None
-            n_s = scatter(out, rid, widx, take, hp_over, keep)
+            n_s = scatter(out, rid, widx, take, hp_over, keep,
+                          nsegs_b=rows_ctx[2], stream=stream, wall=now - t0)
+            tracer.close(b_sp, windows=take, solved=n_s)
             log.log("batch", windows=take, solved=n_s, stream=stream,
                     overflow=int(out.get("esc_overflow", 0)),
                     # live rescue-pool gauge: lets a log reader (and the
@@ -1362,6 +1462,8 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                 else ("final" if final else "lag")))
                 stale = False
                 take = min(cfg.batch_size, r_nrows[bi])
+                fl_sp = tracer.open("flush", reason=reason, rows=take,
+                                    bucket=bi)
                 seqs, lens, nsg, rid, widx = _pop_rows(
                     (r_seqs, r_lens, r_nsegs, r_rid, r_widx),
                     r_nrows, r_first_seen, bi, take)
@@ -1373,7 +1475,19 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 batch = pad_batch(batch, cfg.batch_size)
                 stats.pad_cells += batch.seqs.size
                 stats.used_cells += int(batch.lens.sum())
+                # the flush span covers the pool pop + pad only: the
+                # dispatch below books under the dispatch stage, and the
+                # two stages must stay disjoint or daccord-trace's stage
+                # table double-counts the (synchronous, on inline engines)
+                # solve wall
+                tracer.close(fl_sp)
+                b_sp = tracer.open("batch", attach=False, stream="rescue",
+                                   rows=take, bucket=bi)
+                d_sp = tracer.open("dispatch", parent=b_sp, stream="rescue")
                 handle = dispatch_fn(batch)
+                tracer.close(d_sp)
+                metrics.counter("dispatches").inc()
+                metrics.histogram("flush_rows").observe(take)
                 stats.n_dispatch_rescue += 1
                 stats.n_rescue_windows += take
                 stats.rescue_slots_executed += batch.size
@@ -1383,7 +1497,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                            reason=reason, bucket=bi)
                 rows_ctx = (batch.seqs, batch.lens, batch.nsegs)
                 inflight.append((handle, rid, widx, take, time.time(),
-                                 rows_ctx, bi, "rescue"))
+                                 rows_ctx, bi, "rescue", b_sp))
                 if len(inflight) >= cfg.max_inflight:
                     drain(cfg.max_inflight // 2)
 
@@ -1415,7 +1529,13 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     batch = pad_batch(batch, cfg.batch_size)
                 stats.pad_cells += batch.seqs.size
                 stats.used_cells += int(batch.lens.sum())
+                b_sp = tracer.open("batch", attach=False, stream=batch.stream,
+                                   rows=take, bucket=bi)
+                d_sp = tracer.open("dispatch", parent=b_sp,
+                                   stream=batch.stream)
                 handle = dispatch_fn(batch)
+                tracer.close(d_sp)
+                metrics.counter("dispatches").inc()
                 if split_ladder:
                     stats.n_dispatch_tier0 += 1
                 # hp rescue reconstructs segments, and the split ladder pools
@@ -1424,7 +1544,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 # whole batch anyway)
                 rows_ctx = (batch.seqs, batch.lens, batch.nsegs)
                 inflight.append((handle, rid, widx, take, time.time(),
-                                 rows_ctx, bi, batch.stream))
+                                 rows_ctx, bi, batch.stream, b_sp))
                 # let the in-flight window FILL, then drain half of it in one
                 # grouped fetch — steady state pays one tunnel RTT per
                 # max_inflight/2 batches instead of one per batch
@@ -1508,8 +1628,58 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         blocks = _segmented()
     else:
         blocks = _block_iter(start, end)
+
+    def _timed_blocks():
+        # feeder spans bracket the host windowing wall per pile block (the
+        # block generator's __next__ — decode, k-mer extraction,
+        # tensorization); the previous pile span is closed by then, so
+        # these parent under the run span
+        it = iter(blocks)
+        while True:
+            f_sp = tracer.open("feeder")
+            try:
+                blk = next(it)
+            except StopIteration:
+                tracer.close(f_sp, status="end")
+                return
+            tracer.close(f_sp)
+            yield blk
+
+    def _metrics_snap(final: bool = False):
+        # registry update + periodic snapshot event: derived rates from the
+        # live stats plus the two samplers (host RSS; device peak memory on
+        # device-ladder paths only — memory_stats would needlessly init a
+        # backend under the native engine)
+        el = max(time.time() - t_start, 1e-9)
+        g = metrics.gauge
+        g("windows_per_sec").set(stats.n_windows / el)
+        g("bases_per_sec").set(stats.bases_out / el)
+        g("pad_waste").set(stats.pad_waste)
+        g("rescue_density").set(stats.rescue_density)
+        g("rss_mb").set(host_rss_mb())
+        g("pool_rows").set(float(sum(r_nrows)) if split_ladder else 0.0)
+        g("inflight").set(float(len(inflight)))
+        g("n_reads").set(float(stats.n_reads))
+        g("n_windows").set(float(stats.n_windows))
+        g("n_solved").set(float(stats.n_solved))
+        if ladder is not None and not native_dispatch:
+            from ..utils.obs import device_peak_bytes
+
+            dpb = device_peak_bytes()
+            if dpb is not None:
+                g("device_peak_bytes").set(float(dpb))
+        if not final:
+            metrics.snapshot(ev_log)
+
     bp_latched = None
-    for blk in blocks:
+    last_snap = time.time()
+    for blk in _timed_blocks():
+        if (cfg.metrics_snapshot_s
+                and time.time() - last_snap >= cfg.metrics_snapshot_s):
+            last_snap = time.time()
+            _metrics_snap()
+        pa = blk[1] if blk[0] == "quarantine" else blk[0]
+        pile_sp = tracer.open("pile", aread=int(-1 if pa is None else pa))
         # host watermark (capacity governor, one check per pile block): under
         # memory pressure the feeder pauses here while the buffered rows —
         # partial buckets and split-ladder rescue pools (soft), plus the
@@ -1563,6 +1733,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 order.append(int(q_aread))
                 ready[int(q_aread)] = [a]
             yield from emit_ready()
+            tracer.close(pile_sp, quarantined=True)
             continue
         aread, a_bases, seqs, lens, nsegs = blk
         stats.n_reads += 1
@@ -1587,6 +1758,13 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 if ns:
                     for wj in np.nonzero(shallow)[0]:
                         pr.results[int(wj)] = (int(wj) * adv, w, None)
+                        if ledger is not None:
+                            # skipped-shallow windows never dispatch but ARE
+                            # counted windows: the ledger's row count must
+                            # equal stats.n_windows
+                            ledger.record(aread, int(wj), w, int(nsegs[wj]),
+                                          -1, -1, False, "skip",
+                                          rescued=False, wall_s=0.0)
                     pr.n_done += ns
                     stats.n_skipped_shallow += ns
                     keep = ~shallow
@@ -1626,6 +1804,7 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                         first_seen[bi] = stats.n_reads
         run_batches(final=False)
         yield from emit_ready()
+        tracer.close(pile_sp)
 
     run_batches(final=True)
     while emit_idx < len(order):
@@ -1654,35 +1833,51 @@ def correct_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         ev_log.log("sup_done", state=sup.state, degraded=sup.failed_over,
                    **sup.counters,
                    **{f"gov_{k}": v for k, v in gov.counters.items()})
-    log.log("shard_done", reads=stats.n_reads, windows=stats.n_windows,
-            solved=stats.n_solved, skipped_shallow=stats.n_skipped_shallow,
-            topm_overflow=stats.n_topm_overflow,
-            hp_rescued=stats.n_hp_rescued,
-            qv_ranked=stats.qv_ranked, bases_out=stats.bases_out,
-            quarantined=stats.n_quarantined,
-            ingest_issues=stats.n_ingest_issues,
-            pad_waste=round(stats.pad_waste, 4), wall_s=round(stats.wall_s, 3),
-            tiers=stats.tier_histogram, native=stats.native_host,
-            # two-stream ladder decision counters (ISSUE 4): fused-vs-split
-            # rescue tail cost is measurable from these with no chip
-            ladder=cfg.ladder_mode,
-            rescue_slots=stats.rescue_slots_executed,
-            rescue_windows=stats.n_rescue_windows,
-            rescue_density=round(stats.rescue_density, 4),
-            # capacity governor (ISSUE 5): degraded speed, never bytes
-            capacity_events=stats.n_capacity_events,
-            backpressure=stats.n_backpressure,
-            monster_piles=stats.n_monster_piles,
-            batch_effective=stats.batch_effective,
-            # north-star counters (BASELINE.json metric; SURVEY.md §5 metrics)
-            bases_per_sec=round(stats.bases_per_sec(), 1),
-            degraded=stats.degraded,
-            windows_per_sec=round(stats.n_windows / stats.wall_s, 1) if stats.wall_s else 0.0)
+    # end-of-run metrics rollup: final gauge refresh, one last snapshot
+    # event, and the registry dict on stats — run_shard commits it durably
+    # beside the shard manifest
+    _metrics_snap(final=True)
+    metrics.snapshot(ev_log, final=True)
+    stats.metrics = metrics.rollup()
+    done = dict(
+        reads=stats.n_reads, windows=stats.n_windows,
+        solved=stats.n_solved, skipped_shallow=stats.n_skipped_shallow,
+        topm_overflow=stats.n_topm_overflow,
+        hp_rescued=stats.n_hp_rescued,
+        qv_ranked=stats.qv_ranked, bases_out=stats.bases_out,
+        quarantined=stats.n_quarantined,
+        ingest_issues=stats.n_ingest_issues,
+        pad_waste=round(stats.pad_waste, 4), wall_s=round(stats.wall_s, 3),
+        # wall decomposition anchors (ISSUE 6): daccord-trace reconciles
+        # its device/host stage sums against these
+        device_s=round(stats.device_s, 4), host_s=round(stats.host_s, 4),
+        tiers=stats.tier_histogram, native=stats.native_host,
+        # two-stream ladder decision counters (ISSUE 4): fused-vs-split
+        # rescue tail cost is measurable from these with no chip
+        ladder=cfg.ladder_mode,
+        rescue_slots=stats.rescue_slots_executed,
+        rescue_windows=stats.n_rescue_windows,
+        rescue_density=round(stats.rescue_density, 4),
+        # capacity governor (ISSUE 5): degraded speed, never bytes
+        capacity_events=stats.n_capacity_events,
+        backpressure=stats.n_backpressure,
+        monster_piles=stats.n_monster_piles,
+        batch_effective=stats.batch_effective,
+        # north-star counters (BASELINE.json metric; SURVEY.md §5 metrics)
+        bases_per_sec=round(stats.bases_per_sec(), 1),
+        degraded=stats.degraded,
+        windows_per_sec=round(stats.n_windows / stats.wall_s, 1)
+        if stats.wall_s else 0.0)
+    log.log("shard_done", **done)
+    if ev_log is not log:
+        # the events sidecar is what daccord-trace merges — the terminal
+        # record (and its device_s/host_s anchors) must land there too
+        ev_log.log("shard_done", **done)
+    # clean completion: the run span closes HERE (not in the unwind) so a
+    # trace can tell a finished shard from an aborted one
+    tracer.close(tel.run_span, reads=stats.n_reads, status="done")
     if qfh is not None:
         qfh.close()
-    if ev_log is not log:
-        ev_log.close()
-    log.close()
 
 
 def correct_to_fasta(db_path: str, las_path: str, out_path, cfg: PipelineConfig | None = None,
@@ -1720,6 +1915,11 @@ def correct_to_fasta(db_path: str, las_path: str, out_path, cfg: PipelineConfig 
         # go through launch.py, which appends deliberately). Other policies
         # never write the sidecar, so a prior run's record is left alone
         os.remove(cfg.quarantine_path)
+    if cfg.ledger_path and os.path.exists(cfg.ledger_path):
+        # same rule for the outcome ledger: a whole-range run starts fresh
+        # (row count must equal the run's window count; only checkpointed
+        # resumes, via launch.py, append deliberately)
+        os.remove(cfg.ledger_path)
     # only the strict policy aborts on DB validation failures: quarantine
     # contains them via bad_reads, and 'off' trusts the input (no raise —
     # the pre-ISSUE-2 behavior an operator opts back into)

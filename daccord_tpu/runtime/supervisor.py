@@ -226,10 +226,11 @@ class DeviceSupervisor:
                  faults: FaultPlan | None = None, probe_fn=None,
                  rtt_s: float | None = None, describe: str = "",
                  fingerprint_prefix: str = "", inline: bool = False,
-                 clamp_solve=None, governor_cfg: GovernorConfig | None = None):
+                 clamp_solve=None, governor_cfg: GovernorConfig | None = None,
+                 tracer=None):
         import random
 
-        from ..utils.obs import NullLogger
+        from ..utils.obs import NullLogger, Tracer
 
         self._dispatch_fn = dispatch_fn
         self._fetch_fn = fetch_fn
@@ -269,10 +270,16 @@ class DeviceSupervisor:
         # byte-identical degradation ladder instead of the transient retry
         # ladder; native failover is demoted to its last rung
         self._clamp_solve = clamp_solve
+        # trace spans (ISSUE 6): the pipeline passes its tracer so probe /
+        # governor-rung spans parent into the run's span chain; standalone
+        # supervisors get their own over the same log (span ids are
+        # process-unique, so mixing tracers on one file is safe)
+        self.tracer = tracer if tracer is not None else Tracer(self.log)
         self.governor = CapacityGovernor(
             self._gov_solve_width, log=self.log,
             cfg=governor_cfg or GovernorConfig.from_env(),
-            clamp_solve_fn=self._gov_clamp if clamp_solve is not None else None)
+            clamp_solve_fn=self._gov_clamp if clamp_solve is not None else None,
+            tracer=self.tracer)
         if rtt_s:
             self.op_deadline_s = max(self.cfg.min_op_deadline_s,
                                      rtt_s * self.cfg.rtt_mult)
@@ -289,8 +296,10 @@ class DeviceSupervisor:
     def _transition(self, to: str, reason: str = "") -> None:
         if to == self.state:
             return
+        # no explicit ts: JsonlLogger stamps every record with the absolute
+        # clock now (an explicit kwarg would clobber the base field)
         self.log.log("sup_state", state_from=self.state, state_to=to,
-                     reason=reason, ts=round(time.time(), 3))
+                     reason=reason)
         self.state = to
 
     def _probe(self) -> bool:
@@ -301,12 +310,13 @@ class DeviceSupervisor:
             if ov is not None:
                 self.log.log("sup_probe", alive=ov, wall_s=0.0, injected=True)
                 return ov
-        if self._probe_fn is not None:
-            alive = bool(self._probe_fn())
-        else:
-            from ..utils.obs import device_alive
+        with self.tracer.span("probe"):
+            if self._probe_fn is not None:
+                alive = bool(self._probe_fn())
+            else:
+                from ..utils.obs import device_alive
 
-            alive = device_alive(self.cfg.probe_timeout_s)
+                alive = device_alive(self.cfg.probe_timeout_s)
         self.log.log("sup_probe", alive=alive,
                      wall_s=round(time.time() - t0, 3))
         return alive
@@ -517,7 +527,7 @@ class DeviceSupervisor:
         self._transition(FAILBACK, reason="re-probe alive")
         self._seen_shapes.clear()
         self._ignore_fp_registry = True
-        self.log.log("sup_failback", ts=round(now, 3))
+        self.log.log("sup_failback")
         return True
 
     # ---- capacity governor hooks ---------------------------------------

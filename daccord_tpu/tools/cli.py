@@ -108,6 +108,11 @@ def daccord_main(argv=None) -> int:
                         "(reference --eprofonly role)")
     p.add_argument("--stats", default=None, help="write run stats JSON here")
     p.add_argument("--log", default=None, help="jsonl event log path ('-' = stderr)")
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="per-window outcome ledger jsonl (window identity, "
+                        "length, depth, tier reached, rescue membership, "
+                        "batch solve wall — the learned-router training "
+                        "set; see daccord-trace)")
     p.add_argument("--events", default=None, metavar="PATH",
                    help="supervisor events jsonl (state transitions, "
                         "compile heartbeats, retries, failover; schema: "
@@ -339,7 +344,8 @@ def daccord_main(argv=None) -> int:
                          ingest_policy=args.ingest_policy,
                          quarantine_path=args.quarantine,
                          ladder_mode=args.ladder,
-                         max_pile_overlaps=args.max_pile_overlaps)
+                         max_pile_overlaps=args.max_pile_overlaps,
+                         ledger_path=args.ledger)
 
     import os
 
@@ -888,6 +894,10 @@ def shard_main(argv=None) -> int:
                    default="auto")
     p.add_argument("--events", default=None, metavar="PATH",
                    help="supervisor events jsonl (see daccord --events)")
+    p.add_argument("--ledger", default="auto", metavar="PATH",
+                   help="per-window outcome ledger jsonl (see daccord "
+                        "--ledger); 'auto' (default) = "
+                        "shardNNNN.ledger.jsonl in OUTDIR, 'none' disables")
     p.add_argument("--ingest-policy", choices=("strict", "quarantine", "off"),
                    default="strict",
                    help="validated LAS/DB decode policy (see daccord "
@@ -913,13 +923,19 @@ def shard_main(argv=None) -> int:
     i, n = (int(x) for x in args.J.split(","))
     if not (0 <= i < n):
         raise SystemExit(f"bad -J {args.J}")
-    from ..parallel.launch import run_shard
+    from ..parallel.launch import run_shard, shard_paths
 
+    ledger = args.ledger
+    if ledger == "auto":
+        ledger = shard_paths(args.outdir, i)["ledger"]
+    elif ledger == "none":
+        ledger = None
     scfg = PipelineConfig(batch_size=args.batch,
                           native_solver=args.backend == "native",
                           events_path=args.events,
                           ingest_policy=args.ingest_policy,
-                          max_pile_overlaps=args.max_pile_overlaps)
+                          max_pile_overlaps=args.max_pile_overlaps,
+                          ledger_path=ledger)
     if args.profile_sample is not None:
         scfg.profile_sample_piles = args.profile_sample
     from ..formats.ingest import IngestError
@@ -1009,6 +1025,12 @@ def fleet_main(argv=None) -> int:
                    help="fleet events jsonl (spawn/heartbeat/takeover/retry/"
                         "poison/speculate/done; schema: tools/eventcheck.py). "
                         "Default: OUTDIR/fleet.events.jsonl")
+    p.add_argument("--no-worker-telemetry", action="store_true",
+                   help="do not thread per-worker telemetry sidecars "
+                        "(shardNNNN.events.jsonl trace spans + "
+                        "shardNNNN.ledger.jsonl outcome ledger) through the "
+                        "workers — daccord-trace then sees the fleet file "
+                        "only")
     p.add_argument("--merge", default=None, metavar="FASTA",
                    help="after the fleet finishes, run the validating merge "
                         "gate into this file")
@@ -1030,6 +1052,7 @@ def fleet_main(argv=None) -> int:
                       batch=args.batch, backend=args.backend,
                       ingest_policy=args.ingest_policy,
                       max_pile_overlaps=args.max_pile_overlaps,
+                      worker_telemetry=not args.no_worker_telemetry,
                       events_path=args.events if args.events is not None
                       else os.path.join(args.outdir, "fleet.events.jsonl"))
     manifest = run_fleet(args.db, args.las, args.outdir, cfg)
@@ -1184,7 +1207,14 @@ def _eventcheck_main(argv=None) -> int:
     return eventcheck_main(argv)
 
 
+def _trace_main(argv=None) -> int:
+    from .trace import trace_main
+
+    return trace_main(argv)
+
+
 _TOOLS["eventcheck"] = _eventcheck_main
+_TOOLS["trace"] = _trace_main
 
 
 def main(argv=None) -> int:

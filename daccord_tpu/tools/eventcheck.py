@@ -23,12 +23,21 @@ _NUM = (int, float)
 #: required fields (name -> allowed types) per event. Events not listed are
 #: accepted as long as they carry the base fields — the schema constrains the
 #: machine-consumed events, it does not forbid new informational ones.
-BASE_FIELDS = {"t": _NUM, "event": str}
+BASE_FIELDS = {"t": _NUM, "ts": _NUM, "event": str}
 EVENT_FIELDS: dict[str, dict] = {
+    # telemetry spine (ISSUE 6): trace spans, metrics snapshots, the
+    # per-window outcome ledger, and the per-run stream boundary
+    "shard_start": {"start": int, "end": int, "pid": int},
+    "span_open": {"span": str, "parent": str, "name": str},
+    "span_close": {"span": str, "name": str, "wall_s": _NUM},
+    "metrics": {"counters": dict, "gauges": dict, "hists": dict},
+    "window": {"aread": int, "widx": int, "len": int, "depth": int,
+               "tier": int, "k": int, "solved": bool, "stream": str,
+               "rescued": bool, "wall_s": _NUM},
     "sup_init": {"primary": str, "op_deadline_s": _NUM,
                  "compile_deadline_s": _NUM},
-    "sup_state": {"state_from": str, "state_to": str, "reason": str,
-                  "ts": _NUM},
+    # (ts moved to BASE_FIELDS: the logger stamps every record)
+    "sup_state": {"state_from": str, "state_to": str, "reason": str},
     "sup_compile": {"key": str, "expected_wall_s": _NUM},
     "sup_heartbeat": {"op": str, "key": str, "waited_s": _NUM,
                       "deadline_s": _NUM},
@@ -40,7 +49,7 @@ EVENT_FIELDS: dict[str, dict] = {
     "sup_probe": {"alive": bool, "wall_s": _NUM},
     "sup_fault": {"kind": str, "op": str, "n": int},
     "sup_failover": {"reason": str, "fallback": str},
-    "sup_failback": {"ts": _NUM},
+    "sup_failback": {},
     "sup_done": {"state": str, "degraded": bool},
     "batch": {"windows": int, "solved": int},
     # two-stream tier ladder (ISSUE 4): one row per Stream B rescue dispatch
@@ -101,6 +110,8 @@ def validate_events(path: str, strict: bool = False) -> list[str]:
     errs: list[str] = []
     state = None
     last_t = None
+    open_spans: set[str] = set()
+    in_shard_segment = False
     try:
         with open(path) as fh:
             lines = fh.readlines()
@@ -135,12 +146,24 @@ def validate_events(path: str, strict: bool = False) -> list[str]:
                             f"type {type(val).__name__}")
         if not strict:
             continue
-        if rec.get("event") in ("sup_init", "bench_start"):
+        ev_name = rec.get("event")
+        if ev_name == "shard_start" or (
+                ev_name in ("sup_init", "bench_start")
+                and not in_shard_segment):
             # stream boundary: JsonlLogger appends with a per-process
             # relative clock, so a rerun against the same --events path (or
-            # a resumed shard) legitimately restarts t and the state chain
+            # a resumed shard) legitimately restarts t and the state chain.
+            # Spans reset too — a killed attempt's unclosed spans must not
+            # poison the next attempt's pairing (daccord-trace --check is
+            # the stricter per-segment lint). Inside a shard_start-opened
+            # segment the mid-run sup_init is NOT a boundary (the telemetry
+            # spine emits shard_start first; spans opened before the
+            # supervisor exists must stay tracked) — bench and pre-spine
+            # files, which have no shard_start, keep the old reset points.
             last_t = None
             state = None
+            open_spans = set()
+            in_shard_segment = ev_name == "shard_start"
         t = rec.get("t")
         if (isinstance(t, _NUM) and not isinstance(t, bool)
                 # shard-level commit/fault rows are stamped by launch.py's
@@ -152,6 +175,19 @@ def validate_events(path: str, strict: bool = False) -> list[str]:
                 errs.append(f"line {ln}: t went backwards "
                             f"({t} < {last_t})")
             last_t = t
+        if rec.get("event") == "span_open":
+            sid = rec.get("span")
+            if isinstance(sid, str):
+                if sid in open_spans:
+                    errs.append(f"line {ln}: span {sid!r} opened twice")
+                open_spans.add(sid)
+        elif rec.get("event") == "span_close":
+            sid = rec.get("span")
+            if isinstance(sid, str):
+                if sid not in open_spans:
+                    errs.append(f"line {ln}: span_close {sid!r} without a "
+                                "matching span_open")
+                open_spans.discard(sid)
         if rec.get("event") == "sup_state":
             f, to = rec.get("state_from"), rec.get("state_to")
             if f not in _STATES or to not in _STATES:

@@ -116,6 +116,14 @@ def main(argv=None) -> int:
         # sub-batch shape is fixed ONCE (candidate count rounded up to a
         # power of two) so the timed loop re-runs one compiled program pair
         # rather than compiling per candidate count.
+        from daccord_tpu.utils.obs import JsonlLogger, Tracer
+
+        # kernel.tier0 / kernel.rescue spans (ISSUE 6) land in the bench
+        # events sidecar pounce already collects and lints: the trace can
+        # then attribute this row's wall to the cheap-vs-quadratic split
+        ev_path = os.environ.get("DACCORD_BENCH_EVENTS")
+        tr_log = JsonlLogger(ev_path) if ev_path else None
+        tracer = Tracer(tr_log)
         out0 = fetch(solve_tier0_async(wb, ladder))
         n_resc = int(np.sum(rescue_candidates(out0, wb.nsegs, ladder)))
         rb = 1
@@ -124,9 +132,12 @@ def main(argv=None) -> int:
         rb = min(rb, B)
         ms_split = timed(
             "ladder_split",
-            lambda: solve_ladder_split(wb, ladder, rescue_batch=rb),
+            lambda: solve_ladder_split(wb, ladder, rescue_batch=rb,
+                                       tracer=tracer),
             extra={"rescue_rows": n_resc, "rescue_batch": rb,
                    "rescue_fraction": round(n_resc / B, 4)})
+        if tr_log is not None:
+            tr_log.close()
         if ms_full is not None:
             # the decision row: fused vs two-stream on identical inputs.
             # split_speedup > 1 means Stream A + dense Stream B beat the

@@ -1,0 +1,430 @@
+"""daccord-trace: merge per-worker telemetry, attribute wall clock, lint spans.
+
+Every process stamps events with an absolute wall-clock ``ts`` next to its
+process-relative ``t`` (``utils/obs.py``), so the per-worker sidecars of a
+fleet run — the orchestrator's ``fleet.events.jsonl`` plus each worker's
+``shardNNNN.events.jsonl`` — merge into ONE timeline here, the thing the
+per-process relative clocks could never give (ParaFold's lesson: attributing
+CPU pre/post stages vs device compute is what unlocks fleet-size scaling
+decisions).
+
+Three jobs:
+
+- **Span lint** (``--check``): every ``span_open`` has a matching
+  ``span_close`` (the pipeline/fleet ``finally`` unwinds guarantee this even
+  on abort/failover paths), no double-opens, no orphan closes; plus the
+  strict ``eventcheck`` schema lint, and per-shard ledger row-count
+  reconciliation (rows deduped on aread+widx must equal the manifest's
+  window count for non-resumed shards). Exit 1 on any violation — the
+  tools_pounce.sh pre-chip gate.
+
+- **Per-stage wall decomposition**: stage sums over span walls (feeder,
+  dispatch, device.fetch, hp, flush, governor rungs, setup) per worker, with
+  the device/host split reconciled against the run's own
+  ``stats.device_s``/``host_s`` anchors in ``shard_done`` — the
+  ``device.fetch`` span wraps exactly the region the ``device_s`` timer
+  measures, so honest telemetry reconciles to well under 5%.
+
+- **Fleet timeline** (and ``--probe-history``): milestone events on the
+  merged absolute clock; ``--probe-history`` summarizes TUNNEL_LOG.jsonl
+  (probe pass/fail runs, last-alive timestamp) so a ``fallback: true`` bench
+  row is attributable to a dated tunnel death at a glance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+#: span names per decomposition stage; device.fetch wraps exactly the
+#: region stats.device_s times, setup = one-time pre-loop work
+STAGES = (
+    ("feeder", ("feeder",)),
+    ("dispatch", ("dispatch",)),
+    ("device.fetch", ("device.fetch",)),
+    ("hp", ("hp",)),
+    ("flush", ("flush",)),
+    ("governor", ("governor.rung",)),
+    ("setup", ("scan", "profile", "ladder.build")),
+    ("probe", ("probe",)),
+)
+
+#: merged-timeline milestone events (everything else is summarized, not
+#: printed — a 100k-window run has far too many batch/window rows to list)
+MILESTONES = frozenset({
+    "fleet.init", "fleet.spawn", "fleet.takeover", "fleet.retry",
+    "fleet.poison", "fleet.capacity", "fleet.speculate", "fleet.done",
+    "fleet.finish", "fleet.demote", "fleet.fault",
+    "shard_start", "shard_done", "sup_init", "sup_failover", "sup_failback",
+    "sup_fault", "governor.classify", "governor.backpressure",
+    "governor.monster", "ingest.quarantine", "ingest.fault",
+    "bench_start", "bench_rung", "bench_done",
+})
+
+
+def _read_jsonl(path: str) -> list[dict]:
+    out = []
+    try:
+        with open(path) as fh:
+            for ln in fh:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue   # eventcheck reports malformed lines
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def _segments(records: list[dict]) -> list[list[dict]]:
+    """Split one file's records at ``shard_start`` boundaries (appended
+    worker attempts / resumes restart the stream there). Files without
+    shard_start (fleet sidecars, bench files) are one segment."""
+    segs: list[list[dict]] = []
+    cur: list[dict] = []
+    for rec in records:
+        if rec.get("event") == "shard_start" and cur:
+            segs.append(cur)
+            cur = []
+        cur.append(rec)
+    if cur:
+        segs.append(cur)
+    return segs
+
+
+def check_spans(records: list[dict], src: str = "") -> tuple[list[str], dict]:
+    """Span-pairing lint over one file's records.
+
+    Returns ``(errors, stage_walls)`` where ``stage_walls`` maps span name →
+    summed wall over the file's CLOSED spans. Pairing is validated per
+    shard_start segment: every open must close (the telemetry bundle's
+    ``finally`` unwind makes that hold even for aborted attempts — an
+    unclosed span means lost telemetry, e.g. a SIGKILLed worker's unflushed
+    buffer, and is flagged)."""
+    errs: list[str] = []
+    walls: dict[str, float] = {}
+    for si, seg in enumerate(_segments(records)):
+        open_spans: dict[str, str] = {}
+        for rec in seg:
+            ev = rec.get("event")
+            if ev == "span_open":
+                sid = str(rec.get("span"))
+                if sid in open_spans:
+                    errs.append(f"{src}: span {sid} opened twice")
+                open_spans[sid] = str(rec.get("name"))
+            elif ev == "span_close":
+                sid = str(rec.get("span"))
+                if sid not in open_spans:
+                    errs.append(f"{src}: span_close {sid} "
+                                f"({rec.get('name')}) without a matching "
+                                "span_open")
+                else:
+                    open_spans.pop(sid)
+                    w = rec.get("wall_s")
+                    if isinstance(w, (int, float)):
+                        name = str(rec.get("name"))
+                        walls[name] = walls.get(name, 0.0) + float(w)
+        for sid, name in open_spans.items():
+            errs.append(f"{src}: span {sid} ({name}) never closed "
+                        f"(segment {si}: telemetry lost mid-flight?)")
+    return errs, walls
+
+
+def decompose(records: list[dict], src: str = "") -> dict | None:
+    """Per-stage wall decomposition of one worker file's LAST completed
+    segment (the one whose shard_done carries the run's anchors). None when
+    the file has no shard_done (fleet/bench sidecars)."""
+    segs = _segments(records)
+    for seg in reversed(segs):
+        done = next((r for r in reversed(seg)
+                     if r.get("event") == "shard_done"), None)
+        if done is None:
+            continue
+        _, walls = check_spans(seg, src)
+        sup = next((r for r in seg if r.get("event") == "sup_init"), None)
+        inline = bool(sup.get("inline")) if sup else True
+        stages = {label: round(sum(walls.get(n, 0.0) for n in names), 4)
+                  for label, names in STAGES}
+        run_wall = walls.get("run", float(done.get("wall_s") or 0.0))
+        # the device side of the split: grouped fetches, plus governor-rung
+        # solves when the engine is remote (inline engines run rungs on
+        # host — the pipeline books them as host time too)
+        device_sum = stages["device.fetch"] + (
+            0.0 if inline else stages["governor"])
+        accounted = sum(stages.values())
+        return {"src": src, "wall_s": round(run_wall, 4),
+                "device_s": done.get("device_s"),
+                "host_s": done.get("host_s"),
+                "stages": stages,
+                "device_sum": round(device_sum, 4),
+                "host_sum": round(run_wall - device_sum, 4),
+                "other": round(max(run_wall - accounted, 0.0), 4),
+                "windows": done.get("windows"),
+                "reads": done.get("reads"),
+                "degraded": done.get("degraded")}
+    return None
+
+
+def reconcile(d: dict, tol_frac: float = 0.05,
+              tol_abs: float = 0.05) -> list[str]:
+    """Decomposition-vs-anchors check: the trace's device/host sums must
+    agree with the run's own ``stats.device_s``/``host_s`` within
+    ``tol_frac`` of the wall (floored at ``tol_abs`` seconds for near-zero
+    device time, e.g. the native engine)."""
+    issues = []
+    tol = max(tol_frac * max(d["wall_s"], 1e-9), tol_abs)
+    for key, mine in (("device_s", d["device_sum"]),
+                      ("host_s", d["host_sum"])):
+        anchor = d.get(key)
+        if anchor is None:
+            continue
+        if abs(float(anchor) - mine) > tol:
+            issues.append(f"{d['src']}: {key} decomposition off: span sum "
+                          f"{mine:.3f}s vs stats {float(anchor):.3f}s "
+                          f"(tolerance {tol:.3f}s)")
+    return issues
+
+
+def ledger_rows(path: str) -> tuple[int, int]:
+    """(total rows, distinct windows) of a ledger sidecar — a resumed shard
+    legitimately re-records the windows past its checkpoint, so the
+    manifest reconciliation keys on the DEDUPED count."""
+    seen = set()
+    total = 0
+    for rec in _read_jsonl(path):
+        if rec.get("event") != "window":
+            continue
+        total += 1
+        seen.add((rec.get("aread"), rec.get("widx")))
+    return total, len(seen)
+
+
+def check_dir_ledgers(outdir: str) -> tuple[list[str], list[str]]:
+    """(errors, report lines): per-shard ledger row counts vs manifest
+    window counts. Resumed shards (manifest ``resumed_at_read``) can
+    over-count in the MANIFEST (in-flight windows recount across the
+    checkpoint), so only non-resumed shards are enforced."""
+    errs: list[str] = []
+    lines: list[str] = []
+    for mpath in sorted(glob.glob(os.path.join(outdir, "shard*.json"))):
+        if mpath.endswith("progress.json") or mpath.endswith("metrics.json"):
+            continue
+        try:
+            with open(mpath) as fh:
+                m = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(m, dict) or "windows" not in m:
+            continue
+        lpath = mpath[: -len(".json")] + ".ledger.jsonl"
+        if not os.path.exists(lpath):
+            continue
+        total, distinct = ledger_rows(lpath)
+        ok = distinct == m["windows"]
+        resumed = "resumed_at_read" in m
+        lines.append(f"  {os.path.basename(lpath)}: {distinct} windows "
+                     f"({total} rows) vs manifest {m['windows']}"
+                     + (" [resumed]" if resumed else "")
+                     + ("" if ok or resumed else "  MISMATCH"))
+        if not ok and not resumed:
+            errs.append(f"{lpath}: ledger holds {distinct} distinct windows, "
+                        f"manifest says {m['windows']}")
+    return errs, lines
+
+
+def _expand(paths: list[str]) -> tuple[list[str], list[str], list[str]]:
+    """(event files, ledger files, dirs) from the argument list; a directory
+    contributes its fleet + per-shard sidecars."""
+    events, ledgers, dirs = [], [], []
+    for p in paths:
+        if os.path.isdir(p):
+            dirs.append(p)
+            events.extend(sorted(glob.glob(os.path.join(p, "*.events.jsonl"))))
+            ledgers.extend(sorted(glob.glob(os.path.join(p, "*.ledger.jsonl"))))
+        elif p.endswith(".ledger.jsonl"):
+            ledgers.append(p)
+        else:
+            events.append(p)
+    return events, ledgers, dirs
+
+
+def _fmt_ts(ts: float) -> str:
+    import time as _time
+
+    return _time.strftime("%H:%M:%S", _time.localtime(ts))
+
+
+def print_timeline(merged: list[tuple[float, str, dict]], out) -> None:
+    """Milestone events on the merged absolute clock, offsets from t0."""
+    rows = [(ts, src, rec) for ts, src, rec in merged
+            if rec.get("event") in MILESTONES]
+    if not rows:
+        return
+    t0 = rows[0][0]
+    print(f"merged timeline ({len(rows)} milestones, "
+          f"t0 {_fmt_ts(t0)}):", file=out)
+    for ts, src, rec in rows:
+        ev = rec.get("event")
+        detail = {k: v for k, v in rec.items()
+                  if k not in ("t", "ts", "event")}
+        print(f"  +{ts - t0:9.3f}s  [{src}] {ev} "
+              f"{json.dumps(detail, default=str)[:120]}", file=out)
+
+
+def trace_main(argv=None) -> int:
+    """daccord-trace: merge per-worker event files on absolute timestamps,
+    validate span pairing, and print the fleet timeline + per-stage wall
+    decomposition (reconciled against stats.device_s/host_s)."""
+    p = argparse.ArgumentParser(prog="daccord-trace",
+                                description=trace_main.__doc__)
+    p.add_argument("paths", nargs="*",
+                   help="event jsonl files, ledger sidecars, or run "
+                        "directories (a directory contributes its "
+                        "*.events.jsonl + *.ledger.jsonl + manifests)")
+    p.add_argument("--check", action="store_true",
+                   help="lint mode: strict eventcheck schema + span pairing "
+                        "+ ledger/manifest reconciliation; exit 1 on any "
+                        "violation")
+    p.add_argument("--json", action="store_true",
+                   help="emit the decomposition as one JSON line on stdout")
+    p.add_argument("--no-timeline", action="store_true")
+    p.add_argument("--probe-history", nargs="?", const="TUNNEL_LOG.jsonl",
+                   default=None, metavar="LOG",
+                   help="summarize a tunnel probe log (default "
+                        "TUNNEL_LOG.jsonl): pass/fail runs and the "
+                        "last-alive timestamp, so 'fallback: true' bench "
+                        "rows are attributable at a glance")
+    args = p.parse_args(argv)
+
+    if args.probe_history is not None:
+        return probe_history_main(args.probe_history)
+    if not args.paths:
+        p.error("no input files (or use --probe-history)")
+
+    from .eventcheck import validate_events
+
+    events, ledgers, dirs = _expand(args.paths)
+    errors: list[str] = []
+    out = sys.stderr
+
+    # 1) schema lint (strict for event streams, shape-only for ledgers —
+    # appended resume segments legitimately restart a ledger's clock)
+    for path in events:
+        errors.extend(f"{path}: {e}"
+                      for e in validate_events(path, strict=True))
+    for path in ledgers:
+        errors.extend(f"{path}: {e}"
+                      for e in validate_events(path, strict=False))
+
+    # 2) span pairing + decomposition per file, merged timeline rows
+    merged: list[tuple[float, str, dict]] = []
+    decomps: list[dict] = []
+    for path in events:
+        recs = _read_jsonl(path)
+        src = os.path.basename(path).replace(".events.jsonl", "")
+        errs, _ = check_spans(recs, src)
+        errors.extend(errs)
+        d = decompose(recs, src)
+        if d is not None:
+            errors.extend(reconcile(d))
+            decomps.append(d)
+        for rec in recs:
+            ts = rec.get("ts")
+            if isinstance(ts, (int, float)):
+                merged.append((float(ts), src, rec))
+    merged.sort(key=lambda x: x[0])
+
+    # 3) ledger reconciliation per run directory
+    ledger_lines: list[str] = []
+    for d_ in dirs:
+        errs, lines = check_dir_ledgers(d_)
+        errors.extend(errs)
+        ledger_lines.extend(lines)
+
+    if not args.no_timeline and not args.json:
+        print_timeline(merged, out)
+    if decomps and not args.json:
+        print("per-stage wall decomposition:", file=out)
+        for d in decomps:
+            dev = d.get("device_s")
+            anchor = (f" [stats device {dev:.3f}s host {d['host_s']:.3f}s]"
+                      if isinstance(dev, (int, float)) else "")
+            print(f"  {d['src']}: wall {d['wall_s']:.3f}s = "
+                  f"device {d['device_sum']:.3f}s + host "
+                  f"{d['host_sum']:.3f}s{anchor}", file=out)
+            for label, _names in STAGES:
+                v = d["stages"][label]
+                if v > 0:
+                    print(f"      {label:<14} {v:9.3f}s", file=out)
+            print(f"      {'other(host)':<14} {d['other']:9.3f}s", file=out)
+    if ledger_lines and not args.json:
+        print("outcome ledgers:", file=out)
+        for ln in ledger_lines:
+            print(ln, file=out)
+    if args.json:
+        print(json.dumps({"decomposition": decomps,
+                          "errors": errors,
+                          "milestones": sum(1 for _, _, r in merged
+                                            if r.get("event") in MILESTONES)}))
+    for e in errors[:40]:
+        print(f"daccord-trace: {e}", file=out)
+    if len(errors) > 40:
+        print(f"daccord-trace: ... {len(errors) - 40} more", file=out)
+    n_files = len(events) + len(ledgers)
+    print(f"daccord-trace: {n_files} file(s), {len(merged)} records, "
+          f"{len(decomps)} decomposition(s): "
+          + ("OK" if not errors else f"{len(errors)} error(s)"), file=out)
+    return 1 if (errors and args.check) else 0
+
+
+def probe_history_main(path: str) -> int:
+    """--probe-history: pass/fail runs over a TUNNEL_LOG-style jsonl."""
+    recs = _read_jsonl(path)
+    if not recs:
+        print(f"daccord-trace: {path}: no probe records", file=sys.stderr)
+        return 1
+    runs: list[tuple[bool, int, str, str]] = []   # (alive, n, first, last)
+    last_alive = None
+    n_alive = 0
+    for r in recs:
+        alive = bool(r.get("alive"))
+        ts = str(r.get("ts", "?"))
+        if alive:
+            last_alive = ts
+            n_alive += 1
+        if runs and runs[-1][0] == alive:
+            a, n, first, _ = runs[-1]
+            runs[-1] = (a, n + 1, first, ts)
+        else:
+            runs.append((alive, 1, ts, ts))
+    print(f"{path}: {len(recs)} probes, {n_alive} alive / "
+          f"{len(recs) - n_alive} dead")
+    print(f"  last alive: {last_alive or 'NEVER'}")
+    cur = runs[-1]
+    print(f"  current streak: {'ALIVE' if cur[0] else 'dead'} x{cur[1]} "
+          f"(since {cur[2]})")
+    print("  timeline (pass/fail runs):")
+    for alive, n, first, last in runs:
+        mark = "#" if alive else "."
+        label = "alive" if alive else "dead"
+        span = first if first == last else f"{first} .. {last}"
+        print(f"    {mark * min(n, 40):<40} {label:>5} x{n:<4} {span}")
+    # attributability hook: the most recent reasons help date a death
+    tail = recs[-3:]
+    for r in tail:
+        print(f"  recent: {r.get('ts')} alive={r.get('alive')} "
+              f"reason={r.get('reason', r.get('note', '?'))} "
+              f"after={r.get('after', '-')}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(trace_main())

@@ -1,35 +1,101 @@
-"""Observability: structured jsonl event log + counters.
+"""Observability: structured jsonl event log, trace spans, metrics, ledger.
 
 The reference's observability is unstructured stderr prints plus
 ``util::Histogram`` dumps (SURVEY.md §5); here every pipeline event is a JSON
 line so runs are machine-checkable: windows/sec, bases/sec/chip, per-tier
 solve counts, pad-waste ratio — the metrics BASELINE.json tracks.
+
+The telemetry spine (ISSUE 6) lives here:
+
+- :class:`JsonlLogger` — every record carries BOTH a process-relative ``t``
+  and an absolute wall-clock ``ts`` (epoch seconds), so per-worker event
+  files from different processes merge onto one fleet timeline
+  (``daccord-trace``). Buffered mode bounds the hot-path cost to one
+  syscall per ``buffer_lines`` records (or ``flush_s`` seconds), while
+  fault/commit-class events (:data:`DURABLE_EVENTS`) keep line-granularity
+  durability by flushing through immediately.
+- :class:`Tracer` — hierarchical trace spans (``span_open``/``span_close``
+  with ids chaining run → pile → batch → dispatch/fetch/flush/governor-rung)
+  over any :class:`JsonlLogger`; span ids are process-unique so merged
+  multi-worker files cannot collide.
+- :class:`MetricsRegistry` — typed counters/gauges/histograms with periodic
+  ``metrics`` snapshot events and an end-of-run rollup dict (committed
+  durably beside the shard manifest by ``launch.run_shard``).
+- :class:`WindowLedger` — the per-window outcome ledger (window identity,
+  length, depth, tier reached, rescue membership, batch solve wall) as a
+  jsonl sidecar: the training set ROADMAP item 5's learned window router
+  needs, written through the buffered logger so it stays off the hot path.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import sys
 import time
 
+#: events that keep line-granularity durability even under a buffered
+#: logger: anything a post-mortem needs the instant it happened (faults,
+#: checkpoint commits, state machine transitions, quarantine/poison
+#: decisions). All of them are off the hot path, so flushing through costs
+#: nothing in steady state.
+DURABLE_EVENTS = frozenset({
+    "sup_fault", "sup_failover", "sup_failback", "sup_state",
+    "ingest.fault", "ingest.commit", "ingest.quarantine",
+    "fleet.fault", "fleet.poison", "fleet.capacity", "fleet.takeover",
+    "governor.classify", "governor.monster",
+})
+
 
 class JsonlLogger:
-    def __init__(self, path: str | None = None, stream=None):
+    def __init__(self, path: str | None = None, stream=None,
+                 buffer_lines: int = 1, flush_s: float = 0.0):
+        """``buffer_lines=1`` (default) flushes after every record — the
+        historical behavior, right for low-rate loggers whose readers poll
+        mid-run. Hot-path writers (the pipeline's event/ledger streams) pass
+        ``buffer_lines``>1 plus a ``flush_s`` cadence bound; records in
+        :data:`DURABLE_EVENTS` always flush through, and ``close()``
+        flushes the tail."""
         self._fh = None
         if path == "-":
             self._fh = stream or sys.stderr
         elif path:
             self._fh = open(path, "at")
         self._t0 = time.time()
+        self._buf: list[str] = []
+        self._buffer_lines = max(1, int(buffer_lines))
+        self._flush_s = flush_s
+        self._last_flush = self._t0
 
     def log(self, event: str, **fields) -> None:
         if self._fh is None:
             return
-        rec = {"t": round(time.time() - self._t0, 3), "event": event, **fields}
-        self._fh.write(json.dumps(rec) + "\n")
+        now = time.time()
+        # t = process-relative (human-scale deltas within one run); ts =
+        # absolute epoch (the cross-process merge key — every fleet worker's
+        # t0 differs, so t alone cannot order a multi-host timeline)
+        rec = {"t": round(now - self._t0, 3), "ts": round(now, 6),
+               "event": event, **fields}
+        self._buf.append(json.dumps(rec) + "\n")
+        if (len(self._buf) >= self._buffer_lines
+                or event in DURABLE_EVENTS
+                or (self._flush_s and now - self._last_flush >= self._flush_s)):
+            self.flush()
+
+    def flush(self) -> None:
+        if self._fh is None or not self._buf:
+            return
+        # one write call for the whole buffer: complete lines only, so
+        # concurrent appenders (launch.py's checkpoint logger shares the
+        # worker's events file) interleave at line granularity
+        self._fh.write("".join(self._buf))
+        self._buf.clear()
         self._fh.flush()
+        self._last_flush = time.time()
 
     def close(self) -> None:
+        self.flush()
         if self._fh is not None and self._fh is not sys.stderr:
             self._fh.close()
 
@@ -47,6 +113,237 @@ class JsonlLogger:
 class NullLogger(JsonlLogger):
     def __init__(self):
         super().__init__(None)
+
+
+#: process-wide span id counter: several Tracer instances may share one
+#: events file (pipeline + supervisor default), so uniqueness must not
+#: depend on which instance minted the id
+_SPAN_IDS = itertools.count(1)
+
+
+class Tracer:
+    """Hierarchical trace spans over a :class:`JsonlLogger`.
+
+    ``open`` emits ``span_open`` (id, parent, name) and pushes the span on
+    the parent stack; ``close`` emits ``span_close`` with the measured wall.
+    Ids are ``<pid-hex>-<n>`` so files merged across fleet workers cannot
+    collide. Non-nested spans (a batch open at dispatch, closed at fetch
+    several piles later) pass ``attach=False`` with an explicit ``parent``
+    so the stack stays well-formed. ``unwind`` closes every span still open
+    (status=abort) — called from the owners' ``finally`` blocks so abort
+    and failover paths keep the every-open-has-a-close invariant that
+    ``daccord-trace --check`` enforces.
+    """
+
+    def __init__(self, log: JsonlLogger | None):
+        self.log = log if log is not None else NullLogger()
+        self.enabled = self.log._fh is not None
+        self._pid = "%x" % os.getpid()
+        self._stack: list[str] = []
+        self._open: dict[str, tuple[str, float]] = {}
+
+    def open(self, name: str, parent: str | None = None, attach: bool = True,
+             **fields) -> str | None:
+        if not self.enabled:
+            return None
+        sid = f"{self._pid}-{next(_SPAN_IDS)}"
+        if parent is None:
+            parent = self._stack[-1] if self._stack else ""
+        self._open[sid] = (name, time.time())
+        if attach:
+            self._stack.append(sid)
+        self.log.log("span_open", span=sid, parent=parent, name=name, **fields)
+        return sid
+
+    def close(self, sid: str | None, **fields) -> None:
+        if sid is None:
+            return
+        name, t0 = self._open.pop(sid, (None, 0.0))
+        if name is None:
+            return   # unknown/already closed: keep close idempotent
+        if sid in self._stack:
+            # normally the top; an out-of-order close (abort unwind) must
+            # not strand descendants' parent pointers
+            self._stack.remove(sid)
+        self.log.log("span_close", span=sid, name=name,
+                     wall_s=round(time.time() - t0, 6), **fields)
+
+    def span(self, name: str, **fields):
+        """Context manager form; closes with ``status=error`` on exception."""
+        return _SpanCtx(self, name, fields)
+
+    def unwind(self, status: str = "abort") -> None:
+        """Close every span still open, innermost first."""
+        for sid in sorted(self._open,
+                          key=lambda s: self._open[s][1], reverse=True):
+            self.close(sid, status=status)
+
+
+class _SpanCtx:
+    __slots__ = ("_tr", "_name", "_fields", "sid")
+
+    def __init__(self, tracer: Tracer, name: str, fields: dict):
+        self._tr, self._name, self._fields = tracer, name, fields
+        self.sid = None
+
+    def __enter__(self):
+        self.sid = self._tr.open(self._name, **self._fields)
+        return self.sid
+
+    def __exit__(self, et, ev, tb) -> bool:
+        if et is None:
+            self._tr.close(self.sid)
+        else:
+            self._tr.close(self.sid, status="error")
+        return False
+
+
+class _Counter:
+    __slots__ = ("n",)
+
+    def __init__(self):
+        self.n = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.n += n
+
+
+class _Gauge:
+    __slots__ = ("v",)
+
+    def __init__(self):
+        self.v = 0.0
+
+    def set(self, v: float) -> None:
+        self.v = float(v)
+
+
+class _Histogram:
+    """Count/sum/min/max plus coarse log2 buckets — enough shape for a
+    turnaround distribution without per-sample storage."""
+
+    __slots__ = ("count", "total", "vmin", "vmax", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = None
+        self.vmax = None
+        self.buckets: dict[int, int] = {}
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.total += v
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = v if self.vmax is None else max(self.vmax, v)
+        b = max(-30, min(30, int(v).bit_length() if v >= 1
+                         else -int(1.0 / max(v, 1e-9)).bit_length()))
+        self.buckets[b] = self.buckets.get(b, 0) + 1
+
+    def summary(self) -> dict:
+        return {"count": self.count, "sum": round(self.total, 6),
+                "min": self.vmin, "max": self.vmax,
+                "mean": round(self.total / self.count, 6) if self.count else None}
+
+
+class MetricsRegistry:
+    """Typed metrics registry: counters, gauges, histograms.
+
+    ``snapshot(log)`` emits one ``metrics`` event with every current value
+    (periodic — the pipeline calls it at a bounded cadence from the pile
+    loop); ``rollup()`` returns the end-of-run dict that ``launch.run_shard``
+    commits durably beside the shard manifest."""
+
+    def __init__(self):
+        self._counters: dict[str, _Counter] = {}
+        self._gauges: dict[str, _Gauge] = {}
+        self._hists: dict[str, _Histogram] = {}
+
+    def counter(self, name: str) -> _Counter:
+        return self._counters.setdefault(name, _Counter())
+
+    def gauge(self, name: str) -> _Gauge:
+        return self._gauges.setdefault(name, _Gauge())
+
+    def histogram(self, name: str) -> _Histogram:
+        return self._hists.setdefault(name, _Histogram())
+
+    def snapshot(self, log: JsonlLogger, **extra) -> None:
+        log.log("metrics",
+                counters={k: c.n for k, c in sorted(self._counters.items())},
+                gauges={k: round(g.v, 6)
+                        for k, g in sorted(self._gauges.items())},
+                hists={k: h.summary() for k, h in sorted(self._hists.items())},
+                **extra)
+
+    def rollup(self) -> dict:
+        return {"counters": {k: c.n for k, c in sorted(self._counters.items())},
+                "gauges": {k: round(g.v, 6)
+                           for k, g in sorted(self._gauges.items())},
+                "hists": {k: h.summary()
+                          for k, h in sorted(self._hists.items())}}
+
+
+class WindowLedger:
+    """Per-window outcome ledger: one ``window`` jsonl row per window the
+    pipeline accounted — the exact training set the learned window router
+    (ROADMAP item 5) needs. Rows are written through a buffered
+    :class:`JsonlLogger` (appending: a checkpointed resume continues the
+    sidecar; fresh runs remove the file first, the quarantine-sidecar rule).
+
+    ``wall_s`` is the window's batch turnaround (dispatch → scatter): windows
+    solve batched, so per-window wall is attributable only at batch
+    granularity. Rows record the outcome at solve time — a later end-trim
+    (rescue-tier read ends) does not rewrite them."""
+
+    def __init__(self, path: str):
+        self.log = JsonlLogger(path, buffer_lines=256, flush_s=5.0)
+        self.rows = 0
+
+    def record(self, aread: int, widx: int, length: int, depth: int,
+               tier: int, k: int, solved: bool, stream: str, rescued: bool,
+               wall_s: float) -> None:
+        self.rows += 1
+        log = self.log
+        if log._fh is None:
+            return
+        # hand-built line (fixed schema, scalar fields only): one ledger row
+        # per window is the highest-volume telemetry record, and skipping
+        # json.dumps keeps it ~3x cheaper — the hot-path budget (<=2% on the
+        # native engine) is spent mostly here
+        now = time.time()
+        log._buf.append(
+            '{"t": %.3f, "ts": %.6f, "event": "window", "aread": %d, '
+            '"widx": %d, "len": %d, "depth": %d, "tier": %d, "k": %d, '
+            '"solved": %s, "stream": "%s", "rescued": %s, "wall_s": %.6f}\n'
+            % (now - log._t0, now, aread, widx, length, depth, tier, k,
+               "true" if solved else "false", stream,
+               "true" if rescued else "false", wall_s))
+        if (len(log._buf) >= log._buffer_lines
+                or (log._flush_s and now - log._last_flush >= log._flush_s)):
+            log.flush()
+
+    def close(self) -> None:
+        self.log.close()
+
+
+def device_peak_bytes() -> int | None:
+    """Peak device memory of device 0 via ``memory_stats()`` (None when the
+    backend does not report it — CPU usually, or jax untouched). Callers
+    gate on a device path: this initializes the default backend if nothing
+    has yet."""
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+
+        ms = jax.devices()[0].memory_stats()
+        if not ms or "peak_bytes_in_use" not in ms:
+            return None
+        return int(ms["peak_bytes_in_use"])
+    except Exception:
+        return None
 
 
 def probe_backend_status(timeout_s: int | None = None) -> tuple[int, str]:

@@ -344,26 +344,26 @@ def test_per_class_retry_budget(tmp_path, monkeypatch):
 def test_eventcheck_governor_schema(tmp_path):
     good = tmp_path / "gov.jsonl"
     good.write_text("\n".join([
-        json.dumps({"t": 0.1, "event": "governor.classify", "key": "B8",
+        json.dumps({"t": 0.1, "ts": 1.1, "event": "governor.classify", "key": "B8",
                     "width": 8, "reason": "RESOURCE_EXHAUSTED"}),
-        json.dumps({"t": 0.2, "event": "governor.shrink", "key": "B8",
+        json.dumps({"t": 0.2, "ts": 1.2, "event": "governor.shrink", "key": "B8",
                     "width_from": 8, "width_to": 4}),
-        json.dumps({"t": 0.3, "event": "governor.clamp", "key": "B8",
+        json.dumps({"t": 0.3, "ts": 1.3, "event": "governor.clamp", "key": "B8",
                     "width": 4, "esc_cap": 2}),
-        json.dumps({"t": 0.4, "event": "governor.ratchet", "key": "B8",
+        json.dumps({"t": 0.4, "ts": 1.4, "event": "governor.ratchet", "key": "B8",
                     "width": 4}),
-        json.dumps({"t": 0.5, "event": "governor.restore", "key": "B8",
+        json.dumps({"t": 0.5, "ts": 1.5, "event": "governor.restore", "key": "B8",
                     "width": 8, "ok": True}),
-        json.dumps({"t": 0.6, "event": "governor.backpressure",
+        json.dumps({"t": 0.6, "ts": 1.6, "event": "governor.backpressure",
                     "level": "hard", "rss_mb": 123.4}),
-        json.dumps({"t": 0.7, "event": "governor.monster", "aread": 3,
+        json.dumps({"t": 0.7, "ts": 1.7, "event": "governor.monster", "aread": 3,
                     "overlaps": 120000, "budget": 100000}),
-        json.dumps({"t": 0.8, "event": "fleet.capacity", "shard": 1,
+        json.dumps({"t": 0.8, "ts": 1.8, "event": "fleet.capacity", "shard": 1,
                     "batch": 256}),
     ]) + "\n")
     assert validate_events(str(good), strict=True) == []
     bad = tmp_path / "bad.jsonl"
-    bad.write_text(json.dumps({"t": 0.1, "event": "governor.shrink",
+    bad.write_text(json.dumps({"t": 0.1, "ts": 1.1, "event": "governor.shrink",
                                "key": "B8", "width_from": "big"}) + "\n")
     errs = validate_events(str(bad))
     assert errs and any("width_to" in e for e in errs)

@@ -71,8 +71,8 @@ def test_eventcheck_ladder_flush_schema(tmp_path):
 
     good = tmp_path / "flush.jsonl"
     good.write_text(json.dumps(
-        {"t": 0.1, "event": "ladder.flush", "rows": 100, "slots": 128,
-         "reason": "lag", "bucket": 0}) + "\n")
+        {"t": 0.1, "ts": 1.0, "event": "ladder.flush", "rows": 100,
+         "slots": 128, "reason": "lag", "bucket": 0}) + "\n")
     assert validate_events(str(good), strict=True) == []
     bad = tmp_path / "bad.jsonl"
     bad.write_text(json.dumps(

@@ -166,7 +166,8 @@ def test_supervisor_fetch_hang_redispatches(tmp_path, monkeypatch):
     # not the exception class name or the combined device-op counter
     faults = [json.loads(x) for x in open(ev)]
     faults = [r for r in faults if r["event"] == "sup_fault"]
-    assert faults == [{"t": faults[0]["t"], "event": "sup_fault",
+    assert faults == [{"t": faults[0]["t"], "ts": faults[0]["ts"],
+                       "event": "sup_fault",
                        "kind": "fetch_hang", "op": "fetch", "n": 1}]
     assert validate_events(ev, strict=True) == []
 
@@ -311,15 +312,19 @@ def test_supervisor_no_fallback_raises(tmp_path, monkeypatch):
 def test_eventcheck_schema_and_transitions(tmp_path):
     good = tmp_path / "good.jsonl"
     good.write_text("\n".join([
-        json.dumps({"t": 0.1, "event": "sup_init", "primary": "x",
+        json.dumps({"t": 0.1, "ts": 1.0, "event": "sup_init", "primary": "x",
                     "op_deadline_s": 1.0, "compile_deadline_s": 2.0}),
-        json.dumps({"t": 0.2, "event": "sup_state", "state_from": "HEALTHY",
-                    "state_to": "SUSPECT", "reason": "r", "ts": 1.0}),
-        json.dumps({"t": 0.3, "event": "sup_state", "state_from": "SUSPECT",
-                    "state_to": "LOST", "reason": "r", "ts": 1.1}),
-        json.dumps({"t": 0.4, "event": "sup_state", "state_from": "LOST",
-                    "state_to": "DEGRADED", "reason": "r", "ts": 1.2}),
-        json.dumps({"t": 0.5, "event": "custom_info", "anything": 1}),
+        json.dumps({"t": 0.2, "ts": 1.1, "event": "sup_state",
+                    "state_from": "HEALTHY",
+                    "state_to": "SUSPECT", "reason": "r"}),
+        json.dumps({"t": 0.3, "ts": 1.2, "event": "sup_state",
+                    "state_from": "SUSPECT",
+                    "state_to": "LOST", "reason": "r"}),
+        json.dumps({"t": 0.4, "ts": 1.3, "event": "sup_state",
+                    "state_from": "LOST",
+                    "state_to": "DEGRADED", "reason": "r"}),
+        json.dumps({"t": 0.5, "ts": 1.4, "event": "custom_info",
+                    "anything": 1}),
     ]) + "\n")
     assert validate_events(str(good), strict=True) == []
 

@@ -1,0 +1,398 @@
+"""Telemetry spine (ISSUE 6): absolute-ts buffered logging, trace spans,
+metrics registry, per-window outcome ledger, and the daccord-trace
+merge/lint/decomposition tool.
+
+The invariants under test are the ones the spine sells: telemetry-on vs
+telemetry-off FASTA is byte-identical under the fault matrix, every
+span_open has a span_close even on abort/failover unwind paths, ledger rows
+equal the run's window count, and daccord-trace's per-stage wall
+decomposition reconciles with ``stats.device_s``/``host_s``.
+"""
+
+import json
+import os
+
+import pytest
+
+from daccord_tpu.runtime.pipeline import PipelineConfig, correct_to_fasta
+from daccord_tpu.sim import SimConfig, make_dataset
+from daccord_tpu.tools.eventcheck import validate_events
+from daccord_tpu.tools import trace as trace_mod
+from daccord_tpu.utils.obs import (
+    DURABLE_EVENTS,
+    JsonlLogger,
+    MetricsRegistry,
+    Tracer,
+    WindowLedger,
+)
+
+pytestmark = pytest.mark.skipif(
+    not pytest.importorskip("daccord_tpu.native").available(),
+    reason="native engine required (telemetry hot-path tests run on it)")
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tracedata"))
+    return make_dataset(d, SimConfig(genome_len=1200, coverage=10,
+                                     read_len_mean=400, min_overlap=150,
+                                     seed=7), name="tr")
+
+
+def _events(path):
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+
+
+# ---------------------------------------------------------------------------
+# JsonlLogger: absolute ts + buffered mode (satellites 1 + 2)
+# ---------------------------------------------------------------------------
+
+def test_logger_ts_and_relative_t(tmp_path):
+    import time
+
+    p = str(tmp_path / "ev.jsonl")
+    before = time.time()
+    with JsonlLogger(p) as log:
+        log.log("batch", windows=3, solved=2)
+    rec = _events(p)[0]
+    # t stays (human-scale within-run deltas); ts is the cross-process
+    # merge key — an absolute epoch stamp
+    assert 0.0 <= rec["t"] < 5.0
+    assert before - 1 <= rec["ts"] <= time.time() + 1
+
+
+def test_logger_buffered_mode(tmp_path):
+    p = str(tmp_path / "buf.jsonl")
+    log = JsonlLogger(p, buffer_lines=100, flush_s=0.0)
+    for i in range(5):
+        log.log("batch", windows=i, solved=0)
+    # nothing hits the disk until a flush condition
+    assert open(p).read() == ""
+    # durable events flush through immediately — WITH the buffered tail
+    # ahead of them (ordering preserved)
+    log.log("sup_fault", kind="device_lost", op="dispatch", n=1)
+    assert "sup_fault" in DURABLE_EVENTS
+    recs = _events(p)
+    assert len(recs) == 6 and recs[-1]["event"] == "sup_fault"
+    # close flushes the remaining tail
+    log.log("batch", windows=9, solved=9)
+    log.close()
+    assert _events(p)[-1]["windows"] == 9
+
+
+def test_logger_flush_interval(tmp_path):
+    import time
+
+    p = str(tmp_path / "cadence.jsonl")
+    log = JsonlLogger(p, buffer_lines=10_000, flush_s=0.05)
+    log.log("batch", windows=1, solved=0)
+    assert open(p).read() == ""
+    time.sleep(0.06)
+    log.log("batch", windows=2, solved=0)   # cadence bound fires here
+    assert len(_events(p)) == 2
+    log.close()
+
+
+# ---------------------------------------------------------------------------
+# Tracer: pairing, nesting, abort unwind
+# ---------------------------------------------------------------------------
+
+def test_tracer_nesting_and_pairing(tmp_path):
+    p = str(tmp_path / "spans.jsonl")
+    log = JsonlLogger(p)
+    tr = Tracer(log)
+    run = tr.open("run")
+    pile = tr.open("pile", aread=3)
+    with tr.span("dispatch"):
+        pass
+    tr.close(pile)
+    tr.close(run)
+    log.close()
+    recs = _events(p)
+    opens = {r["span"]: r for r in recs if r["event"] == "span_open"}
+    closes = {r["span"] for r in recs if r["event"] == "span_close"}
+    assert set(opens) == closes                      # every open has a close
+    d = next(r for r in recs
+             if r["event"] == "span_open" and r["name"] == "dispatch")
+    assert d["parent"] == pile                       # stack parenting
+    assert opens[pile]["parent"] == run
+    assert opens[run]["parent"] == ""
+    assert validate_events(p, strict=False) == []
+    errs, walls = trace_mod.check_spans(recs, "t")
+    assert errs == [] and walls["run"] >= walls["pile"] >= 0.0
+
+
+def test_tracer_error_and_unwind(tmp_path):
+    p = str(tmp_path / "abort.jsonl")
+    log = JsonlLogger(p)
+    tr = Tracer(log)
+    tr.open("run")
+    with pytest.raises(ValueError):
+        with tr.span("dispatch"):
+            raise ValueError("boom")
+    tr.open("pile")
+    tr.unwind()          # the telemetry-bundle finally path
+    log.close()
+    recs = _events(p)
+    closes = [r for r in recs if r["event"] == "span_close"]
+    assert {r["span"] for r in recs if r["event"] == "span_open"} \
+        == {r["span"] for r in closes}
+    assert any(r.get("status") == "error" and r["name"] == "dispatch"
+               for r in closes)
+    assert sum(r.get("status") == "abort" for r in closes) == 2
+    assert trace_mod.check_spans(recs, "t")[0] == []
+    # double close is a no-op, not a second record
+    tr.close("nonexistent-id")
+
+
+# ---------------------------------------------------------------------------
+# MetricsRegistry
+# ---------------------------------------------------------------------------
+
+def test_metrics_registry(tmp_path):
+    m = MetricsRegistry()
+    m.counter("dispatches").inc()
+    m.counter("dispatches").inc(2)
+    m.gauge("rss_mb").set(123.4)
+    h = m.histogram("turnaround_s")
+    for v in (0.5, 1.5, 2.5):
+        h.observe(v)
+    p = str(tmp_path / "m.jsonl")
+    with JsonlLogger(p) as log:
+        m.snapshot(log)
+    assert validate_events(p, strict=False) == []
+    rec = _events(p)[0]
+    assert rec["event"] == "metrics"
+    assert rec["counters"]["dispatches"] == 3
+    assert rec["gauges"]["rss_mb"] == 123.4
+    assert rec["hists"]["turnaround_s"]["count"] == 3
+    roll = m.rollup()
+    assert roll["hists"]["turnaround_s"]["max"] == 2.5
+    assert abs(roll["hists"]["turnaround_s"]["mean"] - 1.5) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# eventcheck: new record kinds + strict span rules
+# ---------------------------------------------------------------------------
+
+def test_eventcheck_span_rules(tmp_path):
+    p = str(tmp_path / "bad.jsonl")
+    rows = [
+        {"t": 0.0, "ts": 1.0, "event": "shard_start", "start": 0, "end": 9,
+         "pid": 1},
+        {"t": 0.1, "ts": 1.1, "event": "span_close", "span": "a-1",
+         "name": "run", "wall_s": 0.1},                # close without open
+        {"t": 0.2, "ts": 1.2, "event": "span_open", "span": "a-2",
+         "parent": "", "name": "run"},
+        {"t": 0.3, "ts": 1.3, "event": "span_open", "span": "a-2",
+         "parent": "", "name": "run"},                 # double open
+    ]
+    with open(p, "wt") as fh:
+        fh.writelines(json.dumps(r) + "\n" for r in rows)
+    errs = validate_events(p, strict=True)
+    assert any("without a matching span_open" in e for e in errs)
+    assert any("opened twice" in e for e in errs)
+    # a shard_start boundary resets span tracking (appended worker attempts)
+    rows2 = rows[:3] + [
+        {"t": 0.0, "ts": 2.0, "event": "shard_start", "start": 0, "end": 9,
+         "pid": 2},
+        {"t": 0.1, "ts": 2.1, "event": "span_open", "span": "b-1",
+         "parent": "", "name": "run"},
+        {"t": 0.2, "ts": 2.2, "event": "span_close", "span": "b-1",
+         "name": "run", "wall_s": 0.1},
+    ]
+    with open(p, "wt") as fh:
+        fh.writelines(json.dumps(r) + "\n" for r in rows2)
+    errs = validate_events(p, strict=True)
+    assert len([e for e in errs if "span" in e]) == 1   # only the orphan close
+
+
+def test_eventcheck_requires_ts():
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("wt", suffix=".jsonl",
+                                     delete=False) as fh:
+        fh.write(json.dumps({"t": 0.0, "event": "batch", "windows": 1,
+                             "solved": 1}) + "\n")
+        p = fh.name
+    errs = validate_events(p)
+    assert any("missing field 'ts'" in e for e in errs)
+    os.unlink(p)
+
+
+# ---------------------------------------------------------------------------
+# pipeline integration: ledger row count, span lint, decomposition
+# ---------------------------------------------------------------------------
+
+def _run(dataset, tmp_path, tag, telemetry: bool, batch=64):
+    d = str(tmp_path)
+    ev = os.path.join(d, f"{tag}.events.jsonl") if telemetry else None
+    led = os.path.join(d, f"{tag}.ledger.jsonl") if telemetry else None
+    cfg = PipelineConfig(native_solver=True, batch_size=batch,
+                         events_path=ev, ledger_path=led,
+                         metrics_snapshot_s=0.2 if telemetry else 0.0)
+    out = os.path.join(d, f"{tag}.fasta")
+    st = correct_to_fasta(dataset["db"], dataset["las"], out, cfg)
+    return out, ev, led, st
+
+
+def test_ledger_rows_equal_window_count(dataset, tmp_path):
+    out, ev, led, st = _run(dataset, tmp_path, "full", telemetry=True)
+    rows = [r for r in _events(led) if r["event"] == "window"]
+    assert len(rows) == st.n_windows
+    # row shape: identity, geometry, outcome — the router training columns
+    r = next(r for r in rows if r["solved"])
+    assert r["depth"] >= 1 and r["len"] > 0 and r["tier"] >= 0 and r["k"] > 0
+    skips = [r for r in rows if r["stream"] == "skip"]
+    assert len(skips) == st.n_skipped_shallow
+    assert validate_events(led, strict=False) == []
+    assert validate_events(ev, strict=True) == []
+    # metrics: periodic snapshots plus the final rollup event
+    snaps = [r for r in _events(ev) if r["event"] == "metrics"]
+    assert snaps and snaps[-1].get("final") is True
+    assert snaps[-1]["gauges"]["n_windows"] == st.n_windows
+    assert st.metrics["gauges"]["n_windows"] == st.n_windows
+
+
+def test_trace_check_and_decomposition_single(dataset, tmp_path):
+    out, ev, led, st = _run(dataset, tmp_path, "dec", telemetry=True)
+    assert trace_mod.trace_main([ev, led, "--check", "--no-timeline"]) == 0
+    recs = _events(ev)
+    d = trace_mod.decompose(recs, "dec")
+    assert d is not None and d["windows"] == st.n_windows
+    # the device.fetch spans wrap exactly the device_s timer region, so the
+    # decomposition reconciles with the run's own anchors (5% / 50 ms)
+    assert trace_mod.reconcile(d) == []
+    assert abs(d["device_s"] - d["device_sum"]) <= 0.05
+    # stage sums exist for the stages this run exercised
+    assert d["stages"]["dispatch"] > 0 and d["stages"]["feeder"] > 0
+
+
+def test_telemetry_byte_parity_under_fault_matrix(dataset, tmp_path,
+                                                  monkeypatch):
+    """Telemetry on vs off must be byte-identical, fault or no fault — and
+    the faulted runs' span files still lint clean (the failover/governor
+    unwind paths close their spans)."""
+    # throwaway registry dir: the injected OOM's ratchet must not land in
+    # the host's real compcache (the tools_pounce.sh governor-smoke rule)
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    for fault in (None, "device_lost:2", "device_oom:2"):
+        tag = (fault or "clean").replace(":", "_")
+        sub = tmp_path / tag
+        sub.mkdir()
+        if fault is None:
+            monkeypatch.delenv("DACCORD_FAULT", raising=False)
+        else:
+            monkeypatch.setenv("DACCORD_FAULT", fault)
+        off, _, _, _ = _run(dataset, sub, "off", telemetry=False)
+        on, ev, led, st = _run(dataset, sub, "on", telemetry=True)
+        assert open(off).read() == open(on).read(), f"parity broke: {fault}"
+        assert trace_mod.trace_main([ev, "--check", "--no-timeline"]) == 0, \
+            f"span lint failed under {fault}"
+        rows = [r for r in _events(led) if r["event"] == "window"]
+        assert len(rows) == st.n_windows, f"ledger drift under {fault}"
+        if fault == "device_oom:2":
+            assert any(r["event"] == "governor.classify" for r in _events(ev))
+    monkeypatch.delenv("DACCORD_FAULT", raising=False)
+
+
+# ---------------------------------------------------------------------------
+# fleet acceptance: 2-worker run, merged timeline + reconciled decomposition
+# ---------------------------------------------------------------------------
+
+def test_fleet_trace_merge_and_ledgers(dataset, tmp_path):
+    """The acceptance scenario: a 2-worker fleet (with an injected worker
+    crash — its resumed shard exercises the append/dedupe path) produces
+    per-worker sidecars that daccord-trace merges into one timeline on
+    absolute ts, with span lint clean, ledger rows reconciling with the
+    manifests, and per-worker wall decompositions reconciling with
+    device_s/host_s."""
+    from daccord_tpu.parallel.fleet import FleetConfig, run_fleet
+    from daccord_tpu.parallel.launch import merge_shards, shard_paths
+    from daccord_tpu.runtime.faults import FaultPlan
+
+    ref = str(tmp_path / "ref")
+    cfg_ref = FleetConfig(nshards=2, workers=2, backend="native",
+                          checkpoint_every=4, worker_telemetry=False,
+                          events_path=os.path.join(ref, "fleet.events.jsonl"))
+    m_ref = run_fleet(dataset["db"], dataset["las"], ref, cfg_ref, faults=None)
+    assert m_ref["done"] == [0, 1]
+
+    d = str(tmp_path / "tele")
+    cfg = FleetConfig(nshards=2, workers=2, backend="native",
+                      checkpoint_every=4, backoff_base_s=0.05,
+                      events_path=os.path.join(d, "fleet.events.jsonl"))
+    m = run_fleet(dataset["db"], dataset["las"], d, cfg,
+                  faults=FaultPlan.parse("worker_crash:1"))
+    assert m["done"] == [0, 1] and not m["poison"]
+
+    # telemetry-on (with crash+requeue) vs telemetry-off byte parity
+    merge_shards(ref, 2, str(tmp_path / "ref.fasta"))
+    merge_shards(d, 2, str(tmp_path / "tele.fasta"))
+    assert open(tmp_path / "ref.fasta").read() \
+        == open(tmp_path / "tele.fasta").read()
+
+    # the whole-directory lint: strict schema + span pairing + ledger
+    # reconciliation across fleet + worker files
+    assert trace_mod.trace_main([d, "--check", "--no-timeline"]) == 0
+
+    # merged timeline carries both workers and the orchestrator on ONE clock
+    evs, _, _ = trace_mod._expand([d])
+    assert len(evs) == 3    # fleet + 2 worker sidecars
+    merged = []
+    for path in evs:
+        src = os.path.basename(path)
+        for rec in trace_mod._read_jsonl(path):
+            if isinstance(rec.get("ts"), (int, float)):
+                merged.append((rec["ts"], src, rec))
+    merged.sort()
+    srcs = {s for _, s, _ in merged}
+    assert len(srcs) == 3
+    assert [x[0] for x in merged] == sorted(x[0] for x in merged)
+
+    # per-worker decomposition reconciles against the shard_done anchors
+    n_dec = 0
+    for path in evs:
+        dd = trace_mod.decompose(trace_mod._read_jsonl(path),
+                                 os.path.basename(path))
+        if dd is None:
+            continue   # the fleet's own sidecar has no shard_done
+        n_dec += 1
+        assert trace_mod.reconcile(dd) == [], dd
+    assert n_dec == 2
+
+    # ledger rows (deduped) equal each manifest's window count; worker
+    # metrics rollups were committed durably beside the manifests
+    errs, lines = trace_mod.check_dir_ledgers(d)
+    assert errs == [] and len(lines) == 2
+    for s in (0, 1):
+        mp = shard_paths(d, s)["metrics"]
+        roll = json.load(open(mp))
+        assert roll["gauges"]["n_windows"] > 0
+        # events sidecar per worker: spans + shard_done landed there
+        ev = shard_paths(d, s)["events"]
+        assert any(r["event"] == "shard_done" for r in _events(ev))
+
+
+# ---------------------------------------------------------------------------
+# --probe-history (satellite: attributable fallback benches)
+# ---------------------------------------------------------------------------
+
+def test_probe_history(tmp_path, capsys):
+    p = str(tmp_path / "tunnel.jsonl")
+    rows = [
+        {"ts": "2026-08-01T00:00:00Z", "alive": False, "probe_s": 120.0,
+         "reason": "probe_timeout"},
+        {"ts": "2026-08-01T01:00:00Z", "alive": False, "probe_s": 120.0,
+         "reason": "probe_timeout"},
+        {"ts": "2026-08-02T00:00:00Z", "alive": True, "probe_s": 3.0,
+         "reason": "ok", "after": "ladder"},
+    ]
+    with open(p, "wt") as fh:
+        fh.writelines(json.dumps(r) + "\n" for r in rows)
+    assert trace_mod.trace_main(["--probe-history", p]) == 0
+    out = capsys.readouterr().out
+    assert "last alive: 2026-08-02T00:00:00Z" in out
+    assert "dead x2" in out and "alive x1" in out
+    assert trace_mod.trace_main(["--probe-history",
+                                 str(tmp_path / "missing.jsonl")]) == 1

@@ -51,6 +51,9 @@ EOF
 # the pkill above is itself a bench-adjacent action: date the chip's health
 # before any chip time is spent
 probe startup
+# operator context: the probe pass/fail timeline + last-alive timestamp, so
+# this window's benches are datable against the tunnel's recent history
+python -m daccord_tpu.tools.cli trace --probe-history TUNNEL_LOG.jsonl || true
 
 # corruption-fuzz smoke (ingest integrity layer, ISSUE 2): synthesize a toy
 # DB/LAS, bit-flip a record and tear the file mid-record, then require a
@@ -72,9 +75,15 @@ EOF
 python -m daccord_tpu.tools.cli daccord "$fuzzdir/fuzz.db" "$fuzzdir/fuzz.las" \
     --backend native -b 64 --ingest-policy quarantine \
     -o "$fuzzdir/fuzz.fasta" --events "$fuzzdir/fuzz.events.jsonl" \
+    --ledger "$fuzzdir/fuzz.ledger.jsonl" \
   || { echo "tools_pounce: corruption-fuzz run FAILED" >&2; exit 1; }
-python -m daccord_tpu.tools.cli eventcheck "$fuzzdir/fuzz.events.jsonl" \
+# strict schema lint + span-pairing/ledger lint (ISSUE 6): a drift in any
+# record kind the telemetry spine emits fails HERE, before chip time
+python -m daccord_tpu.tools.cli eventcheck --strict "$fuzzdir/fuzz.events.jsonl" \
   || { echo "tools_pounce: fuzz events failed schema lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli trace --check --no-timeline \
+    "$fuzzdir/fuzz.events.jsonl" "$fuzzdir/fuzz.ledger.jsonl" \
+  || { echo "tools_pounce: fuzz sidecars failed daccord-trace lint" >&2; exit 1; }
 grep -q '"event": "ingest.quarantine"' "$fuzzdir/fuzz.events.jsonl" \
   || { echo "tools_pounce: fuzz run quarantined nothing" >&2; exit 1; }
 echo "tools_pounce: corruption-fuzz smoke OK" >&2
@@ -105,7 +114,14 @@ DACCORD_FAULT=worker_crash:1 python -m daccord_tpu.tools.cli fleet \
   || { echo "tools_pounce: crash-injected fleet run FAILED" >&2; exit 1; }
 python -m daccord_tpu.tools.cli eventcheck --strict \
     "$fleetdir/ref/fleet.events.jsonl" "$fleetdir/crash/fleet.events.jsonl" \
+    "$fleetdir"/ref/shard*.events.jsonl "$fleetdir"/crash/shard*.events.jsonl \
   || { echo "tools_pounce: fleet events failed schema lint" >&2; exit 1; }
+# whole-directory trace lint (ISSUE 6): merges orchestrator + worker
+# sidecars on absolute ts, enforces span pairing (the crashed attempt's
+# unwind must have closed its spans) and ledger-vs-manifest window counts
+python -m daccord_tpu.tools.cli trace --check --no-timeline \
+    "$fleetdir/ref" "$fleetdir/crash" \
+  || { echo "tools_pounce: fleet sidecars failed daccord-trace lint" >&2; exit 1; }
 grep -q '"event": "fleet.retry"' "$fleetdir/crash/fleet.events.jsonl" \
   || { echo "tools_pounce: injected worker crash was never requeued" >&2; exit 1; }
 cmp -s "$fleetdir/ref.fasta" "$fleetdir/crash.fasta" \
@@ -142,6 +158,8 @@ env "$govcc" DACCORD_FAULT=device_oom:2 python -m daccord_tpu.tools.cli daccord 
   || { echo "tools_pounce: device_oom-injected run FAILED" >&2; exit 1; }
 python -m daccord_tpu.tools.cli eventcheck --strict "$govdir/oom.events.jsonl" \
   || { echo "tools_pounce: governor events failed schema lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli trace --check --no-timeline "$govdir/oom.events.jsonl" \
+  || { echo "tools_pounce: governor sidecar failed daccord-trace lint" >&2; exit 1; }
 grep -q '"event": "governor.classify"' "$govdir/oom.events.jsonl" \
   || { echo "tools_pounce: injected OOM was never classified" >&2; exit 1; }
 grep -q '"event": "sup_failover"' "$govdir/oom.events.jsonl" \
@@ -155,6 +173,8 @@ env "$govcc" DACCORD_FAULT=monster_pile:2 python -m daccord_tpu.tools.cli daccor
   || { echo "tools_pounce: monster_pile-injected run FAILED" >&2; exit 1; }
 python -m daccord_tpu.tools.cli eventcheck --strict "$govdir/mon.events.jsonl" \
   || { echo "tools_pounce: monster events failed schema lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli trace --check --no-timeline "$govdir/mon.events.jsonl" \
+  || { echo "tools_pounce: monster sidecar failed daccord-trace lint" >&2; exit 1; }
 python - "$govdir" <<'EOF' || { echo "tools_pounce: monster quarantine parity FAILED" >&2; exit 1; }
 import json, sys
 from daccord_tpu.formats.fasta import read_fasta
