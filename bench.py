@@ -561,6 +561,36 @@ def _memory_telemetry() -> dict:
     return out
 
 
+def _pad_waste_telemetry(data: dict, batch: int,
+                         max_batches: int | None = None) -> dict:
+    """Pad-waste provenance for a bench sidecar (ISSUE 7): the fraction of
+    dispatched batch cells that are padding — a first-class BASELINE.md
+    metric the rung sidecars previously omitted — plus per-depth-bucket
+    occupancy of the measured window set (the pipeline's default bucket
+    grid), so a paged-vs-dense comparison is attributable per rung without
+    re-deriving the corpus histogram."""
+    lens = data["lens"]
+    nsegs = data["nsegs"]
+    N = len(nsegs)
+    nb = N // batch
+    if max_batches is not None:
+        nb = min(nb, max_batches)
+    n = nb * batch
+    total = n * lens.shape[1] * SEG_LEN
+    used = int(lens[:n].sum())
+    occ = {}
+    d_buckets = (8, 16, 32)
+    assign = np.searchsorted(np.asarray(d_buckets), nsegs[:n], side="left")
+    for i, db_ in enumerate(d_buckets):
+        sel = assign == i
+        cnt = int(sel.sum())
+        if cnt:
+            occ[str(db_)] = round(
+                float(lens[:n][sel].sum()) / (cnt * db_ * SEG_LEN), 4)
+    return {"pad_waste": round(1.0 - used / max(total, 1), 4),
+            "bucket_occupancy": occ}
+
+
 def _measure_device(data: dict, ev, batch: int,
                     max_batches: int | None = None) -> tuple[float, dict]:
     """Pipelined throughput + compute ceiling + efficiency ratio at one
@@ -575,6 +605,7 @@ def _measure_device(data: dict, ev, batch: int,
     info.update(comp_info)
     info["pipeline_efficiency"] = (round(dev_bps / comp_bps, 3)
                                    if comp_bps else None)
+    info.update(_pad_waste_telemetry(data, batch, max_batches))
     # peak-memory telemetry AFTER both passes: the rung's sidecar commits
     # the B->HBM point next to its B->wall point
     info.update(_memory_telemetry())
@@ -659,7 +690,8 @@ def run_ladder(data: dict, ev, orc_bps: float) -> int:
                                              f"BENCH_LADDER_B{rung:04d}.json"),
                                 line)
                 print(json.dumps(line), flush=True)
-                ev.log("bench_rung", batch=rung, bases_per_sec=0.0, fallback=True)
+                ev.log("bench_rung", batch=rung, bases_per_sec=0.0,
+                       fallback=True, pad_waste=0.0)
                 break
             line = {"metric": "consensus_bases_per_sec_per_chip",
                     "value": round(dev_bps, 1), "unit": "bases/s", "rung": True,
@@ -671,7 +703,7 @@ def run_ladder(data: dict, ev, orc_bps: float) -> int:
                             line)
             print(json.dumps(line), flush=True)
             ev.log("bench_rung", batch=rung, bases_per_sec=round(dev_bps, 1),
-                   fallback=False)
+                   fallback=False, pad_waste=info.get("pad_waste", 0.0))
             landed += 1
     finally:
         if warm is not None and warm.poll() is None:

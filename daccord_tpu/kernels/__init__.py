@@ -2,8 +2,10 @@ from .tensorize import BatchShape, WindowBatch, tensorize_windows, pad_batch
 from .window_kernel import KernelParams, solve_window_batch
 from .tiers import (TierLadder, rescue_candidates, solve_ladder,
                     solve_ladder_split, solve_tier0_async, solve_tiered)
+from .paging import (PagedWindowBatch, ShapeFamily, pack_paged, unpack_paged)
 
 __all__ = ["BatchShape", "WindowBatch", "tensorize_windows", "pad_batch",
            "KernelParams", "solve_window_batch", "TierLadder", "solve_tiered",
            "solve_ladder", "solve_ladder_split", "solve_tier0_async",
-           "rescue_candidates"]
+           "rescue_candidates", "PagedWindowBatch", "ShapeFamily",
+           "pack_paged", "unpack_paged"]

@@ -40,6 +40,67 @@ NEG = -1e30   # python floats: jnp constants may not be captured by kernels
 PAD = 4
 
 
+def gather_pages(pool: jnp.ndarray, table: jnp.ndarray,
+                 interpret: bool = False) -> jnp.ndarray:
+    """Paged-pool gather: pool [N, PL] int8, table [B, PPW] i32 ->
+    [B, PPW, PL] int8 (the Ragged Paged Attention page-fetch pattern,
+    arxiv 2604.15464, applied to window segments).
+
+    The flat page table rides the scalar-prefetch lane so page addresses
+    are known before the body runs; the pool stays in ANY (compiler-placed,
+    HBM at real pool sizes) and each table slot is one
+    ``pltpu.make_async_copy`` HBM->VMEM row DMA into the window's output
+    block. DMAs are issued per slot and drained at the end of the window's
+    loop — correctness-first; widening to multi-page DMAs over
+    pool-contiguous runs (which the packer's (window, segment, page) fill
+    order makes the common case) is the queued on-chip follow-up next to
+    the ``decision:paged`` kernelbench row. Used on TPU behind
+    ``use_pallas``; every other backend takes the pure-jnp ``take``
+    fallback in ``paging.gather_windows`` (bit-identical; interpret=True
+    covers parity tests off-TPU).
+    """
+    N, PL = pool.shape
+    B, PPW = table.shape
+
+    def kern(tbl_ref, pool_ref, out_ref):
+        b = pl.program_id(0)
+
+        def scoped(sems):
+            def start_slot(p, _):
+                page = tbl_ref[b * PPW + p]
+                pltpu.make_async_copy(pool_ref.at[page],
+                                      out_ref.at[0, p],
+                                      sems.at[p]).start()
+                return _
+
+            jax.lax.fori_loop(0, PPW, start_slot, 0)
+
+            def wait_slot(p, _):
+                page = tbl_ref[b * PPW + p]
+                pltpu.make_async_copy(pool_ref.at[page],
+                                      out_ref.at[0, p],
+                                      sems.at[p]).wait()
+                return _
+
+            jax.lax.fori_loop(0, PPW, wait_slot, 0)
+
+        pl.run_scoped(scoped, pltpu.SemaphoreType.DMA((PPW,)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, PPW, PL), lambda b, tbl: (b, 0, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, PPW, PL), jnp.int8),
+        interpret=interpret,
+    )(table.reshape(-1).astype(jnp.int32), pool)
+
+
 def _tile(B: int) -> int:
     for tb in (16, 8, 4, 2):
         if B % tb == 0:

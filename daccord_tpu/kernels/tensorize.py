@@ -56,7 +56,13 @@ class WindowBatch:
 
 def tensorize_windows(items: list[tuple[int, WindowSegments]],
                       shape: BatchShape) -> WindowBatch:
-    """Pack (read_id, WindowSegments) pairs into one WindowBatch."""
+    """Pack (read_id, WindowSegments) pairs into one WindowBatch.
+
+    The segment copies run as ONE concatenated buffer + flat-index scatter
+    instead of O(B*D) single-row numpy assignments: this sits on the
+    measured host-feeder hot path (the python windowing fallback and every
+    bench/tool that tensorizes), where per-row assignment overhead
+    dominated the actual byte movement (tools/feederbench.py)."""
     B = len(items)
     D, L = shape.depth, shape.seg_len
     seqs = np.full((B, D, L), PAD, dtype=np.int8)
@@ -64,51 +70,80 @@ def tensorize_windows(items: list[tuple[int, WindowSegments]],
     nsegs = np.zeros(B, dtype=np.int32)
     read_ids = np.zeros(B, dtype=np.int64)
     wstarts = np.zeros(B, dtype=np.int64)
+    segs: list[np.ndarray] = []
+    rows: list[int] = []          # flat (b * D + d) row of each segment
     for b, (rid, ws) in enumerate(items):
         read_ids[b] = rid
         wstarts[b] = ws.wstart
-        d = 0
-        for seg in ws.segments:
-            if d >= D:
-                break
-            s = np.asarray(seg, dtype=np.int8)[:L]
-            seqs[b, d, : len(s)] = s
-            lens[b, d] = len(s)
-            d += 1
+        d = min(len(ws.segments), D)
         nsegs[b] = d
+        base = b * D
+        for di in range(d):
+            s = np.asarray(ws.segments[di], dtype=np.int8)
+            segs.append(s[:L] if len(s) > L else s)
+            rows.append(base + di)
+    if segs:
+        slens = np.fromiter(map(len, segs), np.int64, len(segs))
+        rows_a = np.asarray(rows, dtype=np.int64)
+        lens.reshape(-1)[rows_a] = slens
+        flat = np.concatenate(segs) if len(segs) > 1 else segs[0]
+        # ragged arange: position of every base within its own segment
+        pos = np.arange(len(flat), dtype=np.int64) - np.repeat(
+            np.cumsum(slens) - slens, slens)
+        seqs.reshape(-1)[np.repeat(rows_a * L, slens) + pos] = flat
     return WindowBatch(seqs=seqs, lens=lens, nsegs=nsegs, shape=shape,
                        read_ids=read_ids, wstarts=wstarts)
 
 
-def slice_batch(batch: WindowBatch, lo: int, hi: int) -> WindowBatch:
+def slice_batch(batch, lo: int, hi: int):
     """Row slice [lo, hi) of a batch — views, no copies; only the per-row
     arrays are replaced, so shape/stream (and any future non-row field)
     carry over untouched — a bisected Stream B rescue batch must keep
     routing to the rescue program. The capacity governor's bisect rung is
     this plus :func:`pad_batch`: by per-window independence the re-batched
-    windows solve to identical bytes at any width."""
+    windows solve to identical bytes at any width. Paged batches
+    (``kernels/paging.py``) slice by table rows — the page pool is shared."""
     import dataclasses
 
+    if getattr(batch, "pool", None) is not None:
+        from .paging import slice_paged
+
+        return slice_paged(batch, lo, hi)
     return dataclasses.replace(
         batch, seqs=batch.seqs[lo:hi], lens=batch.lens[lo:hi],
         nsegs=batch.nsegs[lo:hi], read_ids=batch.read_ids[lo:hi],
         wstarts=batch.wstarts[lo:hi])
 
 
-def pad_batch(batch: WindowBatch, target: int) -> WindowBatch:
-    """Pad a batch to ``target`` windows (static batch shapes for jit)."""
+def pad_batch(batch, target: int):
+    """Pad a batch to ``target`` windows (static batch shapes for jit).
+
+    Target-shape arrays are allocated ONCE and filled (live rows copied,
+    the pad region written in place) — the previous five full
+    ``np.concatenate`` calls copied every live cell AND materialized the
+    pad blocks separately on every partial-bucket and rescue-pool flush.
+    Paged batches pad by sentinel table rows (``paging.pad_paged``)."""
     B = batch.size
     if B == target:
         return batch
     assert B < target
-    pad = target - B
+    if getattr(batch, "pool", None) is not None:
+        from .paging import pad_paged
+
+        return pad_paged(batch, target)
     D, L = batch.shape.depth, batch.shape.seg_len
-    return WindowBatch(
-        seqs=np.concatenate([batch.seqs, np.full((pad, D, L), PAD, dtype=np.int8)]),
-        lens=np.concatenate([batch.lens, np.zeros((pad, D), dtype=np.int32)]),
-        nsegs=np.concatenate([batch.nsegs, np.zeros(pad, dtype=np.int32)]),
-        shape=batch.shape,
-        read_ids=np.concatenate([batch.read_ids, np.full(pad, -1, dtype=np.int64)]),
-        wstarts=np.concatenate([batch.wstarts, np.zeros(pad, dtype=np.int64)]),
-        stream=batch.stream,
-    )
+    seqs = np.empty((target, D, L), dtype=np.int8)
+    seqs[:B] = batch.seqs
+    seqs[B:] = PAD
+    lens = np.zeros((target, D), dtype=np.int32)
+    lens[:B] = batch.lens
+    nsegs = np.zeros(target, dtype=np.int32)
+    nsegs[:B] = batch.nsegs
+    read_ids = np.empty(target, dtype=np.int64)
+    read_ids[:B] = batch.read_ids
+    read_ids[B:] = -1
+    wstarts = np.zeros(target, dtype=np.int64)
+    wstarts[:B] = batch.wstarts
+    return WindowBatch(seqs=seqs, lens=lens, nsegs=nsegs, shape=batch.shape,
+                       read_ids=read_ids, wstarts=wstarts,
+                       stream=batch.stream)

@@ -237,6 +237,64 @@ def _tier0_packed_jit(seqs, lens, nsegs, table0, p0, use_pallas=False,
                                   pallas_interpret))
 
 
+def ladder_core_paged(pool, table, lens, nsegs, tables: tuple,
+                      params: tuple[KernelParams, ...], esc_cap: int,
+                      page_len: int, seg_len: int, use_pallas: bool = False,
+                      pallas_interpret: bool = False,
+                      wide_p0: KernelParams | None = None):
+    """Paged-wire form of :func:`ladder_core`: a device-side page gather
+    (``paging.gather_windows`` — the Pallas kernel under ``use_pallas``, the
+    pure-jnp ``take`` fallback elsewhere) reconstructs the exact dense
+    ``[B, D, L]`` tile inside the SAME jitted program, then the unchanged
+    ladder consumes it. Paging changes which cells cross the wire, never any
+    window's result — byte parity with the dense program is the invariant
+    (tests/test_paging.py)."""
+    from .paging import gather_windows
+
+    seqs = gather_windows(pool, table, lens, page_len=page_len,
+                          seg_len=seg_len, use_pallas=use_pallas,
+                          interpret=pallas_interpret)
+    return ladder_core(seqs, lens, nsegs, tables, params, esc_cap,
+                       use_pallas, pallas_interpret, wide_p0)
+
+
+def tier0_core_paged(pool, table, lens, nsegs, table0, p0: KernelParams,
+                     page_len: int, seg_len: int, use_pallas: bool = False,
+                     pallas_interpret: bool = False):
+    """Paged-wire Stream A core: page gather + :func:`tier0_core`."""
+    from .paging import gather_windows
+
+    seqs = gather_windows(pool, table, lens, page_len=page_len,
+                          seg_len=seg_len, use_pallas=use_pallas,
+                          interpret=pallas_interpret)
+    return tier0_core(seqs, lens, nsegs, table0, p0, use_pallas,
+                      pallas_interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("params", "esc_cap", "page_len",
+                                    "seg_len", "use_pallas",
+                                    "pallas_interpret", "wide_p0"))
+def _ladder_packed_paged_jit(pool, table, lens, nsegs, tables, params,
+                             esc_cap, page_len, seg_len, use_pallas=False,
+                             pallas_interpret=False, wide_p0=None):
+    return pack_result(ladder_core_paged(pool, table, lens, nsegs, tables,
+                                         params, esc_cap, page_len, seg_len,
+                                         use_pallas, pallas_interpret,
+                                         wide_p0))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("p0", "page_len", "seg_len", "use_pallas",
+                                    "pallas_interpret"))
+def _tier0_packed_paged_jit(pool, table, lens, nsegs, table0, p0, page_len,
+                            seg_len, use_pallas=False,
+                            pallas_interpret=False):
+    return pack_result(tier0_core_paged(pool, table, lens, nsegs, table0, p0,
+                                        page_len, seg_len, use_pallas,
+                                        pallas_interpret))
+
+
 def pack_result(out: dict) -> jnp.ndarray:
     """Pack a ladder result dict into ONE int32 array [B, words+3].
 
@@ -319,8 +377,18 @@ def solve_ladder_async(batch: WindowBatch, ladder: TierLadder,
     runtime when nothing failed.
     """
     if esc_cap is None:
-        esc_cap = int(batch.seqs.shape[0])
+        esc_cap = int(batch.size)
     tables = tuple(ladder.tables[p.k] for p in ladder.params)
+    if getattr(batch, "pool", None) is not None:
+        # paged wire format (kernels/paging.py): pool + page table ship,
+        # the dense tile is gathered device-side inside the same program
+        arr = _ladder_packed_paged_jit(
+            jnp.asarray(batch.pool), jnp.asarray(batch.table),
+            jnp.asarray(batch.lens), jnp.asarray(batch.nsegs), tables,
+            tuple(ladder.params), esc_cap, batch.family.page_len,
+            batch.shape.seg_len, use_pallas, pallas_interpret,
+            ladder.wide_p0)
+        return _PackedHandle(arr, ladder.params[0].cons_len)
     arr = _ladder_packed_jit(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
                              jnp.asarray(batch.nsegs), tables,
                              tuple(ladder.params), esc_cap, use_pallas,
@@ -377,6 +445,13 @@ def solve_tier0_async(batch: WindowBatch, ladder: TierLadder,
     wire format — but the program never carries the rescue tiers, so a
     tier-0 failure costs nothing here (the window pools for Stream B)."""
     p0 = ladder.params[0]
+    if getattr(batch, "pool", None) is not None:
+        arr = _tier0_packed_paged_jit(
+            jnp.asarray(batch.pool), jnp.asarray(batch.table),
+            jnp.asarray(batch.lens), jnp.asarray(batch.nsegs),
+            ladder.tables[p0.k], p0, batch.family.page_len,
+            batch.shape.seg_len, use_pallas, pallas_interpret)
+        return _PackedHandle(arr, p0.cons_len)
     arr = _tier0_packed_jit(jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
                             jnp.asarray(batch.nsegs), ladder.tables[p0.k],
                             p0, use_pallas, pallas_interpret)

@@ -225,6 +225,8 @@ class FleetConfig:
     checkpoint_every: int = 16        # >0: progress manifests drive hang
                                       # detection and lossless requeue
     ingest_policy: str = "strict"
+    paged: str = "off"                # ragged paged window batching, forwarded
+                                      # to every worker (see daccord --paged)
     max_pile_overlaps: int | None = None  # monster-pile budget (None = the
                                           # pipeline default; 0 disables)
     worker_telemetry: bool = True     # thread per-worker telemetry sidecars
@@ -323,7 +325,8 @@ class Fleet:
                 "-J", f"{shard},{cfg.nshards}",
                 "--backend", cfg.backend,
                 "--checkpoint-every", str(cfg.checkpoint_every),
-                "--ingest-policy", cfg.ingest_policy]
+                "--ingest-policy", cfg.ingest_policy,
+                "--paged", cfg.paged]
         if cfg.worker_telemetry:
             # per-worker sidecars land beside the shard outputs; attempts
             # append (shard_start is the eventcheck stream boundary) and

@@ -136,7 +136,33 @@ class PipelineConfig:
                                  # length (e.g. (48,)): windows whose segments
                                  # all fit go to a narrower batch — exact, like
                                  # depth buckets, but multiplies compile count;
-                                 # off by default until measured on hardware
+                                 # off by default until measured on hardware.
+                                 # Subsumed (with depth_buckets) by the paged
+                                 # router's auto-derived shape families when
+                                 # --paged is active
+    paged: str = "off"           # ragged paged window batching
+                                 # (kernels/paging.py, ISSUE 7): 'on' ships
+                                 # batches as a page pool + page table bucketed
+                                 # into corpus-derived (depth, pages) shape
+                                 # families instead of dense [B, D, L]
+                                 # rectangles — byte-identical output, the
+                                 # dense tile is gathered device-side inside
+                                 # the same jitted program; 'auto' enables it
+                                 # on device (non-cpu) platforms only; 'off'
+                                 # (default until the on-chip paged-vs-dense
+                                 # decision row lands, BASELINE.md) keeps the
+                                 # dense wire format. JAX ladder paths only —
+                                 # the native engine iterates dense rows on
+                                 # host and a custom (mesh) solver brings its
+                                 # own programs
+    page_len: int = 16           # paged page length in bases (must divide
+                                 # seg_len); segments are page-aligned, so
+                                 # rounding waste averages page_len/2 per
+                                 # segment — 16 keeps it under ~20% of a
+                                 # w=40 window segment
+    paged_families: int = 4      # compile-count budget for the auto-derived
+                                 # shape families (each family is one extra
+                                 # jitted program per stream)
     hp_native: bool = True       # --backend native runs the hp rescue in
                                  # the C++ engine (hp_rescue_windows,
                                  # oracle/hp.py parity by test); False forces
@@ -281,6 +307,10 @@ class PipelineStats:
     governor_ratchet: dict = field(default_factory=dict)
                                  # shape fingerprint -> ratcheted width,
                                  # entries touched this run (manifest state)
+    paged: bool = False          # the shard dispatched the paged wire format
+                                 # (kernels/paging.py); pad_cells then counts
+                                 # shipped pool payload cells instead of the
+                                 # dense rectangle
     pad_cells: int = 0
     used_cells: int = 0
     wall_s: float = 0.0
@@ -490,7 +520,8 @@ def _strided_pile_ranges(las: LasFile, n: int, start: int | None,
 def estimate_profile_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                start: int | None = None,
                                end: int | None = None,
-                               pile_ranges: list | None = None) -> ErrorProfile:
+                               pile_ranges: list | None = None,
+                               return_windows: bool = False):
     """Profile pass over ``cfg.profile_sample_piles`` piles strided across the
     shard (oracle path: the sample is tiny and this doubles as a continuous
     cross-check of the native path).
@@ -498,9 +529,25 @@ def estimate_profile_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     ``pile_ranges`` overrides the sidecar-index stride with an explicit list
     of (start, end) pile byte ranges — the quarantine path passes the
     validating scan's CLEAN piles so estimation never decodes corrupt bytes
-    (index_las would reject the file outright)."""
+    (index_las would reject the file outright). ``return_windows`` also
+    returns the sampled windows: the paged router derives its shape families
+    from exactly this sample, so a paged run pays the alignment-heavy
+    sampling pass once, not twice."""
     from ..oracle.consensus import estimate_profile_two_pass
 
+    refined_all, windows_all = _sample_windows(db, las, cfg, start, end,
+                                               pile_ranges)
+    prof = estimate_profile_two_pass(refined_all, windows_all, cfg.consensus,
+                                     sample=32)
+    return (prof, windows_all) if return_windows else prof
+
+
+def _sample_windows(db: DazzDB, las: LasFile, cfg: PipelineConfig,
+                    start, end, pile_ranges: list | None = None):
+    """The shard's ONE strided pile-sampling procedure (refined overlaps +
+    cut windows of ``cfg.profile_sample_piles`` piles), shared by the
+    profile pass and the paged family derivation so their sampling rules —
+    the quarantine clean-pile branch included — cannot drift apart."""
     if pile_ranges is not None:
         take = _stride_take(len(pile_ranges), cfg.profile_sample_piles,
                             cfg.profile_sample_offset)
@@ -519,8 +566,45 @@ def estimate_profile_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             windows_all.extend(cut_windows(a_bases, refined, w=cfg.consensus.w,
                                            adv=cfg.consensus.adv))
             break   # one pile per strided range
-    return estimate_profile_two_pass(refined_all, windows_all, cfg.consensus,
-                                     sample=32)
+    return refined_all, windows_all
+
+
+def families_from_windows(windows: list[WindowSegments],
+                          cfg: PipelineConfig):
+    """Shape families for the paged router (kernels/paging.py) from a
+    window sample — the corpus length x depth histogram the ISSUE names.
+    The sample approximates the runtime histogram (depth ranking reorders
+    which segments survive the cap, not how many), which only shifts family
+    budgets, never correctness: the mandatory full-coverage family routes
+    any window the sample never predicted."""
+    from ..kernels import paging
+
+    shape = BatchShape(depth=cfg.depth, seg_len=cfg.seg_len,
+                       wlen=cfg.consensus.w)
+    if windows:
+        b = tensorize_windows([(0, ws) for ws in windows], shape)
+        ns = b.nsegs
+        pg = paging.window_pages(b.lens, cfg.page_len)
+    else:
+        ns = pg = np.zeros(0, np.int64)
+    return paging.derive_families(
+        ns, pg, max_depth=cfg.depth,
+        max_pages=-(-cfg.depth * cfg.seg_len // cfg.page_len),
+        budget=cfg.paged_families, page_len=cfg.page_len)
+
+
+def derive_families_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
+                              start: int | None = None,
+                              end: int | None = None,
+                              pile_ranges: list | None = None):
+    """:func:`families_from_windows` over a fresh strided pile sample
+    (:func:`_sample_windows` — the profile pass's exact sampling rule,
+    ``pile_ranges`` = the validating scan's clean piles under the
+    quarantine policy). Only for callers with no profile-pass sample to
+    reuse — a precomputed-profile run; in-run estimation hands its windows
+    straight to families_from_windows."""
+    _, windows_all = _sample_windows(db, las, cfg, start, end, pile_ranges)
+    return families_from_windows(windows_all, cfg)
 
 
 def _window_one_pile(db: DazzDB, col, cfg: PipelineConfig, aread: int, s: int, e: int,
@@ -751,10 +835,14 @@ def _make_clamp_solve(ladder: TierLadder, use_pallas: bool, interp: bool,
                     & (np.asarray(b.nsegs) >= min_depth))
             idx = np.nonzero(need)[0]
             if len(idx):
+                # the host-routed completion iterates dense rows: unpack a
+                # paged batch first (byte-identical by the round-trip
+                # property, tests/test_paging.py)
+                bd = b.to_dense() if hasattr(b, "to_dense") else b
                 sub = dataclasses.replace(
-                    b, seqs=b.seqs[idx], lens=b.lens[idx],
-                    nsegs=b.nsegs[idx], read_ids=b.read_ids[idx],
-                    wstarts=b.wstarts[idx])
+                    bd, seqs=bd.seqs[idx], lens=bd.lens[idx],
+                    nsegs=bd.nsegs[idx], read_ids=bd.read_ids[idx],
+                    wstarts=bd.wstarts[idx])
                 r = solve_tiered(sub, ladder)
                 for kk in ("cons", "cons_len", "err", "solved", "tier",
                            "m_ovf"):
@@ -886,14 +974,28 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
             cfg = dataclasses.replace(cfg, batch_size=auto_batch_size(
                 False, jax.default_backend()))
+    # paged intent resolved BEFORE the profile pass so family derivation can
+    # reuse the pass's window sample (one alignment-heavy sampling pass, not
+    # two); the authoritative paged_on below uses identical conditions
+    paged_want = (cfg.paged in ("on", "auto")
+                  and solver is None and not cfg.native_solver)
+    if paged_want and cfg.paged == "auto":
+        import jax
+
+        paged_want = jax.default_backend() != "cpu"
+    paged_sample = None
     if profile is None:
         with tracer.span("profile"):
-            if report is not None and report.issues:
-                # sample only validated-clean piles: index_las rejects the file
-                profile = estimate_profile_for_shard(
-                    db, las, cfg, start, end, pile_ranges=report.pile_ranges)
+            # quarantine policy: sample only validated-clean piles —
+            # index_las rejects the file outright on a corrupt one
+            kw = (dict(pile_ranges=report.pile_ranges)
+                  if report is not None and report.issues else {})
+            if paged_want:
+                profile, paged_sample = estimate_profile_for_shard(
+                    db, las, cfg, start, end, return_windows=True, **kw)
             else:
-                profile = estimate_profile_for_shard(db, las, cfg, start, end)
+                profile = estimate_profile_for_shard(db, las, cfg, start,
+                                                     end, **kw)
     ladder = None
     if not (solver is None and cfg.native_solver):
         # the native C++ solver builds its own OffsetLikely tables from the
@@ -941,6 +1043,51 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     if cfg.ladder_mode == "split" and not split_ladder:
         log.log("info", msg="ladder_mode=split inapplicable here "
                             "(native engine or custom solver); running fused")
+    # ragged paged window batching (kernels/paging.py, ISSUE 7): JAX ladder
+    # paths only — the native engine iterates dense rows on host, and a
+    # custom (mesh) solver brings its own programs. 'auto' enables paging on
+    # device platforms only (the pre-decision-row default posture); explicit
+    # 'on' also takes the async ladder on CPU so the whole fault/capacity
+    # matrix can verify the paged path with no chip.
+    paged_on = False
+    if cfg.paged not in ("off", "on", "auto"):
+        raise SystemExit(f"--paged {cfg.paged!r}: expected on|off|auto")
+    if cfg.paged != "off":
+        if solver is not None or native_dispatch:
+            log.log("info", msg=f"paged={cfg.paged} inapplicable here "
+                                "(native engine or custom solver); "
+                                "running dense")
+        else:
+            paged_on = paged_want
+    families = None
+    if paged_on:
+        from ..kernels import paging
+
+        if cfg.seg_len % cfg.page_len:
+            raise SystemExit(f"--paged: page_len {cfg.page_len} must divide "
+                             f"seg-len {cfg.seg_len}")
+        with tracer.span("paging.derive"):
+            if paged_sample is not None:
+                # in-run profile estimation: families come from the SAME
+                # window sample the profile pass already cut
+                families = families_from_windows(paged_sample, cfg)
+            elif report is not None and report.issues:
+                families = derive_families_for_shard(
+                    db, las, cfg, start, end, pile_ranges=report.pile_ranges)
+            else:
+                families = derive_families_for_shard(db, las, cfg, start, end)
+        # a batch's pool must hold at least one worst-case window of its
+        # family, or the router's budget cut could never make progress
+        families = [
+            f if cfg.batch_size * f.budget >= f.pages else
+            paging.ShapeFamily(depth=f.depth, pages=f.pages,
+                               page_len=f.page_len,
+                               pool_pages=-(-f.pages // cfg.batch_size))
+            for f in families]
+        for fi, f in enumerate(families):
+            ev_log.log("paging.family", family=f.describe(), bucket=fi,
+                       depth=int(f.depth), pages=int(f.pages),
+                       page_len=int(f.page_len), pool_pages=int(f.budget))
     clamp_solve = None   # governor esc-cap-clamp rung (JAX async ladder only)
     if solver is not None:
         if hasattr(solver, "dispatch") and hasattr(solver, "fetch"):
@@ -953,9 +1100,11 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     else:
         import jax
 
-        if jax.default_backend() == "cpu" and not split_ladder:
+        if jax.default_backend() == "cpu" and not split_ladder and not paged_on:
             # host-routed ladder: skips escalation tiers when nothing failed
-            # (cheap syncs; right trade-off for local CPU execution)
+            # (cheap syncs; right trade-off for local CPU execution). Paged
+            # batches always take the async ladder below — paging IS the
+            # jitted wire format
             from ..kernels.tiers import solve_tiered
 
             if cfg.use_pallas:
@@ -1128,13 +1277,30 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     D, L = cfg.depth, cfg.seg_len
     adv = cfg.consensus.adv
     w = cfg.consensus.w
-    # depth (and optional seg-len) buckets: windows route to the smallest
-    # bucket holding their segment count / max segment length; each (D, L)
-    # bucket is its own statically-shaped batch stream
-    d_buckets = sorted({b for b in cfg.depth_buckets if 0 < b < D} | {D})
-    l_buckets = sorted({b for b in cfg.seg_len_buckets if 0 < b < L} | {L})
-    buckets = [(dv, lv) for dv in d_buckets for lv in l_buckets]
-    shapes = [BatchShape(depth=db, seg_len=lb, wlen=w) for db, lb in buckets]
+    if paged_on:
+        # paged mode: the corpus-derived shape families ARE the buckets —
+        # they subsume the hand-tuned depth/seg-len grids (windows route by
+        # (nsegs, pages); L stays global, page rounding absorbs length)
+        buckets = [(f.depth, L) for f in families]
+        shapes = [BatchShape(depth=f.depth, seg_len=L, wlen=w)
+                  for f in families]
+        d_arr = l_arr = None
+        nl = 1
+        # per-family pool capacity of one batch-size-wide dispatch (pages):
+        # the router cuts a batch early rather than overflow it
+        cap_pages = [cfg.batch_size * f.budget for f in families]
+    else:
+        # depth (and optional seg-len) buckets: windows route to the smallest
+        # bucket holding their segment count / max segment length; each (D, L)
+        # bucket is its own statically-shaped batch stream
+        d_buckets = sorted({b for b in cfg.depth_buckets if 0 < b < D} | {D})
+        l_buckets = sorted({b for b in cfg.seg_len_buckets if 0 < b < L} | {L})
+        buckets = [(dv, lv) for dv in d_buckets for lv in l_buckets]
+        shapes = [BatchShape(depth=db, seg_len=lb, wlen=w) for db, lb in buckets]
+        d_arr = np.asarray(d_buckets)
+        l_arr = np.asarray(l_buckets)
+        nl = len(l_buckets)
+        cap_pages = None
 
     pending: dict[int, _PendingRead] = {}
     order: list[int] = []
@@ -1142,14 +1308,16 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     emit_idx = 0
     # per-bucket row buffers: parallel lists of blocks + (rid, widx) bookkeeping
     nb = len(buckets)
-    d_arr = np.asarray(d_buckets)
-    l_arr = np.asarray(l_buckets)
-    nl = len(l_buckets)
     blk_seqs: list[list[np.ndarray]] = [[] for _ in range(nb)]
     blk_lens: list[list[np.ndarray]] = [[] for _ in range(nb)]
     blk_nsegs: list[list[np.ndarray]] = [[] for _ in range(nb)]
     blk_rid: list[list[np.ndarray]] = [[] for _ in range(nb)]
     blk_widx: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    # paged mode only: per-row page counts + running totals, so the router
+    # can cut a batch at the family's pool budget (and trigger a flush when
+    # the buffered pages alone would fill a pool)
+    blk_pages: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    npages = [0] * nb
     nrows = [0] * nb
     first_seen = [None] * nb     # read counter when the bucket got its oldest row
 
@@ -1170,6 +1338,8 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     r_nsegs: list[list[np.ndarray]] = [[] for _ in range(nb)]
     r_rid: list[list[np.ndarray]] = [[] for _ in range(nb)]
     r_widx: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    r_pages: list[list[np.ndarray]] = [[] for _ in range(nb)]
+    r_npages = [0] * nb
     r_nrows = [0] * nb
     r_first_seen = [None] * nb   # read counter when the pool got its oldest row
 
@@ -1359,9 +1529,58 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         r_nsegs[bi].append(nsegs_b[sel])
         r_rid[bi].append(rid[sel])
         r_widx[bi].append(widx[sel])
+        if paged_on:
+            from ..kernels import paging
+
+            pgs = paging.window_pages(lens_b[sel], cfg.page_len)
+            r_pages[bi].append(pgs)
+            r_npages[bi] += int(pgs.sum())
         r_nrows[bi] += len(sel)
         if r_first_seen[bi] is None:
             r_first_seen[bi] = stats.n_reads
+
+    def _paged_take(pages_lists, bi: int, take: int) -> int:
+        """Rows of bucket ``bi``'s buffer that fit one pool budget: the
+        largest prefix (never zero) whose page total stays within the
+        family's per-dispatch capacity — the router-side guarantee behind
+        pack_paged's overflow assertion."""
+        cat = (np.concatenate(pages_lists[bi]) if len(pages_lists[bi]) > 1
+               else pages_lists[bi][0])
+        fit = int(np.searchsorted(np.cumsum(cat[:take]), cap_pages[bi],
+                                  side="right"))
+        return max(min(take, fit), 1)
+
+    def _finish_batch(batch: WindowBatch, bi: int, pages_popped: int):
+        """Shared tail of batch assembly: pad (dense) or pack (paged) to the
+        dispatch width, account pad-waste cells, and return the dispatchable
+        batch plus its rows_ctx (dense host-side arrays the hp pass and the
+        rescue pool reconstruct segments from)."""
+        if paged_on:
+            from ..kernels import paging
+
+            dense_seqs = batch.seqs
+            pb = paging.pack_paged(batch, families[bi],
+                                   target_rows=cfg.batch_size)
+            # payload-cell accounting, symmetric with the dense metric
+            # (which counts seqs only — never lens/nsegs metadata); the
+            # table's byte cost is reported on the batch.paged event
+            stats.pad_cells += int(pb.pool.size)
+            stats.used_cells += int(pb.lens.sum())
+            ev_log.log("batch.paged", windows=int(batch.size), bucket=bi,
+                       family=families[bi].describe(),
+                       pages=int(pages_popped),
+                       pool_pages=int(pb.pool.shape[0] - 1),
+                       table_cells=int(pb.table.size) * 4,
+                       occupancy=round(pages_popped
+                                       / max(pb.pool.shape[0] - 1, 1), 4))
+            return pb, (dense_seqs, pb.lens, pb.nsegs)
+        if not native_dispatch:
+            # padding exists only for jit static shapes; the native engine
+            # iterates real rows and would just walk PAD
+            batch = pad_batch(batch, cfg.batch_size)
+        stats.pad_cells += batch.seqs.size
+        stats.used_cells += int(batch.lens.sum())
+        return batch, (batch.seqs, batch.lens, batch.nsegs)
 
     def drain(to_depth: int):
         # drain in ONE grouped fetch: the tunnel charges its ~100 ms RTT per
@@ -1455,27 +1674,35 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         for bi in range(nb):
             stale = (r_first_seen[bi] is not None
                      and stats.n_reads - r_first_seen[bi] >= cfg.rescue_flush_reads)
-            while r_nrows[bi] >= cfg.batch_size or ((final or stale)
-                                                    and r_nrows[bi] > 0):
-                reason = ("full" if r_nrows[bi] >= cfg.batch_size
+            while (r_nrows[bi] >= cfg.batch_size
+                   or (paged_on and r_npages[bi] >= cap_pages[bi])
+                   or ((final or stale) and r_nrows[bi] > 0)):
+                full = (r_nrows[bi] >= cfg.batch_size
+                        or (paged_on and r_npages[bi] >= cap_pages[bi]))
+                reason = ("full" if full
                           else ("pressure" if pressure
                                 else ("final" if final else "lag")))
                 stale = False
                 take = min(cfg.batch_size, r_nrows[bi])
+                if paged_on:
+                    take = _paged_take(r_pages, bi, take)
                 fl_sp = tracer.open("flush", reason=reason, rows=take,
                                     bucket=bi)
-                seqs, lens, nsg, rid, widx = _pop_rows(
-                    (r_seqs, r_lens, r_nsegs, r_rid, r_widx),
-                    r_nrows, r_first_seen, bi, take)
+                pools = (r_seqs, r_lens, r_nsegs, r_rid, r_widx) + (
+                    (r_pages,) if paged_on else ())
+                arrs = _pop_rows(pools, r_nrows, r_first_seen, bi, take)
+                seqs, lens, nsg, rid, widx = arrs[:5]
+                pages_popped = 0
+                if paged_on:
+                    pages_popped = int(arrs[5][:take].sum())
+                    r_npages[bi] -= pages_popped
                 batch = WindowBatch(seqs=seqs[:take], lens=lens[:take],
                                     nsegs=nsg[:take], shape=shapes[bi],
                                     read_ids=rid[:take],
                                     wstarts=widx[:take].astype(np.int64) * adv,
                                     stream="rescue")
-                batch = pad_batch(batch, cfg.batch_size)
-                stats.pad_cells += batch.seqs.size
-                stats.used_cells += int(batch.lens.sum())
-                # the flush span covers the pool pop + pad only: the
+                batch, rows_ctx = _finish_batch(batch, bi, pages_popped)
+                # the flush span covers the pool pop + pad/pack only: the
                 # dispatch below books under the dispatch stage, and the
                 # two stages must stay disjoint or daccord-trace's stage
                 # table double-counts the (synchronous, on inline engines)
@@ -1495,7 +1722,6 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     {"rows": take, "slots": int(batch.size), "reason": reason})
                 ev_log.log("ladder.flush", rows=take, slots=int(batch.size),
                            reason=reason, bucket=bi)
-                rows_ctx = (batch.seqs, batch.lens, batch.nsegs)
                 inflight.append((handle, rid, widx, take, time.time(),
                                  rows_ctx, bi, "rescue", b_sp))
                 if len(inflight) >= cfg.max_inflight:
@@ -1513,22 +1739,26 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             # bounds the in-order emission lag under bucket skew
             stale = (first_seen[bi] is not None
                      and stats.n_reads - first_seen[bi] >= cfg.bucket_flush_reads)
-            while nrows[bi] >= cfg.batch_size or ((final or stale) and nrows[bi] > 0):
+            while (nrows[bi] >= cfg.batch_size
+                   or (paged_on and npages[bi] >= cap_pages[bi])
+                   or ((final or stale) and nrows[bi] > 0)):
                 stale = False
                 take = min(cfg.batch_size, nrows[bi])
-                seqs, lens, nsg, rid, widx = _pop_rows(
-                    (blk_seqs, blk_lens, blk_nsegs, blk_rid, blk_widx),
-                    nrows, first_seen, bi, take)
+                if paged_on:
+                    take = _paged_take(blk_pages, bi, take)
+                pools = (blk_seqs, blk_lens, blk_nsegs, blk_rid, blk_widx) + (
+                    (blk_pages,) if paged_on else ())
+                arrs = _pop_rows(pools, nrows, first_seen, bi, take)
+                seqs, lens, nsg, rid, widx = arrs[:5]
+                pages_popped = 0
+                if paged_on:
+                    pages_popped = int(arrs[5][:take].sum())
+                    npages[bi] -= pages_popped
                 batch = WindowBatch(seqs=seqs[:take], lens=lens[:take], nsegs=nsg[:take],
                                     shape=shapes[bi], read_ids=rid[:take],
                                     wstarts=widx[:take].astype(np.int64) * adv,
                                     stream="tier0" if split_ladder else "full")
-                if not native_dispatch:
-                    # padding exists only for jit static shapes; the native
-                    # engine iterates real rows and would just walk PAD
-                    batch = pad_batch(batch, cfg.batch_size)
-                stats.pad_cells += batch.seqs.size
-                stats.used_cells += int(batch.lens.sum())
+                batch, rows_ctx = _finish_batch(batch, bi, pages_popped)
                 b_sp = tracer.open("batch", attach=False, stream=batch.stream,
                                    rows=take, bucket=bi)
                 d_sp = tracer.open("dispatch", parent=b_sp,
@@ -1539,10 +1769,9 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 if split_ladder:
                     stats.n_dispatch_tier0 += 1
                 # hp rescue reconstructs segments, and the split ladder pools
-                # rescue rows, from the dispatched arrays — keep them alive
-                # until the fetch (the supervisor's replay handles retain the
-                # whole batch anyway)
-                rows_ctx = (batch.seqs, batch.lens, batch.nsegs)
+                # rescue rows, from the dispatched rows_ctx arrays — keep
+                # them alive until the fetch (the supervisor's replay handles
+                # retain the whole batch anyway)
                 inflight.append((handle, rid, widx, take, time.time(),
                                  rows_ctx, bi, batch.stream, b_sp))
                 # let the in-flight window FILL, then drain half of it in one
@@ -1560,6 +1789,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 flush_rescues(True, pressure)
                 drain(0)
 
+    stats.paged = paged_on
     qvr = load_qv_ranker(db, las, cfg)
     stats.qv_ranked = qvr is not None
     if cfg.qv_track and qvr is None:
@@ -1774,7 +2004,31 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     if nwin == 0:
                         finalize_read(aread, pr)
         if nwin and aread in pending:
-            if nb == 1:
+            if paged_on:
+                # family router: smallest (depth, pages) family fitting each
+                # window — the corpus-derived replacement for the depth/
+                # seg-len bucket grid
+                from ..kernels import paging
+
+                pgs = paging.window_pages(lens, cfg.page_len)
+                assign = np.asarray(paging.assign_family(families, nsegs,
+                                                         pgs))
+                for bi in range(nb):
+                    sel = np.nonzero(assign == bi)[0]
+                    if len(sel) == 0:
+                        continue
+                    Df = families[bi].depth
+                    blk_seqs[bi].append(seqs[sel, :Df])
+                    blk_lens[bi].append(lens[sel, :Df])
+                    blk_nsegs[bi].append(nsegs[sel])
+                    blk_rid[bi].append(rid_arr[sel])
+                    blk_widx[bi].append(widx_arr[sel])
+                    blk_pages[bi].append(pgs[sel])
+                    npages[bi] += int(pgs[sel].sum())
+                    nrows[bi] += len(sel)
+                    if first_seen[bi] is None:
+                        first_seen[bi] = stats.n_reads
+            elif nb == 1:
                 # single bucket: append the pile block as-is, zero copies
                 blk_seqs[0].append(seqs); blk_lens[0].append(lens)
                 blk_nsegs[0].append(nsegs); blk_rid[0].append(rid_arr)
@@ -1847,7 +2101,8 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         qv_ranked=stats.qv_ranked, bases_out=stats.bases_out,
         quarantined=stats.n_quarantined,
         ingest_issues=stats.n_ingest_issues,
-        pad_waste=round(stats.pad_waste, 4), wall_s=round(stats.wall_s, 3),
+        pad_waste=round(stats.pad_waste, 4), paged=stats.paged,
+        wall_s=round(stats.wall_s, 3),
         # wall decomposition anchors (ISSUE 6): daccord-trace reconciles
         # its device/host stage sums against these
         device_s=round(stats.device_s, 4), host_s=round(stats.host_s, 4),

@@ -322,6 +322,20 @@ class DeviceSupervisor:
         return alive
 
     def _shape_key(self, batch) -> str:
+        if getattr(batch, "pool", None) is not None:
+            # paged wire format (kernels/paging.py): pool rows + table width
+            # + lens depth are the jit shape dims; the :pg suffix keeps
+            # paged and dense programs of the same batch width classifying
+            # (and fingerprinting) separately — a warm dense shape must not
+            # rob the paged cold compile of its long deadline
+            b, ppw = batch.table.shape
+            key = (f"{self._fp_prefix}B{b}xD{batch.lens.shape[1]}"
+                   f"xL{batch.shape.seg_len}"
+                   f"xP{ppw}x{batch.family.page_len}"
+                   f"xN{batch.pool.shape[0]}:pg")
+            if getattr(batch, "stream", "full") == "tier0":
+                key += ":t0"
+            return key
         seqs = getattr(batch, "seqs", None)
         if seqs is None:
             return self._fp_prefix + "opaque"
@@ -507,6 +521,11 @@ class DeviceSupervisor:
         if self.faults is not None:
             self.faults.op(op, degraded=True)   # only `crash` can fire here
         self.counters["degraded_solves"] += 1
+        if hasattr(batch, "to_dense"):
+            # degraded engines (native C++ ladder, host-routed solve_tiered)
+            # iterate dense rows: unpack the retained paged batch first —
+            # byte-identical by the pack/unpack round-trip property
+            batch = batch.to_dense()
         return fb(batch)
 
     def _maybe_failback(self) -> bool:

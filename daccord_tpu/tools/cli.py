@@ -205,6 +205,20 @@ def daccord_main(argv=None) -> int:
                         "--stages ladder_full,ladder_split). Ignored by "
                         "--backend native (per-window host escalation) "
                         "and --mesh")
+    p.add_argument("--paged", choices=("on", "off", "auto"), default="off",
+                   help="ragged paged window batching (kernels/paging.py): "
+                        "batches ship as a page pool + per-window page table "
+                        "bucketed into corpus-derived (depth, pages) shape "
+                        "families instead of dense [B, D, L] rectangles — "
+                        "byte-identical FASTA, the dense tile is gathered "
+                        "device-side inside the same jitted program; 'auto' "
+                        "enables it on device (non-cpu) platforms. Default "
+                        "off until the on-chip paged-vs-dense decision row "
+                        "lands (BASELINE.md). JAX ladder paths only")
+    p.add_argument("--page-len", type=int, default=16, metavar="N",
+                   help="paged page length in bases (must divide --seg-len; "
+                        "segments are page-aligned, so rounding waste "
+                        "averages half a page per segment)")
     p.add_argument("--pallas", action="store_true",
                    help="run the heaviest-path DP as the Pallas TPU kernel "
                         "(bit-identical results; TPU backend only)")
@@ -240,6 +254,16 @@ def daccord_main(argv=None) -> int:
         raise SystemExit("--ladder split is a JAX-ladder dispatch strategy; "
                          "--backend native escalates per window on host "
                          "(drop one of the two flags)")
+    if args.paged == "on" and args.backend == "native":
+        # same rule as --ladder split: the native engine iterates dense rows
+        # on host, so an explicit paged request is a contradiction (an
+        # auto-resolved native backend only logs and runs dense)
+        raise SystemExit("--paged on is a JAX-ladder wire format; --backend "
+                         "native solves dense rows on host (drop one flag)")
+    if args.paged != "off" and (args.page_len <= 0
+                                or args.seg_len % args.page_len):
+        raise SystemExit(f"--page-len {args.page_len} must be positive and "
+                         f"divide --seg-len {args.seg_len}")
     if args.max_kmers == 0 and args.backend not in ("native", "auto"):
         # on the device ladder M=0 means top_k(…, 0): an empty active set
         # that silently solves nothing — only the native engine interprets
@@ -344,6 +368,7 @@ def daccord_main(argv=None) -> int:
                          ingest_policy=args.ingest_policy,
                          quarantine_path=args.quarantine,
                          ladder_mode=args.ladder,
+                         paged=args.paged, page_len=args.page_len,
                          max_pile_overlaps=args.max_pile_overlaps,
                          ledger_path=args.ledger)
 
@@ -423,6 +448,7 @@ def daccord_main(argv=None) -> int:
         "device_s": round(stats.device_s, 3),
         "tier_histogram": stats.tier_histogram,
         "pad_waste": round(stats.pad_waste, 4),
+        "paged": stats.paged,
         "native_host": stats.native_host,
         "degraded": stats.degraded,
         "quarantined": stats.n_quarantined,
@@ -892,6 +918,8 @@ def shard_main(argv=None) -> int:
                    help="piles sampled by the profile estimation pass")
     p.add_argument("--backend", choices=("auto", "cpu", "tpu", "native"),
                    default="auto")
+    p.add_argument("--paged", choices=("on", "off", "auto"), default="off",
+                   help="ragged paged window batching (see daccord --paged)")
     p.add_argument("--events", default=None, metavar="PATH",
                    help="supervisor events jsonl (see daccord --events)")
     p.add_argument("--ledger", default="auto", metavar="PATH",
@@ -934,6 +962,7 @@ def shard_main(argv=None) -> int:
                           native_solver=args.backend == "native",
                           events_path=args.events,
                           ingest_policy=args.ingest_policy,
+                          paged=args.paged,
                           max_pile_overlaps=args.max_pile_overlaps,
                           ledger_path=ledger)
     if args.profile_sample is not None:
@@ -1018,6 +1047,9 @@ def fleet_main(argv=None) -> int:
                    default="auto")
     p.add_argument("--ingest-policy", choices=("strict", "quarantine", "off"),
                    default="strict")
+    p.add_argument("--paged", choices=("on", "off", "auto"), default="off",
+                   help="ragged paged window batching forwarded to every "
+                        "worker (see daccord --paged)")
     p.add_argument("--max-pile-overlaps", type=int, default=None, metavar="N",
                    help="monster-pile budget forwarded to every worker (see "
                         "daccord --max-pile-overlaps); 0 disables")
@@ -1051,6 +1083,7 @@ def fleet_main(argv=None) -> int:
                       checkpoint_every=args.checkpoint_every,
                       batch=args.batch, backend=args.backend,
                       ingest_policy=args.ingest_policy,
+                      paged=args.paged,
                       max_pile_overlaps=args.max_pile_overlaps,
                       worker_telemetry=not args.no_worker_telemetry,
                       events_path=args.events if args.events is not None

@@ -52,6 +52,16 @@ EVENT_FIELDS: dict[str, dict] = {
     "sup_failback": {},
     "sup_done": {"state": str, "degraded": bool},
     "batch": {"windows": int, "solved": int},
+    # ragged paged window batching (kernels/paging.py, ISSUE 7): one
+    # paging.family row per derived shape family at shard start, one
+    # batch.paged row per paged dispatch (pages = live pages shipped,
+    # pool_pages = the family's static pool budget, occupancy = their
+    # ratio, table_cells = the page table's transfer cost in cell units)
+    "paging.family": {"family": str, "bucket": int, "depth": int,
+                      "pages": int, "page_len": int, "pool_pages": int},
+    "batch.paged": {"windows": int, "bucket": int, "family": str,
+                    "pages": int, "pool_pages": int, "table_cells": int,
+                    "occupancy": _NUM},
     # two-stream tier ladder (ISSUE 4): one row per Stream B rescue dispatch
     # (rows = live rescue windows, slots = padded batch width, reason =
     # full | lag | final | pressure — the last is a host-watermark
@@ -93,8 +103,10 @@ EVENT_FIELDS: dict[str, dict] = {
     "bench_start": {"batch": int},
     "bench_compile": {"batch": int, "cached": bool, "expected_wall_s": _NUM},
     # self-staging bench ladder: one row per completed rung (sidecar
-    # committed the moment the rung lands — see bench.py ladder mode)
-    "bench_rung": {"batch": int, "bases_per_sec": _NUM, "fallback": bool},
+    # committed the moment the rung lands — see bench.py ladder mode).
+    # pad_waste rides every rung so paged-vs-dense is attributable per rung
+    "bench_rung": {"batch": int, "bases_per_sec": _NUM, "fallback": bool,
+                   "pad_waste": _NUM},
     "bench_drain": {"fetched": int, "inflight": int},
     "bench_done": {"wall_s": _NUM},
 }

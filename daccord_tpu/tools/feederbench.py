@@ -21,6 +21,14 @@ def main(argv=None) -> int:
     p.add_argument("--threads", default="1,4,8")
     p.add_argument("--genome", type=int, default=60_000)
     p.add_argument("--coverage", type=float, default=20.0)
+    p.add_argument("--paged", action="store_true",
+                   help="also measure paged packing (kernels/paging.py): "
+                        "family derivation + pack_paged over the fed "
+                        "windows in --batch-row batches; reports the pack "
+                        "wall as a fraction of the feeder wall (the ISSUE 7 "
+                        "acceptance bound is <= 5%%)")
+    p.add_argument("--batch-rows", type=int, default=512,
+                   help="rows per packed batch in --paged mode")
     args = p.parse_args(argv)
 
     import os
@@ -46,19 +54,118 @@ def main(argv=None) -> int:
             cfg = PipelineConfig(feeder_threads=nt)
             t0 = time.perf_counter()
             n_win = n_bases = n_reads = 0
+            blocks = []
             it = (_iter_pile_blocks_threaded(db, las, cfg, None, None, nt)
                   if nt > 0 else _iter_pile_blocks(db, las, cfg, None, None, True))
             for aread, a, seqs, lens, nsegs in it:
                 n_reads += 1
                 n_win += len(nsegs)
                 n_bases += len(a)
+                if args.paged and len(nsegs):
+                    blocks.append((seqs, lens, nsegs))
             dt = time.perf_counter() - t0
-            print(json.dumps({
+            line = {
                 "threads": nt, "reads": n_reads, "windows": n_win,
                 "wall_s": round(dt, 3),
                 "windows_per_s": round(n_win / dt, 1),
-                "bases_per_s": round(n_bases / dt, 1)}))
+                "bases_per_s": round(n_bases / dt, 1)}
+            if args.paged and blocks:
+                line.update(_measure_pack(blocks, cfg, dt,
+                                          args.batch_rows))
+            elif args.paged:
+                # zero window blocks (empty/degenerate corpus): report the
+                # feeder numbers rather than abort on an empty concatenate
+                line["paged_windows"] = 0
+            print(json.dumps(line))
     return 0
+
+
+def _measure_pack(blocks, cfg, feeder_wall_s: float, batch_rows: int) -> dict:
+    """Host-side paged-packing overhead over already-fed window blocks.
+
+    Two arms over the SAME windows: the paged router (family assign + row
+    slice + budget cut + ``pack_paged``) and the dense router it replaces
+    (depth-bucket assign + row slice + ``pad_batch``) — both are per-dispatch
+    feeder-thread work, so the ISSUE 7 acceptance bound (<= 5% of feeder
+    wall) is judged on their DELTA: what paging *adds* to the feeder, not
+    the routing cost both wire formats pay."""
+    import time as _time
+
+    import numpy as np
+
+    from ..kernels import paging
+    from ..kernels.tensorize import BatchShape, WindowBatch, pad_batch
+
+    seqs = np.concatenate([b[0] for b in blocks])
+    lens = np.concatenate([b[1] for b in blocks])
+    nsegs = np.concatenate([b[2] for b in blocks])
+
+    def _wb(sub, depth):
+        return WindowBatch(seqs=seqs[sub, :depth], lens=lens[sub, :depth],
+                           nsegs=nsegs[sub],
+                           shape=BatchShape(depth=depth,
+                                            seg_len=cfg.seg_len),
+                           read_ids=np.zeros(len(sub), np.int64),
+                           wstarts=np.zeros(len(sub), np.int64))
+
+    # ---- paged arm -----------------------------------------------------
+    t0 = _time.perf_counter()
+    pages = paging.window_pages(lens, cfg.page_len)
+    # derive from a strided sample, like the pipeline (which samples a few
+    # piles) — the full-corpus greedy would charge the pack wall for work
+    # the real feeder never does
+    samp = np.unique(np.linspace(0, len(nsegs) - 1,
+                                 min(4096, len(nsegs))).astype(int))
+    fams = paging.derive_families(
+        nsegs[samp], pages[samp], max_depth=cfg.depth,
+        max_pages=-(-cfg.depth * cfg.seg_len // cfg.page_len),
+        budget=cfg.paged_families, page_len=cfg.page_len)
+    assign = paging.assign_family(fams, nsegs, pages)
+    n_packed = 0
+    shipped = used = 0
+    for fi, fam in enumerate(fams):
+        idx = np.nonzero(assign == fi)[0]
+        pgs_f = pages[idx]
+        cap = batch_rows * fam.budget
+        c0 = 0
+        while c0 < len(idx):
+            # same budget cut as the pipeline router: the largest prefix
+            # whose pages fit one pool
+            take = min(batch_rows, len(idx) - c0)
+            fit = int(np.searchsorted(np.cumsum(pgs_f[c0 : c0 + take]),
+                                      cap, side="right"))
+            take = max(min(take, fit), 1)
+            sub = idx[c0 : c0 + take]
+            pb = paging.pack_paged(_wb(sub, fam.depth), fam,
+                                   target_rows=batch_rows)
+            n_packed += len(sub)
+            shipped += pb.pool.size
+            used += int(lens[sub].sum())
+            c0 += take
+    paged_s = _time.perf_counter() - t0
+
+    # ---- dense arm (the default depth-bucket router + jit pad) ---------
+    t0 = _time.perf_counter()
+    d_buckets = sorted({b for b in cfg.depth_buckets
+                        if 0 < b < cfg.depth} | {cfg.depth})
+    d_assign = np.searchsorted(np.asarray(d_buckets), nsegs, side="left")
+    dense_shipped = 0
+    for di, dv in enumerate(d_buckets):
+        idx = np.nonzero(d_assign == di)[0]
+        for c0 in range(0, len(idx), batch_rows):
+            sub = idx[c0 : c0 + batch_rows]
+            db_ = pad_batch(_wb(sub, dv), batch_rows)
+            dense_shipped += db_.seqs.size
+    dense_s = _time.perf_counter() - t0
+
+    return {"paged_windows": int(n_packed),
+            "families": [f.describe() for f in fams],
+            "pack_wall_s": round(paged_s, 3),
+            "dense_route_wall_s": round(dense_s, 3),
+            "pack_overhead_pct_of_feeder": round(
+                100.0 * (paged_s - dense_s) / max(feeder_wall_s, 1e-9), 2),
+            "paged_pad_waste": round(1.0 - used / max(shipped, 1), 4),
+            "dense_pad_waste": round(1.0 - used / max(dense_shipped, 1), 4)}
 
 
 if __name__ == "__main__":

@@ -20,7 +20,8 @@ import json
 import time
 
 #: stages in run order; --stages picks a comma-separated subset
-STAGES = ("ladder_full", "ladder_pallas", "ladder_split", "tier0", "prefixes")
+STAGES = ("ladder_full", "ladder_pallas", "ladder_paged", "ladder_split",
+          "tier0", "prefixes")
 
 
 def main(argv=None) -> int:
@@ -109,6 +110,59 @@ def main(argv=None) -> int:
     if "ladder_pallas" in stages and jax.default_backend() == "tpu":
         timed("ladder_pallas",
               lambda: fetch(solve_ladder_async(wb, ladder, use_pallas=True)))
+
+    if "ladder_paged" in stages:
+        # ragged paged wire format (ISSUE 7): the same full-ladder program
+        # fed pool + page table, dense tile gathered device-side. The
+        # decision row weighs kernel-side gather cost against the shipped-
+        # cell reduction; on a tunneled chip the transfer saving is the
+        # larger term (pad_waste is the per-rung sidecar metric)
+        from daccord_tpu.kernels import paging
+
+        pgs = paging.window_pages(wb.lens)
+        fams = paging.derive_families(wb.nsegs, pgs,
+                                      max_depth=wb.seqs.shape[1],
+                                      max_pages=-(-wb.seqs.shape[1]
+                                                  * wb.seqs.shape[2]
+                                                  // paging.PAGE_LEN),
+                                      budget=1)
+        t_pack = time.perf_counter()
+        pwb = paging.pack_paged(wb, fams[-1], target_rows=B)
+        pack_ms = (time.perf_counter() - t_pack) * 1e3
+        dense_waste = round(wb.pad_waste(), 4)
+        paged_waste = round(pwb.pad_waste(), 4)
+        ms_paged = timed(
+            "ladder_paged",
+            lambda: fetch(solve_ladder_async(pwb, ladder)),
+            extra={"family": fams[-1].describe(),
+                   "pack_ms": round(pack_ms, 2),
+                   "pad_waste_dense": dense_waste,
+                   "pad_waste_paged": paged_waste})
+        ms_paged_pl = None
+        if jax.default_backend() == "tpu":
+            # the gather_pages Pallas DMA kernel is the arm the decision
+            # exists to judge on chip — the jnp row above is its fallback
+            # cost; TPU-gated exactly like the ladder_pallas stage
+            # (interpret mode off-TPU is parity-only, not a perf signal)
+            ms_paged_pl = timed(
+                "ladder_paged_pallas",
+                lambda: fetch(solve_ladder_async(pwb, ladder,
+                                                 use_pallas=True)))
+        if ms_full is not None:
+            row = {
+                "stage": "decision:paged", "batch": B,
+                "dense_ms": round(ms_full, 2), "paged_ms": round(ms_paged, 2),
+                "paged_speedup": round(ms_full / ms_paged, 3) if ms_paged
+                else None,
+                "pad_waste_dense": dense_waste,
+                "pad_waste_paged": paged_waste,
+                "shipped_cells_dense": int(wb.seqs.size),
+                "shipped_cells_paged": int(pwb.shipped_cells),
+                "pack_ms": round(pack_ms, 2),
+                "device": str(jax.devices()[0]).replace(" ", "")}
+            if ms_paged_pl is not None:
+                row["paged_pallas_ms"] = round(ms_paged_pl, 2)
+            print(json.dumps(row))
 
     if "ladder_split" in stages:
         # two-stream ladder (ISSUE 4): tier0 over the full batch + the full

@@ -48,7 +48,7 @@ STAGES = (
     ("hp", ("hp",)),
     ("flush", ("flush",)),
     ("governor", ("governor.rung",)),
-    ("setup", ("scan", "profile", "ladder.build")),
+    ("setup", ("scan", "profile", "ladder.build", "paging.derive")),
     ("probe", ("probe",)),
 )
 

@@ -1,0 +1,400 @@
+"""Ragged paged window batching (ISSUE 7): round-trip/gather parity, shape
+families, and the paged pipeline's byte identity under the fault matrix.
+
+Fast tier: the pack/unpack round-trip property over random ragged piles,
+device-gather parity (jnp + Pallas interpret), shape-family derivation and
+routing units, paged slice/pad (the governor's bisect primitives), the
+supervisor's ``:pg`` shape keys, CLI/schema surfaces — no XLA ladder
+compiles. Slow tier: paged output byte-identical to dense on the cfg2-style
+corpus with a >=2x pad-waste (dead cells per used cell) reduction, and the
+DACCORD_FAULT matrix on the paged path (device_lost failover replay,
+device_oom governor bisect of a paged batch, worker_crash mid-shard resume).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from daccord_tpu.kernels import paging
+from daccord_tpu.kernels.tensorize import (BatchShape, WindowBatch, pad_batch,
+                                           slice_batch, tensorize_windows)
+from daccord_tpu.oracle.windows import WindowSegments
+
+# ---------------------------------------------------------------- fast tier
+
+
+def _ragged_batch(seed=0, b=23, depth=8, seg_len=64, max_seg=70, max_nseg=10):
+    """Random ragged piles -> dense WindowBatch (zero-length segments,
+    empty windows, and depth-capped windows all represented)."""
+    rng = np.random.default_rng(seed)
+    shape = BatchShape(depth=depth, seg_len=seg_len, wlen=40)
+    items = []
+    for i in range(b):
+        nseg = int(rng.integers(0, max_nseg))
+        segs = [rng.integers(0, 4, size=int(rng.integers(0, max_seg)))
+                .astype(np.int8) for _ in range(nseg)]
+        items.append((i, WindowSegments(wstart=i * 10, wlen=40,
+                                        segments=segs, breads=[0] * nseg)))
+    return tensorize_windows(items, shape)
+
+
+def _covering_family(dense, depth=None):
+    pg = paging.window_pages(dense.lens)
+    top = max(int(pg.max(initial=1)), 1)
+    return paging.ShapeFamily(depth=depth or dense.shape.depth,
+                              pages=1 << (top - 1).bit_length())
+
+
+def test_roundtrip_property():
+    """pack -> unpack == dense tensorize, bit for bit, across seeds and
+    shapes (the invariant that lets the whole fault/capacity matrix verify
+    the paged path)."""
+    for seed, depth, seg_len in ((0, 8, 64), (1, 32, 64), (2, 4, 32),
+                                 (3, 8, 64)):
+        dense = _ragged_batch(seed=seed, depth=depth, seg_len=seg_len)
+        fam = _covering_family(dense)
+        pb = paging.pack_paged(dense, fam)
+        rt = paging.unpack_paged(pb)
+        np.testing.assert_array_equal(rt.seqs, dense.seqs)
+        np.testing.assert_array_equal(rt.lens, dense.lens)
+        np.testing.assert_array_equal(rt.nsegs, dense.nsegs)
+        np.testing.assert_array_equal(rt.read_ids, dense.read_ids)
+        np.testing.assert_array_equal(rt.wstarts, dense.wstarts)
+    # padded pack: sentinel rows unpack to all-PAD windows
+    dense = _ragged_batch(seed=4)
+    pb = paging.pack_paged(dense, _covering_family(dense), target_rows=32)
+    assert pb.size == 32
+    d2 = pb.to_dense()
+    np.testing.assert_array_equal(d2.seqs[: dense.size], dense.seqs)
+    assert (d2.seqs[dense.size:] == 4).all()
+    assert (d2.read_ids[dense.size:] == -1).all()
+
+
+def test_gather_parity():
+    """Device-side gather (jnp take fallback AND the Pallas kernel in
+    interpret mode) reconstructs the exact dense tile."""
+    import jax.numpy as jnp
+
+    dense = _ragged_batch(seed=7, b=16)
+    pb = paging.pack_paged(dense, _covering_family(dense))
+    for use_pallas in (False, True):
+        got = paging.gather_windows(
+            jnp.asarray(pb.pool), jnp.asarray(pb.table), jnp.asarray(pb.lens),
+            page_len=pb.family.page_len, seg_len=dense.shape.seg_len,
+            use_pallas=use_pallas, interpret=use_pallas)
+        np.testing.assert_array_equal(np.asarray(got), dense.seqs,
+                                      f"use_pallas={use_pallas}")
+
+
+def test_pack_invariant_violations_raise():
+    dense = _ragged_batch(seed=1, depth=8)
+    pg = paging.window_pages(dense.lens)
+    small = paging.ShapeFamily(depth=8, pages=max(int(pg.max()) - 1, 1))
+    with pytest.raises(ValueError, match="page budget"):
+        paging.pack_paged(dense, small)
+    with pytest.raises(ValueError, match="depth"):
+        paging.pack_paged(dense, paging.ShapeFamily(depth=4, pages=1024))
+    with pytest.raises(ValueError, match="divide"):
+        paging.pack_paged(dense, paging.ShapeFamily(depth=8, pages=1024,
+                                                    page_len=24))
+    # a pool budget too small for the batch is a router bug, not a silent
+    # truncation
+    fam = _covering_family(dense)
+    tight = paging.ShapeFamily(depth=fam.depth, pages=fam.pages,
+                               pool_pages=1)
+    with pytest.raises(ValueError, match="pool budget"):
+        paging.pack_paged(dense, tight)
+
+
+def test_family_derivation_units():
+    rng = np.random.default_rng(3)
+    nsegs = np.concatenate([rng.integers(2, 8, 50),
+                            rng.integers(20, 30, 50)])
+    pages = np.concatenate([rng.integers(2, 12, 50),
+                            rng.integers(50, 90, 50)])
+    fams = paging.derive_families(nsegs, pages, max_depth=32, max_pages=128,
+                                  budget=4)
+    assert 1 <= len(fams) <= 4
+    # pow2 quantization + mandatory full coverage
+    for f in fams:
+        assert f.depth & (f.depth - 1) == 0
+        assert f.pages & (f.pages - 1) == 0
+        assert 0 < f.budget <= f.pages
+    assert fams[-1].depth >= 32 and fams[-1].pages >= 128
+    # router order: sorted by pages, every window fits its family, and the
+    # assignment is the cheapest fit
+    assert [f.pages for f in fams] == sorted(f.pages for f in fams)
+    ai = paging.assign_family(fams, nsegs, pages)
+    for i, fi in enumerate(ai):
+        f = fams[fi]
+        assert nsegs[i] <= f.depth and pages[i] <= f.pages
+        for fj in range(fi):
+            assert not (nsegs[i] <= fams[fj].depth
+                        and pages[i] <= fams[fj].pages)
+    # derivation is deterministic
+    fams2 = paging.derive_families(nsegs, pages, max_depth=32, max_pages=128,
+                                   budget=4)
+    assert fams == fams2
+    # empty sample still yields the covering family
+    fams0 = paging.derive_families(np.zeros(0), np.zeros(0), max_depth=32,
+                                   max_pages=128, budget=4)
+    assert fams0 and fams0[-1].pages >= 128
+    # an unroutable window raises instead of truncating
+    with pytest.raises(ValueError, match="fits no family"):
+        paging.assign_family(fams, np.asarray([64]), np.asarray([500]))
+    # non-pow2 structural maxima (e.g. --depth 24): the full-coverage
+    # family is capped at the EXACT maxima, never rounded up past the
+    # feeder's tensor depth
+    fams24 = paging.derive_families(np.minimum(nsegs, 24),
+                                    np.minimum(pages, 90),
+                                    max_depth=24, max_pages=96, budget=4)
+    assert fams24[-1].depth == 24 and fams24[-1].pages == 96
+    assert all(f.depth <= 24 and f.pages <= 96 for f in fams24)
+    dense24 = _ragged_batch(seed=9, depth=24, max_nseg=26)
+    fam24 = fams24[-1]
+    pb = paging.pack_paged(dense24, fam24)      # must not raise
+    np.testing.assert_array_equal(pb.to_dense().seqs, dense24.seqs)
+
+
+def test_paged_slice_pad_dispatch():
+    """tensorize.slice_batch/pad_batch route paged batches to the table-row
+    forms (the governor's bisect rung primitives): pool shared, stream and
+    family preserved, round-trip intact."""
+    dense = _ragged_batch(seed=5)
+    pb = paging.pack_paged(dense, _covering_family(dense))
+    pb.stream = "rescue"
+    s = slice_batch(pb, 3, 9)
+    assert s.size == 6 and s.stream == "rescue" and s.family is pb.family
+    assert s.pool is pb.pool            # shared, not copied
+    np.testing.assert_array_equal(s.to_dense().seqs, dense.seqs[3:9])
+    p = pad_batch(s, 8)
+    assert p.size == 8 and p.stream == "rescue"
+    d = p.to_dense()
+    np.testing.assert_array_equal(d.seqs[:6], dense.seqs[3:9])
+    assert (d.seqs[6:] == 4).all() and (d.nsegs[6:] == 0).all()
+
+
+def test_supervisor_paged_shape_key(tmp_path, monkeypatch):
+    """Paged batches fingerprint with the :pg suffix (and :t0 for Stream A)
+    so paged and dense programs of the same width classify separately."""
+    from daccord_tpu.runtime.supervisor import DeviceSupervisor
+
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    sup = DeviceSupervisor(lambda b: b, lambda h: h, describe="stub")
+    dense = _ragged_batch(seed=6, b=4)
+    pb = paging.pack_paged(dense, _covering_family(dense))
+    key = sup._shape_key(pb)
+    assert key.endswith(":pg") and "x16xN" in key and "B4x" in key
+    pb.stream = "tier0"
+    assert sup._shape_key(pb).endswith(":pg:t0")
+    # dense keys are untouched
+    assert sup._shape_key(dense) == "B4xD8xL64"
+
+
+def test_degraded_solve_unpacks_paged(tmp_path, monkeypatch):
+    """A failed-over supervisor replays a retained PAGED batch on the dense
+    fallback engine via to_dense — the engine sees exact dense rows."""
+    from daccord_tpu.runtime.faults import FaultPlan
+    from daccord_tpu.runtime.supervisor import (DeviceSupervisor,
+                                                SupervisorConfig)
+
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    seen = {}
+
+    def fallback(b):
+        seen["type"] = type(b).__name__
+        seen["seqs"] = np.array(b.seqs)
+        return {"ok": True}
+
+    sup = DeviceSupervisor(
+        lambda b: ("h", b), lambda h: h,
+        fallback_factory=lambda: fallback,
+        cfg=SupervisorConfig(backoff_base_s=0.01),
+        faults=FaultPlan.parse("device_lost:1"), describe="stub")
+    dense = _ragged_batch(seed=8, b=4)
+    pb = paging.pack_paged(dense, _covering_family(dense))
+    h = sup.dispatch(pb)     # op 1: device lost -> failover
+    assert sup.failed_over
+    assert sup.fetch(h) == {"ok": True}
+    assert seen["type"] == "WindowBatch"
+    np.testing.assert_array_equal(seen["seqs"], dense.seqs)
+
+
+def test_eventcheck_paged_schema(tmp_path):
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    good = tmp_path / "pg.jsonl"
+    good.write_text(
+        json.dumps({"t": 0.1, "ts": 1.0, "event": "paging.family",
+                    "family": "D8xP16x16b13", "bucket": 0, "depth": 8,
+                    "pages": 16, "page_len": 16, "pool_pages": 13}) + "\n"
+        + json.dumps({"t": 0.2, "ts": 1.1, "event": "batch.paged",
+                      "windows": 32, "bucket": 0, "family": "D8xP16x16b13",
+                      "pages": 300, "pool_pages": 416, "table_cells": 2048,
+                      "occupancy": 0.72}) + "\n")
+    assert validate_events(str(good), strict=True) == []
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(
+        {"t": 0.1, "ts": 1.0, "event": "batch.paged", "windows": 32}) + "\n")
+    errs = validate_events(str(bad))
+    assert errs and any("pool_pages" in e for e in errs)
+
+
+def test_cli_paged_flag_validation():
+    from daccord_tpu.tools.cli import daccord_main
+
+    with pytest.raises(SystemExit, match="paged"):
+        daccord_main(["db", "las", "--paged", "on", "--backend", "native"])
+    with pytest.raises(SystemExit, match="page-len"):
+        daccord_main(["db", "las", "--paged", "on", "--page-len", "24"])
+
+
+# ---------------------------------------------------------------- slow tier
+# (XLA ladder compiles; byte parity + the pad-waste bar are the acceptance)
+
+
+@pytest.fixture(scope="module")
+def cfg2ish(tmp_path_factory):
+    """cfg2-style synthetic corpus (production-like depth: the regime the
+    >=2x pad-waste acceptance is judged on)."""
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path_factory.mktemp("paged_e2e"))
+    cfg = SimConfig(genome_len=4000, coverage=26, read_len_mean=800,
+                    min_overlap=300, seed=23)
+    return make_dataset(d, cfg, name="c2"), d
+
+
+@pytest.fixture(scope="module")
+def smallish(tmp_path_factory):
+    """Small corpus for the fault-matrix arms (bounds compile wall)."""
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path_factory.mktemp("paged_faults"))
+    cfg = SimConfig(genome_len=1500, coverage=10, read_len_mean=500,
+                    min_overlap=200, seed=5)
+    return make_dataset(d, cfg, name="pf"), d
+
+
+def _pipe_cfg(**kw):
+    from daccord_tpu.runtime import PipelineConfig
+
+    kw.setdefault("batch_size", 64)
+    return PipelineConfig(**kw)
+
+
+@pytest.mark.slow
+def test_paged_vs_dense_byte_parity_and_waste(cfg2ish):
+    """ISSUE 7 acceptance: paged FASTA byte-identical to dense on the
+    cfg2-style corpus, pad-waste (dead cells per used cell) drops >= 2x vs
+    the default dense bucketing, and every paged dispatch leaves lint-clean
+    paging events. Split mode composes on top, byte-identical too."""
+    from daccord_tpu.runtime import correct_to_fasta
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    out, d = cfg2ish
+    f_dense = os.path.join(d, "dense.fasta")
+    f_paged = os.path.join(d, "paged.fasta")
+    ev = os.path.join(d, "paged.events.jsonl")
+    s_dense = correct_to_fasta(out["db"], out["las"], f_dense, _pipe_cfg())
+    s_paged = correct_to_fasta(out["db"], out["las"], f_paged,
+                               _pipe_cfg(paged="on", events_path=ev))
+    assert open(f_dense).read() == open(f_paged).read()
+    assert s_paged.paged and not s_dense.paged
+
+    dead_dense = s_dense.pad_waste / (1 - s_dense.pad_waste)
+    dead_paged = s_paged.pad_waste / (1 - s_paged.pad_waste)
+    assert dead_dense >= 2.0 * dead_paged, (s_dense.pad_waste,
+                                            s_paged.pad_waste)
+
+    assert validate_events(ev, strict=True) == []
+    recs = [json.loads(x) for x in open(ev)]
+    fams = [r for r in recs if r["event"] == "paging.family"]
+    dispatches = [r for r in recs if r["event"] == "batch.paged"]
+    assert fams and dispatches
+    # every dispatch's pages fit its family's static pool
+    for r in dispatches:
+        assert 0 < r["pages"] <= r["pool_pages"]
+
+    # split-ladder composition: Stream B pools re-pack as paged batches
+    f_split = os.path.join(d, "split_paged.fasta")
+    s_split = correct_to_fasta(out["db"], out["las"], f_split,
+                               _pipe_cfg(paged="on", ladder_mode="split"))
+    assert open(f_split).read() == open(f_dense).read()
+    assert s_split.n_dispatch_rescue > 0
+
+
+@pytest.mark.slow
+def test_paged_fault_matrix(smallish, monkeypatch):
+    """DACCORD_FAULT matrix on the paged path: transient retries, declared
+    device loss (both streams' paged batches replay on the dense fallback),
+    and a device OOM that bisects a PAGED batch down the governor ladder —
+    FASTA byte-identical to the unfaulted dense run throughout."""
+    from daccord_tpu.runtime import correct_to_fasta
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    out, d = smallish
+    monkeypatch.setenv("DACCORD_COMPCACHE", os.path.join(d, "cc"))
+    ref = os.path.join(d, "ref.fasta")
+    correct_to_fasta(out["db"], out["las"], ref, _pipe_cfg(batch_size=32))
+    ref_bytes = open(ref).read()
+    monkeypatch.setenv("DACCORD_SUP_BACKOFF_S", "0.01")
+    for fault, expect_degraded in (("dispatch_error:2", False),
+                                   ("device_lost:3", True),
+                                   ("device_oom:2", False)):
+        monkeypatch.setenv("DACCORD_FAULT", fault)
+        name = fault.split(":")[0]
+        f = os.path.join(d, f"{name}.fasta")
+        ev = os.path.join(d, f"{name}.events.jsonl")
+        st = correct_to_fasta(out["db"], out["las"], f,
+                              _pipe_cfg(batch_size=32, paged="on",
+                                        events_path=ev))
+        assert open(f).read() == ref_bytes, fault
+        assert st.degraded == expect_degraded, fault
+        assert validate_events(ev, strict=True) == []
+        if name == "device_oom":
+            evs = [json.loads(x) for x in open(ev)]
+            cls = [e for e in evs if e["event"] == "governor.classify"]
+            assert cls and all(":pg" in e["key"] for e in cls)
+            assert any(e["event"] == "governor.shrink" for e in evs)
+            assert not any(e["event"] == "sup_failover" for e in evs)
+            assert not st.degraded
+    monkeypatch.delenv("DACCORD_FAULT")
+
+
+@pytest.mark.slow
+def test_paged_worker_crash_resume(smallish, monkeypatch):
+    """Mid-shard crash + checkpoint resume with the paged wire format: the
+    resumed shard reproduces the uninterrupted run's exact bytes."""
+    from daccord_tpu.parallel.launch import run_shard, shard_paths
+    from daccord_tpu.runtime.faults import InjectedCrash
+
+    out, d = smallish
+    monkeypatch.setenv("DACCORD_COMPCACHE", os.path.join(d, "cc"))
+
+    def cfg():
+        return _pipe_cfg(batch_size=32, paged="on")
+
+    ref_dir = os.path.join(d, "ref_out")
+    m_ref = run_shard(out["db"], out["las"], ref_dir, 0, 1, cfg(),
+                      checkpoint_every=2)
+    assert not m_ref.get("degraded")
+    ref_fasta = open(shard_paths(ref_dir, 0)["fasta"]).read()
+
+    crash_dir = os.path.join(d, "crash_out")
+    # measured on this corpus/config: 45 dispatches + 11 grouped fetches
+    # (= 56 device ops) per clean paged run, so op 40 lands mid-shard with
+    # checkpoints already committed and reads still pending
+    monkeypatch.setenv("DACCORD_FAULT", "crash:40")
+    with pytest.raises(InjectedCrash):
+        run_shard(out["db"], out["las"], crash_dir, 0, 1, cfg(),
+                  checkpoint_every=2)
+    paths = shard_paths(crash_dir, 0)
+    assert os.path.exists(paths["progress"])
+    assert not os.path.exists(paths["manifest"])
+    monkeypatch.delenv("DACCORD_FAULT")
+    run_shard(out["db"], out["las"], crash_dir, 0, 1, cfg(),
+              checkpoint_every=2)
+    assert open(paths["fasta"]).read() == ref_fasta

@@ -199,6 +199,53 @@ EOF
 echo "tools_pounce: capacity-governor smoke OK" >&2
 rm -rf "$govdir"
 
+# paged-batching smoke (ISSUE 7): synth a toy corpus, run the dense and the
+# paged JAX-CPU ladder, and require byte-identical FASTA plus a >=2x
+# pad-waste (dead cells per used cell) reduction with lint-clean
+# paging.family/batch.paged events — all CPU-side, before any chip minute.
+# A failure here means the paged wire format regressed; abort the pounce
+# rather than spend chip time on it. Uses the REAL compcache (clean runs, no
+# ratchets): the first pounce pays ~2 CPU ladder compiles per shape, later
+# pounces run warm.
+pagedir=$(mktemp -d)
+python - "$pagedir" <<'EOF' || { echo "tools_pounce: paged synth failed" >&2; exit 1; }
+import sys
+from daccord_tpu.sim.synth import SimConfig, make_dataset
+make_dataset(sys.argv[1], SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=500, min_overlap=200,
+                                    seed=5), name="pg")
+EOF
+python -m daccord_tpu.tools.cli daccord "$pagedir/pg.db" "$pagedir/pg.las" \
+    --backend cpu -b 32 -o "$pagedir/dense.fasta" \
+    --stats "$pagedir/dense.stats.json" \
+  || { echo "tools_pounce: paged-smoke dense run FAILED" >&2; exit 1; }
+python -m daccord_tpu.tools.cli daccord "$pagedir/pg.db" "$pagedir/pg.las" \
+    --backend cpu -b 32 --paged on -o "$pagedir/paged.fasta" \
+    --stats "$pagedir/paged.stats.json" \
+    --events "$pagedir/paged.events.jsonl" \
+  || { echo "tools_pounce: paged-smoke paged run FAILED" >&2; exit 1; }
+cmp -s "$pagedir/dense.fasta" "$pagedir/paged.fasta" \
+  || { echo "tools_pounce: paged FASTA diverged from dense run" >&2; exit 1; }
+python -m daccord_tpu.tools.cli eventcheck --strict "$pagedir/paged.events.jsonl" \
+  || { echo "tools_pounce: paged events failed schema lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli trace --check --no-timeline "$pagedir/paged.events.jsonl" \
+  || { echo "tools_pounce: paged sidecar failed daccord-trace lint" >&2; exit 1; }
+grep -q '"event": "paging.family"' "$pagedir/paged.events.jsonl" \
+  || { echo "tools_pounce: paged run derived no shape families" >&2; exit 1; }
+python - "$pagedir" <<'EOF' || { echo "tools_pounce: paged pad-waste check FAILED" >&2; exit 1; }
+import json, sys
+d = sys.argv[1]
+pw_d = json.load(open(f"{d}/dense.stats.json"))["pad_waste"]
+pw_p = json.load(open(f"{d}/paged.stats.json"))["pad_waste"]
+dead_d = pw_d / (1 - pw_d)      # dead cells per used cell
+dead_p = pw_p / (1 - pw_p)
+ratio = dead_d / max(dead_p, 1e-9)
+print(f"pad waste: dense {pw_d} paged {pw_p}; dead/used reduction {ratio:.2f}x")
+assert ratio >= 2.0, f"paged pad-waste reduction {ratio:.2f}x < 2x"
+EOF
+echo "tools_pounce: paged-batching smoke OK" >&2
+rm -rf "$pagedir"
+
 run() {  # run <name> <cmd...>: capture one experiment, commit its sidecar
   name=$1; shift
   out="POUNCE_${stamp}_${name}.json"
@@ -235,11 +282,12 @@ for f in BENCH_LADDER_B*.json BENCH_LADDER_B*.warm.log BENCH_LADDER_B*.warm.even
 done
 git commit -q -m "pounce: bench ladder rung sidecars (${stamp})" || true
 probe ladder
-# 2. the two open device decision rows, first minutes of the window
-# (VERDICT r5 #4): fused-Pallas vs scan (open since r3) AND the new
-# fused-vs-split two-stream ladder row (ISSUE 4)
+# 2. the open device decision rows, first minutes of the window
+# (VERDICT r5 #4): fused-Pallas vs scan (open since r3), the fused-vs-split
+# two-stream ladder row (ISSUE 4), AND the paged-vs-dense wire-format row
+# (ISSUE 7: decision:paged — adopt --paged auto per the BASELINE.md rule)
 run ladder_rows      python -m daccord_tpu.tools.kernelbench --backend auto \
-                       --stages ladder_full,ladder_pallas,ladder_split
+                       --stages ladder_full,ladder_pallas,ladder_paged,ladder_split
 probe ladder_rows
 # 3. esc_cap tail cost (experiment 3) — the fused-program comparator for
 # the split ladder: B/8 rescue cap vs the split row above
