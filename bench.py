@@ -80,6 +80,16 @@ if BENCH_LADDER is not None:
                              f"N_BENCH_WINDOWS={N_BENCH_WINDOWS}")
 _lmb = os.environ.get("DACCORD_BENCH_LADDER_MAX_BATCHES")
 LADDER_MAX_BATCHES = int(_lmb) if _lmb else None
+# serving-plane bench (ISSUE 10): DACCORD_BENCH_SERVE=1 replays a recorded
+# job-arrival trace against a real daccord-serve HTTP server and commits a
+# sidecar with per-job p50/p99 latency + windows/sec — the latency axis
+# landing next to the rung ladder's throughput axis on the first live
+# window. DACCORD_BENCH_SERVE_TRACE names a jsonl of {"dt": seconds-since-
+# previous-arrival} rows (default: a bursty 6-job trace);
+# DACCORD_BENCH_SERVE_BACKEND overrides the engine (default: native when
+# built, else cpu — the serving plane benches chip-free).
+BENCH_SERVE = os.environ.get("DACCORD_BENCH_SERVE") == "1"
+BENCH_SERVE_TRACE = os.environ.get("DACCORD_BENCH_SERVE_TRACE")
 
 
 def _bench_consensus_config():
@@ -719,6 +729,112 @@ def run_ladder(data: dict, ev, orc_bps: float) -> int:
     return landed
 
 
+def run_serve_bench(ev) -> dict:
+    """Serving-plane stage (DACCORD_BENCH_SERVE=1): synth a toy corpus,
+    start a REAL daccord-serve HTTP server in-process, replay a job-arrival
+    trace against it over the wire, and commit a sidecar with per-job
+    p50/p99 latency + aggregate windows/sec — ISSUE 10's acceptance metric.
+    The arrival trace is deterministic (recorded or the default burst), so
+    two rounds' serve sidecars compare like-for-like."""
+    import tempfile
+    import urllib.request
+
+    from daccord_tpu.serve import AdmissionConfig, ConsensusService, ServeConfig
+    from daccord_tpu.serve.http import start_server
+    from daccord_tpu.sim.synth import SimConfig, make_dataset
+
+    backend = os.environ.get("DACCORD_BENCH_SERVE_BACKEND")
+    if not backend:
+        try:
+            from daccord_tpu.native import available as _nat
+
+            backend = "native" if _nat() else "cpu"
+        except Exception:
+            backend = "cpu"
+    if backend in ("cpu", "native"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    arrivals = [0.0, 0.1, 0.2, 0.5, 0.8, 1.2]      # bursty default trace
+    if BENCH_SERVE_TRACE:
+        arrivals = []
+        t = 0.0
+        with open(BENCH_SERVE_TRACE) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    t += float(json.loads(line).get("dt", 0.0))
+                    arrivals.append(t)
+    d = tempfile.mkdtemp(prefix="daccord-serve-bench-")
+    data = make_dataset(d, SimConfig(genome_len=3000, coverage=12,
+                                     read_len_mean=600, min_overlap=250,
+                                     seed=11), name="sv")
+    batch = 64 if backend != "native" else 256
+    svc = ConsensusService(ServeConfig(
+        workdir=os.path.join(d, "srv"), backend=backend,
+        backend_explicit=True, batch=batch, workers=2, flush_lag_s=0.05,
+        metrics_snapshot_s=0.0,
+        admission=AdmissionConfig(max_queued_jobs=64, tenant_max_queued=64)))
+    httpd, port, _t = start_server(svc, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{port}"
+
+    def req(method, path, body=None):
+        r = urllib.request.Request(
+            base + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=600) as resp:
+            return json.loads(resp.read())
+
+    t0 = time.perf_counter()
+    ids = []
+    for i, at in enumerate(arrivals):
+        dt = at - (time.perf_counter() - t0)
+        if dt > 0:
+            time.sleep(dt)
+        st = req("POST", "/v1/jobs",
+                 {"db": data["db"], "las": data["las"],
+                  "tenant": f"t{i % 2}"})
+        ids.append(st["job"])
+    rows = []
+    for j in ids:
+        # result?wait=1 blocks to a terminal state; the status carries the
+        # latency decomposition
+        urllib.request.urlopen(
+            urllib.request.Request(base + f"/v1/jobs/{j}/result?wait=1"),
+            timeout=600).read()
+        rows.append(req("GET", f"/v1/jobs/{j}"))
+    wall = time.perf_counter() - t0
+    metrics = req("GET", "/v1/metrics")
+    req("POST", "/v1/shutdown")
+    httpd.shutdown()
+    lat = sorted(r["latency"]["total_s"] for r in rows)
+
+    def q(v, p):
+        return round(v[min(int(p * len(v)), len(v) - 1)], 4) if v else None
+
+    windows = sum(r["windows"] for r in rows)
+    mixed = sum(int(g.get("mixed_batches", 0))
+                for g in metrics["warm"].get("groups", []))
+    line = {
+        "metric": "serve_job_latency_s",
+        "backend": backend, "batch": batch, "jobs": len(rows),
+        "arrivals_s": [round(a, 3) for a in arrivals],
+        "p50_s": q(lat, 0.50), "p99_s": q(lat, 0.99),
+        "max_s": q(lat, 1.0), "wall_s": round(wall, 3),
+        "windows": windows,
+        "windows_per_sec": round(windows / wall, 1) if wall else None,
+        "mixed_batches": mixed,
+        "per_job": [{"job": r["job"], "state": r["state"],
+                     "windows": r["windows"], **r["latency"]}
+                    for r in rows],
+        "warm": {k: metrics["warm"][k] for k in ("hits", "misses")},
+    }
+    _commit_sidecar("BENCH_SERVE.json", line)
+    ev.log("bench_done", wall_s=round(wall, 3))
+    return line
+
+
 def main() -> None:
     import argparse
 
@@ -735,6 +851,12 @@ def main() -> None:
     ev = JsonlLogger(args.events)
     t_main0 = time.perf_counter()
     enable_compilation_cache()
+    if BENCH_SERVE:
+        # serving-plane stage: self-contained (synth corpus + real HTTP
+        # server), chip-free by default — runs before any window build
+        ev.log("bench_start", batch=0, serve=True)
+        print(json.dumps(run_serve_bench(ev)))
+        return
     data = build_windows()
     ev.log("bench_start", batch=BATCH, precompile=BENCH_PRECOMPILE)
     fallback = None

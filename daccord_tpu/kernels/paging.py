@@ -118,6 +118,8 @@ class PagedWindowBatch:
     read_ids: np.ndarray   # int64 [B]
     wstarts: np.ndarray    # int64 [B]
     stream: str = "full"
+    job: str = ""          # serving-plane tag (see WindowBatch.job):
+                           # telemetry only, never part of a shape key
 
     @property
     def size(self) -> int:
@@ -157,7 +159,8 @@ class PagedWindowBatch:
         return WindowBatch(seqs=seqs, lens=lens.copy(),
                            nsegs=self.nsegs.copy(), shape=self.shape,
                            read_ids=self.read_ids.copy(),
-                           wstarts=self.wstarts.copy(), stream=self.stream)
+                           wstarts=self.wstarts.copy(), stream=self.stream,
+                           job=self.job)
 
 
 def page_counts(lens: np.ndarray, page_len: int = PAGE_LEN) -> np.ndarray:
@@ -253,7 +256,8 @@ def pack_paged(batch: WindowBatch, family: ShapeFamily,
         nsegs=_pad_rows(batch.nsegs), family=family,
         shape=BatchShape(depth=D, seg_len=L, wlen=batch.shape.wlen),
         read_ids=_pad_rows(batch.read_ids, fill=-1),
-        wstarts=_pad_rows(batch.wstarts), stream=batch.stream)
+        wstarts=_pad_rows(batch.wstarts), stream=batch.stream,
+        job=batch.job)
 
 
 def unpack_paged(pb: PagedWindowBatch) -> WindowBatch:

@@ -42,6 +42,12 @@ class WindowBatch:
                           # program as "full", tagged for routing/replay —
                           # the supervisor keys compile classification and
                           # failover replay on it, kernels/tiers.py)
+    job: str = ""         # serving-plane tag (daccord_tpu/serve): which
+                          # job(s) the rows belong to — "" for batch runs, a
+                          # job id for a solo job's batches, "a+b" for a
+                          # cross-job merged batch. Telemetry only: it MUST
+                          # never enter a compile/shape key (cohabiting jobs
+                          # share the jitted program — that is the point)
 
     @property
     def size(self) -> int:
@@ -146,4 +152,4 @@ def pad_batch(batch, target: int):
     wstarts[:B] = batch.wstarts
     return WindowBatch(seqs=seqs, lens=lens, nsegs=nsegs, shape=batch.shape,
                        read_ids=read_ids, wstarts=wstarts,
-                       stream=batch.stream)
+                       stream=batch.stream, job=batch.job)

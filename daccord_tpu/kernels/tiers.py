@@ -458,6 +458,26 @@ def solve_tier0_async(batch: WindowBatch, ladder: TierLadder,
     return _PackedHandle(arr, p0.cons_len)
 
 
+def stream_dispatcher(ladder: TierLadder, use_pallas: bool = False,
+                      pallas_interpret: bool = False):
+    """Dispatch function routing a batch to the program its ``stream`` tag
+    names: ``tier0`` → the Stream A tier0-only program, anything else
+    (``full``/``rescue``) → the full ladder. The ONE routing rule shared by
+    the pipeline's split-ladder dispatch and the serving plane's cross-job
+    batcher (daccord_tpu/serve), so the two can never route a job-tagged
+    batch to different programs. The ``job`` tag deliberately plays no part
+    here — cohabiting jobs share the jitted program."""
+
+    def dispatch(batch: WindowBatch):
+        if getattr(batch, "stream", "full") == "tier0":
+            return solve_tier0_async(batch, ladder, use_pallas=use_pallas,
+                                     pallas_interpret=pallas_interpret)
+        return solve_ladder_async(batch, ladder, use_pallas=use_pallas,
+                                  pallas_interpret=pallas_interpret)
+
+    return dispatch
+
+
 def rescue_candidates(out: dict, nsegs: np.ndarray,
                       ladder: TierLadder) -> np.ndarray:
     """Bool mask of batch rows that the fused ladder would have routed

@@ -192,6 +192,13 @@ class PipelineConfig:
                                  # (ROADMAP 5) needs. Buffered writer; None
                                  # = off (daccord-shard defaults it next to
                                  # the shard manifest)
+    job_tag: str | None = None   # serving-plane job/tenant tag (ISSUE 10):
+                                 # stamped on every dispatched batch
+                                 # (WindowBatch.job) and every outcome-ledger
+                                 # row, so the ROADMAP-5 router training set
+                                 # segments per workload and a merged trace
+                                 # attributes batches to jobs. None (batch
+                                 # runs) leaves both exactly as before
     metrics_snapshot_s: float = 30.0  # cadence of periodic `metrics` events
                                  # (registry snapshot: windows/sec,
                                  # bases/sec, pad waste, rescue density,
@@ -997,7 +1004,14 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 profile = estimate_profile_for_shard(db, las, cfg, start,
                                                      end, **kw)
     ladder = None
-    if not (solver is None and cfg.native_solver):
+    if solver is not None and hasattr(solver, "ladder"):
+        # a warm-state solver (the serve batcher) already owns the ladder
+        # for this run's exact solve fingerprint — rebuilding the
+        # OffsetLikely tables per job would re-spend the cold start the
+        # warm group exists to amortize. None (a native group) matches the
+        # solo native path, which builds no device ladder either.
+        ladder = solver.ladder
+    elif not (solver is None and cfg.native_solver):
         # the native C++ solver builds its own OffsetLikely tables from the
         # same make_offset_likely call — constructing the (unused) device
         # ladder too would do that work twice
@@ -1035,14 +1049,24 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             return out
 
         solver = _native_solver
-    # two-stream ladder (ISSUE 4): only the local JAX ladder paths split —
-    # the native engine already escalates per-window on host, and a custom
-    # solver (mesh) brings its own programs
-    split_ladder = (cfg.ladder_mode == "split" and solver is None
-                    and not native_dispatch)
+    # two-stream ladder (ISSUE 4): the local JAX ladder paths split — the
+    # native engine already escalates per-window on host, and a custom
+    # solver (mesh) brings its own programs. Exception (ISSUE 10): an
+    # injected solver that declares ``routes_streams`` (the serving plane's
+    # cross-job batcher) understands the stream tags — it pools tier0 and
+    # rescue rows separately and routes each merged batch to the right
+    # program — so the split machinery runs for it too.
+    split_ladder = (cfg.ladder_mode == "split"
+                    and ((solver is None and not native_dispatch)
+                         or getattr(solver, "routes_streams", False)))
     if cfg.ladder_mode == "split" and not split_ladder:
         log.log("info", msg="ladder_mode=split inapplicable here "
                             "(native engine or custom solver); running fused")
+    # a partial-width-capable solver (the cross-job batcher) pads/packs its
+    # own MERGED batches: padding each job's flush here would ship dead rows
+    # the batcher cannot reclaim for cohabiting jobs
+    partial_dispatch = (solver is not None
+                        and getattr(solver, "accepts_partial", False))
     # ragged paged window batching (kernels/paging.py, ISSUE 7): JAX ladder
     # paths only — the native engine iterates dense rows on host, and a
     # custom (mesh) solver brings its own programs. 'auto' enables paging on
@@ -1123,19 +1147,17 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             from ..kernels.tiers import fetch as _fetch, solve_ladder_async
 
             from ..kernels.tiers import fetch_many as _fetch_many
-            from ..kernels.tiers import solve_tier0_async
             from ..kernels.window_kernel import pallas_needs_interpret
 
             interp = cfg.use_pallas and pallas_needs_interpret()
             if split_ladder:
-                def dispatch_fn(b):
-                    if b.stream == "tier0":
-                        return solve_tier0_async(
-                            b, ladder, use_pallas=cfg.use_pallas,
-                            pallas_interpret=interp)
-                    return solve_ladder_async(
-                        b, ladder, use_pallas=cfg.use_pallas,
-                        pallas_interpret=interp)
+                # the ONE stream-routing rule, shared with the serving
+                # plane's cross-job batcher (kernels.tiers.stream_dispatcher)
+                from ..kernels.tiers import stream_dispatcher
+
+                dispatch_fn = stream_dispatcher(ladder,
+                                                use_pallas=cfg.use_pallas,
+                                                pallas_interpret=interp)
             else:
                 dispatch_fn = (lambda b: solve_ladder_async(
                     b, ladder, use_pallas=cfg.use_pallas, pallas_interpret=interp))
@@ -1252,9 +1274,16 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         if native_dispatch:
             hp_ols = None if hp_use_native else ols
         else:
-            from ..oracle.consensus import make_offset_likely
+            # a warm-state solver (serve batcher) shares its group's
+            # OffsetLikely tables across jobs (read-only) — rebuilding them
+            # per job would re-spend the cold start the warm group
+            # amortizes
+            hp_ols = (getattr(solver, "hp_ols", None)
+                      if solver is not None else None)
+            if hp_ols is None:
+                from ..oracle.consensus import make_offset_likely
 
-            hp_ols = make_offset_likely(profile, cfg.consensus)
+                hp_ols = make_offset_likely(profile, cfg.consensus)
             if hp_use_native:
                 try:
                     from ..native import available as _nat_avail
@@ -1493,7 +1522,8 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     # rescue membership: the window rode a rescue lane —
                     # a Stream B dispatch in split mode, or (fused) any
                     # escalation-tier solve
-                    rescued=(stream == "rescue" or t >= 1), wall_s=wall)
+                    rescued=(stream == "rescue" or t >= 1), wall_s=wall,
+                    job=cfg.job_tag)
             if pr.n_done == pr.n_windows:
                 finalize_read(r, pr)
         return n_batch_solved
@@ -1574,9 +1604,11 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                        occupancy=round(pages_popped
                                        / max(pb.pool.shape[0] - 1, 1), 4))
             return pb, (dense_seqs, pb.lens, pb.nsegs)
-        if not native_dispatch:
+        if not native_dispatch and not partial_dispatch:
             # padding exists only for jit static shapes; the native engine
-            # iterates real rows and would just walk PAD
+            # iterates real rows and would just walk PAD, and a
+            # partial-capable solver (serve batcher) pads its own merged
+            # batches after pooling rows across jobs
             batch = pad_batch(batch, cfg.batch_size)
         stats.pad_cells += batch.seqs.size
         stats.used_cells += int(batch.lens.sum())
@@ -1700,7 +1732,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                     nsegs=nsg[:take], shape=shapes[bi],
                                     read_ids=rid[:take],
                                     wstarts=widx[:take].astype(np.int64) * adv,
-                                    stream="rescue")
+                                    stream="rescue", job=cfg.job_tag or "")
                 batch, rows_ctx = _finish_batch(batch, bi, pages_popped)
                 # the flush span covers the pool pop + pad/pack only: the
                 # dispatch below books under the dispatch stage, and the
@@ -1757,7 +1789,8 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 batch = WindowBatch(seqs=seqs[:take], lens=lens[:take], nsegs=nsg[:take],
                                     shape=shapes[bi], read_ids=rid[:take],
                                     wstarts=widx[:take].astype(np.int64) * adv,
-                                    stream="tier0" if split_ladder else "full")
+                                    stream="tier0" if split_ladder else "full",
+                                    job=cfg.job_tag or "")
                 batch, rows_ctx = _finish_batch(batch, bi, pages_popped)
                 b_sp = tracer.open("batch", attach=False, stream=batch.stream,
                                    rows=take, bucket=bi)
@@ -1994,7 +2027,8 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                             # equal stats.n_windows
                             ledger.record(aread, int(wj), w, int(nsegs[wj]),
                                           -1, -1, False, "skip",
-                                          rescued=False, wall_s=0.0)
+                                          rescued=False, wall_s=0.0,
+                                          job=cfg.job_tag)
                     pr.n_done += ns
                     stats.n_skipped_shallow += ns
                     keep = ~shallow

@@ -1,0 +1,45 @@
+"""daccord-serve: the always-on consensus service (serving plane, ISSUE 10).
+
+Everything else in the repo is batch-job shaped; this package is the
+long-lived server the ROADMAP north star ("serve heavy traffic from millions
+of users") needs: a `daccord-serve` HTTP/JSON front-end accepting concurrent
+correction jobs, a **cross-job batcher** multiplexing their window streams
+into shared device batches (legal by per-window independence — the same
+property the split ladder and the paged router exploit), admission control
+and load shedding built on the capacity governor's watermarks, and a warm
+state manager keeping compiled programs, ratchet registries, and shape
+families resident across jobs.
+
+Layering (ParaFold's CPU-pre / device-compute / CPU-post split, applied at
+serving scale):
+
+    http.py       stdlib HTTP/JSON front-end (upload-or-path jobs,
+                  streaming results, metrics, graceful shutdown)
+    service.py    ConsensusService: job registry, worker pool, ticker
+                  (stale-pool flush, pressure shed, idle eviction)
+    admission.py  per-tenant quotas + RSS watermarks (admission pauses
+                  BEFORE the pipeline's feeder watermarks engage)
+    jobs.py       job spec/config (CLI-default parity), the per-job
+                  pipeline runner with durable streaming commit
+    batcher.py    SolveGroup (shared supervised solve path per solve
+                  fingerprint) + the cross-job row pools
+    state.py      WarmState: solve-group cache with idle eviction
+
+Byte contract: every job's FASTA is byte-identical to a solo ``daccord``
+run over the same inputs and config — enforced by tests/test_serve.py under
+the fault/capacity matrix (device_lost, device_oom bisect of mixed-job
+batches, mid-job aborts).
+"""
+
+from .admission import AdmissionConfig, AdmissionController, AdmissionReject
+from .batcher import JobAborted, JobSolver, SolveGroup
+from .jobs import Job, JobSpec, build_job_config, solve_fingerprint
+from .service import ConsensusService, ServeConfig
+from .state import WarmState
+
+__all__ = [
+    "AdmissionConfig", "AdmissionController", "AdmissionReject",
+    "ConsensusService", "Job", "JobAborted", "JobSolver", "JobSpec",
+    "ServeConfig", "SolveGroup", "WarmState", "build_job_config",
+    "solve_fingerprint",
+]

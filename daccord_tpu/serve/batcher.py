@@ -1,0 +1,687 @@
+"""Cross-job batcher: shared device batches over concurrent jobs' windows.
+
+The serving plane's core claim (ISSUE 10): window streams from concurrent
+jobs may share device batches, because every window solves independently —
+the same per-window-independence argument behind the split ladder, the
+governor's bisect, and the paged router. A job's pipeline runs exactly as a
+solo run does (its own feeder, profile, scatter, stitch, commit); only its
+dispatch seam changes: instead of solving its own (possibly partial) batches,
+it hands row blocks to a :class:`SolveGroup`, which pools rows from every
+cohabiting job per (depth, seg-len, stream) bucket, flushes MERGED batches
+padded to the service width through ONE shared supervised solve path, and
+scatters each merged result back to the per-job handles. The shared path is
+a full production stack — DeviceSupervisor watchdog/retries/failover plus
+the capacity governor's bisect/clamp ladder — so a device_lost replays a
+mixed-job batch whole and a device_oom bisects it, with every job's bytes
+unchanged (tests/test_serve.py).
+
+Warmth is the point: the group owns the TierLadder (and therefore the jitted
+programs' cache identity), the supervisor's compile-fingerprint state, and
+the governor's capacity ratchets, so the Nth job pays none of the cold-start
+a fresh ``daccord`` invocation would. Groups are keyed by solve fingerprint
+(profile + consensus config + backend — see ``jobs.solve_fingerprint``):
+jobs whose solve semantics differ can never share a batch, because their
+results would differ; they still share the process, the admission plane, and
+the warm cache.
+
+Optional group modes mirror the pipeline's dispatch strategies:
+
+- ``ladder_mode='split'``: job pipelines run the two-stream machinery
+  (``PipelineConfig.ladder_mode='split'`` with the solver's
+  ``routes_streams`` opt-in); tier0 and rescue rows pool separately here and
+  each merged batch routes via ``kernels.tiers.stream_dispatcher`` — the
+  SAME routing rule the pipeline uses.
+- ``paged=True``: merged batches pack into the ragged paged wire format
+  (``kernels/paging.py``); shape families derive lazily from the first
+  pooled rows per bucket, so the family router reflects the live mix of
+  workloads rather than any one job's sample.
+
+Locking: one RLock per group serializes pool mutation AND the device solve.
+Jobs therefore take turns driving the device — correct (one device is one
+resource) and simple; the in-flight deque still overlaps each job's host
+windowing with device work exactly as the solo pipeline does.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..kernels.tensorize import BatchShape, WindowBatch, pad_batch, slice_batch
+from ..runtime.governor import GovernorConfig, merge_results
+from ..utils.obs import NullLogger, Tracer
+
+
+class JobAborted(RuntimeError):
+    """Raised by :meth:`SolveGroup.fetch` on a handle whose job was
+    released mid-flight (client disconnect / DELETE). The job's own
+    pipeline unwinds on it; cohabiting jobs never see it."""
+
+
+class JobHandle:
+    """One job-side dispatch: ``n`` rows whose results arrive as ordered
+    parts (a handle's rows may split across consecutive merged batches).
+    ``result()`` materializes via the governor's ``merge_results`` — the
+    same row-exact merge the bisect rung trusts."""
+
+    __slots__ = ("job", "n", "parts", "filled", "event", "aborted")
+
+    def __init__(self, job: str, n: int):
+        self.job = job
+        self.n = int(n)
+        self.parts: list[tuple[int, dict]] = []
+        self.filled = 0
+        self.event = threading.Event()
+        self.aborted = False
+
+    def add_part(self, n: int, out: dict) -> None:
+        self.parts.append((n, out))
+        self.filled += n
+        if self.filled >= self.n:
+            self.event.set()
+
+    def abort(self) -> None:
+        self.aborted = True
+        self.event.set()
+
+    def result(self) -> dict:
+        return merge_results(self.parts)
+
+
+class _Block:
+    """A contiguous run of one handle's rows sitting in a pool."""
+
+    __slots__ = ("handle", "batch", "pages")
+
+    def __init__(self, handle: JobHandle, batch: WindowBatch, pages):
+        self.handle = handle
+        self.batch = batch
+        self.pages = pages          # int64 [rows] (paged groups) or None
+
+
+class _Pool:
+    """FIFO of row blocks for one (depth, seg-len, stream[, family]) bucket."""
+
+    __slots__ = ("blocks", "rows", "pages", "oldest_ts", "shape", "stream")
+
+    def __init__(self, shape: BatchShape, stream: str):
+        self.blocks: deque[_Block] = deque()
+        self.rows = 0
+        self.pages = 0
+        self.oldest_ts: float | None = None
+        self.shape = shape
+        self.stream = stream
+
+    def append(self, blk: _Block) -> None:
+        self.blocks.append(blk)
+        self.rows += blk.batch.size
+        if blk.pages is not None:
+            self.pages += int(blk.pages.sum())
+        if self.oldest_ts is None:
+            self.oldest_ts = time.time()
+
+
+@dataclass
+class GroupConfig:
+    backend: str = "native"      # native | cpu | device (any jax platform)
+    batch: int = 512             # merged dispatch width (service batch)
+    ladder_mode: str = "fused"   # fused | split (group-level routing)
+    paged: bool = False          # pack merged batches as the paged wire format
+    page_len: int = 16
+    paged_families: int = 4
+    use_pallas: bool = False
+    max_inflight: int = 8        # merged batches in flight before a drain
+    min_width: int = 8           # shed floor for the width ladder
+    shed_levels: int = 0         # current load-shed level: merged batches
+                                 # dispatch at batch >> shed_levels — the
+                                 # batch ladder as the overload policy
+                                 # (ISSUE 10 (c)); mutated via set_shed
+    governor: GovernorConfig = field(default_factory=GovernorConfig.from_env)
+
+
+class SolveGroup:
+    """Shared solve path + cross-job row pools for one solve fingerprint.
+
+    Construction mirrors the pipeline's solver resolution exactly (same
+    helpers): ``native`` → the C++ ladder (inline supervisor, fallback =
+    itself); ``cpu`` fused dense → host-routed ``solve_tiered``; anything
+    else (device platforms, or cpu forced onto the jitted path by split/
+    paged modes) → the async ladder via ``stream_dispatcher`` with the
+    esc-cap clamp rung wired for the governor.
+    """
+
+    def __init__(self, key: str, profile, cfg, gcfg: GroupConfig,
+                 log=None, name: str = "g0"):
+        self.key = key
+        self.name = name
+        self.cfg = cfg                      # canonical PipelineConfig
+        self.gcfg = gcfg
+        self.log = log if log is not None else NullLogger()
+        self.tracer = Tracer(self.log)
+        self._lock = threading.RLock()
+        self._pools: dict[tuple, _Pool] = {}
+        self._inflight: deque = deque()
+        self._families: dict[tuple, list] = {}   # (D, L) -> ShapeFamily list
+        self.counters = {"dispatches": 0, "rows": 0, "batches": 0,
+                         "mixed_batches": 0, "demand_flushes": 0,
+                         "lag_flushes": 0, "shed_flushes": 0}
+        self.ladder = None
+        self._profile = profile
+        self._hp_ols = None          # lazy; native groups set it at build
+        self._build_solver(profile, cfg)
+        # refcount/idle bookkeeping owned by WarmState
+        self.refs = 0
+        self.last_used = time.time()
+        self.created = time.time()
+
+    # ------------------------------------------------------------------
+    # solve-path construction (the pipeline's resolution, reused)
+    # ------------------------------------------------------------------
+
+    def _build_solver(self, profile, cfg) -> None:
+        from ..runtime.faults import FaultPlan
+        from ..runtime.pipeline import _build_native_fallback, _make_clamp_solve
+        from ..runtime.supervisor import DeviceSupervisor, SupervisorConfig
+
+        g = self.gcfg
+        clamp = None
+        fetch_many = None
+        rtt_s = None
+        if g.backend == "native":
+            if g.paged or g.ladder_mode == "split":
+                raise ValueError("native serve groups run fused dense: the "
+                                 "C++ engine escalates per window on host")
+            base = _build_native_fallback(profile, cfg)
+            dispatch, fetch = base, (lambda h: h)
+            inline, prefix, desc = True, "native:", "serve-native-ladder"
+            fallback_factory = (lambda: base)
+            # the engine already built the OffsetLikely tables; share them
+            # with every job's hp pass (read-only)
+            self._hp_ols = base.ols
+        else:
+            import jax
+
+            from ..kernels.tiers import TierLadder
+
+            self.ladder = TierLadder.from_config(
+                profile, cfg.consensus, max_kmers=cfg.max_kmers,
+                rescue_max_kmers=cfg.rescue_max_kmers,
+                overflow_rescue=cfg.overflow_rescue)
+            is_cpu = jax.default_backend() == "cpu"
+            prefix = jax.default_backend() + ":"
+            ladder = self.ladder
+            if is_cpu and g.ladder_mode != "split" and not g.paged:
+                from ..kernels.tiers import solve_tiered
+
+                dispatch = (lambda b: solve_tiered(b, ladder))
+                fetch = (lambda h: h)
+                inline, desc = True, "serve-cpu-ladder"
+            else:
+                from ..kernels.tiers import fetch as _fetch
+                from ..kernels.tiers import fetch_many as _fetch_many
+                from ..kernels.tiers import stream_dispatcher
+                from ..kernels.window_kernel import pallas_needs_interpret
+
+                interp = g.use_pallas and pallas_needs_interpret()
+                dispatch = stream_dispatcher(ladder, use_pallas=g.use_pallas,
+                                             pallas_interpret=interp)
+                fetch = _fetch
+                fetch_many = _fetch_many
+                clamp = _make_clamp_solve(ladder, g.use_pallas, interp,
+                                          g.governor.esc_clamp)
+                inline = is_cpu
+                desc = "serve-device-ladder" if not is_cpu else \
+                    "serve-cpu-ladder-async"
+                if not is_cpu:
+                    from ..utils.obs import measure_rtt_s
+
+                    rtt_s = measure_rtt_s()
+
+            def fallback_factory():
+                if is_cpu:
+                    # exact-ladder host fallback (byte-exact vs the primary)
+                    from ..kernels.tiers import solve_tiered as _st
+
+                    def _cpu_fb(b):
+                        if hasattr(b, "to_dense"):
+                            b = b.to_dense()
+                        return _st(b, ladder)
+
+                    _cpu_fb.__name__ = "cpu-ladder"
+                    return _cpu_fb
+                return _build_native_fallback(profile, cfg)
+
+        self.sup = DeviceSupervisor(
+            dispatch, fetch, fetch_many, fallback_factory=fallback_factory,
+            log=self.log, cfg=SupervisorConfig.from_env(),
+            faults=FaultPlan.from_env(), rtt_s=rtt_s, describe=desc,
+            fingerprint_prefix=prefix, inline=inline, clamp_solve=clamp,
+            governor_cfg=g.governor, tracer=self.tracer)
+
+    # ------------------------------------------------------------------
+    # job-side API
+    # ------------------------------------------------------------------
+
+    def job_solver(self, job: str) -> "JobSolver":
+        return JobSolver(self, job)
+
+    @property
+    def hp_ols(self):
+        """The group's shared OffsetLikely tables for the hp-rescue pass
+        (built once, read-only across job threads) — rebuilding them per
+        job was most of the warm path's residual cold start. Double-checked
+        read: once built, a new job must NOT queue behind a cohabitant's
+        in-flight solve (which holds the group lock) just to read the
+        reference."""
+        ols = self._hp_ols
+        if ols is not None:
+            return ols
+        with self._lock:
+            if self._hp_ols is None:
+                from ..oracle.consensus import make_offset_likely
+
+                self._hp_ols = make_offset_likely(self._profile,
+                                                  self.cfg.consensus)
+            return self._hp_ols
+
+    def set_shed(self, levels: int) -> None:
+        """Load-shed rung: merged batches dispatch at ``batch >> levels``
+        (floored) until pressure clears — the capacity governor's batch
+        ladder promoted to the service's overload policy."""
+        with self._lock:
+            self.gcfg.shed_levels = max(0, int(levels))
+
+    def _width(self) -> int:
+        w = self.gcfg.batch >> self.gcfg.shed_levels
+        return max(self.gcfg.min_width, w)
+
+    def _pool_key(self, batch: WindowBatch) -> tuple:
+        return (batch.shape.depth, batch.shape.seg_len, batch.shape.wlen,
+                getattr(batch, "stream", "full"))
+
+    def dispatch(self, job: str, batch: WindowBatch) -> JobHandle:
+        """Pool one job batch's rows; flush merged batches when a bucket
+        holds a dispatch width. Returns the job-side handle."""
+        h = JobHandle(job, batch.size)
+        if batch.size == 0:
+            h.event.set()
+            return h
+        with self._lock:
+            pk = self._pool_key(batch)
+            pool = self._pools.get(pk)
+            if pool is None:
+                pool = self._pools[pk] = _Pool(batch.shape, pk[3])
+            pages = None
+            if self.gcfg.paged:
+                from ..kernels import paging
+
+                pages = paging.window_pages(batch.lens, self.gcfg.page_len)
+            pool.append(_Block(h, batch, pages))
+            self.counters["rows"] += batch.size
+            while pool.rows >= self._width():
+                self._flush(pk, reason="full")
+            if len(self._inflight) >= self.gcfg.max_inflight:
+                self._drain(self.gcfg.max_inflight // 2)
+        return h
+
+    def fetch(self, handle: JobHandle) -> dict:
+        """Block until ``handle``'s rows are solved; the calling job thread
+        drives the shared flush/drain machinery itself (no dedicated device
+        thread), so a lone job proceeds at full speed and cohabiting jobs
+        complete each other's handles as a side effect of their own."""
+        if not handle.event.is_set():
+            with self._lock:
+                while not handle.event.is_set():
+                    pk = self._pool_of(handle)
+                    if pk is not None:
+                        self.counters["demand_flushes"] += 1
+                        self._flush(pk, reason="demand")
+                    elif self._inflight:
+                        self._drain(0)
+                    else:
+                        raise RuntimeError(
+                            f"handle for job {handle.job!r} has rows neither "
+                            "pooled nor in flight (batcher bookkeeping bug)")
+        if handle.aborted:
+            raise JobAborted(f"job {handle.job!r} aborted")
+        return handle.result()
+
+    def fetch_many(self, handles: list) -> list[dict]:
+        return [self.fetch(h) for h in handles]
+
+    def flush_stale(self, max_age_s: float) -> None:
+        """Service-ticker hook: flush pools whose oldest rows have waited
+        longer than ``max_age_s`` — bounds the extra latency one job's rows
+        can pay waiting for cohabitants (the cross-job form of the
+        pipeline's bucket_flush_reads rule). NON-BLOCKING on the group
+        lock: the lock is held across real device solves (minutes during a
+        jit compile), and the single ticker thread must not stall behind
+        one group's solve — a busy group's pools are being drained by the
+        very solve that holds the lock."""
+        if not self._lock.acquire(blocking=False):
+            return
+        try:
+            now = time.time()
+            for pk, pool in list(self._pools.items()):
+                if (pool.rows and pool.oldest_ts is not None
+                        and now - pool.oldest_ts >= max_age_s):
+                    self.counters["lag_flushes"] += 1
+                    self._flush(pk, reason="lag")
+            if self._inflight:
+                self._drain(0)
+        finally:
+            self._lock.release()
+
+    def release_job(self, job: str) -> None:
+        """Drop a released (aborted/finished) job's rows from every pool so
+        they never waste a device slot; handles left incomplete abort. Rows
+        already in a merged in-flight batch stay — their results scatter
+        into dead handles harmlessly; cohabiting rows are untouched (the
+        abort-must-not-poison contract)."""
+        with self._lock:
+            for pool in self._pools.values():
+                kept: deque[_Block] = deque()
+                for blk in pool.blocks:
+                    if blk.handle.job == job:
+                        pool.rows -= blk.batch.size
+                        if blk.pages is not None:
+                            pool.pages -= int(blk.pages.sum())
+                        blk.handle.abort()
+                    else:
+                        kept.append(blk)
+                pool.blocks = kept
+                if not pool.rows:
+                    pool.oldest_ts = None
+
+    def drain_all(self) -> None:
+        """Flush every pool and drain every in-flight batch (shutdown)."""
+        with self._lock:
+            for pk, pool in list(self._pools.items()):
+                while pool.rows:
+                    self._flush(pk, reason="final")
+            if self._inflight:
+                self._drain(0)
+
+    def stats(self) -> dict:
+        """Group stats. NON-BLOCKING on the solve lock (same reasoning as
+        :meth:`flush_stale`): during an in-flight solve the counters are
+        read without the lock — dict reads are atomic under the GIL, and a
+        momentarily-stale gauge beats stalling the ticker (pressure shed,
+        eviction, other groups' flushes) behind a minutes-long compile."""
+        locked = self._lock.acquire(blocking=False)
+        try:
+            pooled = sum(p.rows for p in self._pools.values())
+            return {"key": self.key, "name": self.name, **self.counters,
+                    "pooled_rows": pooled, "inflight": len(self._inflight),
+                    "width": self._width(), "refs": self.refs,
+                    "busy": not locked,
+                    "degraded": self.sup.failed_over,
+                    "governor": self.sup.governor.counters.copy()}
+        finally:
+            if locked:
+                self._lock.release()
+
+    def close(self) -> None:
+        self.tracer.unwind()
+        if self.log is not None:
+            self.log.close()
+
+    # ------------------------------------------------------------------
+    # merged-batch assembly
+    # ------------------------------------------------------------------
+
+    def _pool_of(self, handle: JobHandle) -> tuple | None:
+        for pk, pool in self._pools.items():
+            for blk in pool.blocks:
+                if blk.handle is handle:
+                    return pk
+        return None
+
+    def _family_for(self, pool: _Pool, nsegs: np.ndarray,
+                    pages: np.ndarray):
+        """Lazily-derived shape families for this bucket's (D, L): the
+        corpus sample is the pooled rows themselves, so the family grid
+        reflects the live cross-job mix. The mandatory full-coverage family
+        guarantees any later window routes somewhere."""
+        from ..kernels import paging
+
+        fk = (pool.shape.depth, pool.shape.seg_len)
+        fams = self._families.get(fk)
+        if fams is None:
+            D, L = fk
+            PL = self.gcfg.page_len
+            fams = paging.derive_families(
+                np.asarray(nsegs, np.int64), np.asarray(pages, np.int64),
+                max_depth=D, max_pages=-(-D * L // PL),
+                budget=self.gcfg.paged_families, page_len=PL)
+            # a width-wide pool must fit at least one worst-case window
+            fams = [f if self._width() * f.budget >= f.pages else
+                    paging.ShapeFamily(depth=f.depth, pages=f.pages,
+                                       page_len=f.page_len,
+                                       pool_pages=-(-f.pages // self._width()))
+                    for f in fams]
+            self._families[fk] = fams
+            for fi, f in enumerate(fams):
+                self.log.log("paging.family", family=f.describe(), bucket=fi,
+                             depth=int(f.depth), pages=int(f.pages),
+                             page_len=int(f.page_len), pool_pages=int(f.budget))
+        # smallest family covering every row of this merged batch
+        mxd = int(np.max(nsegs)) if len(nsegs) else 0
+        mxp = int(np.max(pages)) if len(pages) else 0
+        for f in fams:
+            if f.depth >= mxd and f.pages >= mxp:
+                return f
+        return fams[-1]
+
+    def _flush(self, pk: tuple, reason: str) -> None:
+        pool = self._pools.get(pk)
+        if pool is None or not pool.rows:
+            return
+        width = self._width()
+        take = min(width, pool.rows)
+        # pop a `take`-row prefix, splitting the last block if needed
+        taken: list[tuple[JobHandle, WindowBatch, np.ndarray | None]] = []
+        need = take
+        while need > 0:
+            blk = pool.blocks[0]
+            if blk.batch.size <= need:
+                pool.blocks.popleft()
+                taken.append((blk.handle, blk.batch, blk.pages))
+                need -= blk.batch.size
+            else:
+                head = slice_batch(blk.batch, 0, need)
+                tail = slice_batch(blk.batch, need, blk.batch.size)
+                taken.append((blk.handle, head,
+                              None if blk.pages is None else blk.pages[:need]))
+                blk.batch = tail
+                if blk.pages is not None:
+                    blk.pages = blk.pages[need:]
+                need = 0
+        pool.rows -= take
+        pool.oldest_ts = time.time() if pool.rows else None
+        if self.gcfg.paged:
+            pool.pages = sum(int(b.pages.sum()) for b in pool.blocks
+                             if b.pages is not None)
+
+        jobs: list[str] = []
+        for h, _, _ in taken:
+            if h.job not in jobs:
+                jobs.append(h.job)
+
+        def _cat(get):
+            arrs = [get(b) for _, b, _ in taken]
+            return np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
+
+        merged = WindowBatch(
+            seqs=_cat(lambda b: b.seqs), lens=_cat(lambda b: b.lens),
+            nsegs=_cat(lambda b: b.nsegs), shape=pool.shape,
+            read_ids=_cat(lambda b: b.read_ids),
+            wstarts=_cat(lambda b: b.wstarts), stream=pool.stream,
+            job="+".join(jobs))
+        if self.gcfg.paged:
+            from ..kernels import paging
+
+            pages = np.concatenate([p for _, _, p in taken]) \
+                if len(taken) > 1 else taken[0][2]
+            fam = self._family_for(pool, merged.nsegs, pages)
+            # the dispatch width must hold this family's worst-case window
+            # even after a shed rung shrank _width() below the derivation-
+            # time width the family fixup assumed — otherwise the forced
+            # fit>=1 row below could bust pack_paged's pool assertion
+            width = max(width, -(-fam.pages // fam.budget))
+            # respect the family's pool budget: requeue rows past it (front
+            # of the pool, original order — the router-side guarantee
+            # behind pack_paged's overflow assertion)
+            budget = fam.pool_rows(width) - 1
+            fit = int(np.searchsorted(np.cumsum(pages), budget,
+                                      side="right"))
+            fit = max(min(fit, take), 1)
+            if fit < take:
+                self._requeue(pool, taken, fit)
+                taken, merged, pages = self._retake(taken, merged, pages, fit)
+                jobs = [j for j in jobs
+                        if any(h.job == j for h, _, _ in taken)]
+            merged = paging.pack_paged(merged, fam, target_rows=width)
+        elif self.gcfg.backend != "native":
+            merged = pad_batch(merged, width)
+        rows = sum(b.size for _, b, _ in taken)
+        self.counters["batches"] += 1
+        self.counters["dispatches"] += 1
+        if len(jobs) > 1:
+            self.counters["mixed_batches"] += 1
+        if self.gcfg.shed_levels:
+            self.counters["shed_flushes"] += 1
+        self.log.log("serve.batch", windows=rows, jobs=len(jobs),
+                     stream=pool.stream, width=int(merged.size),
+                     reason=reason, job="+".join(jobs))
+        dh = self.sup.dispatch(merged)
+        rowmap = [(h, b.size) for h, b, _ in taken]
+        self._inflight.append((dh, rowmap, rows))
+
+    def _requeue(self, pool: _Pool, taken, fit: int) -> None:
+        """Push rows past ``fit`` back to the FRONT of the pool (paged
+        budget cut), preserving block order and handle row order."""
+        off = 0
+        tail_blocks: list[_Block] = []
+        for h, b, p in taken:
+            if off + b.size <= fit:
+                off += b.size
+                continue
+            lo = max(fit - off, 0)
+            tb = slice_batch(b, lo, b.size)
+            tail_blocks.append(_Block(h, tb, None if p is None else p[lo:]))
+            off += b.size
+        for tb in reversed(tail_blocks):
+            pool.blocks.appendleft(tb)
+            pool.rows += tb.batch.size
+            if tb.pages is not None:
+                pool.pages += int(tb.pages.sum())
+        if pool.rows and pool.oldest_ts is None:
+            pool.oldest_ts = time.time()
+
+    @staticmethod
+    def _retake(taken, merged, pages, fit):
+        """Trim the taken list / merged batch / page vector to ``fit`` rows."""
+        new_taken = []
+        off = 0
+        for h, b, p in taken:
+            if off >= fit:
+                break
+            n = min(b.size, fit - off)
+            new_taken.append((h, slice_batch(b, 0, n),
+                              None if p is None else p[:n]))
+            off += n
+        return new_taken, slice_batch(merged, 0, fit), pages[:fit]
+
+    # ------------------------------------------------------------------
+    # drain + scatter
+    # ------------------------------------------------------------------
+
+    def _drain(self, to_depth: int) -> None:
+        n_pop = len(self._inflight) - to_depth
+        if n_pop <= 0:
+            return
+        entries = [self._inflight.popleft() for _ in range(n_pop)]
+        try:
+            outs = self.sup.fetch_many([e[0] for e in entries])
+        except BaseException:
+            # the popped entries' handles would otherwise be stranded
+            # (neither pooled nor in flight): abort them so cohabiting
+            # jobs' fetch() raises JobAborted with the truth — the solve
+            # path died — instead of a misleading bookkeeping error. The
+            # original exception still propagates to whoever drove this
+            # drain (their job fails with the real reason).
+            for _, rowmap, _ in entries:
+                for handle, _n in rowmap:
+                    handle.abort()
+            raise
+        for (dh, rowmap, rows), out in zip(entries, outs):
+            lo = 0
+            for handle, n in rowmap:
+                part = self._slice_out(out, lo, lo + n, rows)
+                lo += n
+                handle.add_part(n, part)
+
+    @staticmethod
+    def _slice_out(out: dict, lo: int, hi: int, live: int) -> dict:
+        """Rows [lo, hi) of a merged result, per field. Row-shaped arrays
+        slice; numeric scalars (esc_overflow) zero for EVERY part — a
+        batch-level scalar cannot be attributed to one cohabitant's rows,
+        and crediting it to the first job would book another job's overflow
+        in the wrong telemetry stream. (Structurally moot today: the group
+        dispatches at default esc_cap = full width, so esc_overflow is
+        always 0, and the clamp rung zeroes it after host completion.)"""
+        part: dict = {}
+        for k, v in out.items():
+            if isinstance(v, np.ndarray) and v.ndim >= 1 and len(v) >= live:
+                part[k] = v[lo:hi]
+            elif isinstance(v, (int, float, np.integer, np.floating)):
+                part[k] = type(v)(0)
+            elif isinstance(v, np.ndarray) and v.ndim == 0:
+                part[k] = np.zeros_like(v)
+            else:
+                part[k] = v
+        return part
+
+
+class JobSolver:
+    """Per-job facade over a :class:`SolveGroup` — the async-solver duck
+    type ``correct_shard`` injects (``dispatch``/``fetch``/``fetch_many``).
+    ``accepts_partial`` tells the pipeline to skip its own padding (the
+    group pads MERGED batches); ``routes_streams`` opts the pipeline's
+    split-ladder machinery in (the group routes the stream tags)."""
+
+    accepts_partial = True
+    routes_streams = True
+
+    def __init__(self, group: SolveGroup, job: str):
+        self.group = group
+        self.job = job
+
+    @property
+    def ladder(self):
+        """The group's warm TierLadder (None for native groups) — the
+        pipeline reuses it instead of rebuilding OffsetLikely tables per
+        job, which is most of the cold start the warm cache amortizes."""
+        return self.group.ladder
+
+    @property
+    def hp_ols(self):
+        """The group's shared hp-rescue OffsetLikely tables (read-only)."""
+        return self.group.hp_ols
+
+    def describe(self) -> str:
+        return f"serve-batcher:{self.group.name}"
+
+    def dispatch(self, batch: WindowBatch) -> JobHandle:
+        return self.group.dispatch(self.job, batch)
+
+    def fetch(self, handle: JobHandle) -> dict:
+        return self.group.fetch(handle)
+
+    def fetch_many(self, handles: list) -> list[dict]:
+        return self.group.fetch_many(handles)

@@ -1,0 +1,354 @@
+"""Job lifecycle: spec parsing, CLI-default config parity, the runner.
+
+Parity doctrine: a serve job's bytes must equal a solo ``daccord`` run with
+the same inputs and flags, so :func:`build_job_config` constructs the
+pipeline config EXACTLY the way ``tools/cli.py daccord_main`` does (tier
+ladder from ``k``, DBG params from ``candidates``/``max_err``, hp defaults
+keyed by backend) — any drift here is a byte-parity bug, and
+tests/test_serve.py compares against the real solo path to catch it.
+
+Jobs arrive as JSON: server-local ``db``/``las`` paths, or uploaded files
+(``files``: name → base64) spooled into the job's work directory. The
+PR-2 ingest layer validates at ADMISSION (``scan_with_db``): a strict-policy
+job with integrity violations is rejected with the structured report before
+it costs a queue slot, and the scan report is handed to ``correct_shard`` so
+the validation is never paid twice.
+
+The runner streams fragments to ``out.fasta.part`` as they emit (the HTTP
+layer live-streams that file), then commits durably: fsync → rename →
+manifest via ``aio.durable_write`` — the PR-2 crash-durability doctrine
+applied per job.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+# job states
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+ABORTED = "aborted"
+
+
+@dataclass
+class JobSpec:
+    db: str
+    las: str
+    tenant: str = "default"
+    # solve-semantics knobs (CLI flag parity; defaults == daccord defaults)
+    w: int = 40
+    adv: int = 10
+    k: int = 8
+    depth: int = 32
+    seg_len: int = 64
+    max_kmers: int = 64
+    candidates: int = 3
+    max_err: float = 0.3
+    mode: str = "split"
+    overflow_rescue: bool = False
+    hp_rescue: bool | None = None    # None = backend-keyed default (CLI rule)
+    hp_vote: str = "median"
+    hp_accept: str = "rescore"
+    end_trim: bool = True
+    qv_track: str | None = "inqual"
+    ingest_policy: str = "strict"
+    profile_sample_piles: int = 4
+    nbytes: int = 0                  # admission accounting (db + las bytes)
+    uploaded: bool = False
+
+    @classmethod
+    def from_json(cls, body: dict, jobdir: str) -> "JobSpec":
+        """Parse a submission body; uploaded files spool into ``jobdir``.
+        Raises ValueError on a malformed spec (HTTP 400)."""
+        body = dict(body)
+        files = body.pop("files", None)
+        uploaded = False
+        if files:
+            os.makedirs(jobdir, exist_ok=True)
+            for name, b64 in files.items():
+                name = os.path.basename(str(name))
+                if not name:
+                    raise ValueError("files: empty file name")
+                with open(os.path.join(jobdir, name), "wb") as fh:
+                    fh.write(base64.b64decode(b64))
+            uploaded = True
+            for key in ("db", "las"):
+                if key not in body:
+                    raise ValueError(f"upload job needs {key!r} naming the "
+                                     "uploaded entry")
+                body[key] = os.path.join(jobdir,
+                                         os.path.basename(str(body[key])))
+        for key in ("db", "las"):
+            if key not in body:
+                raise ValueError(f"job spec missing {key!r}")
+        known = set(cls.__dataclass_fields__) - {"nbytes", "uploaded"}
+        unknown = set(body) - known
+        if unknown:
+            raise ValueError(f"unknown job fields: {sorted(unknown)}")
+        # type-check the simple fields at the boundary: dataclasses don't,
+        # and a wrong-typed knob accepted here would surface later as an
+        # opaque FAILED job instead of a 400 (bool is an int subclass —
+        # reject it for numeric fields explicitly)
+        _types = {"db": str, "las": str, "tenant": str, "w": int, "adv": int,
+                  "k": int, "depth": int, "seg_len": int, "max_kmers": int,
+                  "candidates": int, "max_err": (int, float), "mode": str,
+                  "overflow_rescue": bool, "hp_rescue": (bool, type(None)),
+                  "hp_vote": str, "hp_accept": str, "end_trim": bool,
+                  "qv_track": (str, type(None)), "ingest_policy": str,
+                  "profile_sample_piles": int}
+        for name, want in _types.items():
+            if name not in body:
+                continue
+            v = body[name]
+            ok = isinstance(v, want)
+            if ok and want in (int, (int, float)) and isinstance(v, bool):
+                ok = False
+            if not ok:
+                raise ValueError(f"job field {name!r}: expected "
+                                 f"{getattr(want, '__name__', want)}, got "
+                                 f"{type(v).__name__}")
+        spec = cls(**body)
+        spec.uploaded = uploaded
+        if spec.ingest_policy not in ("strict", "quarantine", "off"):
+            raise ValueError(f"bad ingest_policy {spec.ingest_policy!r}")
+        if not (4 <= spec.k <= 11):
+            raise ValueError(f"k {spec.k}: supported range is 4..11")
+        for p in (spec.db, spec.las):
+            if not (os.path.exists(p) or os.path.exists(p + ".db")):
+                raise ValueError(f"input not found: {p}")
+        spec.nbytes = sum(os.path.getsize(p) for p in (spec.db, spec.las)
+                          if os.path.exists(p))
+        return spec
+
+
+def build_job_config(spec: JobSpec, backend: str, backend_explicit: bool,
+                     batch: int, ladder_mode: str, jobdir: str,
+                     job_id: str):
+    """The job's PipelineConfig, CLI-parity by construction (see module
+    docstring). The injected cross-job solver supersedes per-job
+    supervision — the SolveGroup's shared supervisor owns faults, retries,
+    failover, and the capacity ladder for every cohabiting job."""
+    from ..oracle.consensus import ConsensusConfig
+    from ..oracle.dbg import DBGParams
+    from ..runtime.pipeline import PipelineConfig
+
+    k = spec.k
+    tiers = ((k, 2, 2), (k + 2, 2, 2), (k + 4, 2, 2), (k, 1, 1))
+    hp = spec.hp_rescue
+    if hp is None:
+        # the CLI rule verbatim: host engines default hp ON only when the
+        # backend was EXPLICIT (an auto-resolved engine must not flip
+        # defaults with tunnel health)
+        hp = backend in ("native", "cpu") and backend_explicit
+    ccfg = ConsensusConfig(w=spec.w, adv=spec.adv, mode=spec.mode,
+                           tiers=tiers,
+                           dbg=DBGParams(n_candidates=spec.candidates,
+                                         max_err=spec.max_err),
+                           hp_rescue=hp, hp_vote=spec.hp_vote,
+                           hp_accept=spec.hp_accept)
+    return PipelineConfig(
+        consensus=ccfg, batch_size=batch, depth=spec.depth,
+        seg_len=spec.seg_len, max_kmers=spec.max_kmers,
+        overflow_rescue=spec.overflow_rescue,
+        end_trim=spec.end_trim, qv_track=spec.qv_track or None,
+        profile_sample_piles=spec.profile_sample_piles,
+        ingest_policy=spec.ingest_policy,
+        quarantine_path=os.path.join(jobdir, "quarantine.jsonl"),
+        events_path=os.path.join(jobdir, "events.jsonl"),
+        ledger_path=os.path.join(jobdir, "ledger.jsonl"),
+        job_tag=job_id,
+        # the group's supervisor is the device authority for every
+        # cohabiting job; a per-job supervisor would double-consume fault
+        # injections and double-wrap the dispatch seam
+        supervise=False,
+        ladder_mode=ladder_mode)
+
+
+def solve_fingerprint(profile, cfg, backend: str) -> str:
+    """Key under which jobs may share device batches: everything that can
+    change a window's BYTES (profile floats, consensus/ladder semantics,
+    engine family) — and nothing that cannot (batch width, shapes, telemetry
+    paths, job identity). Full-precision float reprs: two jobs share a group
+    only when their solve semantics are bit-identical."""
+    import hashlib
+
+    c = cfg.consensus
+    payload = {
+        "backend": "native" if backend == "native" else "jax",
+        "profile": [repr(float(profile.p_ins)), repr(float(profile.p_del)),
+                    repr(float(profile.p_sub)), repr(float(profile.hp_slope)),
+                    repr(float(profile.hp_base)), int(profile.hp_cap)],
+        "w": c.w, "adv": c.adv, "tiers": list(map(list, c.tiers)),
+        "mode": c.mode, "min_fragment": c.min_fragment,
+        "dbg": [c.dbg.n_candidates, repr(float(c.dbg.max_err)),
+                c.dbg.min_depth],
+        "hp": [c.hp_rescue, repr(float(c.hp_err)), c.hp_min_run,
+               repr(float(c.hp_margin)), c.hp_vote, c.hp_accept,
+               repr(float(c.hp_lambda_c))],
+        "max_kmers": cfg.max_kmers, "rescue_max_kmers": cfg.rescue_max_kmers,
+        "overflow_rescue": cfg.overflow_rescue,
+    }
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()).hexdigest()[:24]
+
+
+@dataclass
+class Job:
+    id: str
+    tenant: str
+    spec: JobSpec
+    dir: str
+    state: str = QUEUED
+    submitted_ts: float = field(default_factory=time.time)
+    started_ts: float | None = None
+    first_emit_ts: float | None = None
+    done_ts: float | None = None
+    error: str | None = None
+    reads: int = 0
+    windows: int = 0
+    fragments: int = 0
+    bases_out: int = 0
+    group: str | None = None
+    abort_event: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def fasta_part(self) -> str:
+        return os.path.join(self.dir, "out.fasta.part")
+
+    @property
+    def fasta(self) -> str:
+        return os.path.join(self.dir, "out.fasta")
+
+    def status(self) -> dict:
+        now = time.time()
+        lat = {
+            "queue_s": round((self.started_ts or now) - self.submitted_ts, 4),
+            "first_result_s": (round(self.first_emit_ts - self.submitted_ts, 4)
+                               if self.first_emit_ts else None),
+            "total_s": (round(self.done_ts - self.submitted_ts, 4)
+                        if self.done_ts else None),
+        }
+        return {"job": self.id, "tenant": self.tenant, "state": self.state,
+                "reads": self.reads, "windows": self.windows,
+                "fragments": self.fragments, "bases_out": self.bases_out,
+                "group": self.group, "error": self.error, "latency": lat}
+
+
+def run_job(job: Job, service) -> None:
+    """Execute one admitted job end to end (worker thread). ``service`` is
+    the owning :class:`~.service.ConsensusService` (warm state, events,
+    metrics). State transitions and the durable commit happen here; the
+    byte-producing pipeline is the stock ``correct_shard``."""
+    from ..formats.dazzdb import read_db
+    from ..formats.fasta import write_fasta
+    from ..formats.ingest import scan_with_db
+    from ..formats.las import LasFile
+    from ..runtime.pipeline import correct_shard, estimate_profile_for_shard
+    from ..utils.aio import durable_write
+    from ..utils.bases import ints_to_seq
+
+    scfg = service.cfg
+    job.state = RUNNING
+    job.started_ts = time.time()
+    service.log_event("serve.job", job=job.id, state=RUNNING,
+                      tenant=job.tenant)
+    key = None
+    group = None
+    gen = None
+    try:
+        cfg = build_job_config(job.spec, scfg.backend, scfg.backend_explicit,
+                               scfg.batch, scfg.group_ladder_mode(), job.dir,
+                               job.id)
+        db = read_db(job.spec.db, strict=cfg.ingest_policy == "strict")
+        las = LasFile(job.spec.las)
+        report = None
+        if cfg.ingest_policy != "off":
+            # PR-2 ingest gate at the job boundary; strict violations were
+            # already rejected at admission — this is the (cheap) re-scan
+            # guard for TOCTOU on server-local paths, reused by the pipeline
+            report = scan_with_db(db, las, None, None)
+            if report.issues and cfg.ingest_policy == "strict":
+                raise report.error()
+        kw = (dict(pile_ranges=report.pile_ranges)
+              if report is not None and report.issues else {})
+        profile = estimate_profile_for_shard(db, las, cfg, **kw)
+        key = solve_fingerprint(profile, cfg, scfg.backend)
+        group = service.warm.acquire(
+            key, lambda: service.build_group(key, profile, cfg))
+        job.group = group.name
+        solver = group.job_solver(job.id)
+        t_first = None
+        with open(job.fasta_part, "wt") as fh:
+            gen = correct_shard(db, las, cfg, profile=profile, solver=solver,
+                                ingest_report=report)
+            for rid, frags, st in gen:
+                if t_first is None and frags:
+                    t_first = time.time()
+                    job.first_emit_ts = t_first
+                write_fasta(fh, [(f"read{rid}/{fi}", ints_to_seq(f))
+                                 for fi, f in enumerate(frags)])
+                fh.flush()
+                job.reads = st.n_reads
+                job.windows = st.n_windows
+                job.fragments = st.n_fragments
+                job.bases_out = st.bases_out
+                if job.abort_event.is_set():
+                    raise JobAbortRequested()
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(job.fasta_part, job.fasta)
+        job.done_ts = time.time()
+        job.state = DONE
+        durable_write(os.path.join(job.dir, "manifest.json"),
+                      lambda mh: json.dump(
+                          {**job.status(),
+                           "fasta": job.fasta,
+                           "fasta_bytes": os.path.getsize(job.fasta)}, mh),
+                      mode="wt")
+        service.log_event("serve.commit", job=job.id,
+                          fragments=job.fragments,
+                          bytes=os.path.getsize(job.fasta))
+        service.observe_latency(job)
+    except JobAbortRequested:
+        job.state = ABORTED
+        job.done_ts = time.time()
+        service.log_event("serve.abort", job=job.id, reason="client")
+    except BaseException as e:  # noqa: BLE001 — job isolation boundary
+        # ABORTED only when the CLIENT asked (abort event): a JobAborted
+        # surfacing without it means the shared solve path died under this
+        # job's rows (drain failure) — that is a FAILURE with a reason,
+        # not an abort
+        if job.abort_event.is_set():
+            job.state = ABORTED
+            service.log_event("serve.abort", job=job.id,
+                              reason="client")
+        else:
+            job.state = FAILED
+            job.error = f"{type(e).__name__}: {e}"[:500]
+            service.log_event("serve.job", job=job.id, state=FAILED,
+                              tenant=job.tenant, error=job.error)
+        job.done_ts = time.time()
+        if not isinstance(e, Exception):
+            raise   # KeyboardInterrupt/SystemExit must still unwind
+    finally:
+        if gen is not None:
+            gen.close()     # unwinds the pipeline's telemetry bundle
+        if group is not None:
+            group.release_job(job.id)
+            service.warm.release(key)
+        service.admission.release(job.tenant, job.spec.nbytes)
+        if job.state == DONE:
+            service.log_event("serve.job", job=job.id, state=DONE,
+                              tenant=job.tenant)
+
+
+class JobAbortRequested(Exception):
+    """Internal: the runner noticed the job's abort event between
+    emissions."""

@@ -1,0 +1,499 @@
+"""ConsensusService: the always-on serving core behind ``daccord-serve``.
+
+Owns the job registry, a bounded worker pool draining the admission queue,
+the warm solve-group cache, and a ticker thread doing the housekeeping a
+long-lived server needs: stale cross-job pools flush (latency bound), RSS
+pressure drives the shed ladder (group batch widths halve under sustained
+pressure, restore when it clears), idle groups evict, and the metrics
+registry snapshots into the service events sidecar at a bounded cadence.
+
+Telemetry layout (one file per concern, so the strict eventcheck state
+machines never interleave):
+
+    <workdir>/serve.events.jsonl      serve.* lifecycle + metrics snapshots
+    <workdir>/g<N>.events.jsonl       each solve group's supervisor/governor
+                                      stream (sup_*, governor.*, serve.batch)
+    <workdir>/jobs/<id>/events.jsonl  the job's own pipeline telemetry
+                                      (shard_start, spans, shard_done)
+    <workdir>/jobs/<id>/ledger.jsonl  per-window outcome ledger, job-tagged
+
+All of it passes ``eventcheck --strict`` and ``daccord-trace --check`` — the
+serve smoke in tools_pounce.sh enforces that before any chip time.
+
+Latency is a first-class metric here (the axis ISSUE 10 opens): per-job
+queue/first-result/total latencies feed histograms whose p50/p95/p99 ride
+every metrics snapshot and the durable rollup committed at shutdown.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..utils.obs import JsonlLogger, MetricsRegistry
+from .admission import AdmissionConfig, AdmissionController
+from .batcher import GroupConfig, SolveGroup
+from .jobs import ABORTED, DONE, FAILED, QUEUED, RUNNING, Job, JobSpec, run_job
+from .state import WarmState
+
+
+class _LockedLogger(JsonlLogger):
+    """JsonlLogger safe for concurrent writers (HTTP threads, workers, the
+    ticker): the timestamp is taken and the line buffered under one lock, so
+    ``t`` stays monotonic per file — the strict eventcheck contract."""
+
+    def __init__(self, path: str | None = None, **kw):
+        super().__init__(path, **kw)
+        self._wlock = threading.Lock()
+
+    def log(self, event: str, **fields) -> None:
+        with self._wlock:
+            super().log(event, **fields)
+
+    def close(self) -> None:
+        with self._wlock:
+            super().close()
+
+
+@dataclass
+class ServeConfig:
+    workdir: str = "daccord-serve"
+    backend: str = "native"          # resolved engine (native|cpu|tpu)
+    backend_explicit: bool = True    # the operator named it (hp default rule)
+    batch: int = 512                 # merged dispatch width
+    workers: int = 2                 # concurrent job threads
+    ladder_mode: str = "fused"       # fused | split (JAX groups only)
+    paged: bool = False              # paged wire format for merged batches
+    page_len: int = 16
+    use_pallas: bool = False
+    flush_lag_s: float = 0.05        # stale cross-job pool flush deadline
+    idle_evict_s: float = 600.0      # warm-group TTL
+    job_retention_s: float = 3600.0  # terminal jobs leave the in-memory
+                                     # registry (and GET /v1/jobs) this long
+                                     # after finishing; durable results stay
+                                     # on disk under jobs/<id>/. 0 = keep
+                                     # forever (tests); an always-on server
+                                     # must bound registry growth
+    metrics_snapshot_s: float = 30.0
+    shed_max_levels: int = 3         # batch-ladder floor under pressure
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    events_path: str | None = None   # default: <workdir>/serve.events.jsonl
+
+    def group_ladder_mode(self) -> str:
+        # the native engine escalates per window on host: stream routing
+        # (and paging) are JAX-ladder concepts
+        return "fused" if self.backend == "native" else self.ladder_mode
+
+
+class ConsensusService:
+    def __init__(self, cfg: ServeConfig):
+        from ..runtime.faults import FaultPlan
+
+        self.cfg = cfg
+        os.makedirs(cfg.workdir, exist_ok=True)
+        os.makedirs(os.path.join(cfg.workdir, "jobs"), exist_ok=True)
+        ev = cfg.events_path or os.path.join(cfg.workdir,
+                                             "serve.events.jsonl")
+        self.events = _LockedLogger(ev, buffer_lines=16, flush_s=1.0)
+        self.metrics = MetricsRegistry()
+        self.faults = FaultPlan.from_env()
+        self.admission = AdmissionController(cfg.admission, log=self.events,
+                                             faults=self.faults)
+        self.warm = WarmState(cfg.idle_evict_s, log=self.events)
+        self.jobs: dict[str, Job] = {}
+        self._jobs_lock = threading.Lock()
+        # resume the id sequence past any job dirs already in the (durable)
+        # workdir: a restarted server must never reuse jNNNNN — the old
+        # run's committed out.fasta would be served as (or clobbered by)
+        # the new job's
+        last = 0
+        for name in os.listdir(os.path.join(cfg.workdir, "jobs")):
+            if name.startswith("j") and name[1:].isdigit():
+                last = max(last, int(name[1:]))
+        self._job_ids = itertools.count(last + 1)
+        self._group_ids = itertools.count(0)
+        self._queue: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._shed = 0
+        self.started_ts = time.time()
+        self.log_event("serve.start", workdir=cfg.workdir,
+                       backend=cfg.backend, batch=int(cfg.batch),
+                       workers=int(cfg.workers), pid=os.getpid())
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"daccord-serve-worker-{i}")
+            for i in range(max(1, cfg.workers))]
+        for t in self._workers:
+            t.start()
+        self._ticker = threading.Thread(target=self._tick_loop, daemon=True,
+                                        name="daccord-serve-ticker")
+        self._ticker.start()
+
+    # ------------------------------------------------------------------
+    # plumbing used by jobs.run_job
+    # ------------------------------------------------------------------
+
+    def log_event(self, event: str, **fields) -> None:
+        self.events.log(event, **fields)
+
+    def build_group(self, key: str, profile, cfg) -> SolveGroup:
+        """Factory handed to WarmState.acquire: one solve group with its
+        own events sidecar (the strict state-machine lint needs one
+        supervisor stream per file)."""
+        scfg = self.cfg
+        name = f"g{next(self._group_ids)}"
+        glog = _LockedLogger(os.path.join(scfg.workdir,
+                                          f"{name}.events.jsonl"),
+                             buffer_lines=16, flush_s=1.0)
+        gcfg = GroupConfig(backend=scfg.backend, batch=scfg.batch,
+                           ladder_mode=scfg.group_ladder_mode(),
+                           paged=scfg.paged and scfg.backend != "native",
+                           page_len=scfg.page_len,
+                           use_pallas=scfg.use_pallas,
+                           shed_levels=self._shed)
+        g = SolveGroup(key, profile, cfg, gcfg, log=glog, name=name)
+        self.log_event("serve.group", group=name, key=key[:16],
+                       backend=scfg.backend, batch=int(scfg.batch))
+        return g
+
+    def observe_latency(self, job: Job) -> None:
+        """Per-job latency histograms (p50/p95/p99 ride the snapshots)."""
+        h = self.metrics.histogram
+        if job.started_ts:
+            h("job_queue_s").observe(job.started_ts - job.submitted_ts)
+        if job.first_emit_ts:
+            h("job_first_result_s").observe(
+                job.first_emit_ts - job.submitted_ts)
+        if job.done_ts:
+            h("job_latency_s").observe(job.done_ts - job.submitted_ts)
+        if job.done_ts and job.windows and job.started_ts:
+            run_s = max(job.done_ts - job.started_ts, 1e-9)
+            self.metrics.gauge("last_job_windows_per_sec").set(
+                job.windows / run_s)
+
+    # ------------------------------------------------------------------
+    # front-end API (HTTP layer calls these)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _estimate_bytes(body: dict) -> int:
+        """Admission charge for a submission BEFORE anything is spooled or
+        scanned: path inputs by on-disk size, uploads by decoded base64
+        size. Admission must run on this estimate first — spooling or
+        scanning an over-quota tenant's input would let rejected requests
+        burn disk and CPU the quota exists to protect. A Dazzler ``.db``
+        is a tiny stub whose real payload lives in the hidden
+        ``.<name>.idx``/``.bps`` siblings — charge those too, or a
+        multi-GB DB would bill as a few hundred bytes and the byte quota
+        would be toothless."""
+        files = body.get("files")
+        if isinstance(files, dict):
+            return sum(len(v) * 3 // 4 for v in files.values()
+                       if isinstance(v, str))
+        n = 0
+        for key in ("db", "las"):
+            p = body.get(key)
+            if not isinstance(p, str):
+                continue
+            if not os.path.exists(p) and os.path.exists(p + ".db"):
+                p = p + ".db"
+            if os.path.exists(p):
+                n += os.path.getsize(p)
+            if key == "db":
+                from ..formats.dazzdb import _db_stems
+
+                try:
+                    d, stem = _db_stems(p)
+                except Exception:
+                    continue
+                for ext in (".idx", ".bps", ".names"):
+                    h = os.path.join(d, f".{stem}{ext}")
+                    if os.path.exists(h):
+                        n += os.path.getsize(h)
+        return n
+
+    def submit(self, body: dict) -> dict:
+        """Admit + enqueue one job; returns its status dict. Raises
+        ValueError (bad spec / failed ingest validation → 400) or
+        AdmissionReject (→ 429/503). Admission is charged FIRST, on the
+        pre-spool byte estimate; any later refusal releases the charge and
+        removes the job's spool directory, so rejected requests leave no
+        disk residue."""
+        if not isinstance(body, dict):
+            raise ValueError("body must be a JSON object")
+        job_id = f"j{next(self._job_ids):05d}"
+        jobdir = os.path.join(self.cfg.workdir, "jobs", job_id)
+        tenant = str(body.get("tenant", "default"))
+        charged = self._estimate_bytes(body)
+        self.admission.admit(tenant, charged, job=job_id)
+        try:
+            spec = JobSpec.from_json(body, jobdir)
+            # release() must mirror the admitted charge exactly
+            spec.nbytes = charged
+            # PR-2 ingest gate AT ADMISSION: a strict-policy job with
+            # integrity violations is refused here with the structured
+            # report — it never reaches a worker
+            if spec.ingest_policy == "strict":
+                from ..formats.dazzdb import read_db
+                from ..formats.ingest import IngestError, scan_with_db
+                from ..formats.las import LasFile
+
+                try:
+                    rep = scan_with_db(read_db(spec.db, strict=True),
+                                       LasFile(spec.las), None, None)
+                except (IngestError, ValueError, OSError) as e:
+                    raise ValueError(f"ingest validation failed: {e}")
+                if rep.issues:
+                    first = rep.issues[0]
+                    raise ValueError(
+                        f"ingest validation: {len(rep.issues)} issue(s); "
+                        f"first: {first.kind} at byte {first.offset}")
+        except Exception:
+            import shutil
+
+            self.admission.release(tenant, charged)
+            shutil.rmtree(jobdir, ignore_errors=True)
+            raise
+        os.makedirs(jobdir, exist_ok=True)
+        job = Job(id=job_id, tenant=tenant, spec=spec, dir=jobdir)
+        with self._jobs_lock:
+            self.jobs[job_id] = job
+        self.metrics.counter("jobs_submitted").inc()
+        self.log_event("serve.job", job=job_id, state=QUEUED,
+                       tenant=spec.tenant)
+        self._queue.put(job_id)
+        return job.status()
+
+    def status(self, job_id: str) -> dict | None:
+        with self._jobs_lock:
+            job = self.jobs.get(job_id)
+        return None if job is None else job.status()
+
+    def abort(self, job_id: str, reason: str = "client") -> bool:
+        with self._jobs_lock:
+            job = self.jobs.get(job_id)
+        if job is None or job.state in (DONE, FAILED, ABORTED):
+            return False
+        job.abort_event.set()
+        # a QUEUED job aborts synchronously: its quota charge releases NOW
+        # (a tenant cancelling its backlog must get its slots back without
+        # waiting for a worker to churn to each cancelled job) — the
+        # worker loop skips already-terminal jobs when it dequeues them
+        with self._jobs_lock:
+            was_queued = job.state == QUEUED
+            if was_queued:
+                job.state = ABORTED
+                job.done_ts = time.time()
+        if was_queued:
+            self.admission.release(job.tenant, job.spec.nbytes)
+            self.metrics.counter("jobs_aborted").inc()
+        # otherwise outcome counting happens ONCE in the worker loop
+        # (jobs_<state>); counting the request here too would double-bill
+        self.log_event("serve.abort", job=job_id, reason=reason)
+        return True
+
+    def wait(self, job_id: str, timeout_s: float = 300.0) -> dict | None:
+        """Poll a job to a terminal state (HTTP ?wait=1)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            st = self.status(job_id)
+            if st is None or st["state"] in (DONE, FAILED, ABORTED):
+                return st
+            time.sleep(0.02)
+        return self.status(job_id)
+
+    def health(self) -> dict:
+        """Liveness snapshot that takes NO SolveGroup lock: the group lock
+        is held across real device solves (a first-batch jit compile runs
+        minutes on TPU), and a liveness probe that queued behind it would
+        time out and get a perfectly healthy server killed by its
+        orchestrator. Only the (briefly-held) jobs lock is touched."""
+        from ..runtime.governor import host_rss_mb
+
+        with self._jobs_lock:
+            states: dict[str, int] = {}
+            for j in self.jobs.values():
+                states[j.state] = states.get(j.state, 0) + 1
+        return {"ok": True,
+                "uptime_s": round(time.time() - self.started_ts, 3),
+                "jobs": states, "shed_level": self._shed,
+                "rss_mb": round(host_rss_mb(), 1)}
+
+    def stats(self) -> dict:
+        """Full stats (the /v1/metrics body). NOTE: group stats take each
+        group's solve lock, so this can block behind an in-flight device
+        solve — liveness probes must use :meth:`health` instead."""
+        return {**self.health(),
+                "admission": self.admission.stats(),
+                "warm": self.warm.stats(),
+                "metrics": self.metrics.rollup()}
+
+    def shutdown(self, drain: bool = True, timeout_s: float = 300.0) -> None:
+        """Graceful stop: admission closes, queued+running jobs finish
+        (``drain``), pools drain, telemetry commits durably."""
+        self.admission.drain()
+        if drain:
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                with self._jobs_lock:
+                    busy = any(j.state in (QUEUED, RUNNING)
+                               for j in self.jobs.values())
+                if not busy and self._queue.empty():
+                    break
+                time.sleep(0.05)
+        self._stop.set()
+        for _ in self._workers:
+            self._queue.put(None)
+        for t in self._workers:
+            t.join(timeout=10.0)
+        self._ticker.join(timeout=10.0)
+        for g in self.warm.groups():
+            g.drain_all()
+        self._refresh_gauges()
+        self.metrics.snapshot(self.events, final=True)
+        from ..utils.aio import durable_write
+
+        durable_write(os.path.join(self.cfg.workdir, "serve.metrics.json"),
+                      lambda fh: json.dump(self.stats(), fh), mode="wt")
+        with self._jobs_lock:
+            n_done = sum(j.state == DONE for j in self.jobs.values())
+        self.log_event("serve.done", jobs=len(self.jobs), done=n_done,
+                       wall_s=round(time.time() - self.started_ts, 3))
+        self.warm.close()
+        self.events.close()
+
+    # ------------------------------------------------------------------
+    # background threads
+    # ------------------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            job_id = self._queue.get()
+            if job_id is None:
+                return
+            # claim atomically: abort() releases a QUEUED job's quota
+            # synchronously under this same lock, so exactly one of the two
+            # (claim here, or queued-abort there) wins — never both
+            with self._jobs_lock:
+                job = self.jobs.get(job_id)
+                if job is None or job.state != QUEUED:
+                    # pre-dequeue abort already released the charge/counted
+                    continue
+                aborted_now = job.abort_event.is_set()
+                if aborted_now:
+                    job.state = ABORTED
+                    job.done_ts = time.time()
+                else:
+                    job.state = RUNNING
+            if aborted_now:
+                self.admission.release(job.tenant, job.spec.nbytes)
+                self.metrics.counter("jobs_aborted").inc()
+                continue
+            with self._jobs_lock:
+                running = sum(1 for j in self.jobs.values()
+                              if j.state == RUNNING)
+            self.metrics.gauge("active_jobs").set(running + 1)
+            try:
+                run_job(job, self)
+            except Exception as e:  # noqa: BLE001 — a worker must survive
+                # run_job already isolates job failures; anything escaping
+                # here is a harness bug, and losing the worker thread would
+                # silently shrink service capacity AND strand queued jobs
+                job.state = FAILED
+                job.error = job.error or f"{type(e).__name__}: {e}"[:500]
+                job.done_ts = job.done_ts or time.time()
+                self.log_event("serve.job", job=job.id, state=FAILED,
+                               tenant=job.tenant, error=job.error)
+            self.metrics.counter(f"jobs_{job.state}").inc()
+            with self._jobs_lock:
+                running = sum(1 for j in self.jobs.values()
+                              if j.state == RUNNING)
+            self.metrics.gauge("active_jobs").set(float(running))
+
+    def _tick_loop(self) -> None:
+        last_snap = time.time()
+        last_pressure = 0.0
+        while not self._stop.wait(self.cfg.flush_lag_s):
+            # EVERY housekeeping step is guarded: the single ticker thread
+            # dying (full disk on the events file, a group close raising)
+            # would silently stop pressure shedding, stale flushes, job
+            # pruning, and eviction for the rest of the server's life
+            try:
+                # latency bound: stale cross-job pools flush even when
+                # every cohabitant is busy windowing
+                for g in self.warm.groups():
+                    g.flush_stale(self.cfg.flush_lag_s)
+                now = time.time()
+                if now - last_pressure >= 1.0:
+                    last_pressure = now
+                    self._pressure_tick()
+                    self._prune_jobs(now)
+                self.warm.evict_idle()
+                if (self.cfg.metrics_snapshot_s
+                        and now - last_snap >= self.cfg.metrics_snapshot_s):
+                    last_snap = now
+                    self._refresh_gauges()
+                    self.metrics.snapshot(self.events)
+            except Exception as e:  # noqa: BLE001 — ticker must survive
+                try:
+                    self.log_event("serve.job", job="-", state="tick_error",
+                                   tenant="-", error=str(e)[:200])
+                except Exception:
+                    pass
+
+    def _prune_jobs(self, now: float) -> None:
+        """Bound the in-memory registry: terminal jobs drop out
+        ``job_retention_s`` after finishing (status turns 404; the durable
+        commit under jobs/<id>/ is untouched). Without this an always-on
+        server's registry — and every loop that iterates it — grows with
+        lifetime job count."""
+        ttl = self.cfg.job_retention_s
+        if not ttl:
+            return
+        with self._jobs_lock:
+            for jid, j in list(self.jobs.items()):
+                if (j.state in (DONE, FAILED, ABORTED) and j.done_ts
+                        and now - j.done_ts >= ttl):
+                    del self.jobs[jid]
+
+    def _pressure_tick(self) -> None:
+        """The shed ladder (ISSUE 10 (c)): hard pressure halves every
+        group's merged-batch width one rung per second of sustained
+        pressure (bounded); clear pressure restores one rung per second.
+        Degrades throughput, never bytes — it is the capacity governor's
+        batch-bisect argument applied service-wide."""
+        level, rss = self.admission.pressure_level()
+        want = self._shed
+        if level == "hard":
+            want = min(self._shed + 1, self.cfg.shed_max_levels)
+        elif level is None and self._shed:
+            want = self._shed - 1
+        if want != self._shed:
+            self._shed = want
+            self.log_event("serve.shed", level=int(want),
+                           rss_mb=round(rss, 1))
+            for g in self.warm.groups():
+                g.set_shed(want)
+
+    def _refresh_gauges(self) -> None:
+        from ..runtime.governor import host_rss_mb
+
+        g = self.metrics.gauge
+        with self._jobs_lock:
+            g("jobs_total").set(float(len(self.jobs)))
+            g("jobs_running").set(float(sum(
+                1 for j in self.jobs.values() if j.state == RUNNING)))
+        g("rss_mb").set(host_rss_mb())
+        g("shed_level").set(float(self._shed))
+        mixed = rows = 0
+        for grp in self.warm.groups():
+            s = grp.stats()
+            mixed += s["mixed_batches"]
+            rows += s["rows"]
+        g("batcher_rows").set(float(rows))
+        g("batcher_mixed_batches").set(float(mixed))

@@ -1,0 +1,117 @@
+"""Warm-state manager: solve groups resident across jobs, idle-evicted.
+
+The whole reason a server beats per-invocation `daccord` at serving scale is
+cold-start amortization: the ladder tables, the jitted programs (cache
+identity = the TierLadder object a :class:`~.batcher.SolveGroup` owns), the
+supervisor's compile-fingerprint state, and the governor's capacity ratchets
+all survive from job to job here. Groups are keyed by solve fingerprint
+(``jobs.solve_fingerprint``); a hit means the Nth job starts solving
+immediately. Idle groups (refcount zero past the TTL) evict so a long-lived
+server's memory tracks its live workload mix, not its history.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class WarmState:
+    def __init__(self, idle_evict_s: float = 600.0, log=None):
+        from ..utils.obs import NullLogger
+
+        self.idle_evict_s = float(idle_evict_s)
+        self.log = log if log is not None else NullLogger()
+        self._lock = threading.Lock()
+        self._groups: dict[str, object] = {}
+        self.counters = {"hits": 0, "misses": 0, "evicted": 0}
+
+    def acquire(self, key: str, factory):
+        """The group for ``key`` (built via ``factory()`` on miss), with its
+        refcount taken — callers MUST pair with :meth:`release`.
+
+        The build runs OUTSIDE the cache lock (per-key once-guard): a cold
+        group build is seconds of ladder/table construction, and holding
+        the lock through it would stall the ticker's ``groups()`` sweep —
+        freezing stale-pool flushes for every already-warm group — plus
+        every other job's acquire, warm or not. Concurrent acquirers of
+        the SAME key wait on the build event; a failed build clears the
+        placeholder so the next acquirer retries."""
+        while True:
+            with self._lock:
+                entry = self._groups.get(key)
+                if entry is None:
+                    self.counters["misses"] += 1
+                    building = threading.Event()
+                    self._groups[key] = ("building", building)
+                    break
+                if isinstance(entry, tuple):
+                    building = entry[1]
+                else:
+                    self.counters["hits"] += 1
+                    entry.refs += 1
+                    entry.last_used = time.time()
+                    return entry
+            building.wait()
+        try:
+            g = factory()
+        except BaseException:
+            with self._lock:
+                self._groups.pop(key, None)
+            building.set()
+            raise
+        with self._lock:
+            self._groups[key] = g
+            g.refs += 1
+            g.last_used = time.time()
+        building.set()
+        return g
+
+    @staticmethod
+    def _built(entry) -> bool:
+        # in-progress builds sit in the cache as ("building", Event)
+        # placeholders so concurrent acquirers of the same key can wait
+        return not isinstance(entry, tuple)
+
+    def release(self, key: str) -> None:
+        with self._lock:
+            g = self._groups.get(key)
+            if g is not None and self._built(g):
+                g.refs = max(0, g.refs - 1)
+                g.last_used = time.time()
+
+    def evict_idle(self, now: float | None = None) -> int:
+        """Close and drop groups idle (refcount 0) past the TTL; returns the
+        eviction count. A TTL of 0 evicts every idle group (tests/shutdown)."""
+        now = time.time() if now is None else now
+        n = 0
+        with self._lock:
+            for key, g in list(self._groups.items()):
+                if not self._built(g):
+                    continue
+                if g.refs == 0 and now - g.last_used >= self.idle_evict_s:
+                    del self._groups[key]
+                    self.counters["evicted"] += 1
+                    n += 1
+                    idle = now - g.last_used
+                    self.log.log("serve.evict", group=g.name, key=key[:16],
+                                 idle_s=round(idle, 3))
+                    g.close()
+        return n
+
+    def groups(self) -> list:
+        with self._lock:
+            return [g for g in self._groups.values() if self._built(g)]
+
+    def stats(self) -> dict:
+        with self._lock:
+            built = [g for g in self._groups.values() if self._built(g)]
+            return {**self.counters, "resident": len(built),
+                    "groups": [g.stats() for g in built]}
+
+    def close(self) -> None:
+        with self._lock:
+            for g in self._groups.values():
+                if self._built(g):
+                    g.close()
+            self._groups.clear()

@@ -983,6 +983,137 @@ def shard_main(argv=None) -> int:
     return 0
 
 
+def serve_main(argv=None) -> int:
+    """daccord-serve: always-on consensus service (ISSUE 10) — HTTP/JSON
+    front-end accepting concurrent correction jobs, cross-job continuous
+    batching into shared device batches (byte-identical per job to a solo
+    daccord run), per-tenant admission control with RSS-watermark load
+    shedding, and a warm-state manager keeping compiled programs and
+    capacity ratchets resident across jobs."""
+    p = argparse.ArgumentParser(prog="daccord-serve",
+                                description=serve_main.__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8947,
+                   help="listen port (0 = ephemeral; pair with --ready-file)")
+    p.add_argument("--workdir", required=True,
+                   help="service state root: job spool dirs, durable job "
+                        "commits, telemetry sidecars")
+    p.add_argument("--backend", choices=("auto", "cpu", "tpu", "native"),
+                   default="auto",
+                   help="shared solve engine for every group (see daccord "
+                        "--backend); auto probes the tunnel once at startup")
+    p.add_argument("-b", "--batch", type=int, default=None,
+                   help="merged cross-job dispatch width (default: the "
+                        "backend's auto batch)")
+    p.add_argument("--workers", type=int, default=2,
+                   help="concurrent job slots (each job runs its own "
+                        "feeder; the device is shared through the batcher)")
+    p.add_argument("--ladder", choices=("fused", "split"), default="fused",
+                   help="group dispatch strategy (see daccord --ladder); "
+                        "JAX groups only — native groups run fused dense")
+    p.add_argument("--paged", action="store_true",
+                   help="pack merged cross-job batches as the ragged paged "
+                        "wire format (kernels/paging.py); JAX groups only")
+    p.add_argument("--flush-lag-ms", type=float, default=50.0,
+                   help="stale cross-job pool flush deadline: bounds the "
+                        "latency one job's rows can pay waiting for "
+                        "cohabitants")
+    p.add_argument("--idle-evict-s", type=float, default=600.0,
+                   help="warm solve-group TTL (compiled programs + ratchet "
+                        "state evict after this long idle)")
+    p.add_argument("--max-queued", type=int, default=32,
+                   help="service-wide admission queue depth")
+    p.add_argument("--tenant-max-queued", type=int, default=8,
+                   help="queued+running jobs per tenant")
+    p.add_argument("--tenant-max-mb", type=float, default=1024.0,
+                   help="queued input bytes per tenant (MB)")
+    p.add_argument("--rss-soft-mb", type=float, default=0.0,
+                   help="pause admission at this host RSS (set BELOW the "
+                        "pipeline's DACCORD_GOV_RSS_* watermarks so new "
+                        "work sheds before running feeders pause); 0 = off")
+    p.add_argument("--rss-hard-mb", type=float, default=0.0,
+                   help="reject + engage the batch-ladder shed at this "
+                        "host RSS; 0 = off")
+    p.add_argument("--events", default=None, metavar="PATH",
+                   help="service events jsonl (serve.* lifecycle + metrics "
+                        "snapshots; default WORKDIR/serve.events.jsonl)")
+    p.add_argument("--ready-file", default=None, metavar="PATH",
+                   help="write {port, pid} JSON here once the listener is "
+                        "bound (scripts discovering an ephemeral --port 0)")
+    p.add_argument("--metrics-snapshot-s", type=float, default=30.0)
+    args = p.parse_args(argv)
+
+    backend_explicit = args.backend != "auto"
+    if args.backend == "auto":
+        from ..utils.obs import resolve_auto_backend
+
+        args.backend = resolve_auto_backend()
+    if args.backend in ("cpu", "native"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if args.paged and args.backend == "native":
+        raise SystemExit("--paged is a JAX-ladder wire format; --backend "
+                         "native solves dense rows on host (drop one flag)")
+    if args.ladder == "split" and args.backend == "native":
+        raise SystemExit("--ladder split is a JAX-ladder dispatch strategy; "
+                         "--backend native escalates per window on host")
+    from ..utils.obs import auto_batch_size, enable_compilation_cache
+
+    enable_compilation_cache()
+    if args.batch is None:
+        args.batch = auto_batch_size(args.backend == "native",
+                                     args.backend if args.backend != "native"
+                                     else None)
+    from ..serve import AdmissionConfig, ConsensusService, ServeConfig
+    from ..serve.http import start_server
+
+    cfg = ServeConfig(
+        workdir=args.workdir, backend=args.backend,
+        backend_explicit=backend_explicit, batch=args.batch,
+        workers=args.workers, ladder_mode=args.ladder, paged=args.paged,
+        flush_lag_s=args.flush_lag_ms / 1000.0,
+        idle_evict_s=args.idle_evict_s,
+        metrics_snapshot_s=args.metrics_snapshot_s,
+        admission=AdmissionConfig(
+            max_queued_jobs=args.max_queued,
+            tenant_max_queued=args.tenant_max_queued,
+            tenant_max_bytes=int(args.tenant_max_mb * 1024 * 1024),
+            rss_soft_mb=args.rss_soft_mb, rss_hard_mb=args.rss_hard_mb),
+        events_path=args.events)
+    svc = ConsensusService(cfg)
+    httpd, port, _t = start_server(svc, args.host, args.port)
+    if args.ready_file:
+        from ..utils.aio import durable_write
+
+        durable_write(args.ready_file,
+                      lambda fh: json.dump({"port": port,
+                                            "pid": os.getpid()}, fh),
+                      mode="wt")
+    print(json.dumps({"serving": f"http://{args.host}:{port}",
+                      "backend": args.backend, "batch": args.batch,
+                      "workdir": args.workdir}), file=sys.stderr)
+    import signal
+
+    def _stop(signum, frame):
+        # graceful drain on SIGTERM/SIGINT: in-flight jobs finish, pools
+        # drain, telemetry commits durably — the smoke's clean-shutdown
+        # contract
+        import threading
+
+        threading.Thread(target=lambda: (svc.shutdown(drain=True),
+                                         httpd.shutdown()),
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _stop)
+    # SIGINT routes through the same graceful handler (a KeyboardInterrupt
+    # can no longer surface once the handler is installed)
+    signal.signal(signal.SIGINT, _stop)
+    # serve_forever runs on the daemon thread; block until shutdown()
+    _t.join()
+    return 0
+
+
 def merge_main(argv=None) -> int:
     """daccord-merge: validating merge gate + crash-durable concatenation of
     shard FASTAs (reference merge step, minus its trust in whatever it finds):
@@ -1212,6 +1343,7 @@ _TOOLS = {
     "daccord": daccord_main,
     "shard": shard_main,
     "fleet": fleet_main,
+    "serve": serve_main,
     "merge": merge_main,
     "inqual": intrinsicqv_main,
     "repeats": detectrepeats_main,

@@ -100,6 +100,24 @@ EVENT_FIELDS: dict[str, dict] = {
     "fleet.fault": {"kind": str, "shard": int},
     "fleet.demote": {"shard": int, "new_host": str},
     "fleet.finish": {"done": int, "poison": int, "wall_s": _NUM},
+    # serving plane (daccord_tpu/serve, ISSUE 10): service lifecycle,
+    # admission decisions, cross-job merged batches, per-job commits. The
+    # serve.batch row is the batcher's accounting unit: `jobs` counts the
+    # distinct jobs cohabiting the merged batch (>= 2 = cross-job batching
+    # happened), `windows` the live rows, `width` the padded dispatch width
+    "serve.start": {"workdir": str, "backend": str, "batch": int,
+                    "workers": int, "pid": int},
+    "serve.job": {"job": str, "state": str, "tenant": str},
+    "serve.admit": {"tenant": str, "job": str, "bytes": int, "queued": int},
+    "serve.reject": {"tenant": str, "reason": str, "job": str, "bytes": int},
+    "serve.batch": {"windows": int, "jobs": int, "stream": str, "width": int,
+                    "reason": str, "job": str},
+    "serve.commit": {"job": str, "fragments": int, "bytes": int},
+    "serve.abort": {"job": str, "reason": str},
+    "serve.shed": {"level": int, "rss_mb": _NUM},
+    "serve.group": {"group": str, "key": str, "backend": str, "batch": int},
+    "serve.evict": {"group": str, "key": str, "idle_s": _NUM},
+    "serve.done": {"jobs": int, "done": int, "wall_s": _NUM},
     "bench_start": {"batch": int},
     "bench_compile": {"batch": int, "cached": bool, "expected_wall_s": _NUM},
     # self-staging bench ladder: one row per completed rung (sidecar
@@ -160,7 +178,11 @@ def validate_events(path: str, strict: bool = False) -> list[str]:
             continue
         ev_name = rec.get("event")
         if ev_name == "shard_start" or (
-                ev_name in ("sup_init", "bench_start")
+                # serve.start joins the boundary set: a restarted
+                # daccord-serve appends to the same serve.events.jsonl
+                # with a fresh relative clock (same contract as a
+                # requeued shard's sidecar)
+                ev_name in ("sup_init", "bench_start", "serve.start")
                 and not in_shard_segment):
             # stream boundary: JsonlLogger appends with a per-process
             # relative clock, so a rerun against the same --events path (or

@@ -62,6 +62,11 @@ MILESTONES = frozenset({
     "sup_fault", "governor.classify", "governor.backpressure",
     "governor.monster", "ingest.quarantine", "ingest.fault",
     "bench_start", "bench_rung", "bench_done",
+    # serving plane (ISSUE 10): job lifecycle + admission/shed decisions
+    # are milestones; the per-batch serve.batch rows are summarized only
+    "serve.start", "serve.job", "serve.admit", "serve.reject",
+    "serve.commit", "serve.abort", "serve.shed", "serve.group",
+    "serve.evict", "serve.done",
 })
 
 
@@ -196,14 +201,17 @@ def reconcile(d: dict, tol_frac: float = 0.05,
 def ledger_rows(path: str) -> tuple[int, int]:
     """(total rows, distinct windows) of a ledger sidecar — a resumed shard
     legitimately re-records the windows past its checkpoint, so the
-    manifest reconciliation keys on the DEDUPED count."""
+    manifest reconciliation keys on the DEDUPED count. The optional ``job``
+    field (serving plane, ISSUE 10) joins the dedupe key: two jobs over the
+    same inputs legitimately record the same (aread, widx) twice in a
+    merged/concatenated ledger and are distinct windows."""
     seen = set()
     total = 0
     for rec in _read_jsonl(path):
         if rec.get("event") != "window":
             continue
         total += 1
-        seen.add((rec.get("aread"), rec.get("widx")))
+        seen.add((rec.get("job"), rec.get("aread"), rec.get("widx")))
     return total, len(seen)
 
 

@@ -98,6 +98,10 @@ class JsonlLogger:
         self.flush()
         if self._fh is not None and self._fh is not sys.stderr:
             self._fh.close()
+        # a closed logger silently drops later records instead of raising
+        # "I/O operation on closed file": long-lived writers (the serve
+        # plane's shutdown drain window) may race a final log against close
+        self._fh = None
 
     # context manager: the short-lived open/log/close triplets (checkpoint
     # commits, fault events) must not leak the fd when an abort path unwinds
@@ -218,18 +222,32 @@ class _Gauge:
         self.v = float(v)
 
 
-class _Histogram:
-    """Count/sum/min/max plus coarse log2 buckets — enough shape for a
-    turnaround distribution without per-sample storage."""
+#: bounded per-histogram sample (reservoir) backing the quantile estimates;
+#: 512 doubles per histogram is noise memory-wise and keeps p99 exact for
+#: any run under ~50k observations' worth of tail resolution
+_HIST_RESERVOIR = 512
 
-    __slots__ = ("count", "total", "vmin", "vmax", "buckets")
+
+class _Histogram:
+    """Count/sum/min/max, coarse log2 buckets, and p50/p95/p99 quantiles
+    from a bounded reservoir sample (latency is a quantile metric — a serving
+    decision made on count/sum alone hides exactly the tail it is about).
+    The reservoir uses a per-instance seeded RNG, so a run's quantile
+    estimates are deterministic given its observation sequence."""
+
+    __slots__ = ("count", "total", "vmin", "vmax", "buckets", "samples",
+                 "_rng")
 
     def __init__(self):
+        import random
+
         self.count = 0
         self.total = 0.0
         self.vmin = None
         self.vmax = None
         self.buckets: dict[int, int] = {}
+        self.samples: list[float] = []
+        self._rng = random.Random(0xDACC)
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -240,11 +258,31 @@ class _Histogram:
         b = max(-30, min(30, int(v).bit_length() if v >= 1
                          else -int(1.0 / max(v, 1e-9)).bit_length()))
         self.buckets[b] = self.buckets.get(b, 0) + 1
+        # Vitter reservoir: every observation has an equal chance of being
+        # in the sample once count > capacity
+        if len(self.samples) < _HIST_RESERVOIR:
+            self.samples.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < _HIST_RESERVOIR:
+                self.samples[j] = v
+
+    def quantile(self, q: float) -> float | None:
+        """Nearest-rank quantile over the reservoir (exact while count <=
+        reservoir capacity; an unbiased estimate beyond)."""
+        if not self.samples:
+            return None
+        s = sorted(self.samples)
+        return s[min(int(q * len(s)), len(s) - 1)]
 
     def summary(self) -> dict:
         return {"count": self.count, "sum": round(self.total, 6),
                 "min": self.vmin, "max": self.vmax,
-                "mean": round(self.total / self.count, 6) if self.count else None}
+                "mean": round(self.total / self.count, 6) if self.count else None,
+                # the satellite contract (ISSUE 10): quantiles ride every
+                # periodic `metrics` snapshot AND the durable rollup
+                "p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
 
 
 class MetricsRegistry:
@@ -303,7 +341,7 @@ class WindowLedger:
 
     def record(self, aread: int, widx: int, length: int, depth: int,
                tier: int, k: int, solved: bool, stream: str, rescued: bool,
-               wall_s: float) -> None:
+               wall_s: float, job: str | None = None) -> None:
         self.rows += 1
         log = self.log
         if log._fh is None:
@@ -311,15 +349,21 @@ class WindowLedger:
         # hand-built line (fixed schema, scalar fields only): one ledger row
         # per window is the highest-volume telemetry record, and skipping
         # json.dumps keeps it ~3x cheaper — the hot-path budget (<=2% on the
-        # native engine) is spent mostly here
+        # native engine) is spent mostly here. `job` (ISSUE 10 satellite:
+        # the serving plane's per-workload tag) is optional so batch-run
+        # ledgers stay byte-for-byte what they were; when present it lets
+        # the ROADMAP-5 router training set segment per workload
         now = time.time()
+        # json.dumps, not raw interpolation: job_tag is a public config
+        # field, and a quote/backslash in it would corrupt every row
+        jf = ', "job": %s' % json.dumps(job) if job else ""
         log._buf.append(
             '{"t": %.3f, "ts": %.6f, "event": "window", "aread": %d, '
             '"widx": %d, "len": %d, "depth": %d, "tier": %d, "k": %d, '
-            '"solved": %s, "stream": "%s", "rescued": %s, "wall_s": %.6f}\n'
+            '"solved": %s, "stream": "%s", "rescued": %s, "wall_s": %.6f%s}\n'
             % (now - log._t0, now, aread, widx, length, depth, tier, k,
                "true" if solved else "false", stream,
-               "true" if rescued else "false", wall_s))
+               "true" if rescued else "false", wall_s, jf))
         if (len(log._buf) >= log._buffer_lines
                 or (log._flush_s and now - log._last_flush >= log._flush_s)):
             log.flush()

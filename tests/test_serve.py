@@ -1,0 +1,537 @@
+"""Serving plane (daccord_tpu/serve, ISSUE 10): cross-job batching byte
+parity under the fault/capacity matrix, admission control, warm state,
+latency quantiles, and the job-tagged outcome ledger.
+
+The byte contract under test: N concurrent jobs multiplexed into shared
+device batches each produce FASTA byte-identical to their solo ``daccord``
+run — including when the shared supervisor fails over (device_lost), when
+the capacity governor bisects a mixed-job batch (device_oom), and when a
+cohabiting job aborts mid-run. Fast tier runs on the native engine (no XLA
+compiles); the JAX-CPU arms (fused, split two-stream, paged wire format)
+are the slow tier.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from daccord_tpu.sim import SimConfig, make_dataset
+
+try:
+    from daccord_tpu.native import available as _native_available
+
+    HAVE_NATIVE = _native_available()
+except Exception:
+    HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(not HAVE_NATIVE,
+                                  reason="native host path unavailable")
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("serve"))
+    cfg = SimConfig(genome_len=1500, coverage=10, read_len_mean=500,
+                    min_overlap=200, seed=5)
+    return make_dataset(d, cfg, name="sv"), d
+
+
+def _solo_bytes(out, d, backend="native"):
+    """The solo-run reference: the job-config builder's own output (CLI
+    parity by construction), run through the stock pipeline."""
+    import dataclasses
+
+    from daccord_tpu.runtime.pipeline import correct_to_fasta
+    from daccord_tpu.serve.jobs import JobSpec, build_job_config
+
+    spec = JobSpec.from_json({"db": out["db"], "las": out["las"]}, d)
+    cfg = build_job_config(spec, backend, True, 64, "fused", d, "solo")
+    cfg = dataclasses.replace(cfg, native_solver=backend == "native",
+                              supervise=True, events_path=None,
+                              ledger_path=None, job_tag=None,
+                              quarantine_path=None)
+    ref = os.path.join(d, f"solo-{backend}.fasta")
+    if not os.path.exists(ref):
+        correct_to_fasta(out["db"], out["las"], ref, cfg)
+    with open(ref, "rb") as fh:
+        return fh.read()
+
+
+def _svc(workdir, backend="native", **kw):
+    from daccord_tpu.serve import ConsensusService, ServeConfig
+
+    kw.setdefault("batch", 64)
+    kw.setdefault("workers", 2)
+    kw.setdefault("flush_lag_s", 0.02)
+    return ConsensusService(ServeConfig(workdir=str(workdir), backend=backend,
+                                        backend_explicit=True, **kw))
+
+
+def _job_fasta(svc, j):
+    return open(os.path.join(svc.cfg.workdir, "jobs", j["job"],
+                             "out.fasta"), "rb").read()
+
+
+def _lint(paths):
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    for p in paths:
+        errs = validate_events(p, strict=True)
+        assert not errs, (p, errs[:5])
+
+
+@needs_native
+def test_two_jobs_byte_parity(dataset, tmp_path):
+    """Two concurrent jobs through shared batches == two solo runs, with a
+    warm-group hit for the second job and lint-clean telemetry."""
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    svc = _svc(tmp_path / "srv")
+    j1 = svc.submit({"db": out["db"], "las": out["las"], "tenant": "a"})
+    j2 = svc.submit({"db": out["db"], "las": out["las"], "tenant": "b"})
+    s1 = svc.wait(j1["job"], 300)
+    s2 = svc.wait(j2["job"], 300)
+    st = svc.stats()
+    svc.shutdown()
+    assert s1["state"] == "done" and s2["state"] == "done", (s1, s2)
+    assert _job_fasta(svc, j1) == ref
+    assert _job_fasta(svc, j2) == ref
+    # one solve fingerprint -> ONE group: the second job was a warm hit
+    assert st["warm"]["misses"] == 1 and st["warm"]["hits"] == 1
+    # latency quantiles rode the rollup (satellite 1)
+    h = st["metrics"]["hists"]["job_latency_s"]
+    assert h["count"] == 2 and h["p50"] is not None and h["p99"] is not None
+    _lint(glob.glob(os.path.join(svc.cfg.workdir, "*.events.jsonl"))
+          + glob.glob(os.path.join(svc.cfg.workdir, "jobs", "*",
+                                   "events.jsonl")))
+
+
+@needs_native
+def test_cross_job_merged_batch_unit(dataset, tmp_path):
+    """Deterministic mixing: two jobs each pool a sub-width block; the
+    flush merges them into ONE device batch (jobs=2) and each handle's
+    result is byte-identical to solving its rows alone."""
+    from daccord_tpu.formats.dazzdb import read_db
+    from daccord_tpu.formats.las import LasFile
+    from daccord_tpu.kernels.tensorize import BatchShape, tensorize_windows
+    from daccord_tpu.runtime.pipeline import (_sample_windows,
+                                              estimate_profile_for_shard)
+    from daccord_tpu.serve.batcher import GroupConfig, SolveGroup
+    from daccord_tpu.serve.jobs import JobSpec, build_job_config
+
+    out, d = dataset
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    spec = JobSpec.from_json({"db": out["db"], "las": out["las"]}, str(d))
+    cfg = build_job_config(spec, "native", True, 64, "fused",
+                           str(tmp_path), "unit")
+    profile = estimate_profile_for_shard(db, las, cfg)
+    _, windows = _sample_windows(db, las, cfg, None, None)
+    assert len(windows) >= 80, "sample too small for the unit"
+    shape = BatchShape(depth=cfg.depth, seg_len=cfg.seg_len,
+                       wlen=cfg.consensus.w)
+    full = tensorize_windows([(0, ws) for ws in windows[:80]], shape)
+    from daccord_tpu.kernels.tensorize import slice_batch
+
+    a, b = slice_batch(full, 0, 40), slice_batch(full, 40, 80)
+
+    group = SolveGroup("k", profile, cfg,
+                       GroupConfig(backend="native", batch=64))
+    sa, sb = group.job_solver("jobA"), group.job_solver("jobB")
+    ha = sa.dispatch(a)          # 40 rows pooled, below width
+    assert group.counters["batches"] == 0
+    hb = sb.dispatch(b)          # 80 rows -> one 64-row merged flush
+    assert group.counters["batches"] == 1
+    assert group.counters["mixed_batches"] == 1
+    ra, rb = sa.fetch(ha), sb.fetch(hb)
+    assert len(ra["solved"]) == 40 and len(rb["solved"]) == 40
+
+    # solo control: a second group solves each block alone
+    solo = SolveGroup("k2", profile, cfg,
+                      GroupConfig(backend="native", batch=64))
+    ss = solo.job_solver("solo")
+    for blk, res in ((a, ra), (b, rb)):
+        ctrl = ss.fetch(ss.dispatch(blk))
+        for k in ("solved", "tier", "cons_len", "err"):
+            np.testing.assert_array_equal(np.asarray(ctrl[k]),
+                                          np.asarray(res[k]), err_msg=k)
+        # consensus bytes row by row (trailing capacity may differ)
+        for i in range(blk.size):
+            n = int(ctrl["cons_len"][i])
+            np.testing.assert_array_equal(
+                np.asarray(ctrl["cons"][i][:n]),
+                np.asarray(res["cons"][i][:n]))
+
+
+@needs_native
+def test_device_oom_bisects_mixed_batches(dataset, tmp_path, monkeypatch):
+    """Injected device OOM classifies on the SHARED supervisor and the
+    governor bisects merged (mixed-job) batches — bytes unchanged."""
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    monkeypatch.setenv("DACCORD_FAULT", "device_oom:2")
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    svc = _svc(tmp_path / "srv")
+    j1 = svc.submit({"db": out["db"], "las": out["las"], "tenant": "a"})
+    j2 = svc.submit({"db": out["db"], "las": out["las"], "tenant": "b"})
+    s1 = svc.wait(j1["job"], 300)
+    s2 = svc.wait(j2["job"], 300)
+    st = svc.stats()
+    svc.shutdown()
+    assert s1["state"] == "done" and s2["state"] == "done", (s1, s2)
+    g = st["warm"]["groups"][0]
+    assert g["governor"]["classify"] >= 1 and g["governor"]["shrink"] >= 1
+    assert not g["degraded"], "capacity must degrade, never fail over"
+    assert _job_fasta(svc, j1) == ref
+    assert _job_fasta(svc, j2) == ref
+
+
+@needs_native
+def test_device_lost_fails_over_all_jobs(dataset, tmp_path, monkeypatch):
+    """Declared device loss mid-serve: the shared supervisor replays every
+    in-flight merged batch on the fallback engine; every job's bytes hold."""
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    monkeypatch.setenv("DACCORD_FAULT", "device_lost:2")
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    svc = _svc(tmp_path / "srv")
+    j1 = svc.submit({"db": out["db"], "las": out["las"]})
+    j2 = svc.submit({"db": out["db"], "las": out["las"]})
+    s1 = svc.wait(j1["job"], 300)
+    s2 = svc.wait(j2["job"], 300)
+    st = svc.stats()
+    svc.shutdown()
+    assert s1["state"] == "done" and s2["state"] == "done", (s1, s2)
+    assert st["warm"]["groups"][0]["degraded"]
+    assert _job_fasta(svc, j1) == ref
+    assert _job_fasta(svc, j2) == ref
+
+
+@needs_native
+def test_abort_does_not_poison_cohabitants(dataset, tmp_path):
+    """A mid-run client abort drops the job without changing one byte of
+    the cohabiting job's output (the batcher's release contract)."""
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    svc = _svc(tmp_path / "srv")
+    ja = svc.submit({"db": out["db"], "las": out["las"], "tenant": "a"})
+    jb = svc.submit({"db": out["db"], "las": out["las"], "tenant": "b"})
+
+    def chase():
+        while True:
+            s = svc.status(ja["job"])
+            if s is None or s["state"] in ("done", "failed", "aborted"):
+                return
+            if s["state"] == "running" and s["reads"] > 2:
+                svc.abort(ja["job"])
+                return
+            time.sleep(0.005)
+
+    t = threading.Thread(target=chase)
+    t.start()
+    sa = svc.wait(ja["job"], 300)
+    sb = svc.wait(jb["job"], 300)
+    t.join()
+    svc.shutdown()
+    assert sb["state"] == "done", sb
+    assert sa["state"] in ("aborted", "done"), sa   # may have won the race
+    assert _job_fasta(svc, jb) == ref
+
+
+def test_admission_quotas_and_pressure():
+    from daccord_tpu.runtime.faults import FaultPlan
+    from daccord_tpu.serve import (AdmissionConfig, AdmissionController,
+                                   AdmissionReject)
+
+    ctl = AdmissionController(AdmissionConfig(tenant_max_queued=1,
+                                              tenant_max_bytes=100,
+                                              max_queued_jobs=3))
+    ctl.admit("a", 10, job="j1")
+    with pytest.raises(AdmissionReject) as ei:
+        ctl.admit("a", 10, job="j2")
+    assert ei.value.reason == "quota_jobs"
+    with pytest.raises(AdmissionReject) as ei:
+        ctl.admit("b", 1000, job="j3")
+    assert ei.value.reason == "quota_bytes"
+    ctl.release("a", 10)
+    ctl.admit("a", 10, job="j4")          # slot freed
+    # injected host pressure pauses admission deterministically
+    ctl2 = AdmissionController(AdmissionConfig(),
+                               faults=FaultPlan.parse("host_rss:1"))
+    with pytest.raises(AdmissionReject) as ei:
+        ctl2.admit("a", 1, job="j5")
+    assert ei.value.reason == "pressure" and ei.value.retryable
+    ctl2.admit("a", 1, job="j6")          # one-shot injection consumed
+    # draining refuses everything
+    ctl.drain()
+    with pytest.raises(AdmissionReject) as ei:
+        ctl.admit("c", 1, job="j7")
+    assert ei.value.reason == "draining"
+    st = ctl.stats()
+    assert st["rejected"] == 3 and st["admitted"] == 2
+
+
+@needs_native
+def test_restart_never_reuses_job_ids(dataset, tmp_path):
+    """A restarted server on the same (durable) workdir resumes the job-id
+    sequence past existing job dirs — reusing jNNNNN would serve or clobber
+    the previous run's committed result (review finding)."""
+    out, d = dataset
+    svc = _svc(tmp_path / "srv", workers=1)
+    j1 = svc.submit({"db": out["db"], "las": out["las"]})
+    svc.wait(j1["job"], 300)
+    svc.shutdown()
+    assert j1["job"] == "j00001"
+    svc2 = _svc(tmp_path / "srv", workers=1)
+    j2 = svc2.submit({"db": out["db"], "las": out["las"]})
+    svc2.wait(j2["job"], 300)
+    svc2.shutdown()
+    assert j2["job"] == "j00002"
+    # the first run's durable commit is untouched
+    assert os.path.exists(os.path.join(str(tmp_path / "srv"), "jobs",
+                                       "j00001", "out.fasta"))
+
+
+def test_rejected_submit_leaves_no_residue(dataset, tmp_path):
+    """A refused submission (quota or bad spec) releases its admission
+    charge AND leaves no spooled upload behind — rejected requests must not
+    grow the workdir (review finding)."""
+    import base64
+
+    from daccord_tpu.serve import AdmissionConfig, AdmissionReject
+
+    out, d = dataset
+    svc = _svc(tmp_path / "srv", workers=1,
+               admission=AdmissionConfig(tenant_max_queued=0))
+    up = {"db": "u.db", "las": "u.las",
+          "files": {"u.db": base64.b64encode(b"x" * 64).decode(),
+                    "u.las": base64.b64encode(b"y" * 64).decode()}}
+    with pytest.raises(AdmissionReject):
+        svc.submit(up)
+    assert os.listdir(os.path.join(svc.cfg.workdir, "jobs")) == []
+    assert svc.admission.stats()["queued"] == 0
+    # bad spec AFTER admission: charge released, spool removed
+    svc2 = _svc(tmp_path / "srv2", workers=1)
+    with pytest.raises(ValueError):
+        svc2.submit({"db": out["db"], "las": out["las"], "bogus": 1})
+    assert os.listdir(os.path.join(svc2.cfg.workdir, "jobs")) == []
+    assert svc2.admission.stats()["queued"] == 0
+    svc.shutdown()
+    svc2.shutdown()
+
+
+@needs_native
+def test_warm_state_reuse_and_eviction(dataset, tmp_path):
+    out, d = dataset
+    svc = _svc(tmp_path / "srv", idle_evict_s=3600.0, workers=1)
+    j1 = svc.submit({"db": out["db"], "las": out["las"]})
+    svc.wait(j1["job"], 300)
+    j2 = svc.submit({"db": out["db"], "las": out["las"]})
+    svc.wait(j2["job"], 300)
+    assert svc.warm.counters == {"hits": 1, "misses": 1, "evicted": 0}
+    assert len(svc.warm.groups()) == 1
+    svc.warm.idle_evict_s = 0.0
+    assert svc.warm.evict_idle() == 1
+    assert svc.warm.counters["evicted"] == 1
+    assert not svc.warm.groups()
+    svc.shutdown()
+
+
+def test_histogram_quantiles():
+    from daccord_tpu.utils.obs import MetricsRegistry, _Histogram
+
+    h = _Histogram()
+    assert h.summary()["p50"] is None
+    for v in range(1, 101):                 # 1..100
+        h.observe(float(v))
+    s = h.summary()
+    assert s["p50"] == 51.0 and s["p95"] == 96.0 and s["p99"] == 100.0
+    assert s["count"] == 100 and s["min"] == 1.0 and s["max"] == 100.0
+    # beyond the reservoir the estimate stays sane (deterministic seed)
+    h2 = _Histogram()
+    for v in range(10_000):
+        h2.observe(float(v))
+    s2 = h2.summary()
+    assert 3_000 < s2["p50"] < 7_000, s2
+    # quantiles ride the registry snapshot + rollup
+    reg = MetricsRegistry()
+    reg.histogram("lat").observe(2.0)
+    roll = reg.rollup()
+    assert roll["hists"]["lat"]["p50"] == 2.0
+    assert roll["hists"]["lat"]["p99"] == 2.0
+
+
+@needs_native
+def test_ledger_job_field(dataset, tmp_path):
+    """Ledger rows carry the job tag; daccord-trace's reconciliation keys
+    dedupe on (job, aread, widx) so merged multi-job ledgers don't
+    collapse."""
+    import dataclasses
+
+    from daccord_tpu.runtime.pipeline import PipelineConfig, correct_to_fasta
+    from daccord_tpu.serve.jobs import JobSpec, build_job_config
+    from daccord_tpu.tools.trace import ledger_rows
+
+    out, d = dataset
+    led = str(tmp_path / "a.ledger.jsonl")
+    spec = JobSpec.from_json({"db": out["db"], "las": out["las"]}, str(d))
+    cfg = build_job_config(spec, "native", True, 64, "fused",
+                           str(tmp_path), "jobA")
+    cfg = dataclasses.replace(cfg, native_solver=True, supervise=True,
+                              events_path=None, ledger_path=led,
+                              quarantine_path=None)
+    st = correct_to_fasta(out["db"], out["las"],
+                          str(tmp_path / "a.fasta"), cfg)
+    rows = [json.loads(ln) for ln in open(led)]
+    assert rows and all(r["job"] == "jobA" for r in rows)
+    assert len(rows) == st.n_windows
+    # two jobs' ledgers concatenated: distinct count keys on the job tag
+    merged = str(tmp_path / "m.ledger.jsonl")
+    with open(merged, "wt") as fh:
+        for ln in open(led):
+            fh.write(ln)
+        for ln in open(led):
+            fh.write(ln.replace('"job": "jobA"', '"job": "jobB"'))
+    total, distinct = ledger_rows(merged)
+    assert total == 2 * st.n_windows and distinct == 2 * st.n_windows
+
+
+def test_job_spec_validation(tmp_path, dataset):
+    import base64
+
+    from daccord_tpu.serve.jobs import JobSpec
+
+    out, d = dataset
+    with pytest.raises(ValueError, match="missing 'db'"):
+        JobSpec.from_json({"las": out["las"]}, str(tmp_path))
+    with pytest.raises(ValueError, match="unknown job fields"):
+        JobSpec.from_json({"db": out["db"], "las": out["las"],
+                           "bogus": 1}, str(tmp_path))
+    with pytest.raises(ValueError, match="supported range"):
+        JobSpec.from_json({"db": out["db"], "las": out["las"], "k": 99},
+                          str(tmp_path))
+    with pytest.raises(ValueError, match="not found"):
+        JobSpec.from_json({"db": out["db"], "las": "/nope.las"},
+                          str(tmp_path))
+    # upload mode: b64 files spool into the job dir
+    payload = {"db": "up.db", "las": "up.las",
+               "files": {"up.db": base64.b64encode(b"x").decode(),
+                         "up.las": base64.b64encode(b"y").decode()}}
+    spec = JobSpec.from_json(payload, str(tmp_path / "spool"))
+    assert spec.uploaded and os.path.exists(spec.db)
+    assert open(spec.las, "rb").read() == b"y"
+
+
+@needs_native
+def test_http_end_to_end(dataset, tmp_path):
+    """The real HTTP surface: submit, wait, result parity, metrics with
+    quantiles, DELETE abort, graceful shutdown."""
+    import urllib.error
+    import urllib.request
+
+    from daccord_tpu.serve.http import start_server
+
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    svc = _svc(tmp_path / "srv")
+    httpd, port, _t = start_server(svc, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{port}"
+
+    def req(method, path, body=None):
+        r = urllib.request.Request(
+            base + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=300) as resp:
+            return resp.status, resp.read()
+
+    code, b = req("POST", "/v1/jobs", {"db": out["db"], "las": out["las"]})
+    assert code == 201
+    j = json.loads(b)["job"]
+    code, fasta = req("GET", f"/v1/jobs/{j}/result?wait=1")
+    assert code == 200 and fasta == ref
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req("POST", "/v1/jobs", {"las": out["las"]})
+    assert ei.value.code == 400
+    # wrong-typed field must be a 400, never a dropped connection
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req("POST", "/v1/jobs", {"db": out["db"], "las": out["las"],
+                                 "k": "8"})
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req("GET", f"/v1/jobs/{j}/result?wait=1&timeout=abc")
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req("GET", "/v1/jobs/nope")
+    assert ei.value.code == 404
+    code, m = req("GET", "/v1/metrics")
+    m = json.loads(m)
+    assert m["metrics"]["hists"]["job_latency_s"]["p50"] is not None
+    # a second job, aborted over the wire
+    code, b = req("POST", "/v1/jobs", {"db": out["db"], "las": out["las"]})
+    j2 = json.loads(b)["job"]
+    req("DELETE", f"/v1/jobs/{j2}")
+    st = svc.wait(j2, 300)
+    assert st["state"] in ("aborted", "done")
+    code, _ = req("POST", "/v1/shutdown")
+    assert code == 200
+    for _ in range(200):
+        if svc.admission.stats()["draining"]:
+            break
+        time.sleep(0.05)
+    httpd.server_close()
+
+
+@needs_native
+def test_strict_ingest_rejected_at_admission(dataset, tmp_path):
+    """A corrupt LAS under strict policy is refused at submit time with the
+    structured report — it never costs a queue slot."""
+    import shutil
+
+    from daccord_tpu.runtime import faults
+
+    out, d = dataset
+    bad_las = str(tmp_path / "bad.las")
+    shutil.copy(out["las"], bad_las)
+    for ext in (".db", ".idx", ".bps"):
+        src = out["db"][:-3] + ext if out["db"].endswith(".db") else \
+            out["db"] + ext
+        if os.path.exists(src):
+            shutil.copy(src, str(tmp_path / ("bad" + ext)))
+    faults.corrupt_las_bitflip(bad_las, 4)
+    svc = _svc(tmp_path / "srv", workers=1)
+    with pytest.raises(ValueError, match="ingest validation"):
+        svc.submit({"db": str(tmp_path / "bad.db"), "las": bad_las})
+    assert svc.admission.stats()["queued"] == 0
+    svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the JAX-CPU arms (XLA ladder compiles)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["fused", "split", "paged"])
+def test_jax_cpu_arm_byte_parity(dataset, tmp_path, mode):
+    """Cross-job batching through the jitted ladder paths: fused dense,
+    split two-stream (stream-routed merged pools), and the ragged paged
+    wire format — each byte-identical to the solo cpu run."""
+    out, d = dataset
+    ref = _solo_bytes(out, d, backend="cpu")
+    svc = _svc(tmp_path / "srv", backend="cpu", batch=32,
+               ladder_mode="split" if mode == "split" else "fused",
+               paged=mode == "paged", flush_lag_s=0.05)
+    j1 = svc.submit({"db": out["db"], "las": out["las"]})
+    j2 = svc.submit({"db": out["db"], "las": out["las"]})
+    s1 = svc.wait(j1["job"], 900)
+    s2 = svc.wait(j2["job"], 900)
+    svc.shutdown()
+    assert s1["state"] == "done" and s2["state"] == "done", (s1, s2)
+    assert _job_fasta(svc, j1) == ref
+    assert _job_fasta(svc, j2) == ref

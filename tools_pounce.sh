@@ -246,6 +246,84 @@ EOF
 echo "tools_pounce: paged-batching smoke OK" >&2
 rm -rf "$pagedir"
 
+# serving-plane smoke (ISSUE 10): start a real daccord-serve HTTP server on
+# the native engine, submit two overlapping jobs, and require each job's
+# FASTA to be byte-identical to its solo `daccord` run, with lint-clean
+# serve/group/job telemetry and a clean drain on shutdown — all CPU-side,
+# before any chip time. A failure here means the cross-job batcher or the
+# admission plane regressed; abort the pounce rather than serve on top of it.
+servedir=$(mktemp -d)
+python - "$servedir" <<'EOF' || { echo "tools_pounce: serve synth failed" >&2; exit 1; }
+import sys
+from daccord_tpu.sim.synth import SimConfig, make_dataset
+make_dataset(sys.argv[1], SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=500, min_overlap=200,
+                                    seed=5), name="sv")
+EOF
+python -m daccord_tpu.tools.cli daccord "$servedir/sv.db" "$servedir/sv.las" \
+    --backend native -b 64 -o "$servedir/solo.fasta" \
+  || { echo "tools_pounce: serve solo reference run FAILED" >&2; exit 1; }
+python -m daccord_tpu.tools.cli serve --workdir "$servedir/srv" \
+    --backend native -b 64 --port 0 --ready-file "$servedir/ready.json" \
+    > "$servedir/serve.log" 2>&1 &
+SERVE_PID=$!
+python - "$servedir" <<'EOF' || { echo "tools_pounce: serve job round-trip FAILED" >&2; kill "$SERVE_PID" 2>/dev/null; exit 1; }
+import json, os, sys, time, urllib.request
+d = sys.argv[1]
+for _ in range(300):
+    if os.path.exists(f"{d}/ready.json"):
+        break
+    time.sleep(0.1)
+else:
+    raise SystemExit("serve never wrote its ready file")
+port = json.load(open(f"{d}/ready.json"))["port"]
+base = f"http://127.0.0.1:{port}"
+def req(method, path, body=None):
+    r = urllib.request.Request(base + path, method=method,
+                               data=json.dumps(body).encode() if body is not None else None,
+                               headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=300) as resp:
+        return resp.read()
+# two overlapping jobs, distinct tenants, same inputs (same solve
+# fingerprint -> one warm group; cross-job batches whenever both have rows
+# pooled inside the flush-lag window)
+j1 = json.loads(req("POST", "/v1/jobs", {"db": f"{d}/sv.db", "las": f"{d}/sv.las", "tenant": "a"}))
+j2 = json.loads(req("POST", "/v1/jobs", {"db": f"{d}/sv.db", "las": f"{d}/sv.las", "tenant": "b"}))
+f1 = req("GET", f"/v1/jobs/{j1['job']}/result?wait=1")
+f2 = req("GET", f"/v1/jobs/{j2['job']}/result?wait=1")
+solo = open(f"{d}/solo.fasta", "rb").read()
+assert f1 == solo, "job 1 FASTA diverged from the solo run"
+assert f2 == solo, "job 2 FASTA diverged from the solo run"
+m = json.loads(req("GET", "/v1/metrics"))
+assert m["warm"]["misses"] == 1 and m["warm"]["hits"] >= 1, m["warm"]
+hists = m["metrics"]["hists"]
+assert "job_latency_s" in hists and hists["job_latency_s"]["p50"] is not None, \
+    "latency quantiles missing from the metrics rollup"
+# clean shutdown must drain in-flight work and exit 0
+req("POST", "/v1/shutdown")
+print("serve smoke: parity OK, latency p50 =", hists["job_latency_s"]["p50"])
+EOF
+wait "$SERVE_PID" \
+  || { echo "tools_pounce: serve did not shut down cleanly" >&2; exit 1; }
+python -m daccord_tpu.tools.cli eventcheck --strict \
+    "$servedir/srv/serve.events.jsonl" "$servedir"/srv/g*.events.jsonl \
+    "$servedir"/srv/jobs/*/events.jsonl \
+  || { echo "tools_pounce: serve events failed schema lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli trace --check --no-timeline \
+    "$servedir/srv/serve.events.jsonl" "$servedir"/srv/g*.events.jsonl \
+    "$servedir"/srv/jobs/*/events.jsonl "$servedir"/srv/jobs/*/ledger.jsonl \
+  || { echo "tools_pounce: serve sidecars failed daccord-trace lint" >&2; exit 1; }
+echo "tools_pounce: serving-plane smoke OK" >&2
+rm -rf "$servedir"
+
+# serve bench stage (ISSUE 10 satellite): replay the default job-arrival
+# trace against the server and commit the latency sidecar — the first
+# serving number (p50/p99 + windows/sec) lands beside the rung ladder
+env DACCORD_BENCH_SERVE=1 python bench.py > "BENCH_SERVE_${stamp}.log" 2>&1 \
+  && git add BENCH_SERVE.json "BENCH_SERVE_${stamp}.log" \
+  && git commit -q -m "pounce: serve latency bench (${stamp})" \
+  || echo "tools_pounce: serve bench stage failed (non-fatal)" >&2
+
 run() {  # run <name> <cmd...>: capture one experiment, commit its sidecar
   name=$1; shift
   out="POUNCE_${stamp}_${name}.json"
