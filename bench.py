@@ -90,6 +90,20 @@ LADDER_MAX_BATCHES = int(_lmb) if _lmb else None
 # built, else cpu — the serving plane benches chip-free).
 BENCH_SERVE = os.environ.get("DACCORD_BENCH_SERVE") == "1"
 BENCH_SERVE_TRACE = os.environ.get("DACCORD_BENCH_SERVE_TRACE")
+# multichip mesh arm (ISSUE 12): DACCORD_BENCH_MESH=1 measures mesh-N
+# windows/sec scaling vs single-device ON THIS HOST through the sharded
+# ladder (parallel/mesh.py) and commits the next MULTICHIP_r*.json sidecar —
+# per-rung wall decomposed into dispatch vs fetch, per-device slice width,
+# and the pad-to-mesh-multiple waste. With no live device the arm re-execs
+# itself under the off-pod recipe (JAX_PLATFORMS=cpu + forced host platform
+# device count), so the multichip trajectory resumes chip-free; on a live
+# tunnel the same env var is the queued on-chip mesh rung.
+# DACCORD_BENCH_MESH_N overrides the mesh width (default 8);
+# DACCORD_BENCH_MESH_MAX_BATCHES caps batches per rung (CPU smoke).
+BENCH_MESH = os.environ.get("DACCORD_BENCH_MESH") == "1"
+BENCH_MESH_N = int(os.environ.get("DACCORD_BENCH_MESH_N", "8"))
+_mmb = os.environ.get("DACCORD_BENCH_MESH_MAX_BATCHES")
+BENCH_MESH_MAX_BATCHES = int(_mmb) if _mmb else None
 
 
 def _bench_consensus_config():
@@ -729,6 +743,125 @@ def run_ladder(data: dict, ev, orc_bps: float) -> int:
     return landed
 
 
+def _next_multichip_path() -> str:
+    """Next MULTICHIP_rNN.json index in the repo root (the committed
+    multichip trajectory: r01-r05 are the graft dry runs, the bench arm
+    resumes the series)."""
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    idx = 0
+    for f in os.listdir(here):
+        m = re.fullmatch(r"MULTICHIP_r(\d+)\.json", f)
+        if m:
+            idx = max(idx, int(m.group(1)))
+    return os.path.join(here, f"MULTICHIP_r{idx + 1:02d}.json")
+
+
+def run_mesh_bench(data: dict, ev, fallback_reason=None) -> dict:
+    """Mesh scaling rung (DACCORD_BENCH_MESH=1): pipelined throughput of the
+    sharded ladder at mesh widths 1 and N over the same window set, with the
+    pipeline's own dispatch discipline (bounded in-flight window + grouped
+    fetch). Commits the next MULTICHIP_r*.json sidecar with per-rung wall
+    decomposition (dispatch vs fetch-blocked), per-device slice width, and
+    the pad-to-mesh-multiple waste rows."""
+    from collections import deque
+
+    import jax
+
+    from daccord_tpu.kernels.tensorize import BatchShape
+    from daccord_tpu.oracle.profile import ErrorProfile
+    from daccord_tpu.kernels.tiers import TierLadder
+    from daccord_tpu.parallel.mesh import make_mesh, make_sharded_solver
+
+    nd = min(BENCH_MESH_N, len(jax.devices()))
+    prof = ErrorProfile(float(data["p_ins"]), float(data["p_del"]),
+                        float(data["p_sub"]))
+    ladder = TierLadder.from_config(prof, _bench_consensus_config())
+    shape = BatchShape(depth=DEPTH, seg_len=SEG_LEN, wlen=WLEN)
+    nb = len(data["nsegs"]) // BATCH
+    if BENCH_MESH_MAX_BATCHES is not None:
+        nb = min(nb, BENCH_MESH_MAX_BATCHES)
+    widths = [1, nd] if nd > 1 else [1]
+    rungs = []
+    for mesh_w in widths:
+        solver = make_sharded_solver(ladder, make_mesh(mesh_w), batch=BATCH)
+        # warmup / compile outside the timed region (the expected-wall echo
+        # for cold mesh shapes rides the same bench_compile event)
+        _announce_compile(ev, BATCH)
+        solver(_make_batch(data, 0, BATCH, shape))
+        t0 = time.perf_counter()
+        t_disp = 0.0
+        t_fetch = 0.0
+        windows = 0
+        solved = 0
+        inflight: deque = deque()
+
+        def drain(to_depth: int):
+            nonlocal t_fetch, windows, solved
+            n_pop = len(inflight) - to_depth
+            if n_pop <= 0:
+                return
+            entries = [inflight.popleft() for _ in range(n_pop)]
+            tf = time.perf_counter()
+            outs = solver.fetch_many(entries)
+            t_fetch += time.perf_counter() - tf
+            for out in outs:
+                windows += len(out["solved"])
+                solved += int(out["solved"].sum())
+
+        for i in range(nb):
+            td = time.perf_counter()
+            inflight.append(solver.dispatch(_make_batch(data, i, BATCH,
+                                                        shape)))
+            t_disp += time.perf_counter() - td
+            if len(inflight) >= 8:
+                drain(4)
+        drain(0)
+        wall = time.perf_counter() - t0
+        wps = windows / wall if wall > 0 else 0.0
+        rungs.append({
+            "mesh": mesh_w, "batch": BATCH, "batches": nb,
+            "windows": windows, "solved": solved,
+            "wall_s": round(wall, 3),
+            # wall decomposition: host time spent issuing sharded dispatches
+            # vs blocked on the grouped fetch — the rest is overlap slack
+            "dispatch_s": round(t_disp, 3), "fetch_s": round(t_fetch, 3),
+            "windows_per_sec": round(wps, 1),
+            # per-device view: each device ran rows/mesh of every batch
+            "per_device_rows": BATCH // mesh_w,
+            "windows_per_sec_per_device": round(wps / mesh_w, 1),
+            "pad_to_mesh_rows": int(solver.pad_rows),
+            "pad_to_mesh_waste": round(
+                solver.pad_rows / max(solver.pad_rows + solver.live_rows, 1),
+                6),
+        })
+        ev.log("bench_rung", batch=BATCH,
+               bases_per_sec=0.0, fallback=bool(fallback_reason),
+               pad_waste=rungs[-1]["pad_to_mesh_waste"])
+    line = {
+        "metric": "multichip_windows_per_sec",
+        "mesh": nd, "batch": BATCH,
+        "device": str(jax.devices()[0]).replace(" ", ""),
+        "n_devices_visible": len(jax.devices()),
+        "fallback": bool(fallback_reason),
+        "fallback_reason": fallback_reason,
+        "rungs": rungs,
+        "ts": round(time.time(), 1),
+    }
+    if len(rungs) == 2 and rungs[0]["windows_per_sec"]:
+        # the headline: mesh-N throughput over single-device on this host.
+        # On forced host devices this is bounded by host cores (the rung
+        # exists for parity + plumbing provenance); the on-chip run of the
+        # same arm is the real scaling number.
+        line["scaling_vs_single"] = round(
+            rungs[1]["windows_per_sec"] / rungs[0]["windows_per_sec"], 3)
+    path = _next_multichip_path()
+    _commit_sidecar(path, line)
+    line["sidecar"] = os.path.basename(path)
+    return line
+
+
 def run_serve_bench(ev) -> dict:
     """Serving-plane stage (DACCORD_BENCH_SERVE=1): synth a toy corpus,
     start a REAL daccord-serve HTTP server in-process, replay a job-arrival
@@ -876,6 +1009,26 @@ def main() -> None:
             jax.config.update("jax_platforms", "cpu")
             fallback = "cpu-fallback (device init unreachable at bench time)"
             fallback_reason = reason
+    if BENCH_MESH:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if fallback and "xla_force_host_platform_device_count" not in flags:
+            # no live device and no forced host pool: re-exec under the
+            # off-pod recipe so the mesh rung still lands chip-free (the
+            # same pattern as the mid-run device-loss re-exec below)
+            import subprocess
+            import sys as _sys
+
+            env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=(
+                flags + " --xla_force_host_platform_device_count="
+                f"{BENCH_MESH_N}").strip())
+            if args.events:
+                env["DACCORD_BENCH_EVENTS"] = args.events + ".mesh"
+            r = subprocess.run([_sys.executable, os.path.abspath(__file__)],
+                               env=env)
+            raise SystemExit(r.returncode)
+        print(json.dumps(run_mesh_bench(data, ev, fallback_reason)))
+        ev.log("bench_done", wall_s=round(time.perf_counter() - t_main0, 3))
+        return
     if BENCH_PRECOMPILE:
         if fallback:
             line = {"precompile": True, "batch": BATCH, "skipped": True,

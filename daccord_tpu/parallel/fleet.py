@@ -227,6 +227,12 @@ class FleetConfig:
     ingest_policy: str = "strict"
     paged: str = "off"                # ragged paged window batching, forwarded
                                       # to every worker (see daccord --paged)
+    mesh: int = 0                     # each worker shards its batches over
+                                      # the first N local devices (forwarded
+                                      # as daccord-shard --mesh): one host,
+                                      # N chips is ONE worker — the capacity
+                                      # requeue and auto batch sizing scale
+                                      # by N (0/1 = single device)
     max_pile_overlaps: int | None = None  # monster-pile budget (None = the
                                           # pipeline default; 0 disables)
     worker_telemetry: bool = True     # thread per-worker telemetry sidecars
@@ -310,11 +316,15 @@ class Fleet:
     def _resolve_auto_batch(self) -> None:
         from ..utils.obs import auto_batch_size, resolve_auto_backend
 
+        mesh = self.cfg.mesh if self.cfg.mesh and self.cfg.mesh > 1 else 0
         try:
-            backend = resolve_auto_backend()
+            # mesh workers cannot run the native engine — resolve exactly
+            # as the worker CLI will (prefer_native=mesh<=1)
+            backend = resolve_auto_backend(prefer_native=not mesh)
         except Exception:
             backend = "cpu"
-        self._auto_batch = auto_batch_size(backend == "native", backend)
+        self._auto_batch = auto_batch_size(backend == "native", backend,
+                                           mesh=mesh)
 
     # -- worker process management ------------------------------------------
 
@@ -337,6 +347,11 @@ class Fleet:
             # daccord-shard's own --ledger default is 'auto': an opted-out
             # fleet must say so explicitly or workers write ledgers anyway
             argv += ["--ledger", "none"]
+        if cfg.mesh and cfg.mesh > 1:
+            # the worker drives a local device mesh (daccord --mesh model);
+            # plumbed like --max-pile-overlaps was in PR 5 — a fleet that
+            # cannot forward it would run every multi-chip host single-chip
+            argv += ["--mesh", str(cfg.mesh)]
         if cfg.max_pile_overlaps is not None:
             argv += ["--max-pile-overlaps", str(cfg.max_pile_overlaps)]
         # a capacity-requeued shard re-runs at its reduced batch (the env-
@@ -351,11 +366,13 @@ class Fleet:
     def _worker_batch(self) -> int:
         """The batch a worker actually runs: cfg.batch when -b was given,
         else the pipeline's auto-selection for this backend (native 4096;
-        JAX 2048 on TPU, 512 elsewhere). The capacity requeue halves THIS
-        number — halving a hardcoded guess instead would cut an auto-batch
-        native worker 16x, not 2x."""
+        JAX 2048 on TPU, 512 elsewhere; scaled by mesh width — one host, N
+        chips is one worker). The capacity requeue halves THIS number —
+        halving a hardcoded guess instead would cut an auto-batch native
+        worker 16x, not 2x."""
         from ..utils.obs import auto_batch_size
 
+        mesh = self.cfg.mesh if self.cfg.mesh and self.cfg.mesh > 1 else 0
         if self.cfg.batch:
             return self.cfg.batch
         if self.cfg.backend == "auto":
@@ -365,8 +382,9 @@ class Fleet:
             # long done and this join is instant
             if self._auto_batch_thread is not None:
                 self._auto_batch_thread.join()
-            return self._auto_batch or auto_batch_size(False)
-        return auto_batch_size(self.cfg.backend == "native", self.cfg.backend)
+            return self._auto_batch or auto_batch_size(False, mesh=mesh)
+        return auto_batch_size(self.cfg.backend == "native", self.cfg.backend,
+                               mesh=mesh)
 
     def _worker_env(self, sabotage: str | None) -> dict:
         env = dict(os.environ)

@@ -10,7 +10,25 @@ zero-communication design (SURVEY.md §5 "Distributed communication backend").
 The full escalation ladder (tier 0 + device-compacted rescue tiers, see
 ``kernels.tiers.ladder_core``) runs INSIDE shard_map: each device solves and
 escalates its own slice, so one sharded batch costs one dispatch and one
-fetch regardless of mesh size.
+fetch regardless of mesh size. The solver speaks every wire format the
+single-device path does:
+
+- dense ``WindowBatch`` (the r1-r8 format);
+- the ragged paged format (``kernels/paging.py``): the page TABLE shards on
+  the batch axis while the page POOL replicates — per-device gather indices
+  are global pool-page ids, so each shard gathers its own dense tile from
+  the replicated pool inside the same jitted program (Ragged Paged
+  Attention's per-device-gather argument, PAPERS.md arxiv 2604.15464);
+- the two-stream split ladder (``routes_streams``): a ``stream='tier0'``
+  batch dispatches the sharded tier0-only program, everything else the full
+  sharded ladder — the same routing rule as ``kernels.tiers
+  .stream_dispatcher``, so ``:t0`` and ``:m<N>`` compile keys compose.
+
+**Partial-mesh degradation** (runtime/supervisor.py): :meth:`shrink` halves
+the device set N → N/2 → … → 1 and the supervisor re-dispatches retained
+batches on the smaller mesh instead of failing over whole-program — byte-
+identical by per-window independence (re-sharding a window cannot change its
+bytes). :meth:`restore` rebuilds the full mesh on failback.
 
 Multi-host scale-out composes this with host-side LAS byte-range sharding
 (``formats.las.shard_ranges``): every process corrects its own aread range on
@@ -27,7 +45,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..kernels.tensorize import WindowBatch, pad_batch
-from ..kernels.tiers import TierLadder, ladder_core
+from ..kernels.tiers import TierLadder, ladder_core, tier0_core
 from ..kernels.window_kernel import KernelParams
 
 
@@ -40,20 +58,39 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), axis_names=("d",))
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("params", "esc_cap", "mesh", "use_pallas",
-                                    "pallas_interpret", "wide_p0"))
-def _ladder_sharded(seqs, lens, nsegs, tables, params, esc_cap, mesh,
-                    use_pallas=False, pallas_interpret=False, wide_p0=None):
+#: the off-pod recipe every mesh entry point names on a device-count failure
+OFF_POD_RECIPE = ("off-pod: set JAX_PLATFORMS=cpu and "
+                  "XLA_FLAGS=--xla_force_host_platform_device_count=N")
+
+
+def check_mesh_devices(n_devices: int) -> None:
+    """Raise ``SystemExit`` with the off-pod recipe when fewer than
+    ``n_devices`` devices are visible — the one device-count gate shared by
+    the CLI, the pipeline's in-run construction, and the serve group."""
+    if len(jax.devices()) < n_devices:
+        raise SystemExit(
+            f"mesh {n_devices}: only {len(jax.devices())} devices visible "
+            f"({OFF_POD_RECIPE})")
+
+
+def _vma_kw(use_pallas: bool) -> tuple:
     # pallas_call's out_shape carries no varying-axes info, so the vma check
     # must be off when the ladder routes its DP through the Pallas kernel
     # (the pre-0.8 fallback spells the same knob check_rep)
     try:
         from jax import shard_map  # jax >= 0.8
-        vma_kw = {"check_vma": not use_pallas}
+        return shard_map, {"check_vma": not use_pallas}
     except ImportError:  # pragma: no cover - older jax
         from jax.experimental.shard_map import shard_map
-        vma_kw = {"check_rep": not use_pallas}
+        return shard_map, {"check_rep": not use_pallas}
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("params", "esc_cap", "mesh", "use_pallas",
+                                    "pallas_interpret", "wide_p0"))
+def _ladder_sharded(seqs, lens, nsegs, tables, params, esc_cap, mesh,
+                    use_pallas=False, pallas_interpret=False, wide_p0=None):
+    shard_map, vma_kw = _vma_kw(use_pallas)
 
     def local(seqs, lens, nsegs, tables):
         out = ladder_core(seqs, lens, nsegs, tables, params, esc_cap,
@@ -86,24 +123,195 @@ def _ladder_sharded_packed(seqs, lens, nsegs, tables, params, esc_cap, mesh,
         pallas_interpret, wide_p0))
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("p0", "mesh", "use_pallas",
+                                    "pallas_interpret"))
+def _tier0_sharded_packed(seqs, lens, nsegs, table0, p0, mesh,
+                          use_pallas=False, pallas_interpret=False):
+    """Stream A of the two-stream ladder, sharded: each device runs the
+    cheap tier0-only program over its own slice (the ``:t0`` compile, now at
+    a ``:m<N>`` key). No collective at all — tier0 has no overflow counter."""
+    from ..kernels.tiers import pack_result
+
+    shard_map, vma_kw = _vma_kw(use_pallas)
+
+    def local(seqs, lens, nsegs, table0):
+        return tier0_core(seqs, lens, nsegs, table0, p0, use_pallas,
+                          pallas_interpret)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P("d"), P("d"), P("d"), P()),
+                   out_specs={"cons": P("d"), "cons_len": P("d"), "err": P("d"),
+                              "solved": P("d"), "tier": P("d"), "m_ovf": P("d"),
+                              "esc_overflow": P()},
+                   **vma_kw)
+    return pack_result(fn(seqs, lens, nsegs, table0))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("params", "esc_cap", "mesh", "page_len",
+                                    "seg_len", "use_pallas",
+                                    "pallas_interpret", "wide_p0"))
+def _ladder_sharded_paged_packed(pool, table, lens, nsegs, tables, params,
+                                 esc_cap, mesh, page_len, seg_len,
+                                 use_pallas=False, pallas_interpret=False,
+                                 wide_p0=None):
+    """Paged wire format through shard_map: the page table (and lens/nsegs)
+    shard on the batch axis, the page pool replicates, and each device's
+    gather reconstructs its own dense tile from the replicated pool —
+    table entries are global pool-page ids, so no offset rebasing is needed.
+    The full ladder then runs per shard exactly as in the dense program."""
+    from ..kernels.paging import gather_windows
+    from ..kernels.tiers import pack_result
+
+    shard_map, vma_kw = _vma_kw(use_pallas)
+
+    def local(pool, table, lens, nsegs, tables):
+        seqs = gather_windows(pool, table, lens, page_len=page_len,
+                              seg_len=seg_len, use_pallas=use_pallas,
+                              interpret=pallas_interpret)
+        out = ladder_core(seqs, lens, nsegs, tables, params, esc_cap,
+                          use_pallas, pallas_interpret, wide_p0)
+        out["esc_overflow"] = jax.lax.psum(out["esc_overflow"], "d")
+        return out
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(), P("d"), P("d"), P("d"), P()),
+                   out_specs={"cons": P("d"), "cons_len": P("d"), "err": P("d"),
+                              "solved": P("d"), "tier": P("d"), "m_ovf": P("d"),
+                              "esc_overflow": P()},
+                   **vma_kw)
+    return pack_result(fn(pool, table, lens, nsegs, tables))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("p0", "mesh", "page_len", "seg_len",
+                                    "use_pallas", "pallas_interpret"))
+def _tier0_sharded_paged_packed(pool, table, lens, nsegs, table0, p0, mesh,
+                                page_len, seg_len, use_pallas=False,
+                                pallas_interpret=False):
+    from ..kernels.paging import gather_windows
+    from ..kernels.tiers import pack_result
+
+    shard_map, vma_kw = _vma_kw(use_pallas)
+
+    def local(pool, table, lens, nsegs, table0):
+        seqs = gather_windows(pool, table, lens, page_len=page_len,
+                              seg_len=seg_len, use_pallas=use_pallas,
+                              interpret=pallas_interpret)
+        return tier0_core(seqs, lens, nsegs, table0, p0, use_pallas,
+                          pallas_interpret)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(), P("d"), P("d"), P("d"), P()),
+                   out_specs={"cons": P("d"), "cons_len": P("d"), "err": P("d"),
+                              "solved": P("d"), "tier": P("d"), "m_ovf": P("d"),
+                              "esc_overflow": P()},
+                   **vma_kw)
+    return pack_result(fn(pool, table, lens, nsegs, table0))
+
+
 class ShardedLadderSolver:
     """Async mesh solver: ``dispatch`` returns a non-blocking handle,
     ``fetch`` materializes it (single packed-array transfer, like the
     single-device path in ``kernels.tiers``). Calling the object directly is
-    the blocking convenience form used by tests and the dry run."""
+    the blocking convenience form used by tests and the dry run.
+
+    Supervisor contract (runtime/supervisor.py): ``nd``/``shrink``/
+    ``restore`` drive the partial-mesh degradation rung and the dynamic
+    ``:m<N>`` shape-key suffix; ``routes_streams``/``supports_paged`` opt the
+    pipeline's split-ladder and paged machinery in.
+    """
+
+    #: a stream='tier0' batch dispatches the sharded tier0-only program —
+    #: the pipeline's split-ladder machinery may run against this solver
+    routes_streams = True
+    #: paged batches dispatch the table-sharded/pool-replicated program —
+    #: the pipeline's paged router may run against this solver
+    supports_paged = True
 
     def __init__(self, ladder: TierLadder, mesh: Mesh, esc_cap: int | None = None,
-                 use_pallas: bool = False, pallas_interpret: bool = False):
+                 use_pallas: bool = False, pallas_interpret: bool = False,
+                 batch: int | None = None):
+        self.ladder = ladder
         self.mesh = mesh
         self.nd = mesh.devices.size
+        # full-mesh device list, retained for restore() after a failback
+        self._devices0 = list(mesh.devices.flat)
         self.sharding = NamedSharding(mesh, P("d"))
+        self.replicated = NamedSharding(mesh, P())
         self.tables = tuple(ladder.tables[p.k] for p in ladder.params)
         self.params = tuple(ladder.params)
         self.wide_p0 = ladder.wide_p0
-        self.esc_cap = esc_cap   # None = full per-device slice (no overflow)
+        # per-device escalation capacity. Explicit esc_cap wins; the default
+        # resolves ONCE from the configured batch (first dispatch when no
+        # batch was configured) instead of per dispatch — the old
+        # ``target // nd`` default made the capacity a function of batch
+        # width, so every distinct batch size (governor bisect rungs, final
+        # partial flushes) compiled a fresh mesh program. A fixed cap >= the
+        # per-device slice keeps overflow structurally impossible (narrower
+        # governor-shrunk batches reuse the same cap; the jnp.nonzero rescue
+        # compaction tolerates cap > slice).
+        self.esc_cap = esc_cap       # explicit per-device cap (None = auto)
+        self.batch = batch           # configured dispatch width (None = lazy)
+        self._cap_base = batch       # width the auto cap derives from
+        self._auto_cap: int | None = None
         self.use_pallas = use_pallas
         self.pallas_interpret = pallas_interpret
         self.cl = ladder.params[0].cons_len
+        # pad-to-mesh-multiple accounting (rows added so B divides nd) —
+        # the MULTICHIP bench sidecar's waste metric
+        self.pad_rows = 0
+        self.live_rows = 0
+
+    # ---- partial-mesh degradation (supervisor hooks) --------------------
+
+    @property
+    def host_local(self) -> bool:
+        """True when every mesh device is a host CPU device (forced host
+        platform count): the supervisor then runs inline — a local shard_map
+        cannot hang the way a tunnel can."""
+        return all(d.platform == "cpu" for d in self._devices0)
+
+    def _rebuild(self, devices) -> None:
+        self.mesh = Mesh(np.asarray(devices), axis_names=("d",))
+        self.nd = self.mesh.devices.size
+        self.sharding = NamedSharding(self.mesh, P("d"))
+        self.replicated = NamedSharding(self.mesh, P())
+        if self.esc_cap is None and self._cap_base is not None:
+            # keep overflow structurally impossible on the new (wider)
+            # per-device slice: the cap follows the slice width
+            self._auto_cap = max(-(-int(self._cap_base) // self.nd), 1)
+
+    def shrink(self) -> bool:
+        """Partial-mesh degradation rung: halve the device set (keep the
+        first half — which member died is unknowable from a whole-program
+        abort, so the policy is deterministic; a survivor set containing the
+        dead device just shrinks again on the next loss). Returns False at
+        mesh width 1 — the supervisor then falls through to whole-program
+        failover."""
+        if self.nd <= 1:
+            return False
+        self._rebuild(list(self.mesh.devices.flat)[: self.nd // 2])
+        return True
+
+    def restore(self) -> None:
+        """Rebuild the full construction-time mesh (supervisor failback:
+        the revived device pool re-enters, and every shape recompiles under
+        its original ``:m<N>`` key)."""
+        self._rebuild(self._devices0)
+
+    def _esc_cap_for(self, target: int) -> int:
+        if self.esc_cap is not None:
+            return self.esc_cap
+        if self._auto_cap is None:
+            self._cap_base = self._cap_base or target
+            self._auto_cap = max(-(-int(self._cap_base) // self.nd), 1)
+        # safety: a batch wider than the configured base still must not
+        # overflow (cap >= per-device slice keeps it structurally impossible)
+        return max(self._auto_cap, -(-target // self.nd))
+
+    # ---- dispatch / fetch ----------------------------------------------
 
     def dispatch(self, batch: WindowBatch):
         from ..kernels.tiers import _PackedHandle
@@ -111,14 +319,40 @@ class ShardedLadderSolver:
         B0 = batch.size
         target = ((B0 + self.nd - 1) // self.nd) * self.nd
         batch = pad_batch(batch, target) if target != B0 else batch
-        esc_cap = self.esc_cap if self.esc_cap is not None else target // self.nd
-        arr = _ladder_sharded_packed(
-            jax.device_put(jnp.asarray(batch.seqs), self.sharding),
-            jax.device_put(jnp.asarray(batch.lens), self.sharding),
-            jax.device_put(jnp.asarray(batch.nsegs), self.sharding),
-            self.tables, params=self.params, esc_cap=esc_cap,
-            mesh=self.mesh, use_pallas=self.use_pallas,
-            pallas_interpret=self.pallas_interpret, wide_p0=self.wide_p0)
+        self.pad_rows += target - B0
+        self.live_rows += B0
+        tier0 = getattr(batch, "stream", "full") == "tier0"
+        put = lambda a: jax.device_put(jnp.asarray(a), self.sharding)
+        if getattr(batch, "pool", None) is not None:
+            # paged wire format: table/lens/nsegs shard, the pool replicates
+            pool = jax.device_put(jnp.asarray(batch.pool), self.replicated)
+            args = (pool, put(batch.table), put(batch.lens), put(batch.nsegs))
+            pl, sl = batch.family.page_len, batch.shape.seg_len
+            if tier0:
+                arr = _tier0_sharded_paged_packed(
+                    *args, self.tables[0], p0=self.params[0], mesh=self.mesh,
+                    page_len=pl, seg_len=sl, use_pallas=self.use_pallas,
+                    pallas_interpret=self.pallas_interpret)
+            else:
+                arr = _ladder_sharded_paged_packed(
+                    *args, self.tables, params=self.params,
+                    esc_cap=self._esc_cap_for(target), mesh=self.mesh,
+                    page_len=pl, seg_len=sl, use_pallas=self.use_pallas,
+                    pallas_interpret=self.pallas_interpret,
+                    wide_p0=self.wide_p0)
+            return (_PackedHandle(arr, self.cl), B0)
+        args = (put(batch.seqs), put(batch.lens), put(batch.nsegs))
+        if tier0:
+            arr = _tier0_sharded_packed(
+                *args, self.tables[0], p0=self.params[0], mesh=self.mesh,
+                use_pallas=self.use_pallas,
+                pallas_interpret=self.pallas_interpret)
+        else:
+            arr = _ladder_sharded_packed(
+                *args, self.tables, params=self.params,
+                esc_cap=self._esc_cap_for(target), mesh=self.mesh,
+                use_pallas=self.use_pallas,
+                pallas_interpret=self.pallas_interpret, wide_p0=self.wide_p0)
         return (_PackedHandle(arr, self.cl), B0)
 
     @staticmethod
@@ -150,13 +384,17 @@ class ShardedLadderSolver:
 
 
 def make_sharded_solver(ladder: TierLadder, mesh: Mesh, esc_cap: int | None = None,
-                        use_pallas: bool = False, pallas_interpret: bool = False):
+                        use_pallas: bool = False, pallas_interpret: bool = False,
+                        batch: int | None = None):
     """WindowBatch -> results dict, the full ladder sharded over the mesh.
 
-    ``esc_cap`` is the per-device escalation capacity. A drop-in ``solver``
-    for ``runtime.pipeline.correct_shard`` (which detects the async
-    ``dispatch``/``fetch`` interface and pipelines batches through it)."""
-    return ShardedLadderSolver(ladder, mesh, esc_cap, use_pallas, pallas_interpret)
+    ``esc_cap`` is an explicit per-device escalation capacity (None = auto:
+    resolved once from ``batch``, the configured dispatch width). A drop-in
+    ``solver`` for ``runtime.pipeline.correct_shard`` (which detects the
+    async ``dispatch``/``fetch`` interface and pipelines batches through
+    it); ``PipelineConfig.mesh`` builds it in-pipeline."""
+    return ShardedLadderSolver(ladder, mesh, esc_cap, use_pallas,
+                               pallas_interpret, batch=batch)
 
 
 def build_sharded_solver(n_devices: int, profile, consensus_cfg,
@@ -164,17 +402,15 @@ def build_sharded_solver(n_devices: int, profile, consensus_cfg,
                          use_pallas: bool = False,
                          max_kmers: int = 64,
                          rescue_max_kmers: int = 256,
-                         overflow_rescue: bool = False) -> ShardedLadderSolver:
+                         overflow_rescue: bool = False,
+                         batch: int | None = None) -> ShardedLadderSolver:
     """Device-count-checked mesh solver from an error profile.
 
-    The one construction path shared by the ``daccord --mesh`` CLI and the
-    ladder bench; raises ``SystemExit`` with the off-pod recipe when fewer
-    than ``n_devices`` devices are visible."""
-    if len(jax.devices()) < n_devices:
-        raise SystemExit(
-            f"mesh {n_devices}: only {len(jax.devices())} devices visible "
-            "(off-pod: set JAX_PLATFORMS=cpu and "
-            "XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    Standalone construction (bench/tests); the pipeline builds from its own
+    TierLadder instead (``PipelineConfig.mesh``) so the OffsetLikely tables
+    are not constructed twice. Raises ``SystemExit`` with the off-pod recipe
+    when fewer than ``n_devices`` devices are visible."""
+    check_mesh_devices(n_devices)
     from ..kernels.window_kernel import pallas_needs_interpret
 
     ladder = TierLadder.from_config(profile, consensus_cfg,
@@ -183,4 +419,5 @@ def build_sharded_solver(n_devices: int, profile, consensus_cfg,
                                     overflow_rescue=overflow_rescue)
     interpret = use_pallas and pallas_needs_interpret()
     return make_sharded_solver(ladder, make_mesh(n_devices), esc_cap,
-                               use_pallas=use_pallas, pallas_interpret=interpret)
+                               use_pallas=use_pallas,
+                               pallas_interpret=interpret, batch=batch)

@@ -259,11 +259,18 @@ class CapacityGovernor:
 
     def __init__(self, solve_width_fn, *, log=None,
                  cfg: GovernorConfig | None = None, clamp_solve_fn=None,
-                 tracer=None):
+                 tracer=None, quantum_fn=None):
         from ..utils.obs import NullLogger, Tracer
 
         self._solve = solve_width_fn
         self._clamp = clamp_solve_fn
+        # mesh-aware bisect (parallel/mesh.py): ``quantum_fn() -> N`` makes
+        # every rung width a multiple of the mesh width and scales the floor
+        # per device (min_width rows PER DEVICE, not per batch) — the OOM is
+        # a per-device-slice property, and a non-multiple width would just
+        # pad back up to one inside the solver. Callable because the
+        # partial-mesh rung changes N mid-run.
+        self._quantum_fn = quantum_fn
         self.cfg = cfg or GovernorConfig.from_env()
         self.log = log if log is not None else NullLogger()
         # governor-rung trace spans (ISSUE 6): each ladder-rung chunk solve
@@ -349,14 +356,20 @@ class CapacityGovernor:
         died mid-walk — a different failure class)."""
         self._ensure_loaded()
         B = int(batch.size)
-        floor = max(1, min(self.cfg.min_width, B))
+        q = max(1, int(self._quantum_fn())) if self._quantum_fn else 1
+
+        def _q_up(w: int) -> int:
+            # round a proposed width up to a mesh multiple (never above B)
+            return min(-(-w // q) * q, B)
+
+        floor = max(1, min(self.cfg.min_width * q, B))
         clamped = key in self._clamped
         if reason is not None:
             self.counters["classify"] += 1
             self.log.log("governor.classify", key=key, width=B,
                          reason=str(reason)[:200])
             width = self.ratchet.get(key, B)
-            proposed = max(B // 2, floor)
+            proposed = _q_up(max(B // 2, floor))
             if proposed < B:
                 width = min(width, proposed)
                 if width < B:
@@ -409,7 +422,7 @@ class CapacityGovernor:
             except CapacityError as e:
                 self.tracer.close(rung_sp, status="capacity")
                 if not clamped and width > floor:
-                    new = max(width // 2, floor)
+                    new = _q_up(max(width // 2, floor))
                     self.counters["shrink"] += 1
                     self.log.log("governor.shrink", key=key,
                                  width_from=int(width), width_to=int(new))

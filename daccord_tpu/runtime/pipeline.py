@@ -125,8 +125,10 @@ class PipelineConfig:
                                  # to fused by per-window independence,
                                  # tests/test_split_ladder.py). Applies to
                                  # the JAX ladder paths only; the native
-                                 # engine escalates per-window on host and
-                                 # mesh solvers bring their own programs
+                                 # engine escalates per-window on host. The
+                                 # mesh solver routes streams itself
+                                 # (sharded tier0 + sharded full ladder), so
+                                 # split and --mesh compose
     rescue_flush_reads: int = 128    # split mode: flush a partial rescue pool
                                  # once its oldest row has waited this many
                                  # reads (the bucket_flush_reads rule applied
@@ -140,6 +142,24 @@ class PipelineConfig:
                                  # Subsumed (with depth_buckets) by the paged
                                  # router's auto-derived shape families when
                                  # --paged is active
+    mesh: int = 0                # shard window batches over the first N
+                                 # local devices (parallel/mesh.py): the
+                                 # full escalation ladder runs inside
+                                 # shard_map, so one sharded batch costs one
+                                 # dispatch + one fetch regardless of mesh
+                                 # size. First-class: the sharded solver is
+                                 # built in-pipeline from the run's own
+                                 # TierLadder, carries real supervisor
+                                 # identity (:m<N> compile keys, watchdog,
+                                 # retries, partial-mesh degradation before
+                                 # whole-program failover), per-device
+                                 # governor capacity handling, and composes
+                                 # with --paged and --ladder split. 0/1 =
+                                 # single device; ignored (with a log line)
+                                 # by the native engine and injected custom
+                                 # solvers. Off-pod verification recipe:
+                                 # JAX_PLATFORMS=cpu XLA_FLAGS=
+                                 # --xla_force_host_platform_device_count=N
     paged: str = "off"           # ragged paged window batching
                                  # (kernels/paging.py, ISSUE 7): 'on' ships
                                  # batches as a page pool + page table bucketed
@@ -153,8 +173,9 @@ class PipelineConfig:
                                  # decision row lands, BASELINE.md) keeps the
                                  # dense wire format. JAX ladder paths only —
                                  # the native engine iterates dense rows on
-                                 # host and a custom (mesh) solver brings its
-                                 # own programs
+                                 # host. The mesh solver shards the page
+                                 # table and replicates the pool, so paged
+                                 # and --mesh compose
     page_len: int = 16           # paged page length in bases (must divide
                                  # seg_len); segments are page-aligned, so
                                  # rounding waste averages page_len/2 per
@@ -969,6 +990,20 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             # correct_shard's finally closes the telemetry bundle: a driver
             # loop retrying corrupt shards must not leak two fds per abort
             raise report.error()
+    # mesh intent resolved early: a custom/injected solver brings its own
+    # programs and the native engine solves on host — both ignore cfg.mesh
+    # (log, not raise: an auto-resolved native backend must keep working)
+    mesh_n = cfg.mesh if cfg.mesh and cfg.mesh > 1 else 0
+    if mesh_n and (solver is not None or cfg.native_solver):
+        log.log("info", msg=f"mesh={mesh_n} inapplicable here (native "
+                            "engine or custom solver); running single-device")
+        mesh_n = 0
+    if mesh_n:
+        # fail fast — BEFORE the alignment-heavy profile pass — with the
+        # off-pod recipe when the device pool is too small
+        from ..parallel.mesh import check_mesh_devices
+
+        check_mesh_devices(mesh_n)
     if cfg.batch_size is None:
         import dataclasses
 
@@ -979,13 +1014,16 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         else:
             import jax
 
+            # one host, N chips = one worker: the auto batch scales by mesh
+            # size so each device's slice keeps the single-device width
             cfg = dataclasses.replace(cfg, batch_size=auto_batch_size(
-                False, jax.default_backend()))
+                False, jax.default_backend(), mesh=mesh_n))
     # paged intent resolved BEFORE the profile pass so family derivation can
     # reuse the pass's window sample (one alignment-heavy sampling pass, not
     # two); the authoritative paged_on below uses identical conditions
-    paged_want = (cfg.paged in ("on", "auto")
-                  and solver is None and not cfg.native_solver)
+    paged_want = (cfg.paged in ("on", "auto") and not cfg.native_solver
+                  and (solver is None
+                       or getattr(solver, "supports_paged", False)))
     if paged_want and cfg.paged == "auto":
         import jax
 
@@ -1020,6 +1058,26 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                             max_kmers=cfg.max_kmers,
                                             rescue_max_kmers=cfg.rescue_max_kmers,
                                             overflow_rescue=cfg.overflow_rescue)
+    # mesh-native solve path (parallel/mesh.py): build the sharded solver
+    # from the run's OWN TierLadder (no second OffsetLikely construction),
+    # so mesh batches flow through the same supervisor/governor/paging/split
+    # machinery as single-device ones — it is the default multi-chip path,
+    # not a side-door solver
+    mesh_solver = None
+    mesh_interp = False
+    if mesh_n and ladder is not None:
+        from ..kernels.window_kernel import pallas_needs_interpret
+        from ..parallel.mesh import make_mesh, make_sharded_solver
+
+        mesh_interp = cfg.use_pallas and pallas_needs_interpret()
+        with tracer.span("mesh.build"):
+            mesh_solver = make_sharded_solver(
+                ladder, make_mesh(mesh_n), use_pallas=cfg.use_pallas,
+                pallas_interpret=mesh_interp, batch=cfg.batch_size)
+        solver = mesh_solver
+        ev_log.log("mesh.init", nd=int(mesh_solver.nd),
+                   devices=mesh_solver.describe(),
+                   esc_cap=int(mesh_solver._esc_cap_for(cfg.batch_size)))
     fetch_many_fn = None
     native_dispatch = solver is None and cfg.native_solver
     # both votes AND both acceptance objectives are implemented in the C++
@@ -1050,12 +1108,13 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
         solver = _native_solver
     # two-stream ladder (ISSUE 4): the local JAX ladder paths split — the
-    # native engine already escalates per-window on host, and a custom
-    # solver (mesh) brings its own programs. Exception (ISSUE 10): an
-    # injected solver that declares ``routes_streams`` (the serving plane's
-    # cross-job batcher) understands the stream tags — it pools tier0 and
-    # rescue rows separately and routes each merged batch to the right
-    # program — so the split machinery runs for it too.
+    # native engine already escalates per-window on host, and an opaque
+    # custom solver brings its own programs. A solver that declares
+    # ``routes_streams`` understands the stream tags and routes each batch
+    # to the right program, so the split machinery runs for it too: the
+    # serving plane's cross-job batcher (ISSUE 10) pools tier0 and rescue
+    # rows separately, and the mesh solver dispatches the sharded tier0 /
+    # full-ladder program per tag (:t0 and :m<N> compile keys compose).
     split_ladder = (cfg.ladder_mode == "split"
                     and ((solver is None and not native_dispatch)
                          or getattr(solver, "routes_streams", False)))
@@ -1077,7 +1136,9 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     if cfg.paged not in ("off", "on", "auto"):
         raise SystemExit(f"--paged {cfg.paged!r}: expected on|off|auto")
     if cfg.paged != "off":
-        if solver is not None or native_dispatch:
+        if (solver is not None
+                and not getattr(solver, "supports_paged", False)) \
+                or native_dispatch:
             log.log("info", msg=f"paged={cfg.paged} inapplicable here "
                                 "(native engine or custom solver); "
                                 "running dense")
@@ -1121,6 +1182,13 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             fetch_many_fn = getattr(solver, "fetch_many", None)
         else:
             dispatch_fn, fetch_fn = solver, (lambda h: h)
+        if mesh_solver is not None:
+            # the mesh gets the full governor ladder: its clamp rung is the
+            # single-device clamped program + host completion — byte-
+            # identical by per-window independence, and a rung narrower
+            # than one mesh slice has no sharded form anyway
+            clamp_solve = _make_clamp_solve(ladder, cfg.use_pallas,
+                                            mesh_interp, gov_cfg.esc_clamp)
     else:
         import jax
 
@@ -1188,6 +1256,14 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             if solver is not None:
                 d = getattr(solver, "describe", None)
                 desc = d() if callable(d) else type(solver).__name__
+                # a host-local mesh (forced host platform count) cannot
+                # hang the way a tunnel can: run the supervisor inline,
+                # same rule as the single-device cpu ladder below
+                inline = bool(getattr(solver, "host_local", False))
+                if mesh_solver is not None and not inline:
+                    from ..utils.obs import measure_rtt_s
+
+                    rtt_s = measure_rtt_s()
             else:
                 import jax
 
@@ -1257,7 +1333,8 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 **({"failback": True} if cfg.failback else {})),
             faults=plan, rtt_s=rtt_s, describe=desc,
             fingerprint_prefix=fp_prefix, inline=inline,
-            clamp_solve=clamp_solve, governor_cfg=gov_cfg, tracer=tracer)
+            clamp_solve=clamp_solve, governor_cfg=gov_cfg, tracer=tracer,
+            mesh=mesh_solver)
         dispatch_fn, fetch_fn = sup.dispatch, sup.fetch
         if fetch_many_fn is not None:
             fetch_many_fn = sup.fetch_many

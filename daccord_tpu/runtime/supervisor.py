@@ -81,7 +81,10 @@ TRANSITIONS = {
     HEALTHY: {COMPILING, SUSPECT},
     COMPILING: {HEALTHY, SUSPECT},
     SUSPECT: {RETRYING, LOST, HEALTHY},
-    RETRYING: {HEALTHY, SUSPECT, LOST},
+    # a partial-mesh retry (mesh N -> N/2, runtime/supervisor.py
+    # _mesh_degrade) re-dispatches at a fresh :m<N/2> shape key — a cold
+    # compile — so COMPILING is reachable from RETRYING like from FAILBACK
+    RETRYING: {HEALTHY, COMPILING, SUSPECT, LOST},
     LOST: {DEGRADED},
     DEGRADED: {FAILBACK},
     # a failback re-compiles every bucket shape (the revived device has no
@@ -227,7 +230,7 @@ class DeviceSupervisor:
                  rtt_s: float | None = None, describe: str = "",
                  fingerprint_prefix: str = "", inline: bool = False,
                  clamp_solve=None, governor_cfg: GovernorConfig | None = None,
-                 tracer=None):
+                 tracer=None, mesh=None):
         import random
 
         from ..utils.obs import NullLogger, Tracer
@@ -261,7 +264,7 @@ class DeviceSupervisor:
         self.fail_reason: str | None = None
         self.counters = {"dispatch": 0, "fetch": 0, "retries": 0,
                          "timeouts": 0, "probes": 0, "degraded_solves": 0,
-                         "heartbeats": 0}
+                         "heartbeats": 0, "mesh_shrinks": 0}
         # host-blocking wall spent inside governor ladder solves (they run
         # synchronously at dispatch time, so the pipeline's fetch timer
         # never sees them) — folded into stats.device_s at shard end
@@ -275,11 +278,25 @@ class DeviceSupervisor:
         # supervisors get their own over the same log (span ids are
         # process-unique, so mixing tracers on one file is safe)
         self.tracer = tracer if tracer is not None else Tracer(self.log)
+        # mesh-native solve path (parallel/mesh.py): ``mesh`` is the sharded
+        # solver itself (``nd``/``shrink``/``restore``). It gives mesh
+        # programs real supervisor identity — a dynamic ``:m<N>`` shape-key
+        # suffix so mesh compiles classify/fingerprint/ratchet separately —
+        # and a partial-mesh degradation rung: on declared device loss the
+        # mesh shrinks N -> N/2 -> ... -> 1 (re-pad + re-dispatch the
+        # retained batch, byte-identical by per-window independence) BEFORE
+        # whole-program native/CPU failover.
+        self._mesh = mesh
         self.governor = CapacityGovernor(
             self._gov_solve_width, log=self.log,
             cfg=governor_cfg or GovernorConfig.from_env(),
             clamp_solve_fn=self._gov_clamp if clamp_solve is not None else None,
-            tracer=self.tracer)
+            tracer=self.tracer,
+            # capacity bisect operates on the PER-DEVICE slice: widths stay
+            # mesh multiples and the floor scales by mesh size, so one
+            # chip's HBM ceiling shrinks every device's slice in lockstep
+            # instead of collapsing the whole mesh to the scalar floor
+            quantum_fn=(lambda: self._mesh.nd) if mesh is not None else None)
         if rtt_s:
             self.op_deadline_s = max(self.cfg.min_op_deadline_s,
                                      rtt_s * self.cfg.rtt_mult)
@@ -321,6 +338,15 @@ class DeviceSupervisor:
                      wall_s=round(time.time() - t0, 3))
         return alive
 
+    def _mesh_suffix(self) -> str:
+        """Dynamic ``:m<N>`` compile-key suffix for mesh dispatches: a mesh
+        program is a different XLA program from the single-device one at the
+        same batch shape (and from the same mesh at a different width), so
+        it must classify/fingerprint/ratchet separately — composes with
+        ``:t0`` and ``:pg``. Dynamic because the partial-mesh rung changes N
+        mid-run; post-shrink shapes are cold again."""
+        return f":m{self._mesh.nd}" if self._mesh is not None else ""
+
     def _shape_key(self, batch) -> str:
         if getattr(batch, "pool", None) is not None:
             # paged wire format (kernels/paging.py): pool rows + table width
@@ -335,10 +361,10 @@ class DeviceSupervisor:
                    f"xN{batch.pool.shape[0]}:pg")
             if getattr(batch, "stream", "full") == "tier0":
                 key += ":t0"
-            return key
+            return key + self._mesh_suffix()
         seqs = getattr(batch, "seqs", None)
         if seqs is None:
-            return self._fp_prefix + "opaque"
+            return self._fp_prefix + "opaque" + self._mesh_suffix()
         b, d, l = seqs.shape
         key = f"{self._fp_prefix}B{b}xD{d}xL{l}"
         # the two-stream ladder dispatches TWO distinct programs at the same
@@ -349,7 +375,7 @@ class DeviceSupervisor:
         # its long deadline and heartbeats.
         if getattr(batch, "stream", "full") == "tier0":
             key += ":t0"
-        return key
+        return key + self._mesh_suffix()
 
     def _is_fresh(self, key: str) -> bool:
         """Cold-compile classification: not yet dispatched this process AND
@@ -546,7 +572,47 @@ class DeviceSupervisor:
         self._transition(FAILBACK, reason="re-probe alive")
         self._seen_shapes.clear()
         self._ignore_fp_registry = True
+        if self._mesh is not None and self._mesh.nd < len(
+                getattr(self._mesh, "_devices0", [])):
+            # the revived device pool re-enters whole: the shrunken mesh
+            # rebuilds at full width (every shape recompiles under the
+            # original :m<N> key — _seen_shapes was just cleared)
+            nd_from = self._mesh.nd
+            self._mesh.restore()
+            self.log.log("mesh.restore", nd_from=nd_from, nd_to=self._mesh.nd)
         self.log.log("sup_failback")
+        return True
+
+    # ---- partial-mesh degradation rung ----------------------------------
+
+    def _mesh_degrade(self, reason: str) -> bool:
+        """On declared device loss with a mesh primary: shrink the mesh
+        N -> N/2 and keep the run on the (smaller) primary — the retained
+        batch re-pads and re-dispatches, byte-identical by per-window
+        independence — instead of failing over whole-program. Returns False
+        when no smaller mesh exists (width 1): the caller then engages the
+        native/CPU fallback as before. Walks SUSPECT -> RETRYING, the same
+        legal chain a transient retry uses."""
+        m = self._mesh
+        if m is None:
+            return False
+        if m.nd <= 1:
+            self.log.log("mesh.degrade", nd=int(m.nd), reason=reason[:200])
+            return False
+        nd_from = m.nd
+        m.shrink()
+        if self.faults is not None:
+            # an injected device_lost marks the whole (virtual) backend dead;
+            # in mesh terms the loss was ONE member, and the shrink just
+            # removed it — the surviving sub-mesh is a fresh primary, so the
+            # plan's dead latch clears (a second device_lost spec kills
+            # another member and shrinks again)
+            self.faults.device_dead = False
+        self.counters["mesh_shrinks"] += 1
+        self.log.log("mesh.shrink", nd_from=int(nd_from), nd_to=int(m.nd),
+                     reason=reason[:200])
+        self._transition(RETRYING,
+                         reason=f"partial mesh {nd_from}->{m.nd}")
         return True
 
     # ---- capacity governor hooks ---------------------------------------
@@ -603,19 +669,26 @@ class DeviceSupervisor:
         """Route ``batch`` through the governor's degradation ladder;
         returns a handle carrying the solved result. A ladder exhausted all
         the way down demotes to native failover (the last rung); a device
-        loss mid-walk fails over normally."""
+        loss mid-walk shrinks a mesh primary first (the partial-mesh rung —
+        ratchets then re-key under the new :m<N>), else fails over."""
         t0 = time.time()
         try:
-            out = self.governor.solve(batch, key, reason=reason)
+            while True:
+                try:
+                    out = self.governor.solve(batch, key, reason=reason)
+                    break
+                except DeviceLostError as e:
+                    if self._mesh_degrade(str(e)):
+                        key = self._shape_key(batch)
+                        continue
+                    self._engage_fallback(str(e))
+                    return _SupHandle(None, batch, key, degraded=True)
         except CapacityError as e:
             # last rung: native failover. Walk the legal state chain — the
             # device is declared unusable (for this workload), not merely
             # busy, so SUSPECT precedes LOST exactly like a probe-dead path
             self._transition(SUSPECT, reason=f"capacity: {e}"[:200])
             self._engage_fallback(f"capacity ladder exhausted: {e}")
-            return _SupHandle(None, batch, key, degraded=True)
-        except DeviceLostError as e:
-            self._engage_fallback(str(e))
             return _SupHandle(None, batch, key, degraded=True)
         finally:
             if not self._inline:
@@ -648,16 +721,24 @@ class DeviceSupervisor:
                 # own guarded ops count themselves
                 return self._gov_dispatch(batch, key, reason=None)
         self.counters["dispatch"] += 1
-        fresh = self._is_fresh(key)
-        try:
-            inner = self._guarded("dispatch", self._dispatch_fn,
-                                  lambda attempt: (batch,), key, fresh,
-                                  width=w)
-        except CapacityError as e:
-            return self._gov_dispatch(batch, key, reason=str(e))
-        except DeviceLostError as e:
-            self._engage_fallback(str(e))
-            return _SupHandle(None, batch, key, degraded=True)
+        while True:
+            fresh = self._is_fresh(key)
+            try:
+                inner = self._guarded("dispatch", self._dispatch_fn,
+                                      lambda attempt: (batch,), key, fresh,
+                                      width=w)
+                break
+            except CapacityError as e:
+                return self._gov_dispatch(batch, key, reason=str(e))
+            except DeviceLostError as e:
+                # partial-mesh degradation rung: a shrunken mesh is a new
+                # primary at a new :m<N> key (cold-classified), so the
+                # re-dispatch below gets real compile deadlines
+                if self._mesh_degrade(str(e)):
+                    key = self._shape_key(batch)
+                    continue
+                self._engage_fallback(str(e))
+                return _SupHandle(None, batch, key, degraded=True)
         self._seen_shapes.add(key)
         if fresh:
             from ..utils.obs import record_fingerprint
@@ -697,6 +778,11 @@ class DeviceSupervisor:
                 return gh.result
             return self._degraded_solve(h.batch, "fetch")
         except DeviceLostError as e:
+            if self._mesh_degrade(str(e)):
+                # re-dispatch the retained batch on the shrunken mesh and
+                # fetch THAT: dispatch/fetch recursion absorbs any further
+                # loss (another shrink, or failover at mesh width 1)
+                return self.fetch(self.dispatch(h.batch))
             self._engage_fallback(str(e))
             return self._degraded_solve(h.batch, "fetch")
 
@@ -732,5 +818,10 @@ class DeviceSupervisor:
             self.counters["fetch"] -= 1
             return [self.fetch(h) for h in handles]
         except DeviceLostError as e:
+            if self._mesh_degrade(str(e)):
+                # every batch in the drained group replays on the shrunken
+                # mesh (dispatch/fetch recursion absorbs further losses)
+                self.counters["fetch"] -= 1
+                return [self.fetch(self.dispatch(h.batch)) for h in handles]
             self._engage_fallback(str(e))
             return [self._degraded_solve(h.batch, "fetch") for h in handles]

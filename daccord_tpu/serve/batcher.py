@@ -133,6 +133,12 @@ class GroupConfig:
     paged: bool = False          # pack merged batches as the paged wire format
     page_len: int = 16
     paged_families: int = 4
+    mesh: int = 0                # mesh-backed group (parallel/mesh.py):
+                                 # merged cross-job batches shard over the
+                                 # first N local devices through ONE warm
+                                 # supervised solve path — N x the
+                                 # continuous-batching width per compile;
+                                 # 0/1 = single device (JAX backends only)
     use_pallas: bool = False
     max_inflight: int = 8        # merged batches in flight before a drain
     min_width: int = 8           # shed floor for the width ladder
@@ -170,6 +176,7 @@ class SolveGroup:
                          "mixed_batches": 0, "demand_flushes": 0,
                          "lag_flushes": 0, "shed_flushes": 0}
         self.ladder = None
+        self.mesh_solver = None      # set when gcfg.mesh > 1 (JAX backends)
         self._profile = profile
         self._hp_ols = None          # lazy; native groups set it at build
         self._build_solver(profile, cfg)
@@ -214,7 +221,37 @@ class SolveGroup:
             is_cpu = jax.default_backend() == "cpu"
             prefix = jax.default_backend() + ":"
             ladder = self.ladder
-            if is_cpu and g.ladder_mode != "split" and not g.paged:
+            if g.mesh and g.mesh > 1:
+                # mesh-backed group: merged cross-job batches shard over the
+                # device mesh, through the same supervisor (with the
+                # partial-mesh rung) and governor (per-device bisect) the
+                # pipeline wraps a --mesh run in
+                from ..kernels.window_kernel import pallas_needs_interpret
+                from ..parallel.mesh import (check_mesh_devices, make_mesh,
+                                             make_sharded_solver)
+                from ..runtime.pipeline import _make_clamp_solve as _mk_clamp
+
+                check_mesh_devices(g.mesh)
+                interp = g.use_pallas and pallas_needs_interpret()
+                self.mesh_solver = make_sharded_solver(
+                    ladder, make_mesh(g.mesh), use_pallas=g.use_pallas,
+                    pallas_interpret=interp, batch=g.batch)
+                dispatch = self.mesh_solver.dispatch
+                fetch = self.mesh_solver.fetch
+                fetch_many = self.mesh_solver.fetch_many
+                clamp = _mk_clamp(ladder, g.use_pallas, interp,
+                                  g.governor.esc_clamp)
+                inline = self.mesh_solver.host_local
+                desc = f"serve-{self.mesh_solver.describe()}"
+                if not inline:
+                    from ..utils.obs import measure_rtt_s
+
+                    rtt_s = measure_rtt_s()
+                self.log.log("mesh.init", nd=int(self.mesh_solver.nd),
+                             devices=self.mesh_solver.describe(),
+                             esc_cap=int(
+                                 self.mesh_solver._esc_cap_for(g.batch)))
+            elif is_cpu and g.ladder_mode != "split" and not g.paged:
                 from ..kernels.tiers import solve_tiered
 
                 dispatch = (lambda b: solve_tiered(b, ladder))
@@ -260,7 +297,8 @@ class SolveGroup:
             log=self.log, cfg=SupervisorConfig.from_env(),
             faults=FaultPlan.from_env(), rtt_s=rtt_s, describe=desc,
             fingerprint_prefix=prefix, inline=inline, clamp_solve=clamp,
-            governor_cfg=g.governor, tracer=self.tracer)
+            governor_cfg=g.governor, tracer=self.tracer,
+            mesh=self.mesh_solver)
 
     # ------------------------------------------------------------------
     # job-side API

@@ -171,12 +171,18 @@ def build_job_config(spec: JobSpec, backend: str, backend_explicit: bool,
         ladder_mode=ladder_mode)
 
 
-def solve_fingerprint(profile, cfg, backend: str) -> str:
+def solve_fingerprint(profile, cfg, backend: str, mesh: int = 0) -> str:
     """Key under which jobs may share device batches: everything that can
     change a window's BYTES (profile floats, consensus/ladder semantics,
     engine family) — and nothing that cannot (batch width, shapes, telemetry
     paths, job identity). Full-precision float reprs: two jobs share a group
-    only when their solve semantics are bit-identical."""
+    only when their solve semantics are bit-identical.
+
+    ``mesh`` (the group's device-mesh width) joins the key even though it
+    cannot change bytes: a mesh group owns mesh-width-specific jitted
+    programs and per-:m<N> capacity ratchets, so a mesh and a single-device
+    group must never share warm state (0 = single device, and the key is
+    unchanged from pre-mesh builds)."""
     import hashlib
 
     c = cfg.consensus
@@ -195,6 +201,8 @@ def solve_fingerprint(profile, cfg, backend: str) -> str:
         "max_kmers": cfg.max_kmers, "rescue_max_kmers": cfg.rescue_max_kmers,
         "overflow_rescue": cfg.overflow_rescue,
     }
+    if mesh and mesh > 1:
+        payload["mesh"] = int(mesh)
     return hashlib.sha256(
         json.dumps(payload, sort_keys=True).encode()).hexdigest()[:24]
 
@@ -279,7 +287,8 @@ def run_job(job: Job, service) -> None:
         kw = (dict(pile_ranges=report.pile_ranges)
               if report is not None and report.issues else {})
         profile = estimate_profile_for_shard(db, las, cfg, **kw)
-        key = solve_fingerprint(profile, cfg, scfg.backend)
+        key = solve_fingerprint(profile, cfg, scfg.backend,
+                                mesh=scfg.group_mesh())
         group = service.warm.acquire(
             key, lambda: service.build_group(key, profile, cfg))
         job.group = group.name

@@ -70,6 +70,13 @@ class ServeConfig:
     ladder_mode: str = "fused"       # fused | split (JAX groups only)
     paged: bool = False              # paged wire format for merged batches
     page_len: int = 16
+    mesh: int = 0                    # mesh-backed solve groups (JAX groups
+                                     # only): merged cross-job batches shard
+                                     # over the first N local devices — N x
+                                     # the continuous-batching width per
+                                     # warm compile; the solve fingerprint
+                                     # includes N so mesh and single-device
+                                     # groups never share warm state
     use_pallas: bool = False
     flush_lag_s: float = 0.05        # stale cross-job pool flush deadline
     idle_evict_s: float = 600.0      # warm-group TTL
@@ -88,6 +95,10 @@ class ServeConfig:
         # the native engine escalates per window on host: stream routing
         # (and paging) are JAX-ladder concepts
         return "fused" if self.backend == "native" else self.ladder_mode
+
+    def group_mesh(self) -> int:
+        # same rule for the mesh: a device-mesh group is a JAX-ladder concept
+        return 0 if self.backend == "native" else (self.mesh or 0)
 
 
 class ConsensusService:
@@ -154,6 +165,7 @@ class ConsensusService:
                            ladder_mode=scfg.group_ladder_mode(),
                            paged=scfg.paged and scfg.backend != "native",
                            page_len=scfg.page_len,
+                           mesh=scfg.group_mesh(),
                            use_pallas=scfg.use_pallas,
                            shed_levels=self._shed)
         g = SolveGroup(key, profile, cfg, gcfg, log=glog, name=name)

@@ -203,8 +203,9 @@ def daccord_main(argv=None) -> int:
                         "saturated batches. Default fused until the on-chip "
                         "fused-vs-split decision row lands (kernelbench "
                         "--stages ladder_full,ladder_split). Ignored by "
-                        "--backend native (per-window host escalation) "
-                        "and --mesh")
+                        "--backend native (per-window host escalation); "
+                        "composes with --mesh (sharded tier0 + sharded "
+                        "full-ladder programs)")
     p.add_argument("--paged", choices=("on", "off", "auto"), default="off",
                    help="ragged paged window batching (kernels/paging.py): "
                         "batches ship as a page pool + per-window page table "
@@ -224,7 +225,15 @@ def daccord_main(argv=None) -> int:
                         "(bit-identical results; TPU backend only)")
     p.add_argument("--mesh", type=int, default=0, metavar="N",
                    help="shard window batches over the first N local devices "
-                        "(shard_map data parallelism; 0/1 = single device)")
+                        "(shard_map data parallelism; 0/1 = single device). "
+                        "First-class multi-chip path: mesh programs get "
+                        "supervisor identity (:m<N> compile keys, watchdog/"
+                        "retry, partial-mesh degradation N->N/2->...->1 "
+                        "before whole-program failover), per-device governor "
+                        "capacity handling, and compose with --paged and "
+                        "--ladder split; auto batch scales by N. Off-pod "
+                        "verification: JAX_PLATFORMS=cpu XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N")
     p.add_argument("--block", type=int, default=None, metavar="I",
                    help="process only DB block I (1-based, after db-split; the "
                         "reference's per-block workflow). Mutually exclusive with -J")
@@ -369,6 +378,7 @@ def daccord_main(argv=None) -> int:
                          quarantine_path=args.quarantine,
                          ladder_mode=args.ladder,
                          paged=args.paged, page_len=args.page_len,
+                         mesh=args.mesh,
                          max_pile_overlaps=args.max_pile_overlaps,
                          ledger_path=args.ledger)
 
@@ -377,7 +387,7 @@ def daccord_main(argv=None) -> int:
     from ..oracle.profile import ErrorProfile
 
     def _estimate_validated():
-        # -E/--mesh pre-estimation under the same ingest policy as the run:
+        # -E pre-estimation under the same ingest policy as the run:
         # without the scan, a coords-corrupt record sails through index_las
         # (framing intact) and dies as a raw assertion inside refine_overlap.
         # Strict -> structured IngestError; quarantine -> sample clean piles
@@ -397,8 +407,8 @@ def daccord_main(argv=None) -> int:
         return estimate_profile_for_shard(db_, las_, cfg, start, end,
                                           pile_ranges=clean)
 
-    # everything that touches the artifacts — the -E/--mesh pre-estimation
-    # passes included — runs under the IngestError handler so an integrity
+    # everything that touches the artifacts — the -E pre-estimation pass
+    # included — runs under the IngestError handler so an integrity
     # failure always exits with the structured report, never a traceback
     try:
         prof = None
@@ -415,27 +425,24 @@ def daccord_main(argv=None) -> int:
                       file=sys.stderr)
                 return 0
 
-        solver = None
         if args.mesh > 1:
-            from ..parallel.mesh import build_sharded_solver
+            # fail fast with the off-pod recipe before any artifact work;
+            # the pipeline builds the sharded solver itself (cfg.mesh) from
+            # the run's own TierLadder — supervisor/governor/paging/split
+            # all wrap it like the single-device path
+            from ..parallel.mesh import check_mesh_devices
 
-            if prof is None:
-                prof = _estimate_validated()
-            solver = build_sharded_solver(args.mesh, prof, cfg.consensus,
-                                          use_pallas=args.pallas,
-                                          max_kmers=cfg.max_kmers,
-                                          rescue_max_kmers=cfg.rescue_max_kmers,
-                                          overflow_rescue=cfg.overflow_rescue)
+            check_mesh_devices(args.mesh)
 
         if args.profile:
             import jax
 
             with jax.profiler.trace(args.profile):
                 stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
-                                         end=end, profile=prof, solver=solver)
+                                         end=end, profile=prof)
         else:
             stats = correct_to_fasta(args.db, args.las, args.out, cfg, start=start,
-                                     end=end, profile=prof, solver=solver)
+                                     end=end, profile=prof)
     except IngestError as ex:
         _ingest_exit(ex)
     line = {
@@ -920,6 +927,11 @@ def shard_main(argv=None) -> int:
                    default="auto")
     p.add_argument("--paged", choices=("on", "off", "auto"), default="off",
                    help="ragged paged window batching (see daccord --paged)")
+    p.add_argument("--mesh", type=int, default=0, metavar="N",
+                   help="shard window batches over the first N local devices "
+                        "(see daccord --mesh); fleet workers drive a local "
+                        "mesh through this — one host, N chips is ONE "
+                        "worker, auto batch scales by N")
     p.add_argument("--events", default=None, metavar="PATH",
                    help="supervisor events jsonl (see daccord --events)")
     p.add_argument("--ledger", default="auto", metavar="PATH",
@@ -937,10 +949,15 @@ def shard_main(argv=None) -> int:
                         "--max-pile-overlaps); 0 disables (default: "
                         f"{PipelineConfig().max_pile_overlaps})")
     args = p.parse_args(argv)
+    if args.backend == "native" and args.mesh > 1:
+        raise SystemExit("--backend native solves on host C++; it cannot be "
+                         "combined with --mesh (pick one)")
     if args.backend == "auto":
         from ..utils.obs import resolve_auto_backend
 
-        args.backend = resolve_auto_backend()
+        # --mesh shards over devices — incompatible with the native engine,
+        # so a dead tunnel then falls back to the CPU device ladder
+        args.backend = resolve_auto_backend(prefer_native=args.mesh <= 1)
     if args.backend in ("cpu", "native"):
         import jax
 
@@ -948,6 +965,10 @@ def shard_main(argv=None) -> int:
     from ..utils.obs import enable_compilation_cache
 
     enable_compilation_cache()
+    if args.mesh > 1:
+        from ..parallel.mesh import check_mesh_devices
+
+        check_mesh_devices(args.mesh)
     i, n = (int(x) for x in args.J.split(","))
     if not (0 <= i < n):
         raise SystemExit(f"bad -J {args.J}")
@@ -962,7 +983,7 @@ def shard_main(argv=None) -> int:
                           native_solver=args.backend == "native",
                           events_path=args.events,
                           ingest_policy=args.ingest_policy,
-                          paged=args.paged,
+                          paged=args.paged, mesh=args.mesh,
                           max_pile_overlaps=args.max_pile_overlaps,
                           ledger_path=ledger)
     if args.profile_sample is not None:
@@ -1014,6 +1035,11 @@ def serve_main(argv=None) -> int:
     p.add_argument("--paged", action="store_true",
                    help="pack merged cross-job batches as the ragged paged "
                         "wire format (kernels/paging.py); JAX groups only")
+    p.add_argument("--mesh", type=int, default=0, metavar="N",
+                   help="mesh-backed solve groups: merged cross-job batches "
+                        "shard over the first N local devices (see daccord "
+                        "--mesh) — N x the continuous-batching width per "
+                        "warm compile; auto -b scales by N. JAX groups only")
     p.add_argument("--flush-lag-ms", type=float, default=50.0,
                    help="stale cross-job pool flush deadline: bounds the "
                         "latency one job's rows can pay waiting for "
@@ -1044,10 +1070,13 @@ def serve_main(argv=None) -> int:
     args = p.parse_args(argv)
 
     backend_explicit = args.backend != "auto"
+    if args.backend == "native" and args.mesh > 1:
+        raise SystemExit("--backend native solves on host C++; it cannot be "
+                         "combined with --mesh (pick one)")
     if args.backend == "auto":
         from ..utils.obs import resolve_auto_backend
 
-        args.backend = resolve_auto_backend()
+        args.backend = resolve_auto_backend(prefer_native=args.mesh <= 1)
     if args.backend in ("cpu", "native"):
         import jax
 
@@ -1061,10 +1090,16 @@ def serve_main(argv=None) -> int:
     from ..utils.obs import auto_batch_size, enable_compilation_cache
 
     enable_compilation_cache()
+    if args.mesh > 1:
+        from ..parallel.mesh import check_mesh_devices
+
+        check_mesh_devices(args.mesh)
     if args.batch is None:
+        # mesh-backed groups get N x the merged width per warm compile —
+        # each device's slice keeps the single-device batch
         args.batch = auto_batch_size(args.backend == "native",
                                      args.backend if args.backend != "native"
-                                     else None)
+                                     else None, mesh=args.mesh)
     from ..serve import AdmissionConfig, ConsensusService, ServeConfig
     from ..serve.http import start_server
 
@@ -1072,6 +1107,7 @@ def serve_main(argv=None) -> int:
         workdir=args.workdir, backend=args.backend,
         backend_explicit=backend_explicit, batch=args.batch,
         workers=args.workers, ladder_mode=args.ladder, paged=args.paged,
+        mesh=args.mesh,
         flush_lag_s=args.flush_lag_ms / 1000.0,
         idle_evict_s=args.idle_evict_s,
         metrics_snapshot_s=args.metrics_snapshot_s,
@@ -1181,6 +1217,11 @@ def fleet_main(argv=None) -> int:
     p.add_argument("--paged", choices=("on", "off", "auto"), default="off",
                    help="ragged paged window batching forwarded to every "
                         "worker (see daccord --paged)")
+    p.add_argument("--mesh", type=int, default=0, metavar="N",
+                   help="each worker shards its batches over the first N "
+                        "local devices (see daccord --mesh): one host, N "
+                        "chips is ONE worker — size --workers for the host's "
+                        "device pool, and auto batch scales by N")
     p.add_argument("--max-pile-overlaps", type=int, default=None, metavar="N",
                    help="monster-pile budget forwarded to every worker (see "
                         "daccord --max-pile-overlaps); 0 disables")
@@ -1202,6 +1243,12 @@ def fleet_main(argv=None) -> int:
                         "missing shards, and exit 0 even when shards were "
                         "poisoned")
     args = p.parse_args(argv)
+    if args.backend == "native" and args.mesh > 1:
+        # fail fast here like daccord/daccord-shard/daccord-serve do —
+        # forwarded to workers, the pair would crash every spawn and surface
+        # as a confusing multi-shard poison report instead of a config error
+        raise SystemExit("--backend native solves on host C++; it cannot be "
+                         "combined with --mesh (pick one)")
     from ..parallel.fleet import FleetConfig, run_fleet
     from ..parallel.launch import MergeGateError, merge_shards
 
@@ -1214,7 +1261,7 @@ def fleet_main(argv=None) -> int:
                       checkpoint_every=args.checkpoint_every,
                       batch=args.batch, backend=args.backend,
                       ingest_policy=args.ingest_policy,
-                      paged=args.paged,
+                      paged=args.paged, mesh=args.mesh,
                       max_pile_overlaps=args.max_pile_overlaps,
                       worker_telemetry=not args.no_worker_telemetry,
                       events_path=args.events if args.events is not None

@@ -62,6 +62,15 @@ EVENT_FIELDS: dict[str, dict] = {
     "batch.paged": {"windows": int, "bucket": int, "family": str,
                     "pages": int, "pool_pages": int, "table_cells": int,
                     "occupancy": _NUM},
+    # mesh-native solve path (parallel/mesh.py): one mesh.init per built
+    # sharded solver; mesh.shrink = the partial-mesh degradation rung
+    # (N -> N/2 on declared device loss, run stays on the smaller primary);
+    # mesh.restore = failback rebuilt the full mesh; mesh.degrade = no
+    # smaller mesh exists (width 1) — whole-program failover follows
+    "mesh.init": {"nd": int, "devices": str, "esc_cap": int},
+    "mesh.shrink": {"nd_from": int, "nd_to": int, "reason": str},
+    "mesh.restore": {"nd_from": int, "nd_to": int},
+    "mesh.degrade": {"nd": int, "reason": str},
     # two-stream tier ladder (ISSUE 4): one row per Stream B rescue dispatch
     # (rows = live rescue windows, slots = padded batch width, reason =
     # full | lag | final | pressure — the last is a host-watermark

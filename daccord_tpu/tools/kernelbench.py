@@ -20,8 +20,8 @@ import json
 import time
 
 #: stages in run order; --stages picks a comma-separated subset
-STAGES = ("ladder_full", "ladder_pallas", "ladder_paged", "ladder_split",
-          "tier0", "prefixes")
+STAGES = ("ladder_full", "ladder_pallas", "ladder_paged", "ladder_mesh",
+          "ladder_split", "tier0", "prefixes")
 
 
 def main(argv=None) -> int:
@@ -163,6 +163,43 @@ def main(argv=None) -> int:
             if ms_paged_pl is not None:
                 row["paged_pallas_ms"] = round(ms_paged_pl, 2)
             print(json.dumps(row))
+
+    if "ladder_mesh" in stages:
+        # mesh-sharded full ladder (parallel/mesh.py): the same batch solved
+        # over every visible device vs the single-device program above. On a
+        # pod slice this is the on-chip mesh rung; off-pod the forced-host-
+        # device recipe (conftest's trick) gives the pre-chip parity/scaling
+        # signal — wall-clock scaling on N virtual CPU devices is bounded by
+        # host cores, so the decision row carries the recipe for the queued
+        # on-chip rung (DACCORD_BENCH_MESH=1 in a live tunnel window).
+        nd = min(8, len(jax.devices()))
+        if nd < 2:
+            print(json.dumps({
+                "stage": "ladder_mesh", "skipped": True,
+                "reason": f"{len(jax.devices())} device(s) visible",
+                "recipe": "JAX_PLATFORMS=cpu XLA_FLAGS="
+                          "--xla_force_host_platform_device_count=8"}))
+        else:
+            from daccord_tpu.parallel.mesh import (make_mesh,
+                                                   make_sharded_solver)
+
+            solver = make_sharded_solver(ladder, make_mesh(nd), batch=B)
+            ms_mesh = timed("ladder_mesh",
+                            lambda: solver(wb),
+                            extra={"mesh": nd,
+                                   "pad_to_mesh_rows": int(
+                                       (-B) % nd)})
+            if ms_full is not None:
+                print(json.dumps({
+                    "stage": "decision:mesh", "batch": B, "mesh": nd,
+                    "single_ms": round(ms_full, 2),
+                    "mesh_ms": round(ms_mesh, 2),
+                    "mesh_speedup": round(ms_full / ms_mesh, 3)
+                    if ms_mesh else None,
+                    "per_device_rows": B // nd,
+                    "queued_on_chip_rung": "DACCORD_BENCH_MESH=1 python "
+                                           "bench.py (live tunnel window)",
+                    "device": str(jax.devices()[0]).replace(" ", "")}))
 
     if "ladder_split" in stages:
         # two-stream ladder (ISSUE 4): tier0 over the full batch + the full

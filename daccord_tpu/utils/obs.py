@@ -477,16 +477,20 @@ def resolve_auto_backend(prefer_native: bool = True) -> str:
     return "cpu"
 
 
-def auto_batch_size(native: bool, jax_backend: str | None = None) -> int:
+def auto_batch_size(native: bool, jax_backend: str | None = None,
+                    mesh: int = 0) -> int:
     """Batch auto-selection when ``-b`` is not given: the native C++ engine
     pays no shape-scaled compile cost so bigger is strictly better (4096);
-    the JAX ladder runs 2048 on TPU, 512 elsewhere. The single source for
-    this mapping — ``correct_shard`` sizes its batches with it and the
+    the JAX ladder runs 2048 on TPU, 512 elsewhere — times the mesh width
+    when batches shard over a device mesh (one host, N chips is ONE worker:
+    each device's slice keeps the single-device width). The single source
+    for this mapping — ``correct_shard`` sizes its batches with it and the
     fleet's capacity requeue halves it, so the two can never disagree on
     what a worker's effective batch was."""
     if native:
         return 4096
-    return 2048 if jax_backend == "tpu" else 512
+    base = 2048 if jax_backend == "tpu" else 512
+    return base * max(int(mesh or 0), 1)
 
 
 def env_float(name: str, default: float) -> float:

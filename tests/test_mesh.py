@@ -1,0 +1,416 @@
+"""Mesh-native solve path (ISSUE 12): the sharded ladder as a first-class
+citizen of the supervisor/governor/paging/serve stack.
+
+Runs on the 8 forced host CPU devices (conftest) — the off-pod recipe
+``build_sharded_solver`` documents. The invariant behind every arm: sharding
+(and re-sharding, after a partial-mesh shrink) a batch over devices cannot
+change any window's bytes, because windows solve independently — so mesh-8
+FASTA must be byte-identical to the single-device run under the whole fault
+matrix. Heavy fleet/serve/crash-resume arms are in the slow tier; the core
+parity + fault matrix stays in tier-1.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# cheap units (no XLA compile)
+# ---------------------------------------------------------------------------
+
+
+def _stub_ladder(depth=4):
+    """Minimal TierLadder stand-in for solver-construction units."""
+    from types import SimpleNamespace
+
+    from daccord_tpu.kernels.window_kernel import KernelParams
+
+    p = KernelParams(k=8, min_count=2, edge_min_count=2, wlen=40)
+    return SimpleNamespace(params=[p], tables={p.k: None}, wide_p0=None)
+
+
+def test_esc_cap_fixed_at_construction():
+    """Satellite 1: esc_cap resolves once from the configured batch — a
+    narrower (governor-bisected) batch reuses the same per-device cap
+    instead of deriving a width-dependent one per dispatch."""
+    from daccord_tpu.parallel.mesh import ShardedLadderSolver, make_mesh
+
+    s = ShardedLadderSolver(_stub_ladder(), make_mesh(8), batch=512)
+    assert s._esc_cap_for(512) == 64
+    # narrower batches (bisect rungs) keep the SAME cap — no fresh program
+    # per width beyond the unavoidable batch-dim recompile
+    assert s._esc_cap_for(256) == 64
+    assert s._esc_cap_for(64) == 64
+    # wider-than-configured keeps overflow structurally impossible
+    assert s._esc_cap_for(1024) == 128
+    # explicit cap wins everywhere
+    s2 = ShardedLadderSolver(_stub_ladder(), make_mesh(8), esc_cap=32,
+                             batch=512)
+    assert s2._esc_cap_for(512) == 32
+
+
+def test_shrink_restore_and_cap_follow():
+    from daccord_tpu.parallel.mesh import ShardedLadderSolver, make_mesh
+
+    s = ShardedLadderSolver(_stub_ladder(), make_mesh(8), batch=512)
+    assert s._esc_cap_for(512) == 64
+    assert s.shrink() and s.nd == 4
+    # the per-device slice doubled: the cap follows so overflow stays
+    # structurally impossible on the shrunken mesh
+    assert s._esc_cap_for(512) == 128
+    assert s.shrink() and s.nd == 2
+    assert s.shrink() and s.nd == 1
+    assert not s.shrink()           # width 1: no smaller mesh exists
+    s.restore()
+    assert s.nd == 8 and s._esc_cap_for(512) == 64
+    assert s.host_local             # forced host devices are cpu platform
+
+
+def test_shape_key_mesh_suffix():
+    """Mesh programs classify/fingerprint under :m<N> keys (composing with
+    :t0), and the suffix follows the CURRENT mesh width after a shrink."""
+    from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch
+    from daccord_tpu.parallel.mesh import ShardedLadderSolver, make_mesh
+    from daccord_tpu.runtime.supervisor import DeviceSupervisor
+
+    solver = ShardedLadderSolver(_stub_ladder(), make_mesh(8), batch=64)
+    sup = DeviceSupervisor(lambda b: b, lambda h: h, inline=True,
+                           fingerprint_prefix="cpu:", mesh=solver)
+    b = WindowBatch(seqs=np.zeros((64, 4, 8), np.int8),
+                    lens=np.zeros((64, 4), np.int32),
+                    nsegs=np.zeros(64, np.int32), shape=BatchShape(4, 8, 40),
+                    read_ids=np.zeros(64, np.int64),
+                    wstarts=np.zeros(64, np.int64))
+    assert sup._shape_key(b) == "cpu:B64xD4xL8:m8"
+    import dataclasses
+
+    assert sup._shape_key(dataclasses.replace(b, stream="tier0")) \
+        == "cpu:B64xD4xL8:t0:m8"
+    solver.shrink()
+    assert sup._shape_key(b) == "cpu:B64xD4xL8:m4"
+    # no mesh -> keys unchanged from the pre-mesh builds
+    sup1 = DeviceSupervisor(lambda b: b, lambda h: h, inline=True,
+                            fingerprint_prefix="cpu:")
+    assert sup1._shape_key(b) == "cpu:B64xD4xL8"
+
+
+def test_governor_quantum_widths():
+    """Mesh-aware bisect: every rung width is a mesh multiple and the floor
+    scales per device, so one device's ceiling shrinks every slice in
+    lockstep instead of collapsing the batch to the scalar floor."""
+    from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch
+    from daccord_tpu.runtime.governor import (CapacityError, CapacityGovernor,
+                                              GovernorConfig)
+
+    widths = []
+
+    def solve(b):
+        widths.append(b.size)
+        if b.size > 16:
+            raise CapacityError("RESOURCE_EXHAUSTED: too wide", width=b.size)
+        return {"cons": np.zeros((b.size, 4), np.int8),
+                "cons_len": np.zeros(b.size, np.int32),
+                "err": np.zeros(b.size, np.float32),
+                "solved": np.ones(b.size, bool),
+                "tier": np.zeros(b.size, np.int32), "esc_overflow": 0}
+
+    gov = CapacityGovernor(solve, cfg=GovernorConfig(min_width=1,
+                                                     persist=False),
+                           quantum_fn=lambda: 8)
+    b = WindowBatch(seqs=np.zeros((128, 4, 8), np.int8),
+                    lens=np.zeros((128, 4), np.int32),
+                    nsegs=np.zeros(128, np.int32), shape=BatchShape(4, 8, 40),
+                    read_ids=np.zeros(128, np.int64),
+                    wstarts=np.zeros(128, np.int64))
+    out = gov.solve(b, "cpu:B128xD4xL8:m8", reason="injected")
+    assert len(out["solved"]) == 128
+    assert all(w % 8 == 0 for w in widths), widths
+    assert gov.ratchet["cpu:B128xD4xL8:m8"] == 16
+
+
+def test_auto_batch_scales_by_mesh():
+    from daccord_tpu.utils.obs import auto_batch_size
+
+    assert auto_batch_size(False, "tpu") == 2048
+    assert auto_batch_size(False, "tpu", mesh=8) == 16384
+    assert auto_batch_size(False, "cpu", mesh=4) == 2048
+    assert auto_batch_size(True) == 4096          # native ignores mesh
+
+
+def test_fleet_worker_argv_forwards_mesh(tmp_path):
+    """Satellite 6: the fleet forwards --mesh to daccord-shard workers and
+    its capacity-requeue batch scales by mesh width."""
+    from daccord_tpu.parallel.fleet import Fleet, FleetConfig
+
+    cfg = FleetConfig(nshards=2, backend="cpu", mesh=8)
+    f = Fleet("db", "las", str(tmp_path), cfg, faults=None)
+    argv = f._worker_argv(0)
+    i = argv.index("--mesh")
+    assert argv[i + 1] == "8"
+    assert f._worker_batch() == 512 * 8
+    cfg1 = FleetConfig(nshards=2, backend="cpu")
+    f1 = Fleet("db", "las", str(tmp_path), cfg1, faults=None)
+    assert "--mesh" not in f1._worker_argv(0)
+
+
+def test_solve_fingerprint_includes_mesh():
+    from daccord_tpu.oracle.profile import ErrorProfile
+    from daccord_tpu.runtime.pipeline import PipelineConfig
+    from daccord_tpu.serve.jobs import solve_fingerprint
+
+    prof = ErrorProfile(0.05, 0.05, 0.02)
+    cfg = PipelineConfig()
+    base = solve_fingerprint(prof, cfg, "cpu")
+    assert solve_fingerprint(prof, cfg, "cpu", mesh=0) == base
+    assert solve_fingerprint(prof, cfg, "cpu", mesh=8) != base
+    assert solve_fingerprint(prof, cfg, "cpu", mesh=8) != \
+        solve_fingerprint(prof, cfg, "cpu", mesh=4)
+
+
+# ---------------------------------------------------------------------------
+# e2e parity + fault matrix (tier-1: the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    from daccord_tpu.formats import LasFile, read_db
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+    from daccord_tpu.runtime.pipeline import estimate_profile_for_shard
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path_factory.mktemp("meshcorpus"))
+    out = make_dataset(d, SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=700, min_overlap=300,
+                                    seed=47), name="mesh")
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    base = dict(batch_size=64, depth_buckets=(16,))
+    profile = estimate_profile_for_shard(db, las, PipelineConfig(**base))
+
+    def run(**kw):
+        cfg = PipelineConfig(**base, **kw)
+        return [(rid, [f.tobytes() for f in frags])
+                for rid, frags, _ in correct_shard(db, las, cfg,
+                                                   profile=profile)]
+
+    single = run()
+    assert len(single) > 0
+    return {"db": db, "las": las, "base": base, "profile": profile,
+            "run": run, "single": single, "dir": d, "paths": out}
+
+
+@pytest.fixture()
+def throwaway_compcache(tmp_path, monkeypatch):
+    # injected-fault ratchets/fingerprints must not land in the host's real
+    # registry (same doctrine as the pounce governor smoke)
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+
+
+def test_mesh_dense_parity(corpus):
+    assert corpus["run"](mesh=8) == corpus["single"]
+
+
+def test_mesh_paged_parity(corpus):
+    """Paged + mesh compose: the page table shards, the pool replicates,
+    and the FASTA stays byte-identical to the dense single-device run."""
+    assert corpus["run"](mesh=8, paged="on") == corpus["single"]
+
+
+def test_mesh_split_ladder_parity(corpus):
+    """:t0 + :m<N> compose: Stream A runs mesh-wide tier0, rescue pools
+    flush mesh-width Stream B batches — same bytes."""
+    assert corpus["run"](mesh=8, ladder_mode="split") == corpus["single"]
+
+
+def test_mesh_device_lost_partial_mesh_rung(corpus, tmp_path, monkeypatch,
+                                            throwaway_compcache):
+    """device_lost mid-mesh engages the partial-mesh degradation rung
+    (8 -> 4), NOT whole-program failover, and the output is byte-identical."""
+    monkeypatch.setenv("DACCORD_FAULT", "device_lost:2")
+    ev = str(tmp_path / "lost.events.jsonl")
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+
+    cfg = PipelineConfig(**corpus["base"], mesh=8, events_path=ev)
+    got = [(rid, [f.tobytes() for f in frags])
+           for rid, frags, st in correct_shard(corpus["db"], corpus["las"],
+                                               cfg, profile=corpus["profile"])]
+    assert got == corpus["single"]
+    evs = [json.loads(x) for x in open(ev)]
+    kinds = [e["event"] for e in evs]
+    assert "mesh.init" in kinds
+    shr = [e for e in evs if e["event"] == "mesh.shrink"]
+    assert shr and shr[0]["nd_from"] == 8 and shr[0]["nd_to"] == 4
+    assert "sup_failover" not in kinds        # stayed on the (smaller) mesh
+    done = [e for e in evs if e["event"] == "sup_done"][-1]
+    assert done["mesh_shrinks"] >= 1 and not done["degraded"]
+    # post-shrink dispatches classify under the :m4 key
+    assert any(":m4" in e.get("key", "") for e in evs
+               if e["event"] == "sup_compile")
+    # lint the whole sidecar (mesh.* kinds are schema'd)
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    assert validate_events(ev, strict=True) == []
+
+
+def test_mesh_device_oom_bisect_and_ratchet(corpus, tmp_path, monkeypatch,
+                                            throwaway_compcache):
+    """device_oom on a mesh dispatch walks the per-device bisect (widths
+    stay mesh multiples) and ratchets under the :m8 key — persisted for the
+    next run, byte-identical output, no failover."""
+    monkeypatch.setenv("DACCORD_FAULT", "device_oom:2")
+    monkeypatch.setenv("DACCORD_GOV_MIN_WIDTH", "2")
+    ev = str(tmp_path / "oom.events.jsonl")
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+
+    cfg = PipelineConfig(**corpus["base"], mesh=8, events_path=ev)
+    got = [(rid, [f.tobytes() for f in frags])
+           for rid, frags, st in correct_shard(corpus["db"], corpus["las"],
+                                               cfg, profile=corpus["profile"])]
+    assert got == corpus["single"]
+    evs = [json.loads(x) for x in open(ev)]
+    assert not any(e["event"] == "sup_failover" for e in evs)
+    shrinks = [e for e in evs if e["event"] == "governor.shrink"]
+    assert shrinks and all(e["width_to"] % 8 == 0 for e in shrinks)
+    rats = [e for e in evs if e["event"] == "governor.ratchet"]
+    assert rats and ":m8" in rats[0]["key"]
+    # ratchet persistence: the registry beside the (throwaway) compile cache
+    # carries the :m8 key, so the NEXT run of this shape dispatches reduced
+    from daccord_tpu.runtime.governor import load_ratchets
+
+    persisted = load_ratchets()
+    mesh_keys = [k for k in persisted if ":m8" in k]
+    assert mesh_keys and persisted[mesh_keys[0]] == rats[-1]["width"]
+
+
+# ---------------------------------------------------------------------------
+# heavy arms: crash+resume, fleet worker, serve group (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mesh_crash_resume_parity(corpus, tmp_path, monkeypatch,
+                                  throwaway_compcache):
+    """An injected hard crash mid-mesh-shard resumes from the checkpoint and
+    the final FASTA is byte-identical to an uninterrupted single-device
+    shard run."""
+    from daccord_tpu.parallel import launch
+    from daccord_tpu.runtime import PipelineConfig
+
+    paths = corpus["paths"]
+    ref_dir = str(tmp_path / "ref")
+    cfg = PipelineConfig(**corpus["base"])
+    launch.run_shard(paths["db"], paths["las"], ref_dir, 0, 1, cfg,
+                     checkpoint_every=3)
+    ref_fasta = open(launch.shard_paths(ref_dir, 0)["fasta"]).read()
+
+    mesh_dir = str(tmp_path / "mesh")
+    mcfg = PipelineConfig(**corpus["base"], mesh=8)
+    # op 20 sits past the second grouped drain (max_inflight 8), so reads
+    # have emitted and a checkpoint exists to resume from
+    monkeypatch.setenv("DACCORD_FAULT", "crash:20")
+    from daccord_tpu.runtime.faults import InjectedCrash
+
+    with pytest.raises(InjectedCrash):
+        launch.run_shard(paths["db"], paths["las"], mesh_dir, 0, 1, mcfg,
+                         checkpoint_every=3)
+    monkeypatch.delenv("DACCORD_FAULT")
+    m = launch.run_shard(paths["db"], paths["las"], mesh_dir, 0, 1, mcfg,
+                         checkpoint_every=3)
+    assert m.get("resumed_at_read", 0) > 0
+    assert open(launch.shard_paths(mesh_dir, 0)["fasta"]).read() == ref_fasta
+
+
+@pytest.mark.slow
+def test_fleet_worker_with_mesh(corpus, tmp_path):
+    """A daccord-fleet run whose workers drive a local 8-device mesh merges
+    byte-identically to a single-device fleet of the same shards."""
+    from daccord_tpu.parallel.fleet import FleetConfig, run_fleet
+    from daccord_tpu.parallel.launch import merge_shards
+
+    paths = corpus["paths"]
+    ref = str(tmp_path / "ref")
+    cfg0 = FleetConfig(nshards=2, workers=2, backend="cpu", batch=64,
+                       checkpoint_every=4, worker_telemetry=True)
+    m0 = run_fleet(paths["db"], paths["las"], ref, cfg0, faults=None)
+    assert not m0["poison"]
+    mdir = str(tmp_path / "mesh")
+    cfg8 = FleetConfig(nshards=2, workers=1, backend="cpu", batch=64,
+                       checkpoint_every=4, mesh=8, worker_telemetry=True)
+    m8 = run_fleet(paths["db"], paths["las"], mdir, cfg8, faults=None)
+    assert not m8["poison"]
+    f_ref = str(tmp_path / "ref.fasta")
+    f_mesh = str(tmp_path / "mesh.fasta")
+    merge_shards(ref, 2, f_ref)
+    merge_shards(mdir, 2, f_mesh)
+    assert open(f_mesh).read() == open(f_ref).read()
+    # the worker really ran a mesh: its events sidecar carries mesh.init
+    evs = [json.loads(x)
+           for x in open(os.path.join(mdir, "shard0000.events.jsonl"))]
+    assert any(e["event"] == "mesh.init" and e["nd"] == 8 for e in evs)
+
+
+@pytest.mark.slow
+def test_serve_mesh_group_mixed_batch_parity(corpus, tmp_path):
+    """A serve mixed-job batch solved on a mesh-backed group: two jobs'
+    rows merge into mesh-wide batches and each job's rows come back equal
+    to its solo control (deterministic batcher-level arm)."""
+    import dataclasses
+
+    from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch, \
+        tensorize_windows
+    from daccord_tpu.oracle import cut_windows, refine_overlap
+    from daccord_tpu.runtime import PipelineConfig
+    from daccord_tpu.serve.batcher import GroupConfig, SolveGroup
+
+    db, las = corpus["db"], corpus["las"]
+    cfg = PipelineConfig(**corpus["base"])
+    # one real pile's windows as the job payload
+    aread, pile = next(iter(las.iter_piles(None, None)))
+    a = db.read_bases(aread)
+    refined = [refine_overlap(o, a, db.read_bases(o.bread), las.tspace)
+               for o in pile]
+    windows = cut_windows(a, refined, w=cfg.consensus.w, adv=cfg.consensus.adv)
+    shape = BatchShape(depth=cfg.depth, seg_len=cfg.seg_len,
+                       wlen=cfg.consensus.w)
+    wb = tensorize_windows([(aread, ws) for ws in windows], shape)
+    n = (wb.size // 2) * 2
+    half = n // 2
+    rows_a = dataclasses.replace(
+        wb, seqs=wb.seqs[:half], lens=wb.lens[:half], nsegs=wb.nsegs[:half],
+        read_ids=wb.read_ids[:half], wstarts=wb.wstarts[:half])
+    rows_b = dataclasses.replace(
+        wb, seqs=wb.seqs[half:n], lens=wb.lens[half:n], nsegs=wb.nsegs[half:n],
+        read_ids=wb.read_ids[half:n], wstarts=wb.wstarts[half:n])
+
+    group = SolveGroup("k", corpus["profile"], cfg,
+                       GroupConfig(backend="cpu", batch=n, mesh=8), name="g0")
+    assert group.mesh_solver is not None and group.mesh_solver.nd == 8
+    sa = group.job_solver("A")
+    sb = group.job_solver("B")
+    ha = sa.dispatch(rows_a)
+    hb = sb.dispatch(rows_b)           # fills the pool -> ONE merged batch
+    out_a = sa.fetch(ha)
+    out_b = sb.fetch(hb)
+    assert group.counters["mixed_batches"] >= 1
+    # solo control: the same rows through a single-device solve
+    from daccord_tpu.kernels.tiers import TierLadder, solve_tiered
+
+    ladder = TierLadder.from_config(corpus["profile"], cfg.consensus,
+                                    max_kmers=cfg.max_kmers,
+                                    rescue_max_kmers=cfg.rescue_max_kmers)
+    ref = solve_tiered(dataclasses.replace(
+        wb, seqs=wb.seqs[:n], lens=wb.lens[:n], nsegs=wb.nsegs[:n],
+        read_ids=wb.read_ids[:n], wstarts=wb.wstarts[:n]), ladder)
+    np.testing.assert_array_equal(np.asarray(out_a["solved"]),
+                                  ref["solved"][:half])
+    np.testing.assert_array_equal(np.asarray(out_b["solved"]),
+                                  ref["solved"][half:n])
+    for i in range(half):
+        np.testing.assert_array_equal(np.asarray(out_a["cons"][i]),
+                                      ref["cons"][i])
+        np.testing.assert_array_equal(np.asarray(out_b["cons"][i]),
+                                      ref["cons"][half + i])

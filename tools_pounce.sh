@@ -246,6 +246,40 @@ EOF
 echo "tools_pounce: paged-batching smoke OK" >&2
 rm -rf "$pagedir"
 
+# mesh smoke (ISSUE 12): synth a toy corpus, run the mesh-8-on-CPU sharded
+# ladder (forced host platform devices — the off-pod recipe) WITH paged
+# batching on, and require byte-identical FASTA vs the single-device run
+# plus lint-clean mesh.*/paging.* events — all CPU-side, before any chip
+# minute. A failure here means the mesh solve path (supervisor :m keys,
+# sharded paged gather, pad-to-mesh plumbing) regressed; abort the pounce
+# rather than burn a pod slice on it.
+meshdir=$(mktemp -d)
+python - "$meshdir" <<'EOF' || { echo "tools_pounce: mesh synth failed" >&2; exit 1; }
+import sys
+from daccord_tpu.sim.synth import SimConfig, make_dataset
+make_dataset(sys.argv[1], SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=500, min_overlap=200,
+                                    seed=5), name="mx")
+EOF
+python -m daccord_tpu.tools.cli daccord "$meshdir/mx.db" "$meshdir/mx.las" \
+    --backend cpu -b 64 -o "$meshdir/single.fasta" \
+  || { echo "tools_pounce: mesh-smoke single-device run FAILED" >&2; exit 1; }
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m daccord_tpu.tools.cli daccord "$meshdir/mx.db" "$meshdir/mx.las" \
+    --backend cpu -b 64 --mesh 8 --paged on -o "$meshdir/mesh.fasta" \
+    --events "$meshdir/mesh.events.jsonl" \
+  || { echo "tools_pounce: mesh-8-on-CPU run FAILED" >&2; exit 1; }
+cmp -s "$meshdir/single.fasta" "$meshdir/mesh.fasta" \
+  || { echo "tools_pounce: mesh-8 FASTA diverged from single-device run" >&2; exit 1; }
+python -m daccord_tpu.tools.cli eventcheck --strict "$meshdir/mesh.events.jsonl" \
+  || { echo "tools_pounce: mesh events failed schema lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli trace --check --no-timeline "$meshdir/mesh.events.jsonl" \
+  || { echo "tools_pounce: mesh sidecar failed daccord-trace lint" >&2; exit 1; }
+grep -q '"event": "mesh.init"' "$meshdir/mesh.events.jsonl" \
+  || { echo "tools_pounce: mesh run never initialized a mesh" >&2; exit 1; }
+echo "tools_pounce: mesh smoke OK" >&2
+rm -rf "$meshdir"
+
 # serving-plane smoke (ISSUE 10): start a real daccord-serve HTTP server on
 # the native engine, submit two overlapping jobs, and require each job's
 # FASTA to be byte-identical to its solo `daccord` run, with lint-clean
@@ -362,11 +396,20 @@ git commit -q -m "pounce: bench ladder rung sidecars (${stamp})" || true
 probe ladder
 # 2. the open device decision rows, first minutes of the window
 # (VERDICT r5 #4): fused-Pallas vs scan (open since r3), the fused-vs-split
-# two-stream ladder row (ISSUE 4), AND the paged-vs-dense wire-format row
-# (ISSUE 7: decision:paged — adopt --paged auto per the BASELINE.md rule)
+# two-stream ladder row (ISSUE 4), the paged-vs-dense wire-format row
+# (ISSUE 7: decision:paged — adopt --paged auto per the BASELINE.md rule),
+# AND the mesh-vs-single decision row (ISSUE 12: decision:mesh over the
+# visible device pool)
 run ladder_rows      python -m daccord_tpu.tools.kernelbench --backend auto \
-                       --stages ladder_full,ladder_pallas,ladder_paged,ladder_split
+                       --stages ladder_full,ladder_pallas,ladder_paged,ladder_mesh,ladder_split
 probe ladder_rows
+# 2b. the on-chip mesh rung (ISSUE 12): mesh-N vs single-device pipelined
+# throughput over the real device pool, committed as the next
+# MULTICHIP_r*.json — the first measured point of the >=20x north star
+run mesh_rung        env DACCORD_BENCH_MESH=1 python bench.py
+for f in MULTICHIP_r*.json; do [ -e "$f" ] && git add "$f"; done
+git commit -q -m "pounce: multichip mesh rung sidecar (${stamp})" || true
+probe mesh_rung
 # 3. esc_cap tail cost (experiment 3) — the fused-program comparator for
 # the split ladder: B/8 rescue cap vs the split row above
 run esccap256        env DACCORD_BENCH_ESC_CAP=256 python bench.py
