@@ -264,12 +264,20 @@ def precompile_ladder(data: dict, ev=None, batch: int = BATCH) -> dict:
     shape = BatchShape(depth=DEPTH, seg_len=SEG_LEN, wlen=WLEN)
     cached = _announce_compile(ev, batch)
     t0 = time.perf_counter()
-    fetch(solve_ladder_async(_make_batch(data, 0, batch, shape), ladder,
-                             esc_cap=ESC_CAP))
+    b0 = _make_batch(data, 0, batch, shape)
+    fetch(solve_ladder_async(b0, ladder, esc_cap=ESC_CAP))
     wall = time.perf_counter() - t0
-    record_fingerprint(_ladder_fingerprint(batch))
+    # compile-wall + HLO-cost telemetry into the fingerprint registry
+    # (ISSUE 13): the AOT lower+compile after the warmup is a cache hit,
+    # and the flops/bytes estimate rides the registry entry so the
+    # host-local per-shape history holds program cost beside compile wall
+    from daccord_tpu.kernels.tiers import ladder_cost
+
+    cost = ladder_cost(b0, ladder, esc_cap=ESC_CAP)
+    record_fingerprint(_ladder_fingerprint(batch), wall_s=wall, meta=cost)
     return {"precompile": True, "batch": batch,
             "compile_wall_s": round(wall, 3), "was_cached": cached,
+            "hlo_cost": cost,
             "device": str(jax.devices()[0]).replace(" ", "")}
 
 
@@ -307,11 +315,16 @@ def device_throughput(data: dict, max_batches: int | None = None,
 
     # warmup / compile all tier shapes (with the expected-wall echo so a
     # long-silent cold compile is not mistaken for a wedge)
-    _announce_compile(ev, batch)
+    was_cached = _announce_compile(ev, batch)
+    t_warm = time.perf_counter()
     fetch(solve_ladder_async(make_batch(0), ladder, esc_cap=ESC_CAP))
     from daccord_tpu.utils.obs import record_fingerprint
 
-    record_fingerprint(_ladder_fingerprint(batch))
+    # a cold warmup's wall IS the compile wall — fold it into the registry
+    # (a cached one records no wall: it would understate the cold cost)
+    record_fingerprint(_ladder_fingerprint(batch),
+                       wall_s=None if was_cached
+                       else time.perf_counter() - t_warm)
 
     # tunnel RTT estimate (sidecar provenance): median of 3 tiny blocking
     # fetches — the fixed per-device_get cost the pipelined dispatch amortizes
@@ -557,6 +570,34 @@ def _commit_sidecar(path: str, payload: dict) -> None:
     durable_write(path, lambda fh: json.dump(payload, fh), mode="wt")
 
 
+def _tunnel_staleness() -> dict:
+    """Last-alive tunnel probe provenance (ISSUE 13 satellite: staleness
+    blindness). Stamped into every BENCH_*/MULTICHIP_* sidecar as
+    ``last_real_tpu_ts``/``last_real_tpu_age_h`` and echoed at bench start,
+    so a ``fallback: true`` rung is attributable to a dated tunnel death
+    from the sidecar alone — no TUNNEL_LOG spelunking."""
+    from daccord_tpu.tools.trace import last_alive_info
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    ts, age_h = last_alive_info(os.path.join(here, "TUNNEL_LOG.jsonl"))
+    return {"last_real_tpu_ts": ts, "last_real_tpu_age_h": age_h}
+
+
+def _echo_staleness() -> dict:
+    import sys as _sys
+
+    st = _tunnel_staleness()
+    if st["last_real_tpu_ts"]:
+        age = (f" ({st['last_real_tpu_age_h']}h ago)"
+               if st["last_real_tpu_age_h"] is not None else "")
+        print(f"bench: last real TPU probe alive {st['last_real_tpu_ts']}"
+              f"{age}", file=_sys.stderr)
+    else:
+        print("bench: NO alive TPU probe on record (TUNNEL_LOG.jsonl) — "
+              "any device number this run is suspect", file=_sys.stderr)
+    return st
+
+
 def _memory_telemetry() -> dict:
     """Peak-memory provenance for a bench sidecar (ISSUE 5): device
     ``memory_stats()`` peak bytes when the backend exposes it (TPU does;
@@ -709,7 +750,7 @@ def run_ladder(data: dict, ev, orc_bps: float) -> int:
                 reason = f"device_loss_mid_run:{type(e).__name__}"
                 line = {"metric": "consensus_bases_per_sec_per_chip",
                         "rung": True, "batch": rung, "fallback": True,
-                        "fallback_reason": reason}
+                        "fallback_reason": reason, **_tunnel_staleness()}
                 _commit_sidecar(os.path.join(here,
                                              f"BENCH_LADDER_B{rung:04d}.json"),
                                 line)
@@ -722,7 +763,8 @@ def run_ladder(data: dict, ev, orc_bps: float) -> int:
                     "vs_baseline": round(dev_bps / orc_bps, 2) if orc_bps else None,
                     "oracle_bases_per_sec": round(orc_bps, 1),
                     "fallback": False, "fallback_reason": None,
-                    "ts": round(time.time(), 1), **info}
+                    "ts": round(time.time(), 1), **_tunnel_staleness(),
+                    **info}
             _commit_sidecar(os.path.join(here, f"BENCH_LADDER_B{rung:04d}.json"),
                             line)
             print(json.dumps(line), flush=True)
@@ -848,6 +890,7 @@ def run_mesh_bench(data: dict, ev, fallback_reason=None) -> dict:
         "fallback_reason": fallback_reason,
         "rungs": rungs,
         "ts": round(time.time(), 1),
+        **_tunnel_staleness(),
     }
     if len(rungs) == 2 and rungs[0]["windows_per_sec"]:
         # the headline: mesh-N throughput over single-device on this host.
@@ -962,6 +1005,7 @@ def run_serve_bench(ev) -> dict:
                      "windows": r["windows"], **r["latency"]}
                     for r in rows],
         "warm": {k: metrics["warm"][k] for k in ("hits", "misses")},
+        **_tunnel_staleness(),
     }
     _commit_sidecar("BENCH_SERVE.json", line)
     ev.log("bench_done", wall_s=round(wall, 3))
@@ -983,6 +1027,9 @@ def main() -> None:
     args = ap.parse_args()
     ev = JsonlLogger(args.events)
     t_main0 = time.perf_counter()
+    # staleness echo FIRST (ISSUE 13 satellite): every bench run dates the
+    # tunnel's last real life sign before any measurement prints
+    _echo_staleness()
     enable_compilation_cache()
     if BENCH_SERVE:
         # serving-plane stage: self-contained (synth corpus + real HTTP
@@ -1049,7 +1096,7 @@ def main() -> None:
             # TUNNEL_LOG.jsonl
             line = {"ladder": True, "skipped": True, "fallback": True,
                     "fallback_reason": fallback_reason,
-                    "rungs": list(BENCH_LADDER)}
+                    "rungs": list(BENCH_LADDER), **_tunnel_staleness()}
         else:
             orc_bps = oracle_baseline(data)
             landed = run_ladder(data, ev, orc_bps)
@@ -1097,6 +1144,7 @@ def main() -> None:
             raise SystemExit(r.returncode)
     info["fallback"] = bool(fallback)   # machine-detectable degraded run
     info["fallback_reason"] = fallback_reason
+    info.update(_tunnel_staleness())
     orc_bps = oracle_baseline(data)
     line = {
         "metric": "consensus_bases_per_sec_per_chip",

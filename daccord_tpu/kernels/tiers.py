@@ -396,6 +396,25 @@ def solve_ladder_async(batch: WindowBatch, ladder: TierLadder,
     return _PackedHandle(arr, ladder.params[0].cons_len)
 
 
+def ladder_cost(batch: WindowBatch, ladder: TierLadder,
+                esc_cap: int | None = None, use_pallas: bool = False,
+                pallas_interpret: bool = False) -> dict | None:
+    """HLO cost analysis (flops, bytes accessed) of the fused ladder
+    program at this batch's shape (ISSUE 13: compile-cost telemetry for the
+    fingerprint registry). Mirrors :func:`solve_ladder_async`'s dense arg
+    assembly through the AOT lower+compile path — call AFTER a warmup solve
+    so the compile is a cache hit, not a second 900 s spend."""
+    from ..utils.obs import hlo_cost
+
+    if esc_cap is None:
+        esc_cap = int(batch.size)
+    tables = tuple(ladder.tables[p.k] for p in ladder.params)
+    return hlo_cost(_ladder_packed_jit, jnp.asarray(batch.seqs),
+                    jnp.asarray(batch.lens), jnp.asarray(batch.nsegs),
+                    tables, tuple(ladder.params), esc_cap, use_pallas,
+                    pallas_interpret, ladder.wide_p0)
+
+
 def fetch(out) -> dict:
     """Materialize a solver result on host (no-op for numpy dicts)."""
     if isinstance(out, _PackedHandle):

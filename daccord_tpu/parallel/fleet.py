@@ -712,6 +712,16 @@ class Fleet:
             }
             _write_manifest_durable(os.path.join(self.outdir, "fleet.json"),
                                     manifest)
+            # fleet-level scrape target (ISSUE 13): the shard rollups as one
+            # shard-labeled prom exposition beside fleet.json
+            try:
+                prom = _fleet_prom_text(self.outdir)
+                if prom:
+                    aio.durable_write(
+                        os.path.join(self.outdir, "fleet.metrics.prom"),
+                        lambda fh: fh.write(prom), mode="wt")
+            except OSError:
+                pass
             self.log.log("fleet.finish", done=len(manifest["done"]),
                          poison=len(manifest["poison"]),
                          wall_s=manifest["wall_s"])
@@ -730,6 +740,50 @@ class Fleet:
             # fleet-run root on an exception path) close with status=abort
             self.tracer.unwind()
             self.log.close()
+
+
+def _fleet_prom_text(outdir: str) -> str:
+    """One Prometheus exposition merging every committed shard rollup,
+    shard-labeled — the fleet-level scrape target (ISSUE 13). The text
+    format requires all samples of a metric to form ONE group, so samples
+    regroup per metric family across shards (a shard-by-shard concat
+    would interleave families and fail promtool) under a single ``# TYPE``
+    each; a torn rollup skips — best-effort, it never sinks the fleet."""
+    import glob
+    import json as _json
+
+    from ..utils.obs import render_prom
+
+    fam_type: dict[str, str] = {}
+    fam_samples: dict[str, list[str]] = {}
+    order: list[str] = []
+    for mp in sorted(glob.glob(os.path.join(outdir,
+                                            "shard*.metrics.json"))):
+        try:
+            with open(mp) as fh:
+                roll = _json.load(fh)
+        except (OSError, _json.JSONDecodeError):
+            continue
+        if not isinstance(roll, dict) or "gauges" not in roll:
+            continue
+        text = render_prom(roll, labels={"shard": roll.get("shard", "?")})
+        fam = None
+        for ln in text.splitlines():
+            if ln.startswith("# TYPE "):
+                # render_prom emits every sample (incl. a summary's _count/
+                # _sum) directly under its family's TYPE line
+                fam = ln.split()[2]
+                if fam not in fam_samples:
+                    fam_type[fam] = ln
+                    fam_samples[fam] = []
+                    order.append(fam)
+            elif fam is not None and ln.strip():
+                fam_samples[fam].append(ln)
+    lines: list[str] = []
+    for fam in order:
+        lines.append(fam_type[fam])
+        lines.extend(fam_samples[fam])
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 def run_fleet(db: str, las: str, outdir: str, cfg: FleetConfig,

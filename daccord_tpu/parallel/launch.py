@@ -188,6 +188,16 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
             "shard": shard, "wall_s": counters.get("wall_s"),
             "device_s": counters.get("device_s"),
             "host_s": counters.get("host_s"), **metrics_rollup})
+        # the scrapeable twin (ISSUE 13): same rollup as a Prometheus text
+        # exposition, shard-labeled, committed durably beside the JSON —
+        # a node exporter (or plain curl | promtool) reads shards with no
+        # JSON adapter in between
+        from ..utils.aio import durable_write
+        from ..utils.obs import render_prom
+
+        prom = render_prom(metrics_rollup, labels={"shard": shard})
+        durable_write(paths["metrics"][: -len(".json")] + ".prom",
+                      lambda fh: fh.write(prom), mode="wt")
     if os.path.exists(paths["progress"]):
         os.remove(paths["progress"])
     return manifest

@@ -238,6 +238,17 @@ class ShardedLadderSolver:
         self.nd = mesh.devices.size
         # full-mesh device list, retained for restore() after a failback
         self._devices0 = list(mesh.devices.flat)
+        # per-device flight recorder (ISSUE 13): one row per ORIGINAL mesh
+        # member, keyed by its index in the construction-time device list —
+        # dispatch wall + row counts accrue per dispatch (two float adds per
+        # device, noise against the jit launch), HBM peak refreshes at
+        # snapshot cadence (health_map), and state tracks the partial-mesh
+        # rung (ok -> lost for the attributed culprit, dropped for members
+        # the deterministic halving removed alongside it)
+        self.device_stats: dict[int, dict] = {
+            i: {"platform": d.platform, "state": "ok", "dispatches": 0,
+                "dispatch_wall_s": 0.0, "rows": 0, "hbm_peak_bytes": None}
+            for i, d in enumerate(self._devices0)}
         self.sharding = NamedSharding(mesh, P("d"))
         self.replicated = NamedSharding(mesh, P())
         self.tables = tuple(ladder.tables[p.k] for p in ladder.params)
@@ -283,22 +294,52 @@ class ShardedLadderSolver:
             # per-device slice: the cap follows the slice width
             self._auto_cap = max(-(-int(self._cap_base) // self.nd), 1)
 
-    def shrink(self) -> bool:
-        """Partial-mesh degradation rung: halve the device set (keep the
-        first half — which member died is unknowable from a whole-program
-        abort, so the policy is deterministic; a survivor set containing the
-        dead device just shrinks again on the next loss). Returns False at
-        mesh width 1 — the supervisor then falls through to whole-program
-        failover."""
+    def _dev_index(self, dev) -> int:
+        """Original mesh-member index of ``dev`` (-1 when foreign)."""
+        for i, d in enumerate(self._devices0):
+            if d is dev:
+                return i
+        return -1
+
+    def shrink(self, culprit: int = -1) -> bool:
+        """Partial-mesh degradation rung: halve the device set. With an
+        attributed ``culprit`` (original member index — fault injection
+        names it, or a per-device probe found it) the surviving half is the
+        one WITHOUT the dead chip; unattributed losses keep the first half
+        (deterministic — a survivor set containing the dead device just
+        shrinks again on the next loss). Dropped members' ``device_stats``
+        rows flip to ``lost`` (the culprit) / ``dropped`` (halving
+        casualties), the per-chip attribution ``mesh.device`` events carry.
+        Returns False at mesh width 1 — the supervisor then falls through
+        to whole-program failover."""
         if self.nd <= 1:
             return False
-        self._rebuild(list(self.mesh.devices.flat)[: self.nd // 2])
+        active = list(self.mesh.devices.flat)
+        half = self.nd // 2
+        first, second = active[:half], active[half:]
+        keep = first
+        if 0 <= culprit < len(self._devices0):
+            bad = self._devices0[culprit]
+            if any(d is bad for d in first) and not any(
+                    d is bad for d in second):
+                keep = second
+        for d in active:
+            if any(k is d for k in keep):
+                continue
+            i = self._dev_index(d)
+            if i >= 0:
+                self.device_stats[i]["state"] = (
+                    "lost" if i == culprit else "dropped")
+        self._rebuild(keep)
         return True
 
     def restore(self) -> None:
         """Rebuild the full construction-time mesh (supervisor failback:
         the revived device pool re-enters, and every shape recompiles under
-        its original ``:m<N>`` key)."""
+        its original ``:m<N>`` key). Every member's fault state resets to
+        ``ok`` — the revived pool re-enters whole."""
+        for row in self.device_stats.values():
+            row["state"] = "ok"
         self._rebuild(self._devices0)
 
     def _esc_cap_for(self, target: int) -> int:
@@ -314,6 +355,88 @@ class ShardedLadderSolver:
     # ---- dispatch / fetch ----------------------------------------------
 
     def dispatch(self, batch: WindowBatch):
+        """Timed wrapper over the dispatch proper: per-device dispatch wall
+        + row accounting accrue on every ACTIVE member (host-side issue cost
+        is shared — the jit launch is one call — while rows split evenly by
+        the batch-axis sharding). Two float adds per device per dispatch:
+        telemetry stays inside the <=2% hot-path budget."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            return self._dispatch(batch)
+        finally:
+            dt = _time.perf_counter() - t0
+            rows = -(-int(batch.size) // max(self.nd, 1))
+            for d in self.mesh.devices.flat:
+                i = self._dev_index(d)
+                if i >= 0:
+                    row = self.device_stats[i]
+                    row["dispatches"] += 1
+                    row["dispatch_wall_s"] += dt
+                    row["rows"] += rows
+
+    def _refresh_hbm(self) -> None:
+        """Per-device HBM peak via ``memory_stats()`` (None on backends that
+        do not report it — host CPU devices usually). Called at snapshot
+        cadence, never per dispatch."""
+        for i, d in enumerate(self._devices0):
+            try:
+                ms = d.memory_stats()
+                if ms and "peak_bytes_in_use" in ms:
+                    self.device_stats[i]["hbm_peak_bytes"] = int(
+                        ms["peak_bytes_in_use"])
+            except Exception:
+                pass
+
+    def health_map(self) -> dict:
+        """The mesh health map metrics snapshots embed (ISSUE 13): current
+        vs construction width, per-device state/wall/rows/HBM-peak keyed by
+        original member index. A partial-mesh degradation reads off this map
+        as exactly which chip is ``lost`` and which rows moved."""
+        self._refresh_hbm()
+        return {"nd": int(self.nd), "nd0": len(self._devices0),
+                "devices": {i: dict(row)
+                            for i, row in self.device_stats.items()}}
+
+    def probe_devices(self, timeout_s: float = 15.0) -> list[int]:
+        """Original indexes of ACTIVE members that fail a tiny per-device
+        op — the culprit finder for unattributed real losses. All probes
+        start first and join against ONE shared deadline, so a fully
+        wedged mesh (the common tunnel-death shape) costs ``timeout_s``
+        total, not ``timeout_s`` per member — this runs inside the shrink
+        recovery path, whose stall it must bound, not multiply. Probe
+        threads are daemons: an abandoned one dies with the process."""
+        import threading
+        import time as _time
+
+        probes: list[tuple[threading.Thread, list, object]] = []
+        for d in self.mesh.devices.flat:
+            ok: list = []
+
+            def work(dev=d, ok=ok):
+                try:
+                    jax.block_until_ready(
+                        jax.device_put(jnp.zeros(8, jnp.int32), dev))
+                    ok.append(True)
+                except Exception:
+                    pass
+
+            t = threading.Thread(target=work, daemon=True,
+                                 name="daccord-mesh-probe")
+            t.start()
+            probes.append((t, ok, d))
+        deadline = _time.monotonic() + timeout_s
+        dead: list[int] = []
+        for t, ok, d in probes:
+            t.join(max(0.0, deadline - _time.monotonic()))
+            if not ok:
+                i = self._dev_index(d)
+                if i >= 0:
+                    dead.append(i)
+        return dead
+
+    def _dispatch(self, batch: WindowBatch):
         from ..kernels.tiers import _PackedHandle
 
         B0 = batch.size

@@ -19,6 +19,13 @@ state they leave behind: ``device_lost`` additionally marks the (virtual)
 device dead, which the supervisor's probe consults before any real probe —
 so the probe-declares-loss path runs deterministically too.
 
+``device_lost`` accepts an optional mesh-member index: ``device_lost:2@3``
+marks device 3 as the member that died. On a mesh primary the supervisor
+attributes the partial-mesh shrink to that device index (``mesh.shrink``
+``culprit`` + a ``mesh.device`` state row) — the per-chip attribution the
+flight recorder (ISSUE 13) exists for. Without ``@K`` the culprit is
+unknown (-1), matching a real whole-program abort.
+
 ``crash`` is a test-only kind: it raises :class:`InjectedCrash`, a
 ``BaseException`` the supervisor deliberately does NOT catch, simulating a
 hard process death (SIGKILL-ish) for checkpoint/resume composition tests.
@@ -151,12 +158,16 @@ class FaultSpec:
     kind: str
     at: int = 1        # 1-based index in the kind's counter domain
     fired: bool = False
+    device: int = -1   # mesh-member index a device_lost names (-1 = unknown)
 
 
 @dataclass
 class FaultPlan:
     specs: list = field(default_factory=list)
     device_dead: bool = False
+    # mesh-member index of the last fired device_lost (-1 = not attributed);
+    # the supervisor's partial-mesh rung reads it to name the culprit chip
+    dead_device: int = -1
     # virtual HBM ceiling left by a fired device_oom spec: every later
     # primary op wider than this raises (None = no ceiling). Not one-shot by
     # design — the doomed shape must keep failing until it is bisected small
@@ -186,13 +197,19 @@ class FaultPlan:
                 raise ValueError(
                     f"DACCORD_FAULT: unknown kind {kind!r} (known: "
                     f"{', '.join(_KINDS)})")
+            at, _, dev = at.partition("@")
+            if dev and kind != "device_lost":
+                raise ValueError(
+                    f"DACCORD_FAULT: @device only applies to device_lost "
+                    f"(got {part!r})")
             try:
                 n = int(at) if at else 1
+                d = int(dev) if dev else -1
             except ValueError:
                 raise ValueError(f"DACCORD_FAULT: bad count in {part!r}")
             if n < 1:
                 raise ValueError(f"DACCORD_FAULT: count must be >= 1 in {part!r}")
-            specs.append(FaultSpec(kind, n))
+            specs.append(FaultSpec(kind, n, device=d))
         return cls(specs=specs)
 
     @classmethod
@@ -240,10 +257,13 @@ class FaultPlan:
             # a lost device stays lost for every later primary op
             _raise(FaultDeviceLost, "device_lost", self.n_device,
                    f"device dead (injected) at {domain}")
-        if self._take("device_lost", self.n_device) is not None:
+        s = self._take("device_lost", self.n_device)
+        if s is not None:
             self.device_dead = True
+            self.dead_device = s.device
             _raise(FaultDeviceLost, "device_lost", self.n_device,
-                   f"injected device_lost at {domain} #{self.n_device}")
+                   f"injected device_lost at {domain} #{self.n_device}"
+                   + (f" (device {s.device})" if s.device >= 0 else ""))
         if self._take("device_oom", self.n_device) is not None:
             # the triggering op sets the ceiling to half its own width, so
             # one bisect step deterministically fits; compose multiple

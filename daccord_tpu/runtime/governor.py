@@ -374,8 +374,14 @@ class CapacityGovernor:
                 width = min(width, proposed)
                 if width < B:
                     self.counters["shrink"] += 1
+                    # per_device = the capacity rung each mesh member now
+                    # runs at (ISSUE 13: the OOM is a per-device-slice
+                    # property, so the telemetry names the slice, not just
+                    # the batch)
                     self.log.log("governor.shrink", key=key, width_from=B,
-                                 width_to=int(width))
+                                 width_to=int(width),
+                                 **({"per_device": int(width) // q}
+                                    if q > 1 else {}))
             elif clamped:
                 # the clamp is already this shape's working rung: stay on it
                 width = min(width, B)
@@ -425,7 +431,9 @@ class CapacityGovernor:
                     new = _q_up(max(width // 2, floor))
                     self.counters["shrink"] += 1
                     self.log.log("governor.shrink", key=key,
-                                 width_from=int(width), width_to=int(new))
+                                 width_from=int(width), width_to=int(new),
+                                 **({"per_device": int(new) // q}
+                                    if q > 1 else {}))
                     width = new
                     continue
                 if not clamped and self._clamp is not None:

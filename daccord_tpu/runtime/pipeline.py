@@ -915,6 +915,11 @@ class _Telemetry:
         self.run_span = self.tracer.open("run")
 
     def close(self) -> None:
+        stop = getattr(self, "prof_stop", None)
+        if stop is not None:
+            # an abort mid-capture must still stop the jax.profiler trace
+            # (a torn trace dir is worse than no trace)
+            stop()
         self.tracer.unwind()
         if self.ledger is not None:
             self.ledger.close()
@@ -1339,6 +1344,77 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         if fetch_many_fn is not None:
             fetch_many_fn = sup.fetch_many
 
+    # ledger mesh column (ISSUE 13 satellite): rows record the solve path's
+    # mesh width — an in-run mesh (cfg.mesh), a mesh-backed serve group
+    # (the injected JobSolver carries its group's width as an int), or a
+    # directly-injected sharded solver (whose `mesh` is the jax Mesh object;
+    # its width is `nd`) — so the ROADMAP-4 router training set can segment
+    # by mesh configuration. 0 (the non-mesh case) is omitted from the row
+    # entirely: non-mesh ledgers stay byte-for-byte what they were.
+    def _solver_mesh_width(s) -> int:
+        if s is None:
+            return 0
+        m = getattr(s, "mesh", 0)
+        if isinstance(m, int):
+            return m
+        return int(getattr(s, "nd", 0) or 0)
+
+    ledger_mesh = mesh_n or _solver_mesh_width(solver)
+
+    # opt-in jax.profiler capture (ISSUE 13): DACCORD_PROFILE_DIR captures a
+    # device trace bracketing the Nth dispatch (DACCORD_PROFILE_DISPATCH,
+    # default 2 — past the cold compile) through the drain that fetches it.
+    # One capture per run, never on the native engine (no jax to trace, and
+    # importing it there would init a backend the native path avoids).
+    from ..utils.obs import env_float as _envf
+
+    _prof_dir = os.environ.get("DACCORD_PROFILE_DIR")
+    _prof = {"n": 0, "fetched": 0, "active": False,
+             "done": not _prof_dir or native_dispatch,
+             "at": max(1, int(_envf("DACCORD_PROFILE_DISPATCH", 2)))}
+
+    def _prof_on_dispatch() -> None:
+        if _prof["done"] or _prof["active"]:
+            return
+        _prof["n"] += 1
+        if _prof["n"] < _prof["at"]:
+            return
+        try:
+            import jax
+
+            os.makedirs(_prof_dir, exist_ok=True)
+            jax.profiler.start_trace(_prof_dir)
+            _prof["active"] = True
+            ev_log.log("profile.capture", dir=_prof_dir,
+                       dispatch=_prof["n"], state="start")
+        except Exception as e:   # profiling must never sink a run
+            log.log("warn", msg=f"profiler start failed: {e}")
+            _prof["done"] = True
+
+    def _prof_on_drain(n_fetched: int = 0, force: bool = False) -> None:
+        # fetches pop FIFO, so the profiled dispatch (the at-th) is the
+        # at-th fetched entry — stop only at the drain that fetches IT,
+        # not the first drain after start (with >=2 batches in flight
+        # those differ and the capture would miss the profiled fetch)
+        _prof["fetched"] += n_fetched
+        if not _prof["active"] or (not force
+                                   and _prof["fetched"] < _prof["at"]):
+            return
+        _prof["active"] = False
+        _prof["done"] = True
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            ev_log.log("profile.capture", dir=_prof_dir,
+                       dispatch=_prof["n"], state="stop")
+        except Exception as e:
+            log.log("warn", msg=f"profiler stop failed: {e}")
+
+    # an aborted run must still stop an in-flight capture (the trace file
+    # would otherwise be left torn); the telemetry bundle's finally runs it
+    tel.prof_stop = lambda: _prof_on_drain(force=True)
+
     hp_ols = None
     hp_nladder = None
     hp_nt = cfg.native_threads if cfg.native_threads > 0 else (
@@ -1600,7 +1676,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     # a Stream B dispatch in split mode, or (fused) any
                     # escalation-tier solve
                     rescued=(stream == "rescue" or t >= 1), wall_s=wall,
-                    job=cfg.job_tag)
+                    job=cfg.job_tag, mesh=ledger_mesh)
             if pr.n_done == pr.n_windows:
                 finalize_read(r, pr)
         return n_batch_solved
@@ -1710,6 +1786,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             outs = [fetch_fn(e[0]) for e in entries]
         now = time.time()
         tracer.close(f_sp)
+        _prof_on_drain(len(entries))
         # device_s = time the host actually BLOCKED on the device/tunnel
         # (in-flight batches overlap, so summing dispatch->fetch spans
         # would double-count and can exceed wall time)
@@ -1820,6 +1897,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 b_sp = tracer.open("batch", attach=False, stream="rescue",
                                    rows=take, bucket=bi)
                 d_sp = tracer.open("dispatch", parent=b_sp, stream="rescue")
+                _prof_on_dispatch()
                 handle = dispatch_fn(batch)
                 tracer.close(d_sp)
                 metrics.counter("dispatches").inc()
@@ -1873,6 +1951,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                    rows=take, bucket=bi)
                 d_sp = tracer.open("dispatch", parent=b_sp,
                                    stream=batch.stream)
+                _prof_on_dispatch()
                 handle = dispatch_fn(batch)
                 tracer.close(d_sp)
                 metrics.counter("dispatches").inc()
@@ -1985,6 +2064,36 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             tracer.close(f_sp)
             yield blk
 
+    def _mesh_telemetry() -> dict | None:
+        # per-device mesh flight recorder (ISSUE 13): the health map rides
+        # the metrics snapshot and each member gets a mesh.device state row
+        # — dispatch wall, rows, HBM peak, fault state, and the capacity
+        # rung its slice currently runs at, keyed by device index
+        if mesh_solver is None:
+            return None
+        hm = mesh_solver.health_map()
+        rung_rows = None
+        if sup is not None:
+            rat = sup.governor.active_state()
+            if rat:
+                rung_rows = min(rat.values()) // max(mesh_solver.nd, 1)
+        if rung_rows is not None:
+            hm["rung_rows_per_device"] = int(rung_rows)
+        lost = sum(1 for r in hm["devices"].values() if r["state"] != "ok")
+        g = metrics.gauge
+        g("mesh_nd").set(float(hm["nd"]))
+        g("mesh_devices_lost").set(float(lost))
+        for i, row in sorted(hm["devices"].items()):
+            ev_log.log("mesh.device", device=int(i), state=row["state"],
+                       platform=row["platform"],
+                       dispatches=int(row["dispatches"]),
+                       dispatch_wall_s=round(row["dispatch_wall_s"], 4),
+                       rows=int(row["rows"]),
+                       hbm_peak_bytes=row["hbm_peak_bytes"],
+                       **({"rung_rows": int(rung_rows)}
+                          if rung_rows is not None else {}))
+        return hm
+
     def _metrics_snap(final: bool = False):
         # registry update + periodic snapshot event: derived rates from the
         # live stats plus the two samplers (host RSS; device peak memory on
@@ -2008,8 +2117,10 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             dpb = device_peak_bytes()
             if dpb is not None:
                 g("device_peak_bytes").set(float(dpb))
+        hm = _mesh_telemetry()
         if not final:
-            metrics.snapshot(ev_log)
+            metrics.snapshot(ev_log, **({"mesh": hm} if hm else {}))
+        return hm
 
     bp_latched = None
     last_snap = time.time()
@@ -2105,7 +2216,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                             ledger.record(aread, int(wj), w, int(nsegs[wj]),
                                           -1, -1, False, "skip",
                                           rescued=False, wall_s=0.0,
-                                          job=cfg.job_tag)
+                                          job=cfg.job_tag, mesh=ledger_mesh)
                     pr.n_done += ns
                     stats.n_skipped_shallow += ns
                     keep = ~shallow
@@ -2201,8 +2312,9 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     # end-of-run metrics rollup: final gauge refresh, one last snapshot
     # event, and the registry dict on stats — run_shard commits it durably
     # beside the shard manifest
-    _metrics_snap(final=True)
-    metrics.snapshot(ev_log, final=True)
+    hm_final = _metrics_snap(final=True)
+    metrics.snapshot(ev_log, final=True,
+                     **({"mesh": hm_final} if hm_final else {}))
     stats.metrics = metrics.rollup()
     done = dict(
         reads=stats.n_reads, windows=stats.n_windows,

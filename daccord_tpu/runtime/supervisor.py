@@ -592,7 +592,14 @@ class DeviceSupervisor:
         independence — instead of failing over whole-program. Returns False
         when no smaller mesh exists (width 1): the caller then engages the
         native/CPU fallback as before. Walks SUSPECT -> RETRYING, the same
-        legal chain a transient retry uses."""
+        legal chain a transient retry uses.
+
+        Culprit attribution (ISSUE 13): an injected loss names its member
+        (``device_lost:N@K``); a real loss on a non-host-local mesh runs a
+        bounded per-device probe. The attributed index rides the
+        ``mesh.shrink`` event and flips that member's ``mesh.device`` state
+        row to ``lost`` — so a partial-mesh degradation is attributable to
+        the single chip that caused it, not just to "the mesh"."""
         m = self._mesh
         if m is None:
             return False
@@ -600,7 +607,17 @@ class DeviceSupervisor:
             self.log.log("mesh.degrade", nd=int(m.nd), reason=reason[:200])
             return False
         nd_from = m.nd
-        m.shrink()
+        culprit = -1
+        if self.faults is not None and self.faults.dead_device >= 0:
+            culprit = self.faults.dead_device
+        elif self.faults is None and not getattr(m, "host_local", True):
+            with self.tracer.span("probe"):
+                dead = m.probe_devices()
+            if len(dead) == 1:
+                culprit = dead[0]
+        prev_state = {i: row.get("state")
+                      for i, row in getattr(m, "device_stats", {}).items()}
+        m.shrink(culprit=culprit)
         if self.faults is not None:
             # an injected device_lost marks the whole (virtual) backend dead;
             # in mesh terms the loss was ONE member, and the shrink just
@@ -608,9 +625,19 @@ class DeviceSupervisor:
             # plan's dead latch clears (a second device_lost spec kills
             # another member and shrinks again)
             self.faults.device_dead = False
+            self.faults.dead_device = -1
         self.counters["mesh_shrinks"] += 1
         self.log.log("mesh.shrink", nd_from=int(nd_from), nd_to=int(m.nd),
-                     reason=reason[:200])
+                     culprit=int(culprit), reason=reason[:200])
+        # one mesh.device state row per member THIS shrink removed (earlier
+        # casualties already have theirs): the flight-recorder record
+        # `daccord-top` keys its device table on
+        for i, row in getattr(m, "device_stats", {}).items():
+            if row.get("state") != prev_state.get(i):
+                self.log.log("mesh.device", device=int(i),
+                             state=row["state"],
+                             platform=row.get("platform", "?"),
+                             dispatches=int(row.get("dispatches", 0)))
         self._transition(RETRYING,
                          reason=f"partial mesh {nd_from}->{m.nd}")
         return True
@@ -633,13 +660,12 @@ class DeviceSupervisor:
         w = self._width_of(batch)
         fresh = self._is_fresh(key)
         self.counters["dispatch"] += 1
+        t_d = time.time()
         inner = self._guarded("dispatch", self._dispatch_fn,
                               lambda attempt: (batch,), key, fresh, width=w)
         self._seen_shapes.add(key)
         if fresh:
-            from ..utils.obs import record_fingerprint
-
-            record_fingerprint(key)
+            self._record_compile(key, time.time() - t_d)
         h = _SupHandle(inner, batch, key)
         self.counters["fetch"] += 1
         return self._guarded("fetch", self._fetch_fn,
@@ -656,13 +682,12 @@ class DeviceSupervisor:
         key = self._shape_key(batch) + ":clamp"
         fresh = self._is_fresh(key)
         self.counters["dispatch"] += 1
+        t_d = time.time()
         out = self._guarded("dispatch", self._clamp_solve,
                             lambda attempt: (batch,), key, fresh, width=eff)
         self._seen_shapes.add(key)
         if fresh:
-            from ..utils.obs import record_fingerprint
-
-            record_fingerprint(key)
+            self._record_compile(key, time.time() - t_d)
         return out
 
     def _gov_dispatch(self, batch, key: str, reason: str | None) -> _SupHandle:
@@ -723,6 +748,7 @@ class DeviceSupervisor:
         self.counters["dispatch"] += 1
         while True:
             fresh = self._is_fresh(key)
+            t_d = time.time()
             try:
                 inner = self._guarded("dispatch", self._dispatch_fn,
                                       lambda attempt: (batch,), key, fresh,
@@ -741,10 +767,19 @@ class DeviceSupervisor:
                 return _SupHandle(None, batch, key, degraded=True)
         self._seen_shapes.add(key)
         if fresh:
-            from ..utils.obs import record_fingerprint
-
-            record_fingerprint(key)
+            self._record_compile(key, time.time() - t_d)
         return _SupHandle(inner, batch, key)
+
+    def _record_compile(self, key: str, wall_s: float) -> None:
+        """Fold a fresh shape's measured dispatch wall into the fingerprint
+        registry (ISSUE 13): jit compilation is synchronous at call time,
+        so a cold dispatch's wall IS the compile wall to within the launch
+        cost. The registry entry keeps the FIRST (cold) wall; the
+        ``sup_compile_done`` event gives live consumers the same number."""
+        from ..utils.obs import record_fingerprint
+
+        record_fingerprint(key, wall_s=wall_s)
+        self.log.log("sup_compile_done", key=key, wall_s=round(wall_s, 3))
 
     def _refetch_args(self, h: _SupHandle, attempt: int):
         """Arg builder for a guarded fetch: attempt 1 uses the live handle;

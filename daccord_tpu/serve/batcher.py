@@ -444,6 +444,15 @@ class SolveGroup:
             if self._inflight:
                 self._drain(0)
 
+    def busy(self) -> bool:
+        """True while the solve lock is held (a dispatch/flush/solve is in
+        flight). Pure try-lock — the lock-free healthz contract: a liveness
+        probe must never queue behind a minutes-long jit compile."""
+        locked = self._lock.acquire(blocking=False)
+        if locked:
+            self._lock.release()
+        return not locked
+
     def stats(self) -> dict:
         """Group stats. NON-BLOCKING on the solve lock (same reasoning as
         :meth:`flush_stale`): during an in-flight solve the counters are
@@ -711,6 +720,14 @@ class JobSolver:
     def hp_ols(self):
         """The group's shared hp-rescue OffsetLikely tables (read-only)."""
         return self.group.hp_ols
+
+    @property
+    def mesh(self) -> int:
+        """The group's mesh width (0 = single-device) — the pipeline stamps
+        it into ledger rows (ISSUE 13 satellite: the ROADMAP-4 router
+        training set segments by mesh configuration), so a job solved
+        through a mesh-backed group records which topology solved it."""
+        return int(getattr(self.group.gcfg, "mesh", 0) or 0)
 
     def describe(self) -> str:
         return f"serve-batcher:{self.group.name}"

@@ -16,9 +16,13 @@ doctrine. The API surface:
                                 (the poison-free abort path the batcher
                                 guarantees — cohabiting jobs unaffected)
     DELETE /v1/jobs/<id>        abort
-    GET    /v1/healthz          liveness + queue depth + RSS
+    GET    /v1/healthz          liveness + uptime + queue depth + per-group
+                                busy flags + RSS (lock-free: never queues
+                                behind a group solve)
     GET    /v1/metrics          registry rollup (latency quantiles),
-                                admission + warm-state + batcher stats
+                                admission + warm-state + batcher stats;
+                                ``?format=prom`` = Prometheus text
+                                exposition of the same registry
     POST   /v1/shutdown         graceful drain + stop
 
 Streaming reads the job's ``out.fasta.part`` as it grows — the runner
@@ -128,6 +132,12 @@ class ServeHandler(BaseHTTPRequestHandler):
             # solve lock (a jit compile holds it for minutes)
             return self._send(200, self.svc.health())
         if path == "/v1/metrics":
+            if self._query().get("format") == "prom":
+                # Prometheus text exposition (ISSUE 13): the scrapeable
+                # health plane — registry + health/admission gauges through
+                # obs.render_prom, no group solve lock taken
+                return self._send(200, body=self.svc.stats_prom().encode(),
+                                  ctype="text/plain; version=0.0.4")
             return self._send(200, self.svc.stats())
         if path == "/v1/jobs":
             with self.svc._jobs_lock:

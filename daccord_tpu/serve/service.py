@@ -88,6 +88,16 @@ class ServeConfig:
                                      # must bound registry growth
     metrics_snapshot_s: float = 30.0
     shed_max_levels: int = 3         # batch-ladder floor under pressure
+    # SLO burn tracking (ISSUE 13): rolling p99 job latency over
+    # slo_window_s compared against the p99 target. burn = p99/target;
+    # crossing slo_shed_burn drives the batch-width shed ladder BEFORE the
+    # target is breached (burn >= 1 is the breach the sentinel flags), and
+    # dropping below slo_clear_burn releases the slo-held shed rung.
+    # 0 = tracking off.
+    slo_p99_s: float = 0.0
+    slo_window_s: float = 60.0
+    slo_shed_burn: float = 0.8
+    slo_clear_burn: float = 0.5
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     events_path: str | None = None   # default: <workdir>/serve.events.jsonl
 
@@ -131,6 +141,21 @@ class ConsensusService:
         self._queue: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._shed = 0
+        # SLO burn state (ISSUE 13): finished-job latencies inside the
+        # rolling window, the slo-held shed rung, and the last emitted burn
+        # band (serve.slo emits on band changes, not every tick)
+        from collections import deque
+
+        self._lat_window: deque = deque()
+        # guards window iteration in _slo_tick against concurrent worker
+        # appends (deque append is atomic; iterating one mid-append raises)
+        self._lat_lock = threading.Lock()
+        self._slo_shed = 0
+        self._slo_band: int | None = None
+        # lifetime peaks (ISSUE 13 satellite): the rollup must answer "how
+        # bad did it GET", not just "how bad is it now"
+        self._peak_rss_mb = 0.0
+        self._peak_queue_depth = 0
         self.started_ts = time.time()
         self.log_event("serve.start", workdir=cfg.workdir,
                        backend=cfg.backend, batch=int(cfg.batch),
@@ -183,6 +208,11 @@ class ConsensusService:
                 job.first_emit_ts - job.submitted_ts)
         if job.done_ts:
             h("job_latency_s").observe(job.done_ts - job.submitted_ts)
+            if self.cfg.slo_p99_s:
+                # rolling SLO window (pruned by the ticker's slo pass)
+                with self._lat_lock:
+                    self._lat_window.append(
+                        (job.done_ts, job.done_ts - job.submitted_ts))
         if job.done_ts and job.windows and job.started_ts:
             run_s = max(job.done_ts - job.started_ts, 1e-9)
             self.metrics.gauge("last_job_windows_per_sec").set(
@@ -324,7 +354,11 @@ class ConsensusService:
         is held across real device solves (a first-batch jit compile runs
         minutes on TPU), and a liveness probe that queued behind it would
         time out and get a perfectly healthy server killed by its
-        orchestrator. Only the (briefly-held) jobs lock is touched."""
+        orchestrator. Only the (briefly-held) jobs lock is touched; the
+        per-group busy flags come from a try-lock (``SolveGroup.busy`` —
+        never a blocking acquire), and queue depth is a lock-free qsize.
+        The on-call triage fields (ISSUE 13): uptime, queue depth, and
+        WHICH group is mid-solve when latency spikes."""
         from ..runtime.governor import host_rss_mb
 
         with self._jobs_lock:
@@ -334,6 +368,9 @@ class ConsensusService:
         return {"ok": True,
                 "uptime_s": round(time.time() - self.started_ts, 3),
                 "jobs": states, "shed_level": self._shed,
+                "queue_depth": self._queue.qsize(),
+                "groups_busy": {g.name: g.busy()
+                                for g in self.warm.groups()},
                 "rss_mb": round(host_rss_mb(), 1)}
 
     def stats(self) -> dict:
@@ -344,6 +381,27 @@ class ConsensusService:
                 "admission": self.admission.stats(),
                 "warm": self.warm.stats(),
                 "metrics": self.metrics.rollup()}
+
+    def stats_prom(self) -> str:
+        """Prometheus text exposition of the live registry (ISSUE 13: the
+        scrapeable health plane behind ``GET /v1/metrics?format=prom``).
+        Health/admission scalars fold in as extra gauges so one scrape
+        answers the whole on-call checklist; renders through the shared
+        ``obs.render_prom`` so the pounce scrape checker lints exactly what
+        production serves."""
+        from ..utils.obs import render_prom
+
+        self._refresh_gauges()
+        roll = self.metrics.rollup()
+        g = roll["gauges"]
+        g["uptime_s"] = round(time.time() - self.started_ts, 3)
+        g["queue_depth"] = self._queue.qsize()
+        adm = self.admission.stats()
+        for k in ("admitted", "rejected", "shed"):
+            roll["counters"][f"admission_{k}"] = int(adm.get(k, 0))
+        for grp in self.warm.groups():
+            g[f"group_busy_{grp.name}"] = float(grp.busy())
+        return render_prom(roll, prefix="daccord_serve")
 
     def shutdown(self, drain: bool = True, timeout_s: float = 300.0) -> None:
         """Graceful stop: admission closes, queued+running jobs finish
@@ -372,6 +430,12 @@ class ConsensusService:
 
         durable_write(os.path.join(self.cfg.workdir, "serve.metrics.json"),
                       lambda fh: json.dump(self.stats(), fh), mode="wt")
+        # the scrapeable twin (ISSUE 13): the same registry as a prom text
+        # exposition, durably beside the JSON rollup — post-mortem tooling
+        # and the pounce scrape checker read one format
+        prom = self.stats_prom()
+        durable_write(os.path.join(self.cfg.workdir, "serve.metrics.prom"),
+                      lambda fh: fh.write(prom), mode="wt")
         with self._jobs_lock:
             n_done = sum(j.state == DONE for j in self.jobs.values())
         self.log_event("serve.done", jobs=len(self.jobs), done=n_done,
@@ -473,18 +537,64 @@ class ConsensusService:
                         and now - j.done_ts >= ttl):
                     del self.jobs[jid]
 
+    def _slo_tick(self) -> None:
+        """SLO burn tracking (ISSUE 13): rolling p99 job latency over the
+        window vs the target. ``burn = p99/target``; crossing the shed
+        fraction raises the slo-held shed rung so the batch ladder engages
+        BEFORE the target is breached (burn >= 1 — the breach the sentinel
+        flags), and a cleared window releases it one rung per tick.
+        ``serve.slo`` emits on burn-band changes, not every tick."""
+        cfg = self.cfg
+        if not cfg.slo_p99_s:
+            return
+        now = time.time()
+        win = self._lat_window
+        with self._lat_lock:
+            while win and now - win[0][0] > cfg.slo_window_s:
+                win.popleft()
+            lats = sorted(v for _, v in win)
+        n = len(lats)
+        p99 = lats[min(int(0.99 * n), n - 1)] if n else None
+        if p99 is None:
+            # an empty window (traffic stopped) must still release a held
+            # rung per tick, or a past burst pins the shed ladder forever
+            if self._slo_shed:
+                self._slo_shed -= 1
+            return
+        burn = round(p99 / cfg.slo_p99_s, 3)
+        self.metrics.gauge("slo_burn").set(burn)
+        self.metrics.gauge("slo_p99_s").set(round(p99, 4))
+        if burn >= cfg.slo_shed_burn:
+            self._slo_shed = min(self._slo_shed + 1, cfg.shed_max_levels)
+        elif burn < cfg.slo_clear_burn and self._slo_shed:
+            self._slo_shed -= 1
+        band = int(burn * 10)
+        if band != self._slo_band:
+            self._slo_band = band
+            self.log_event("serve.slo", target_s=cfg.slo_p99_s,
+                           p99_s=round(p99, 4), burn=burn, n=n,
+                           window_s=cfg.slo_window_s, shed=self._slo_shed)
+
     def _pressure_tick(self) -> None:
         """The shed ladder (ISSUE 10 (c)): hard pressure halves every
         group's merged-batch width one rung per second of sustained
         pressure (bounded); clear pressure restores one rung per second.
-        Degrades throughput, never bytes — it is the capacity governor's
-        batch-bisect argument applied service-wide."""
+        The SLO burn tracker holds its own rung (``_slo_tick``) — the
+        effective level is the max of the two, so latency pressure sheds
+        before an SLO breach even when RSS is fine. Degrades throughput,
+        never bytes — it is the capacity governor's batch-bisect argument
+        applied service-wide."""
         level, rss = self.admission.pressure_level()
+        self._peak_rss_mb = max(self._peak_rss_mb, rss)
+        qd = self._queue.qsize()
+        self._peak_queue_depth = max(self._peak_queue_depth, qd)
+        self._slo_tick()
         want = self._shed
         if level == "hard":
             want = min(self._shed + 1, self.cfg.shed_max_levels)
         elif level is None and self._shed:
             want = self._shed - 1
+        want = max(want, self._slo_shed)
         if want != self._shed:
             self._shed = want
             self.log_event("serve.shed", level=int(want),
@@ -500,7 +610,17 @@ class ConsensusService:
             g("jobs_total").set(float(len(self.jobs)))
             g("jobs_running").set(float(sum(
                 1 for j in self.jobs.values() if j.state == RUNNING)))
-        g("rss_mb").set(host_rss_mb())
+        rss = host_rss_mb()
+        self._peak_rss_mb = max(self._peak_rss_mb, rss)
+        qd = self._queue.qsize()
+        self._peak_queue_depth = max(self._peak_queue_depth, qd)
+        g("rss_mb").set(rss)
+        # peaks, not just the last sample (ISSUE 13 satellite): the durable
+        # rollup must answer "how bad did it get", and a drained shutdown
+        # always reads 0 at the last tick
+        g("rss_mb_peak").set(self._peak_rss_mb)
+        g("queue_depth").set(float(qd))
+        g("queue_depth_peak").set(float(self._peak_queue_depth))
         g("shed_level").set(float(self._shed))
         mixed = rows = 0
         for grp in self.warm.groups():

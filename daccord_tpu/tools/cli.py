@@ -1067,6 +1067,13 @@ def serve_main(argv=None) -> int:
                    help="write {port, pid} JSON here once the listener is "
                         "bound (scripts discovering an ephemeral --port 0)")
     p.add_argument("--metrics-snapshot-s", type=float, default=30.0)
+    p.add_argument("--slo-p99-s", type=float, default=0.0,
+                   help="p99 job-latency SLO target: the ticker tracks "
+                        "rolling p99 vs this, emits serve.slo burn events, "
+                        "and drives the batch-width shed ladder BEFORE "
+                        "breach (0 = off)")
+    p.add_argument("--slo-window-s", type=float, default=60.0,
+                   help="rolling window the SLO p99 is computed over")
     args = p.parse_args(argv)
 
     backend_explicit = args.backend != "auto"
@@ -1111,6 +1118,7 @@ def serve_main(argv=None) -> int:
         flush_lag_s=args.flush_lag_ms / 1000.0,
         idle_evict_s=args.idle_evict_s,
         metrics_snapshot_s=args.metrics_snapshot_s,
+        slo_p99_s=args.slo_p99_s, slo_window_s=args.slo_window_s,
         admission=AdmissionConfig(
             max_queued_jobs=args.max_queued,
             tenant_max_queued=args.tenant_max_queued,
@@ -1425,8 +1433,22 @@ def _trace_main(argv=None) -> int:
     return trace_main(argv)
 
 
+def _top_main(argv=None) -> int:
+    from .top import top_main
+
+    return top_main(argv)
+
+
+def _sentinel_main(argv=None) -> int:
+    from .sentinel import sentinel_main
+
+    return sentinel_main(argv)
+
+
 _TOOLS["eventcheck"] = _eventcheck_main
 _TOOLS["trace"] = _trace_main
+_TOOLS["top"] = _top_main
+_TOOLS["sentinel"] = _sentinel_main
 
 
 def main(argv=None) -> int:

@@ -39,6 +39,12 @@ EVENT_FIELDS: dict[str, dict] = {
     # (ts moved to BASE_FIELDS: the logger stamps every record)
     "sup_state": {"state_from": str, "state_to": str, "reason": str},
     "sup_compile": {"key": str, "expected_wall_s": _NUM},
+    # the measured counterpart (ISSUE 13): cold dispatch wall ~= compile
+    # wall (jit compiles synchronously at call time); also folded into the
+    # compile-fingerprint registry for daccord-sentinel's drift bands
+    "sup_compile_done": {"key": str, "wall_s": _NUM},
+    # opt-in jax.profiler capture bracket (DACCORD_PROFILE_DIR)
+    "profile.capture": {"dir": str, "dispatch": int, "state": str},
     "sup_heartbeat": {"op": str, "key": str, "waited_s": _NUM,
                       "deadline_s": _NUM},
     # cls = retry class (timeout | transient): budgets apply per class, and
@@ -64,13 +70,20 @@ EVENT_FIELDS: dict[str, dict] = {
                     "occupancy": _NUM},
     # mesh-native solve path (parallel/mesh.py): one mesh.init per built
     # sharded solver; mesh.shrink = the partial-mesh degradation rung
-    # (N -> N/2 on declared device loss, run stays on the smaller primary);
-    # mesh.restore = failback rebuilt the full mesh; mesh.degrade = no
-    # smaller mesh exists (width 1) — whole-program failover follows
+    # (N -> N/2 on declared device loss, run stays on the smaller primary;
+    # culprit = attributed dead member index, -1 unknown); mesh.restore =
+    # failback rebuilt the full mesh; mesh.degrade = no smaller mesh exists
+    # (width 1) — whole-program failover follows. mesh.device (ISSUE 13) is
+    # the per-chip flight-recorder row: one per member at snapshot cadence
+    # (state ok + wall/rows/HBM gauges) and one the moment a shrink flips a
+    # member to lost/dropped — the record that makes a partial-mesh
+    # degradation attributable to a single device index.
     "mesh.init": {"nd": int, "devices": str, "esc_cap": int},
-    "mesh.shrink": {"nd_from": int, "nd_to": int, "reason": str},
+    "mesh.shrink": {"nd_from": int, "nd_to": int, "culprit": int,
+                    "reason": str},
     "mesh.restore": {"nd_from": int, "nd_to": int},
     "mesh.degrade": {"nd": int, "reason": str},
+    "mesh.device": {"device": int, "state": str},
     # two-stream tier ladder (ISSUE 4): one row per Stream B rescue dispatch
     # (rows = live rescue windows, slots = padded batch width, reason =
     # full | lag | final | pressure — the last is a host-watermark
@@ -127,6 +140,11 @@ EVENT_FIELDS: dict[str, dict] = {
     "serve.group": {"group": str, "key": str, "backend": str, "batch": int},
     "serve.evict": {"group": str, "key": str, "idle_s": _NUM},
     "serve.done": {"jobs": int, "done": int, "wall_s": _NUM},
+    # SLO burn tracking (ISSUE 13): rolling p99-vs-target over the serve
+    # latency window — burn = p99/target (>= the shed fraction drives the
+    # batch-width shed ladder BEFORE breach; >= 1 is a breach), n = jobs in
+    # the window. Emitted by the serve ticker when burn changes band.
+    "serve.slo": {"target_s": _NUM, "burn": _NUM, "n": int},
     "bench_start": {"batch": int},
     "bench_compile": {"batch": int, "cached": bool, "expected_wall_s": _NUM},
     # self-staging bench ladder: one row per completed rung (sidecar

@@ -67,6 +67,11 @@ MILESTONES = frozenset({
     "serve.start", "serve.job", "serve.admit", "serve.reject",
     "serve.commit", "serve.abort", "serve.shed", "serve.group",
     "serve.evict", "serve.done",
+    # flight recorder (ISSUE 13): mesh topology changes, SLO burn band
+    # changes, and profiler capture brackets are operator-grade milestones
+    # (the per-snapshot mesh.device gauge rows are summarized only)
+    "mesh.init", "mesh.shrink", "mesh.restore", "mesh.degrade",
+    "serve.slo", "profile.capture",
 })
 
 
@@ -393,6 +398,29 @@ def trace_main(argv=None) -> int:
     return 1 if (errors and args.check) else 0
 
 
+def last_alive_info(path: str = "TUNNEL_LOG.jsonl") -> tuple[str | None, float | None]:
+    """``(iso_ts, age_hours)`` of the most recent alive:true probe in a
+    TUNNEL_LOG-style jsonl (None, None when the log has no alive record).
+    The one staleness reader shared by ``--probe-history``, bench.py's
+    startup echo, and the BENCH_* ``last_real_tpu_ts`` stamp — so a
+    ``fallback: true`` rung is attributable to a dated tunnel death at a
+    glance, from the sidecar alone."""
+    import calendar
+    import time as _time
+
+    last = None
+    for r in _read_jsonl(path):
+        if r.get("alive"):
+            last = str(r.get("ts", ""))
+    if not last:
+        return None, None
+    try:
+        t = calendar.timegm(_time.strptime(last, "%Y-%m-%dT%H:%M:%SZ"))
+        return last, round((_time.time() - t) / 3600.0, 1)
+    except ValueError:
+        return last, None
+
+
 def probe_history_main(path: str) -> int:
     """--probe-history: pass/fail runs over a TUNNEL_LOG-style jsonl."""
     recs = _read_jsonl(path)
@@ -415,7 +443,9 @@ def probe_history_main(path: str) -> int:
             runs.append((alive, 1, ts, ts))
     print(f"{path}: {len(recs)} probes, {n_alive} alive / "
           f"{len(recs) - n_alive} dead")
-    print(f"  last alive: {last_alive or 'NEVER'}")
+    _, age_h = last_alive_info(path)
+    print(f"  last alive: {last_alive or 'NEVER'}"
+          + (f" ({age_h}h ago)" if age_h is not None else ""))
     cur = runs[-1]
     print(f"  current streak: {'ALIVE' if cur[0] else 'dead'} x{cur[1]} "
           f"(since {cur[2]})")

@@ -323,6 +323,115 @@ class MetricsRegistry:
                           for k, h in sorted(self._hists.items())}}
 
 
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (the live health plane, ISSUE 13): the one
+# render/parse pair shared by the serve HTTP endpoint
+# (GET /v1/metrics?format=prom), the durable shard/fleet .prom dumps, and
+# the pounce scrape checker — producer and lint can never drift apart.
+# ---------------------------------------------------------------------------
+
+def _prom_name(name: str) -> str:
+    """A registry metric name as a legal Prometheus metric name."""
+    import re
+
+    n = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    return n if not n[:1].isdigit() else "_" + n
+
+
+def _prom_labels(labels: dict | None) -> str:
+    if not labels:
+        return ""
+    import json as _json
+
+    return "{" + ",".join(
+        f"{_prom_name(str(k))}={_json.dumps(str(v))}"
+        for k, v in sorted(labels.items())) + "}"
+
+
+def render_prom(rollup: dict, prefix: str = "daccord",
+                labels: dict | None = None) -> str:
+    """Prometheus text exposition (format 0.0.4) of a registry rollup dict
+    (:meth:`MetricsRegistry.rollup`, or the ``metrics`` key of a committed
+    ``*.metrics.json``). Counters render as ``<prefix>_<name>_total``,
+    gauges as ``<prefix>_<name>``, histograms as summaries (``_count``,
+    ``_sum``, and ``quantile`` series from the reservoir p50/p95/p99).
+    ``labels`` (e.g. ``{"shard": 3}``) ride every sample, so fleet-merged
+    scrapes keep per-shard attribution."""
+    lab = _prom_labels(labels)
+    lines: list[str] = []
+    for name, v in (rollup.get("counters") or {}).items():
+        mn = f"{_prom_name(prefix)}_{_prom_name(name)}_total"
+        lines.append(f"# TYPE {mn} counter")
+        lines.append(f"{mn}{lab} {int(v)}")
+    for name, v in (rollup.get("gauges") or {}).items():
+        mn = f"{_prom_name(prefix)}_{_prom_name(name)}"
+        lines.append(f"# TYPE {mn} gauge")
+        lines.append(f"{mn}{lab} {float(v):g}")
+    for name, h in (rollup.get("hists") or {}).items():
+        mn = f"{_prom_name(prefix)}_{_prom_name(name)}"
+        lines.append(f"# TYPE {mn} summary")
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            val = h.get(key)
+            if val is None:
+                continue
+            ql = dict(labels or {}, quantile=q)
+            lines.append(f"{mn}{_prom_labels(ql)} {float(val):g}")
+        lines.append(f"{mn}_count{lab} {int(h.get('count') or 0)}")
+        lines.append(f"{mn}_sum{lab} {float(h.get('sum') or 0.0):g}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prom(text: str) -> tuple[dict, list[str]]:
+    """Parse/lint a Prometheus text exposition: returns
+    ``({metric_name: [(labels_str, value)]}, errors)``. The checker the
+    pounce scrape gate runs — every sample line must be
+    ``name[{labels}] value`` with a finite float value, every ``# TYPE``
+    must name a known type, and a typed metric must have >= 1 sample."""
+    import math
+    import re
+
+    samples: dict[str, list] = {}
+    errs: list[str] = []
+    typed: dict[str, str] = {}
+    line_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+    for ln, line in enumerate(text.splitlines(), start=1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) != 4 or parts[3] not in (
+                        "counter", "gauge", "summary", "histogram",
+                        "untyped"):
+                    errs.append(f"line {ln}: malformed TYPE comment")
+                else:
+                    typed[parts[2]] = parts[3]
+            continue
+        m = line_re.match(line)
+        if m is None:
+            errs.append(f"line {ln}: not a sample line: {line[:80]!r}")
+            continue
+        name, _labels, val = m.groups()
+        try:
+            fv = float(val)
+        except ValueError:
+            errs.append(f"line {ln}: {name}: non-numeric value {val!r}")
+            continue
+        if math.isnan(fv) or math.isinf(fv):
+            errs.append(f"line {ln}: {name}: non-finite value {val!r}")
+            continue
+        samples.setdefault(name, []).append((_labels or "", fv))
+    for name, kind in typed.items():
+        base = [k for k in samples
+                if k == name or (kind in ("summary", "histogram")
+                                 and k.startswith(name))]
+        if not base:
+            errs.append(f"TYPE {name} declared but no samples follow")
+    return samples, errs
+
+
 class WindowLedger:
     """Per-window outcome ledger: one ``window`` jsonl row per window the
     pipeline accounted — the exact training set the learned window router
@@ -341,7 +450,7 @@ class WindowLedger:
 
     def record(self, aread: int, widx: int, length: int, depth: int,
                tier: int, k: int, solved: bool, stream: str, rescued: bool,
-               wall_s: float, job: str | None = None) -> None:
+               wall_s: float, job: str | None = None, mesh: int = 0) -> None:
         self.rows += 1
         log = self.log
         if log._fh is None:
@@ -350,13 +459,16 @@ class WindowLedger:
         # per window is the highest-volume telemetry record, and skipping
         # json.dumps keeps it ~3x cheaper — the hot-path budget (<=2% on the
         # native engine) is spent mostly here. `job` (ISSUE 10 satellite:
-        # the serving plane's per-workload tag) is optional so batch-run
-        # ledgers stay byte-for-byte what they were; when present it lets
-        # the ROADMAP-5 router training set segment per workload
+        # the serving plane's per-workload tag) and `mesh` (the solve path's
+        # mesh width — lets the ROADMAP-4 router training set segment by
+        # mesh configuration) are optional so non-serve / non-mesh ledgers
+        # stay byte-for-byte what they were
         now = time.time()
         # json.dumps, not raw interpolation: job_tag is a public config
         # field, and a quote/backslash in it would corrupt every row
         jf = ', "job": %s' % json.dumps(job) if job else ""
+        if mesh:
+            jf += ', "mesh": %d' % mesh
         log._buf.append(
             '{"t": %.3f, "ts": %.6f, "event": "window", "aread": %d, '
             '"widx": %d, "len": %d, "depth": %d, "tier": %d, "k": %d, '
@@ -580,6 +692,30 @@ def _fingerprint_path() -> str | None:
     return os.path.join(d, "daccord_shapes.json") if d else None
 
 
+def fingerprint_registry() -> dict:
+    """The compile-fingerprint registry as a dict ``{key: meta}`` where meta
+    carries whatever compile telemetry was recorded (``wall_s``, ``ts``,
+    HLO cost fields). Reads BOTH formats: the pre-ISSUE-13 registry was a
+    bare list of keys (meta then ``{}``). Empty dict when the compile cache
+    is disabled or the registry is unreadable."""
+    import json
+    import os
+
+    p = _fingerprint_path()
+    if p is None or not os.path.exists(p):
+        return {}
+    try:
+        with open(p) as fh:
+            d = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    if isinstance(d, dict):
+        return {str(k): (v if isinstance(v, dict) else {}) for k, v in d.items()}
+    if isinstance(d, list):
+        return {str(k): {} for k in d}
+    return {}
+
+
 def fingerprint_seen(key: str) -> bool:
     """True when ``key`` (a ladder shape fingerprint like ``tpu:B2048xD32xL64``)
     was recorded compiled on this host's persistent cache. The supervisor uses
@@ -587,43 +723,73 @@ def fingerprint_seen(key: str) -> bool:
     echo the expected cold-compile wall BEFORE going silent, so a long-quiet
     warmup is not killed as wedged (the r5 failure mode). With the compile
     cache disabled every shape is cold — always False."""
-    import json
-    import os
-
-    p = _fingerprint_path()
-    if p is None or not os.path.exists(p):
-        return False
-    try:
-        with open(p) as fh:
-            return key in json.load(fh)
-    except (OSError, json.JSONDecodeError):
-        return False
+    return key in fingerprint_registry()
 
 
-def record_fingerprint(key: str) -> None:
+def record_fingerprint(key: str, wall_s: float | None = None,
+                       meta: dict | None = None) -> None:
     """Record ``key`` as compiled-and-cached (atomic rewrite; best-effort —
-    a read-only cache dir must never sink a run)."""
+    a read-only cache dir must never sink a run). ``wall_s`` is the measured
+    cold-compile wall (the supervisor times its fresh guarded dispatches),
+    ``meta`` any extra compile telemetry (HLO flops/bytes from an AOT
+    lower+compile) — both fold into the registry entry, accumulating a
+    host-local per-shape compile-cost history for offline drift analysis
+    (``daccord-sentinel`` gates committed sidecars, not this registry).
+    Re-recording a known key only ever ADDS telemetry (first recorded wall
+    wins: that is the cold one)."""
     import json
     import os
+    import time as _time
 
     p = _fingerprint_path()
     if p is None:
         return
     try:
-        seen: list = []
-        if os.path.exists(p):
-            with open(p) as fh:
-                seen = json.load(fh)
-        if key in seen:
-            return
-        seen.append(key)
+        reg = fingerprint_registry()
+        entry = reg.get(key)
+        fresh_info = {}
+        if wall_s is not None:
+            fresh_info["wall_s"] = round(float(wall_s), 3)
+        if meta:
+            fresh_info.update(meta)
+        if entry is None:
+            entry = {"ts": round(_time.time(), 1), **fresh_info}
+        else:
+            added = {k: v for k, v in fresh_info.items() if k not in entry}
+            if not added:
+                return
+            entry = {**entry, **added}
+        reg[key] = entry
         os.makedirs(os.path.dirname(p), exist_ok=True)
         tmp = f"{p}.tmp.{os.getpid()}"
         with open(tmp, "wt") as fh:
-            json.dump(seen, fh)
+            json.dump(reg, fh)
         os.replace(tmp, p)
     except (OSError, json.JSONDecodeError):
         pass
+
+
+def hlo_cost(fn, *args, **kwargs) -> dict | None:
+    """HLO cost estimate (flops, bytes accessed) of a jitted callable at
+    the given args, via the AOT ``lower().compile()`` path — the compile
+    hits the in-process jit cache (and the persistent XLA cache) when the
+    shape was already traced, so harvesting cost after a warmup is cheap.
+    None when the backend/jax version does not expose cost_analysis; this
+    is telemetry, it must never sink a caller."""
+    try:
+        ca = fn.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            return None
+        out = {}
+        for key in ("flops", "bytes accessed"):
+            v = ca.get(key)
+            if isinstance(v, (int, float)):
+                out[key.replace(" ", "_")] = float(v)
+        return out or None
+    except Exception:
+        return None
 
 
 def expected_compile_wall_s(batch_rows: int) -> float:

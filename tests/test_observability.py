@@ -1,0 +1,526 @@
+"""Fleet flight recorder tests (ISSUE 13): per-device mesh telemetry, the
+prom health plane, daccord-top, daccord-sentinel, SLO burn tracking, and
+the ledger mesh column.
+
+The per-chip attribution contract under test: a FORCED mesh degradation
+(``device_lost:N@K``) must be attributable to device index K from the
+events alone — ``mesh.shrink`` names the culprit, ``mesh.device`` flips its
+state row to ``lost``, the surviving half excludes it, and the output stays
+byte-identical. The golden-output tests run ``daccord-top --once`` and
+``daccord-sentinel`` over COMMITTED fixture sidecars (tests/data/obs), so
+the render/flag contracts cannot drift silently.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "data", "obs")
+
+
+# ---------------------------------------------------------------------------
+# prom exposition (render + parse)
+# ---------------------------------------------------------------------------
+
+
+def test_render_parse_prom_roundtrip():
+    from daccord_tpu.utils.obs import parse_prom, render_prom
+
+    roll = {"counters": {"dispatches": 7, "weird name!": 2},
+            "gauges": {"rss_mb": 812.25},
+            "hists": {"lat_s": {"count": 3, "sum": 1.5, "p50": 0.4,
+                                "p95": 0.9, "p99": None}}}
+    text = render_prom(roll, labels={"shard": 3})
+    samples, errs = parse_prom(text)
+    assert errs == []
+    assert samples["daccord_dispatches_total"] == [('{shard="3"}', 7.0)]
+    # illegal chars sanitize into a legal metric name
+    assert "daccord_weird_name__total" in samples
+    assert samples["daccord_lat_s_count"][0][1] == 3.0
+    # the p99=None quantile is omitted, not rendered as "None"
+    assert not any("None" in ln for ln in text.splitlines())
+
+
+def test_parse_prom_flags_malformed():
+    from daccord_tpu.utils.obs import parse_prom
+
+    _, errs = parse_prom("daccord_x 1.5\nnot a sample line at all\n"
+                         "daccord_y NaN\n# TYPE daccord_ghost gauge\n")
+    msgs = "\n".join(errs)
+    assert "not a sample" in msgs
+    assert "non-finite" in msgs
+    assert "ghost" in msgs
+
+
+# ---------------------------------------------------------------------------
+# fingerprint registry v2 (compile-wall telemetry)
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_registry_v2_and_legacy(tmp_path, monkeypatch):
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    from daccord_tpu.utils.obs import (fingerprint_registry, fingerprint_seen,
+                                       record_fingerprint)
+
+    # legacy list format still reads (pre-ISSUE-13 registries)
+    os.makedirs(tmp_path / "cc", exist_ok=True)
+    with open(tmp_path / "cc" / "daccord_shapes.json", "wt") as fh:
+        json.dump(["cpu:B64xD16xL64"], fh)
+    assert fingerprint_seen("cpu:B64xD16xL64")
+    # new writes upgrade to the dict format, preserving legacy keys and
+    # folding compile telemetry in
+    record_fingerprint("cpu:B128xD16xL64", wall_s=12.345)
+    reg = fingerprint_registry()
+    assert "cpu:B64xD16xL64" in reg
+    assert reg["cpu:B128xD16xL64"]["wall_s"] == 12.345
+    # re-recording never overwrites the (cold) first wall
+    record_fingerprint("cpu:B128xD16xL64", wall_s=0.001)
+    assert fingerprint_registry()["cpu:B128xD16xL64"]["wall_s"] == 12.345
+
+
+# ---------------------------------------------------------------------------
+# ledger mesh column (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_mesh_column_and_byte_stability(tmp_path):
+    from daccord_tpu.utils.obs import WindowLedger
+
+    p0, p1 = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    led = WindowLedger(p0)
+    led.record(1, 2, 40, 10, 0, 8, True, "full", rescued=False, wall_s=0.5)
+    led.close()
+    # mesh=0 (the default) leaves the row BYTE-identical to the pre-column
+    # format: non-mesh ledgers must not change under the router training set
+    row = json.loads(open(p0).read())
+    assert "mesh" not in row and "job" not in row
+    led = WindowLedger(p1)
+    led.record(1, 2, 40, 10, 0, 8, True, "full", rescued=False, wall_s=0.5,
+               job="jobA", mesh=8)
+    led.close()
+    row = json.loads(open(p1).read())
+    assert row["mesh"] == 8 and row["job"] == "jobA"
+
+
+# ---------------------------------------------------------------------------
+# eventcheck strictness for the new kinds (satellite 4)
+# ---------------------------------------------------------------------------
+
+
+def test_eventcheck_new_kinds(tmp_path):
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    good = tmp_path / "good.jsonl"
+    good.write_text(
+        '{"t": 0.0, "ts": 1.0, "event": "mesh.device", "device": 3, '
+        '"state": "lost"}\n'
+        '{"t": 0.1, "ts": 1.1, "event": "serve.slo", "target_s": 2.0, '
+        '"burn": 0.9, "n": 12}\n'
+        '{"t": 0.2, "ts": 1.2, "event": "mesh.shrink", "nd_from": 8, '
+        '"nd_to": 4, "culprit": 3, "reason": "x"}\n'
+        '{"t": 0.3, "ts": 1.3, "event": "sup_compile_done", '
+        '"key": "cpu:B64", "wall_s": 1.5}\n'
+        '{"t": 0.4, "ts": 1.4, "event": "profile.capture", "dir": "/p", '
+        '"dispatch": 2, "state": "start"}\n')
+    assert validate_events(str(good), strict=True) == []
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(
+        '{"t": 0.0, "ts": 1.0, "event": "mesh.device", "device": "three", '
+        '"state": "lost"}\n'
+        '{"t": 0.1, "ts": 1.1, "event": "serve.slo", "target_s": 2.0}\n'
+        '{"t": 0.2, "ts": 1.2, "event": "mesh.shrink", "nd_from": 8, '
+        '"nd_to": 4, "reason": "x"}\n')
+    errs = validate_events(str(bad), strict=True)
+    msgs = "\n".join(errs)
+    assert "mesh.device.device has type str" in msgs
+    assert "serve.slo missing field 'burn'" in msgs
+    assert "mesh.shrink missing field 'culprit'" in msgs
+
+
+# ---------------------------------------------------------------------------
+# forced mesh degradation: per-device attribution (the tentpole contract)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    from daccord_tpu.formats import LasFile, read_db
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+    from daccord_tpu.runtime.pipeline import estimate_profile_for_shard
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path_factory.mktemp("obscorpus"))
+    # same corpus parameters as tests/test_mesh.py, so the :m8/:m4 shapes
+    # reuse the persistent compile cache across the two files
+    out = make_dataset(d, SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=700, min_overlap=300,
+                                    seed=47), name="mesh")
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    base = dict(batch_size=64, depth_buckets=(16,))
+    profile = estimate_profile_for_shard(db, las, PipelineConfig(**base))
+
+    def run(**kw):
+        cfg = PipelineConfig(**base, **kw)
+        return [(rid, [f.tobytes() for f in frags])
+                for rid, frags, _ in correct_shard(db, las, cfg,
+                                                   profile=profile)]
+
+    single = run()
+    assert len(single) > 0
+    return {"db": db, "las": las, "base": base, "profile": profile,
+            "run": run, "single": single}
+
+
+def test_forced_degradation_attributes_device(corpus, tmp_path, monkeypatch):
+    """device_lost:2@3 on a mesh-8 run: the shrink names culprit device 3,
+    its mesh.device row flips to lost, the survivors are the half WITHOUT
+    it, snapshots embed the mesh health map, the ledger rows carry mesh=8,
+    and the bytes match the single-device run. The whole sidecar passes
+    eventcheck --strict and daccord-trace span pairing."""
+    monkeypatch.setenv("DACCORD_FAULT", "device_lost:2@3")
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    ev = str(tmp_path / "lost.events.jsonl")
+    led = str(tmp_path / "lost.ledger.jsonl")
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+
+    cfg = PipelineConfig(**corpus["base"], mesh=8, events_path=ev,
+                         ledger_path=led)
+    got = [(rid, [f.tobytes() for f in frags])
+           for rid, frags, st in correct_shard(corpus["db"], corpus["las"],
+                                               cfg,
+                                               profile=corpus["profile"])]
+    assert got == corpus["single"]
+    evs = [json.loads(x) for x in open(ev)]
+    shr = [e for e in evs if e["event"] == "mesh.shrink"]
+    assert shr and shr[0]["culprit"] == 3, shr
+    dev_rows = [e for e in evs if e["event"] == "mesh.device"]
+    # one lost chip, attributed: device 3 (the shrink row + the later
+    # snapshot rows all agree)
+    assert {e["device"] for e in dev_rows if e["state"] == "lost"} == {3}
+    # culprit in the first half -> the SECOND half survives
+    dropped = {e["device"] for e in dev_rows if e["state"] == "dropped"}
+    assert dropped == {0, 1, 2}
+    # the final metrics snapshot embeds the mesh health map with per-device
+    # wall/rows and the gauges track the shrunken width
+    snaps = [e for e in evs if e["event"] == "metrics" and "mesh" in e]
+    assert snaps, "no metrics snapshot carried the mesh health map"
+    hm = snaps[-1]["mesh"]
+    assert hm["nd"] == 4 and hm["nd0"] == 8
+    assert hm["devices"]["3"]["state"] == "lost"
+    assert any(r["dispatches"] > 0 and r["dispatch_wall_s"] > 0
+               for r in hm["devices"].values())
+    assert snaps[-1]["gauges"]["mesh_nd"] == 4.0
+    assert snaps[-1]["gauges"]["mesh_devices_lost"] == 4.0
+    # ledger mesh column: every row records the mesh-8 solve path
+    rows = [json.loads(x) for x in open(led)]
+    assert rows and all(r.get("mesh") == 8 for r in rows
+                        if r.get("event") == "window")
+    # schema + span pairing across the degradation (satellite 4)
+    from daccord_tpu.tools.eventcheck import validate_events
+    from daccord_tpu.tools.trace import check_spans
+
+    assert validate_events(ev, strict=True) == []
+    errs, _ = check_spans(evs, "lost")
+    assert errs == []
+
+
+def test_compile_wall_lands_in_registry(corpus, tmp_path, monkeypatch):
+    """The supervisor times fresh dispatches: every cold shape's measured
+    wall lands in the fingerprint registry and as sup_compile_done."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    ev = str(tmp_path / "run.events.jsonl")
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+    from daccord_tpu.utils.obs import fingerprint_registry
+
+    cfg = PipelineConfig(**corpus["base"], events_path=ev)
+    list(correct_shard(corpus["db"], corpus["las"], cfg,
+                       profile=corpus["profile"]))
+    evs = [json.loads(x) for x in open(ev)]
+    done = [e for e in evs if e["event"] == "sup_compile_done"]
+    assert done and all(e["wall_s"] >= 0 for e in done)
+    reg = fingerprint_registry()
+    keys = [e["key"] for e in done]
+    assert keys and all(k in reg and "wall_s" in reg[k] for k in keys)
+
+
+def test_profile_capture_hook(corpus, tmp_path, monkeypatch):
+    """DACCORD_PROFILE_DIR captures one jax.profiler trace bracketing the
+    Nth dispatch; the bracket events land and the trace dir is non-empty."""
+    pdir = tmp_path / "prof"
+    monkeypatch.setenv("DACCORD_PROFILE_DIR", str(pdir))
+    monkeypatch.setenv("DACCORD_PROFILE_DISPATCH", "1")
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    ev = str(tmp_path / "prof.events.jsonl")
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+
+    cfg = PipelineConfig(**corpus["base"], events_path=ev)
+    got = [(rid, [f.tobytes() for f in frags])
+           for rid, frags, st in correct_shard(corpus["db"], corpus["las"],
+                                               cfg,
+                                               profile=corpus["profile"])]
+    assert got == corpus["single"]
+    evs = [json.loads(x) for x in open(ev)]
+    caps = [e for e in evs if e["event"] == "profile.capture"]
+    assert [c["state"] for c in caps] == ["start", "stop"], caps
+    assert os.path.isdir(pdir) and any(os.scandir(pdir))
+
+
+# ---------------------------------------------------------------------------
+# daccord-top over committed fixtures (satellite 4 golden output)
+# ---------------------------------------------------------------------------
+
+
+def test_top_once_over_fixtures(capsys):
+    from daccord_tpu.tools.top import collect, render, top_main
+
+    rundir = os.path.join(FIXTURES, "run")
+    srvdir = os.path.join(FIXTURES, "srv")
+    snap = collect([rundir, srvdir])
+    assert snap["mesh"]["devices"]["3"]["state"] == "lost"
+    assert snap["slo"]["burn"] == 0.9
+    assert snap["ratchets"]["cpu:B64xD16xL64:m4"] == 32
+    screen = render(snap)
+    # the one-screen contract: shard row, mesh device table with the lost
+    # chip, SLO burn, governor ratchet, and the fault milestones
+    assert "shard0000" in screen
+    assert "MESH 4/8" in screen
+    assert "lost" in screen and "dropped" in screen
+    assert "SLO burn 0.9" in screen
+    assert "cpu:B64xD16xL64:m4 -> 32" in screen
+    assert "mesh.shrink" in screen and "culprit=3" in screen
+    # the CLI one-shot form exits 0 and prints the same screen
+    assert top_main([rundir, srvdir, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "daccord-top" in out and "MESH 4/8" in out
+
+
+def test_top_handles_empty_dir(tmp_path, capsys):
+    from daccord_tpu.tools.top import top_main
+
+    assert top_main([str(tmp_path), "--once"]) == 0
+    assert "0 source(s)" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# daccord-sentinel (regression + fallback flagging)
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_flags_regression_and_fallback(capsys):
+    """The ISSUE 13 acceptance case: an injected 20% throughput regression
+    (BENCH_s03 is 20% below the s01/s02 median) and a fallback: true rung
+    (BENCH_s04, committed wrapper format) both flag; strict mode fails."""
+    from daccord_tpu.tools.sentinel import sentinel_main
+
+    files = sorted(glob.glob(os.path.join(FIXTURES, "bench", "*.json")))
+    assert sentinel_main(files) == 0          # advisory: warn, exit 0
+    err = capsys.readouterr().err
+    assert "BENCH_s03.json" in err and "below the series median" in err
+    assert "BENCH_s04.json" in err and "fallback: true" in err
+    assert sentinel_main(["--strict"] + files) == 1
+
+
+def test_sentinel_noise_band_suppresses_jitter():
+    from daccord_tpu.tools.sentinel import check_bench_series
+
+    entries = [("a.json", {"metric": "m", "value": 1000.0, "batch": 64}),
+               ("b.json", {"metric": "m", "value": 950.0, "batch": 64})]
+    assert check_bench_series(entries, noise=0.15) == []
+    entries.append(("c.json", {"metric": "m", "value": 700.0, "batch": 64}))
+    issues = check_bench_series(entries, noise=0.15)
+    assert len(issues) == 1 and "c.json" in issues[0]
+    # different batch = different series: a B=64 rung never compares
+    # against a B=2048 one
+    entries.append(("d.json", {"metric": "m", "value": 10.0, "batch": 2048}))
+    assert len(check_bench_series(entries, noise=0.15)) == 1
+
+
+def test_sentinel_event_red_flags(tmp_path):
+    from daccord_tpu.tools.sentinel import scan_events
+
+    bad = tmp_path / "bad.events.jsonl"
+    bad.write_text(
+        '{"t": 0.0, "ts": 1.0, "event": "sup_failover", "reason": "dead", '
+        '"fallback": "native"}\n'
+        '{"t": 1.0, "ts": 2.0, "event": "serve.slo", "target_s": 2.0, '
+        '"burn": 1.2, "n": 5}\n'
+        '{"t": 2.0, "ts": 3.0, "event": "bench_rung", "batch": 64, '
+        '"bases_per_sec": 0.0, "fallback": true, "pad_waste": 0.0}\n'
+        '{"t": 3.0, "ts": 4.0, "event": "shard_done", "reads": 1, '
+        '"windows": 2, "solved": 2, "wall_s": 1.0, "degraded": true}\n')
+    issues = scan_events(str(bad))
+    joined = "\n".join(issues)
+    assert "failover" in joined and "SLO BREACH" in joined
+    assert "fallback: true" in joined and "DEGRADED" in joined
+    clean = tmp_path / "clean.events.jsonl"
+    clean.write_text('{"t": 0.0, "ts": 1.0, "event": "shard_done", '
+                     '"reads": 1, "windows": 2, "solved": 2, "wall_s": 1.0, '
+                     '"degraded": false}\n')
+    assert scan_events(str(clean)) == []
+
+
+def test_sentinel_prom_lint(tmp_path):
+    from daccord_tpu.tools.sentinel import sentinel_main
+
+    good = tmp_path / "good.prom"
+    good.write_text("# TYPE daccord_x gauge\ndaccord_x 1.5\n")
+    assert sentinel_main(["--strict", str(good)]) == 0
+    bad = tmp_path / "bad.prom"
+    bad.write_text("daccord_x one-point-five\n")
+    assert sentinel_main(["--strict", str(bad)]) == 1
+
+
+def test_fixture_sidecars_pass_lint():
+    """The committed fixtures stay schema-valid: eventcheck --strict over
+    both events files, sentinel-clean for the non-degraded ones."""
+    from daccord_tpu.tools.eventcheck import validate_events
+    from daccord_tpu.tools.sentinel import scan_events
+
+    for p in (os.path.join(FIXTURES, "run", "shard0000.events.jsonl"),
+              os.path.join(FIXTURES, "srv", "serve.events.jsonl")):
+        assert validate_events(p, strict=True) == [], p
+        assert scan_events(p) == [], p
+
+
+# ---------------------------------------------------------------------------
+# tunnel staleness (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def test_last_alive_info(tmp_path):
+    from daccord_tpu.tools.trace import last_alive_info
+
+    log = tmp_path / "TUNNEL_LOG.jsonl"
+    log.write_text(
+        '{"ts": "2026-07-30T10:00:00Z", "alive": true, "devices": 1}\n'
+        '{"ts": "2026-08-01T08:00:00Z", "alive": false, "devices": 0}\n')
+    ts, age_h = last_alive_info(str(log))
+    assert ts == "2026-07-30T10:00:00Z"
+    assert age_h is not None and age_h > 24.0
+    ts, age_h = last_alive_info(str(tmp_path / "missing.jsonl"))
+    assert ts is None and age_h is None
+
+
+# ---------------------------------------------------------------------------
+# serve plane: SLO burn + healthz + prom (satellites 3, tentpole 2)
+# ---------------------------------------------------------------------------
+
+try:
+    from daccord_tpu.native import available as _nat_avail
+
+    _HAVE_NATIVE = _nat_avail()
+except Exception:
+    _HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(not _HAVE_NATIVE,
+                                  reason="native library unavailable")
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path_factory.mktemp("obs-serve"))
+    cfg = SimConfig(genome_len=1500, coverage=10, read_len_mean=500,
+                    min_overlap=200, seed=5)
+    return make_dataset(d, cfg, name="sv"), d
+
+
+@needs_native
+def test_serve_slo_healthz_and_prom(dataset, tmp_path):
+    """An impossible SLO target (1 ms) must emit serve.slo with burn >> 1
+    and engage the shed ladder before RSS pressure ever would; healthz
+    carries uptime/queue depth/per-group busy flags lock-free; the prom
+    exposition parses; the durable rollup records peaks; shutdown commits
+    serve.metrics.prom."""
+    import time as _time
+
+    from daccord_tpu.serve import ConsensusService, ServeConfig
+    from daccord_tpu.utils.obs import parse_prom
+
+    out, d = dataset
+    svc = ConsensusService(ServeConfig(
+        workdir=str(tmp_path / "srv"), backend="native",
+        backend_explicit=True, batch=64, workers=2, flush_lag_s=0.02,
+        slo_p99_s=0.001, slo_window_s=60.0))
+    j1 = svc.submit({"db": out["db"], "las": out["las"], "tenant": "a"})
+    svc.wait(j1["job"], 300)
+    # let the 1 Hz slo tick observe the finished job
+    deadline = _time.time() + 10
+    while _time.time() < deadline and svc._slo_shed == 0:
+        _time.sleep(0.1)
+    h = svc.health()
+    assert h["uptime_s"] > 0 and "queue_depth" in h
+    assert isinstance(h["groups_busy"], dict) and h["groups_busy"], h
+    assert all(isinstance(v, bool) for v in h["groups_busy"].values())
+    text = svc.stats_prom()
+    samples, errs = parse_prom(text)
+    assert errs == [] and "daccord_serve_uptime_s" in samples
+    assert svc._slo_shed >= 1, "SLO burn never engaged the shed ladder"
+    svc.shutdown()
+    evs = [json.loads(x) for x in
+           open(os.path.join(svc.cfg.workdir, "serve.events.jsonl"))]
+    slo = [e for e in evs if e["event"] == "serve.slo"]
+    assert slo and slo[-1]["burn"] > 1.0 and slo[-1]["target_s"] == 0.001
+    shed = [e for e in evs if e["event"] == "serve.shed"]
+    assert shed and shed[0]["level"] >= 1
+    # eventcheck accepts the new kind in a real stream
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    assert validate_events(
+        os.path.join(svc.cfg.workdir, "serve.events.jsonl"),
+        strict=True) == []
+    roll = json.load(open(os.path.join(svc.cfg.workdir,
+                                       "serve.metrics.json")))
+    g = roll["metrics"]["gauges"]
+    assert "rss_mb_peak" in g and g["rss_mb_peak"] >= g["rss_mb"] - 1e-6
+    assert "queue_depth_peak" in g
+    prom_path = os.path.join(svc.cfg.workdir, "serve.metrics.prom")
+    assert os.path.exists(prom_path)
+    _, perrs = parse_prom(open(prom_path).read())
+    assert perrs == []
+
+
+def test_slo_shed_releases_on_empty_window(tmp_path):
+    """A past burst must not pin the shed ladder: once the latency window
+    drains empty (traffic stopped), the SLO-held rung releases one per
+    tick instead of holding the reduced batch width forever."""
+    from daccord_tpu.serve import ConsensusService, ServeConfig
+
+    svc = ConsensusService(ServeConfig(
+        workdir=str(tmp_path / "srv"), backend="native",
+        backend_explicit=True, slo_p99_s=1.0, slo_window_s=60.0))
+    try:
+        svc._slo_shed = 3
+        assert not svc._lat_window
+        for _ in range(3):
+            svc._slo_tick()
+        assert svc._slo_shed == 0
+        svc._slo_tick()          # never goes negative
+        assert svc._slo_shed == 0
+    finally:
+        svc.shutdown()
+
+
+@needs_native
+def test_serve_job_ledger_mesh_zero(dataset, tmp_path):
+    """A non-mesh serve job's ledger rows omit the mesh column entirely
+    (byte-stability of the router training set)."""
+    from daccord_tpu.serve import ConsensusService, ServeConfig
+
+    out, d = dataset
+    svc = ConsensusService(ServeConfig(
+        workdir=str(tmp_path / "srv"), backend="native",
+        backend_explicit=True, batch=64, workers=1, flush_lag_s=0.02))
+    j = svc.submit({"db": out["db"], "las": out["las"], "tenant": "a"})
+    svc.wait(j["job"], 300)
+    svc.shutdown()
+    led = os.path.join(svc.cfg.workdir, "jobs", j["job"], "ledger.jsonl")
+    rows = [json.loads(x) for x in open(led)]
+    win = [r for r in rows if r.get("event") == "window"]
+    assert win and all("mesh" not in r for r in win)

@@ -336,7 +336,9 @@ def test_warm_state_reuse_and_eviction(dataset, tmp_path):
     assert svc.warm.counters == {"hits": 1, "misses": 1, "evicted": 0}
     assert len(svc.warm.groups()) == 1
     svc.warm.idle_evict_s = 0.0
-    assert svc.warm.evict_idle() == 1
+    # The ticker also calls evict_idle(); once the TTL drops to 0 either
+    # thread may win the eviction, so assert on the counter, not the return.
+    svc.warm.evict_idle()
     assert svc.warm.counters["evicted"] == 1
     assert not svc.warm.groups()
     svc.shutdown()

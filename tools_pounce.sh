@@ -51,9 +51,15 @@ EOF
 # the pkill above is itself a bench-adjacent action: date the chip's health
 # before any chip time is spent
 probe startup
-# operator context: the probe pass/fail timeline + last-alive timestamp, so
-# this window's benches are datable against the tunnel's recent history
+# operator context: the probe pass/fail timeline + last-alive timestamp (with
+# its age in hours — satellite: staleness blindness), so this window's
+# benches are datable against the tunnel's recent history
 python -m daccord_tpu.tools.cli trace --probe-history TUNNEL_LOG.jsonl || true
+# regression sentinel, advisory pass (ISSUE 13): flag fallback rungs and
+# throughput drift across the COMMITTED bench trajectory before adding to it.
+# Advisory (|| true): the committed history already contains known-degraded
+# rounds; the strict runs below gate the fresh smoke sidecars instead.
+python -m daccord_tpu.tools.cli sentinel BENCH_r*.json MULTICHIP_r*.json || true
 
 # corruption-fuzz smoke (ingest integrity layer, ISSUE 2): synthesize a toy
 # DB/LAS, bit-flip a record and tear the file mid-record, then require a
@@ -84,6 +90,10 @@ python -m daccord_tpu.tools.cli eventcheck --strict "$fuzzdir/fuzz.events.jsonl"
 python -m daccord_tpu.tools.cli trace --check --no-timeline \
     "$fuzzdir/fuzz.events.jsonl" "$fuzzdir/fuzz.ledger.jsonl" \
   || { echo "tools_pounce: fuzz sidecars failed daccord-trace lint" >&2; exit 1; }
+# regression sentinel, strict (ISSUE 13): a failover/degraded outcome in the
+# fuzz smoke would otherwise land as a green exit code
+python -m daccord_tpu.tools.cli sentinel --strict "$fuzzdir/fuzz.events.jsonl" \
+  || { echo "tools_pounce: fuzz sidecar tripped the regression sentinel" >&2; exit 1; }
 grep -q '"event": "ingest.quarantine"' "$fuzzdir/fuzz.events.jsonl" \
   || { echo "tools_pounce: fuzz run quarantined nothing" >&2; exit 1; }
 echo "tools_pounce: corruption-fuzz smoke OK" >&2
@@ -124,6 +134,10 @@ python -m daccord_tpu.tools.cli trace --check --no-timeline \
   || { echo "tools_pounce: fleet sidecars failed daccord-trace lint" >&2; exit 1; }
 grep -q '"event": "fleet.retry"' "$fleetdir/crash/fleet.events.jsonl" \
   || { echo "tools_pounce: injected worker crash was never requeued" >&2; exit 1; }
+# sentinel strict over both fleet dirs: no shard may finish degraded, and the
+# committed fleet.metrics.prom expositions must scrape-parse
+python -m daccord_tpu.tools.cli sentinel --strict "$fleetdir/ref" "$fleetdir/crash" \
+  || { echo "tools_pounce: fleet sidecars tripped the regression sentinel" >&2; exit 1; }
 cmp -s "$fleetdir/ref.fasta" "$fleetdir/crash.fasta" \
   || { echo "tools_pounce: crash-requeued fleet FASTA diverged from clean run" >&2; exit 1; }
 echo "tools_pounce: fleet smoke OK" >&2
@@ -162,6 +176,8 @@ python -m daccord_tpu.tools.cli trace --check --no-timeline "$govdir/oom.events.
   || { echo "tools_pounce: governor sidecar failed daccord-trace lint" >&2; exit 1; }
 grep -q '"event": "governor.classify"' "$govdir/oom.events.jsonl" \
   || { echo "tools_pounce: injected OOM was never classified" >&2; exit 1; }
+python -m daccord_tpu.tools.cli sentinel --strict "$govdir/oom.events.jsonl" \
+  || { echo "tools_pounce: governor sidecar tripped the regression sentinel" >&2; exit 1; }
 grep -q '"event": "sup_failover"' "$govdir/oom.events.jsonl" \
   && { echo "tools_pounce: OOM run failed over instead of degrading" >&2; exit 1; }
 cmp -s "$govdir/ref.fasta" "$govdir/oom.fasta" \
@@ -277,6 +293,13 @@ python -m daccord_tpu.tools.cli trace --check --no-timeline "$meshdir/mesh.event
   || { echo "tools_pounce: mesh sidecar failed daccord-trace lint" >&2; exit 1; }
 grep -q '"event": "mesh.init"' "$meshdir/mesh.events.jsonl" \
   || { echo "tools_pounce: mesh run never initialized a mesh" >&2; exit 1; }
+# per-device flight recorder (ISSUE 13): the clean mesh smoke must emit the
+# mesh health map (mesh.device rows ride the final metrics snapshot), and
+# the sentinel must see no degradation in it
+grep -q '"event": "mesh.device"' "$meshdir/mesh.events.jsonl" \
+  || { echo "tools_pounce: mesh run emitted no per-device telemetry" >&2; exit 1; }
+python -m daccord_tpu.tools.cli sentinel --strict "$meshdir/mesh.events.jsonl" \
+  || { echo "tools_pounce: mesh sidecar tripped the regression sentinel" >&2; exit 1; }
 echo "tools_pounce: mesh smoke OK" >&2
 rm -rf "$meshdir"
 
@@ -333,6 +356,15 @@ assert m["warm"]["misses"] == 1 and m["warm"]["hits"] >= 1, m["warm"]
 hists = m["metrics"]["hists"]
 assert "job_latency_s" in hists and hists["job_latency_s"]["p50"] is not None, \
     "latency quantiles missing from the metrics rollup"
+# live prom scrape (ISSUE 13): the exposition the checker lints below is
+# the one production actually serves, fetched over the wire
+prom = req("GET", "/v1/metrics?format=prom")
+assert b"daccord_serve_" in prom, "prom exposition empty"
+with open(f"{d}/metrics.prom", "wb") as fh:
+    fh.write(prom)
+# lock-free healthz now answers the on-call checklist
+h = json.loads(req("GET", "/v1/healthz"))
+assert "uptime_s" in h and "queue_depth" in h and "groups_busy" in h, h
 # clean shutdown must drain in-flight work and exit 0
 req("POST", "/v1/shutdown")
 print("serve smoke: parity OK, latency p50 =", hists["job_latency_s"]["p50"])
@@ -347,6 +379,15 @@ python -m daccord_tpu.tools.cli trace --check --no-timeline \
     "$servedir/srv/serve.events.jsonl" "$servedir"/srv/g*.events.jsonl \
     "$servedir"/srv/jobs/*/events.jsonl "$servedir"/srv/jobs/*/ledger.jsonl \
   || { echo "tools_pounce: serve sidecars failed daccord-trace lint" >&2; exit 1; }
+# scrape-parse the live prom exposition + the durable serve.metrics.prom,
+# and run the sentinel strict over the whole serve workdir (ISSUE 13)
+python -m daccord_tpu.tools.cli sentinel --strict "$servedir/srv" \
+    --prom "$servedir/metrics.prom" \
+  || { echo "tools_pounce: serve telemetry tripped the regression sentinel" >&2; exit 1; }
+# one-shot operator snapshot must render from the same sidecars (CI form of
+# the live `daccord-top srv/` screen)
+python -m daccord_tpu.tools.cli top --once "$servedir/srv" \
+  || { echo "tools_pounce: daccord-top failed over the serve workdir" >&2; exit 1; }
 echo "tools_pounce: serving-plane smoke OK" >&2
 rm -rf "$servedir"
 
