@@ -356,6 +356,10 @@ def device_throughput(data: dict, max_batches: int | None = None,
     bases = 0
     solved = 0
     inflight: deque = deque()
+    # saturation accounting (ISSUE 14): device-occupancy integral +
+    # fetch-blocked wall, so the committed rung carries the same
+    # starvation gauges + verdict a pipeline run stamps
+    sat = {"busy_s": 0.0, "t0": None, "fetch_s": 0.0, "dispatch_s": 0.0}
 
     def drain(to_depth: int):
         nonlocal bases, solved, n_hp
@@ -369,7 +373,14 @@ def device_throughput(data: dict, max_batches: int | None = None,
             # liveness heartbeat: a pounce watcher tailing the events file
             # can tell a progressing bench from a wedged one
             ev.log("bench_drain", fetched=n_pop, inflight=len(inflight))
-        for (h, bi), out in zip(entries, fetch_many([h for h, _ in entries])):
+        tf = time.perf_counter()
+        outs = fetch_many([h for h, _ in entries])
+        now = time.perf_counter()
+        sat["fetch_s"] += now - tf
+        if not inflight and sat["t0"] is not None:
+            sat["busy_s"] += now - sat["t0"]
+            sat["t0"] = None
+        for (h, bi), out in zip(entries, outs):
             if nladder is not None:
                 # the production drain's hp pass (runtime/pipeline.py
                 # hp_pass C++ branch) on this batch's host-side tensors
@@ -389,16 +400,30 @@ def device_throughput(data: dict, max_batches: int | None = None,
             solved += int(out["solved"].sum())
 
     for i in range(nb):
+        td = time.perf_counter()
+        if sat["t0"] is None:
+            sat["t0"] = td
         inflight.append((solve_ladder_async(make_batch(i), ladder,
                                             esc_cap=ESC_CAP), i))
+        sat["dispatch_s"] += time.perf_counter() - td
         if len(inflight) >= max_inflight:
             drain(max_inflight // 2)
     drain(0)
     dt = time.perf_counter() - t0
+    from daccord_tpu.utils.obs import bottleneck_verdict, saturation_gauges
+
+    gs = saturation_gauges(dt, sat["fetch_s"], sat["busy_s"])
     info = dict(windows=nb * batch, solved=solved, wall_s=round(dt, 3),
                 device=str(jax.devices()[0]).replace(" ", ""),
                 solve_rate=round(solved / (nb * batch), 4),
-                batch=batch, rtt_ms=rtt_ms)
+                batch=batch, rtt_ms=rtt_ms,
+                # ISSUE 14: every committed rung carries the starvation
+                # gauges + the automatic bottleneck verdict, so the device
+                # bench trajectory is sentinel-guarded for feeder drift too
+                saturation={**gs,
+                            "dispatch_s": round(sat["dispatch_s"], 3),
+                            "fetch_blocked_s": round(sat["fetch_s"], 3)},
+                verdict=bottleneck_verdict(gs)["verdict"])
     if ESC_CAP is not None:
         info["esc_cap"] = ESC_CAP
     if N_CANDIDATES is not None:
@@ -524,9 +549,15 @@ def cpu_fallback_throughput(data: dict, n_windows: int = 2048,
         bases += int(out["cons_len"][out["solved"]].sum())
         solved += int(out["solved"].sum())
     dt = time.perf_counter() - t0
+    from daccord_tpu.utils.obs import saturation_gauges
+
     info = dict(windows=nb * batch, solved=solved, wall_s=round(dt, 3),
                 device=str(jax.devices()[0]).replace(" ", ""),
-                solve_rate=round(solved / (nb * batch), 4))
+                solve_rate=round(solved / (nb * batch), 4),
+                # ISSUE 14: the fallback loop is pure synchronous solve —
+                # the host blocks on the engine for the whole timed region
+                saturation=saturation_gauges(dt, dt, dt),
+                verdict="device")
 
     # the native C++ full-graph engine is the framework's real degraded-mode
     # capability (4-7x the JAX-CPU ladder per core; --backend native): report
@@ -838,6 +869,8 @@ def run_mesh_bench(data: dict, ev, fallback_reason=None) -> dict:
         windows = 0
         solved = 0
         inflight: deque = deque()
+        # device-occupancy integral (ISSUE 14): per-rung starvation gauges
+        sat = {"busy_s": 0.0, "t0": None}
 
         def drain(to_depth: int):
             nonlocal t_fetch, windows, solved
@@ -847,13 +880,19 @@ def run_mesh_bench(data: dict, ev, fallback_reason=None) -> dict:
             entries = [inflight.popleft() for _ in range(n_pop)]
             tf = time.perf_counter()
             outs = solver.fetch_many(entries)
-            t_fetch += time.perf_counter() - tf
+            now = time.perf_counter()
+            t_fetch += now - tf
+            if not inflight and sat["t0"] is not None:
+                sat["busy_s"] += now - sat["t0"]
+                sat["t0"] = None
             for out in outs:
                 windows += len(out["solved"])
                 solved += int(out["solved"].sum())
 
         for i in range(nb):
             td = time.perf_counter()
+            if sat["t0"] is None:
+                sat["t0"] = td
             inflight.append(solver.dispatch(_make_batch(data, i, BATCH,
                                                         shape)))
             t_disp += time.perf_counter() - td
@@ -862,7 +901,16 @@ def run_mesh_bench(data: dict, ev, fallback_reason=None) -> dict:
         drain(0)
         wall = time.perf_counter() - t0
         wps = windows / wall if wall > 0 else 0.0
+        from daccord_tpu.utils.obs import (bottleneck_verdict,
+                                           saturation_gauges)
+
+        rung_gs = saturation_gauges(wall, t_fetch, sat["busy_s"])
         rungs.append({
+            # ISSUE 14: per-rung starvation gauges + verdict — a
+            # host_feeder verdict on the mesh rung is the sentinel's
+            # one-host-cannot-feed-this-mesh advisory
+            "saturation": rung_gs,
+            "verdict": bottleneck_verdict(rung_gs)["verdict"],
             "mesh": mesh_w, "batch": BATCH, "batches": nb,
             "windows": windows, "solved": solved,
             "wall_s": round(wall, 3),
@@ -889,6 +937,11 @@ def run_mesh_bench(data: dict, ev, fallback_reason=None) -> dict:
         "fallback": bool(fallback_reason),
         "fallback_reason": fallback_reason,
         "rungs": rungs,
+        # headline saturation = the widest rung's (the mesh the sidecar is
+        # named for); the sentinel's mesh>=4 host_feeder advisory keys on
+        # this verdict next to the `mesh` field above
+        "saturation": rungs[-1]["saturation"],
+        "verdict": rungs[-1]["verdict"],
         "ts": round(time.time(), 1),
         **_tunnel_staleness(),
     }
@@ -1005,6 +1058,14 @@ def run_serve_bench(ev) -> dict:
                      "windows": r["windows"], **r["latency"]}
                     for r in rows],
         "warm": {k: metrics["warm"][k] for k in ("hits", "misses")},
+        # ISSUE 14: the service's demand-weighted saturation verdict +
+        # gauges, read from the live /v1/metrics body the bench already
+        # fetched — the serve sidecar's bottleneck attribution
+        "verdict": metrics.get("verdict"),
+        "saturation": {k: (metrics.get("metrics", {}).get("gauges", {})
+                           .get(k))
+                       for k in ("device_idle_frac", "host_blocked_frac",
+                                 "overlap_frac")},
         **_tunnel_staleness(),
     }
     _commit_sidecar("BENCH_SERVE.json", line)

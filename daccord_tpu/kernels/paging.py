@@ -181,7 +181,7 @@ def window_pages(lens: np.ndarray, page_len: int = PAGE_LEN) -> np.ndarray:
 
 
 def pack_paged(batch: WindowBatch, family: ShapeFamily,
-               target_rows: int | None = None) -> PagedWindowBatch:
+               target_rows: int | None = None, prof=None) -> PagedWindowBatch:
     """Pack a dense batch into ``family``'s paged wire format.
 
     ``target_rows`` pads the TABLE side to the dispatch width with sentinel
@@ -197,7 +197,14 @@ def pack_paged(batch: WindowBatch, family: ShapeFamily,
     dispatch, where per-byte index math measured ~10x the feeder-wall budget.
     Pool cells past a segment's last base are deliberately left undefined
     (see PagedWindowBatch); only the sentinel page is scrubbed.
+
+    ``prof`` (:class:`~..utils.obs.StageProfile`) books the pack wall under
+    the ``pack`` feeder stage — the paged twin of ``pad_batch``'s timer, so
+    the saturation profiler attributes dense and paged assembly identically.
     """
+    if prof is not None:
+        with prof.timed("pack"):
+            return pack_paged(batch, family, target_rows=target_rows)
     B = batch.size
     rows = B if target_rows is None else int(target_rows)
     assert rows >= B

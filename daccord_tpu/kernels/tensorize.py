@@ -61,14 +61,20 @@ class WindowBatch:
 
 
 def tensorize_windows(items: list[tuple[int, WindowSegments]],
-                      shape: BatchShape) -> WindowBatch:
+                      shape: BatchShape, prof=None) -> WindowBatch:
     """Pack (read_id, WindowSegments) pairs into one WindowBatch.
 
     The segment copies run as ONE concatenated buffer + flat-index scatter
     instead of O(B*D) single-row numpy assignments: this sits on the
     measured host-feeder hot path (the python windowing fallback and every
     bench/tool that tensorizes), where per-row assignment overhead
-    dominated the actual byte movement (tools/feederbench.py)."""
+    dominated the actual byte movement (tools/feederbench.py). ``prof``
+    (a :class:`~..utils.obs.StageProfile`) books the call's wall under the
+    ``tensorize`` feeder stage — the saturation profiler's own timer, so
+    the measurement lives with the work, not at scattered call sites."""
+    if prof is not None:
+        with prof.timed("tensorize"):
+            return tensorize_windows(items, shape)
     B = len(items)
     D, L = shape.depth, shape.seg_len
     seqs = np.full((B, D, L), PAD, dtype=np.int8)
@@ -121,14 +127,19 @@ def slice_batch(batch, lo: int, hi: int):
         wstarts=batch.wstarts[lo:hi])
 
 
-def pad_batch(batch, target: int):
+def pad_batch(batch, target: int, prof=None):
     """Pad a batch to ``target`` windows (static batch shapes for jit).
 
     Target-shape arrays are allocated ONCE and filled (live rows copied,
     the pad region written in place) — the previous five full
     ``np.concatenate`` calls copied every live cell AND materialized the
     pad blocks separately on every partial-bucket and rescue-pool flush.
-    Paged batches pad by sentinel table rows (``paging.pad_paged``)."""
+    Paged batches pad by sentinel table rows (``paging.pad_paged``).
+    ``prof`` books the wall under the ``pack`` feeder stage (saturation
+    profiler, ISSUE 14) — same contract as :func:`tensorize_windows`."""
+    if prof is not None:
+        with prof.timed("pack"):
+            return pad_batch(batch, target)
     B = batch.size
     if B == target:
         return batch

@@ -249,6 +249,12 @@ class ShardedLadderSolver:
             i: {"platform": d.platform, "state": "ok", "dispatches": 0,
                 "dispatch_wall_s": 0.0, "rows": 0, "hbm_peak_bytes": None}
             for i, d in enumerate(self._devices0)}
+        # solver birth time: the denominator of the per-member idle_frac
+        # gauge (saturation profiler, ISSUE 14) — a member that accrued
+        # little dispatch wall since construction is a starving chip
+        import time as _time
+
+        self._created = _time.time()
         self.sharding = NamedSharding(mesh, P("d"))
         self.replicated = NamedSharding(mesh, P())
         self.tables = tuple(ladder.tables[p.k] for p in ladder.params)
@@ -392,12 +398,24 @@ class ShardedLadderSolver:
     def health_map(self) -> dict:
         """The mesh health map metrics snapshots embed (ISSUE 13): current
         vs construction width, per-device state/wall/rows/HBM-peak keyed by
-        original member index. A partial-mesh degradation reads off this map
-        as exactly which chip is ``lost`` and which rows moved."""
+        original member index, plus the per-member ``busy_frac``/
+        ``idle_frac`` starvation gauges (ISSUE 14: dispatch wall over the
+        solver's lifetime — a high idle_frac across ALL ok members means the
+        host feeder is starving the mesh, which is exactly what the
+        host_feeder verdict on a mesh run asserts). A partial-mesh
+        degradation reads off this map as exactly which chip is ``lost``
+        and which rows moved."""
+        import time as _time
+
         self._refresh_hbm()
+        el = max(_time.time() - self._created, 1e-9)
+        out = {}
+        for i, row in self.device_stats.items():
+            busy = min(row["dispatch_wall_s"] / el, 1.0)
+            out[i] = dict(row, busy_frac=round(busy, 4),
+                          idle_frac=round(1.0 - busy, 4))
         return {"nd": int(self.nd), "nd0": len(self._devices0),
-                "devices": {i: dict(row)
-                            for i, row in self.device_stats.items()}}
+                "devices": out}
 
     def probe_devices(self, timeout_s: float = 15.0) -> list[int]:
         """Original indexes of ACTIVE members that fail a tiny per-device

@@ -85,6 +85,16 @@ before tensorization (:meth:`FaultPlan.monster_check`). The ceiling left by
 ``device_oom`` is deliberately NOT one-shot: re-dispatching the identical
 doomed shape must keep failing (that is the failure mode under test), while
 a bisected one fits.
+
+The saturation-profiler kind (ISSUE 14) deliberately breaks the index
+grammar: ``feeder_stall:N`` reads N as MILLISECONDS of artificial delay
+injected into EVERY feeder pile block (booked under the profiler's
+``stall`` stage), not a 1-based trigger index — flipping a bottleneck
+verdict requires sustained slowdown, not a one-shot event. It is the A/B
+lever the acceptance run uses: the same corpus with ``feeder_stall:50``
+must flip the committed verdict to ``host_feeder`` with ``stall`` named as
+the dominant sub-stage, while the FASTA stays byte-identical (a slow feeder
+changes wall-clock, never bytes).
 """
 
 from __future__ import annotations
@@ -137,7 +147,8 @@ class InjectedCrash(BaseException):
 _KINDS = ("fetch_hang", "dispatch_error", "device_lost", "compile_stall",
           "crash", "las_bitflip", "las_truncate", "db_garbage",
           "worker_crash", "worker_hang", "lease_stall",
-          "device_oom", "host_rss", "monster_pile", "worker_oom")
+          "device_oom", "host_rss", "monster_pile", "worker_oom",
+          "feeder_stall")
 
 #: fleet-orchestrator kinds: they sabotage worker spawns / lease renewal at
 #: the fleet layer (parallel/fleet.py) and are stripped from the worker
@@ -318,6 +329,17 @@ class FaultPlan:
         ballooning the test process)."""
         self.n_rss += 1
         return self._take("host_rss", self.n_rss) is not None
+
+    def feeder_stall_ms(self) -> float:
+        """Milliseconds of injected per-pile feeder delay (``feeder_stall:N``
+        — N is a DURATION here, see the module doc), 0.0 when the spec is
+        absent. Continuous, never marked fired: the profiler A/B needs the
+        whole run slowed, and the pipeline books the sleep under the
+        ``stall`` stage so the verdict attributes it honestly."""
+        for s in self.specs:
+            if s.kind == "feeder_stall":
+                return float(s.at)
+        return 0.0
 
     def monster_check(self) -> bool:
         """Advance the inspected-pile counter (the monster guard runs once

@@ -344,6 +344,19 @@ class PipelineStats:
     wall_s: float = 0.0
     device_s: float = 0.0
     host_s: float = 0.0
+    # saturation profiler (ISSUE 14). feeder_s = host wall blocked on the
+    # feeder iterator (pipeline-visible: a threaded feeder overlaps, so this
+    # is what the pile loop actually waited, not thread-summed CPU time);
+    # dispatch_s = wall inside dispatch calls (the solve itself on inline
+    # engines, the enqueue on async ones); stage_profile = the per-stage
+    # StageProfile.summary() table; verdict/bottleneck = the automatic
+    # attribution (obs.bottleneck_verdict) stamped into shard_done, every
+    # metrics rollup, the prom exposition, and the bench sidecars.
+    feeder_s: float = 0.0
+    dispatch_s: float = 0.0
+    stage_profile: dict = field(default_factory=dict)
+    verdict: str = "balanced"
+    bottleneck: dict = field(default_factory=dict)
     metrics: dict = field(default_factory=dict)
                                  # end-of-run MetricsRegistry rollup
                                  # (ISSUE 6); launch.run_shard commits it
@@ -636,14 +649,23 @@ def derive_families_for_shard(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
 
 def _window_one_pile(db: DazzDB, col, cfg: PipelineConfig, aread: int, s: int, e: int,
-                     qvr: QvRanker | None = None):
+                     qvr: QvRanker | None = None, prof=None):
     """Window one pile via the native path; shared by the synchronous and
-    threaded feeders so their outputs stay byte-identical by construction."""
+    threaded feeders so their outputs stay byte-identical by construction.
+    ``prof`` (obs.StageProfile) books the per-stage walls — ``decode`` for
+    the DB base decodes, ``rank`` for the depth-ranking sort, ``realign``
+    for the native pile processor (which fuses realign + window cut +
+    tensorize in C++, so the python-path kmer/tensorize stages read 0 on
+    native runs). Runs inside the feeder threads: StageProfile.add is
+    lock-guarded, and timer cost is two perf_counter calls per stage per
+    pile — noise against the pile's own DP."""
     from ..native.api import process_pile_native
 
     w, adv = cfg.consensus.w, cfg.consensus.adv
     D, L = cfg.depth, cfg.seg_len
+    t0 = time.perf_counter()
     a = db.read_bases(aread)
+    t1 = time.perf_counter()
     order = None
     if cfg.depth_rank:
         # quality-ranked depth capping (SURVEY.md §7.3 item 1): best
@@ -655,10 +677,16 @@ def _window_one_pile(db: DazzDB, col, cfg: PipelineConfig, aread: int, s: int, e
             bq = qvr.rates(col.bread[s:e], col.bbpos[s:e], col.bepos[s:e],
                            col.comp[s:e])
         order = np.argsort(_rank_scores(col.diffs[s:e], span, bq), kind="stable")
+    t2 = time.perf_counter()
     idxs = range(s, e) if order is None else (s + order)
     b_reads = db.read_bases_batch(int(col.bread[i]) for i in idxs)
+    t3 = time.perf_counter()
     seqs, lens, nsegs = process_pile_native(a, col, s, e, b_reads, w, adv, D, L,
                                             order=order)
+    if prof is not None:
+        prof.add("decode", (t1 - t0) + (t3 - t2))
+        prof.add("rank", t2 - t1)
+        prof.add("realign", time.perf_counter() - t3)
     return aread, a, seqs, lens, nsegs
 
 
@@ -673,30 +701,49 @@ def _monster_marker(aread: int, n_overlaps: int):
 
 def _iter_pile_blocks(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                       start, end, native_ok: bool, qvr: QvRanker | None = None,
-                      monster=None):
+                      monster=None, prof=None):
     """Yield (aread, a_bases, seqs [nwin,D,L], lens [nwin,D], nsegs [nwin]).
 
     ``monster(aread, n_overlaps) -> bool`` is the capacity governor's
     monster-pile guard, consulted per pile BEFORE the quadratic windowing/
-    realignment spend; a busted pile yields a quarantine marker instead."""
+    realignment spend; a busted pile yields a quarantine marker instead.
+    ``prof`` (obs.StageProfile) books the feeder sub-stage walls — on the
+    python path decode/realign/kmer/tensorize are individually separable,
+    so this is where the full five-way decomposition comes from."""
     w, adv = cfg.consensus.w, cfg.consensus.adv
     D, L = cfg.depth, cfg.seg_len
     if native_ok:
         from ..native.api import ColumnarLas
 
+        t0 = time.perf_counter()
         col = ColumnarLas(las.path, start, end)
+        if prof is not None:
+            # the whole-range columnar LAS parse is byte decode
+            prof.add("decode", time.perf_counter() - t0)
         for aread, s, e in col.piles():
             if monster is not None and monster(aread, e - s):
                 yield _monster_marker(aread, e - s)
                 continue
-            yield _window_one_pile(db, col, cfg, aread, s, e, qvr)
+            yield _window_one_pile(db, col, cfg, aread, s, e, qvr, prof=prof)
     else:
         shape = BatchShape(depth=D, seg_len=L, wlen=w)
-        for aread, pile in las.iter_piles(start, end):
+        it = las.iter_piles(start, end)
+        while True:
+            # the pile decode happens inside the generator's __next__; time
+            # it explicitly so the decode stage covers the LAS byte walk
+            t0 = time.perf_counter()
+            try:
+                aread, pile = next(it)
+            except StopIteration:
+                break
+            if prof is not None:
+                prof.add("decode", time.perf_counter() - t0)
             if monster is not None and monster(aread, len(pile)):
                 yield _monster_marker(aread, len(pile))
                 continue
+            t0 = time.perf_counter()
             a = db.read_bases(aread)
+            t1 = time.perf_counter()
             if cfg.depth_rank and pile:
                 diffs = np.asarray([o.diffs for o in pile])
                 span = np.maximum(
@@ -709,10 +756,28 @@ def _iter_pile_blocks(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                    [o.is_comp for o in pile])
                 order = np.argsort(_rank_scores(diffs, span, bq), kind="stable")
                 pile = [pile[i] for i in order]
-            refined = [refine_overlap(o, a, db.read_bases(o.bread), las.tspace) for o in pile]
+            t2 = time.perf_counter()
+            # B reads decode ONE AT A TIME inside the refine loop (never the
+            # whole pile at once — a deep repeat pile would balloon transient
+            # RSS); the decode timer follows the read into the loop
+            refined = []
+            b_dec_s = 0.0
+            for o in pile:
+                td = time.perf_counter()
+                b = db.read_bases(o.bread)
+                b_dec_s += time.perf_counter() - td
+                refined.append(refine_overlap(o, a, b, las.tspace))
+            t3 = time.perf_counter()
             windows = cut_windows(a, refined, w=w, adv=adv)
+            t4 = time.perf_counter()
+            if prof is not None:
+                prof.add("decode", (t1 - t0) + b_dec_s)
+                prof.add("rank", t2 - t1)
+                prof.add("realign", (t3 - t2) - b_dec_s)
+                prof.add("kmer", t4 - t3)
             if windows:
-                b = tensorize_windows([(aread, ws) for ws in windows], shape)
+                b = tensorize_windows([(aread, ws) for ws in windows], shape,
+                                      prof=prof)
                 yield aread, a, b.seqs, b.lens, b.nsegs
             else:
                 yield aread, a, np.zeros((0, D, L), np.int8), np.zeros((0, D), np.int32), np.zeros(0, np.int32)
@@ -733,25 +798,31 @@ class _Ready:
 
 def _iter_pile_blocks_threaded(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                start, end, nthreads: int,
-                               qvr: QvRanker | None = None, monster=None):
+                               qvr: QvRanker | None = None, monster=None,
+                               prof=None):
     """Same stream as :func:`_iter_pile_blocks` (native path), but piles are
     windowed by a thread pool with bounded in-order prefetch. Output order —
     and therefore every downstream byte — is identical to the synchronous
     path; only wall-clock changes. The monster guard runs in the (ordered)
-    submission loop, so its fault counter stays deterministic."""
+    submission loop, so its fault counter stays deterministic. ``prof``
+    stage walls sum ACROSS pool threads (StageProfile records ``threads``
+    so daccord-prof's reconciliation scales accordingly)."""
     from collections import deque
     from concurrent.futures import ThreadPoolExecutor
 
     from ..native.api import ColumnarLas
 
+    t0 = time.perf_counter()
     col = ColumnarLas(las.path, start, end)
     piles = list(col.piles())
+    if prof is not None:
+        prof.add("decode", time.perf_counter() - t0)
     # QvRanker state is built fully in __init__ and only read here, so the
     # worker threads need no lock
 
     def job(item):
         aread, s, e = item
-        return _window_one_pile(db, col, cfg, aread, s, e, qvr)
+        return _window_one_pile(db, col, cfg, aread, s, e, qvr, prof=prof)
 
     with ThreadPoolExecutor(max_workers=nthreads) as ex:
         def submit(item):
@@ -890,8 +961,8 @@ class _Telemetry:
     ``daccord-trace --check`` enforces)."""
 
     def __init__(self, cfg: PipelineConfig, start, end):
-        from ..utils.obs import (JsonlLogger, MetricsRegistry, Tracer,
-                                 WindowLedger)
+        from ..utils.obs import (JsonlLogger, MetricsRegistry, StageProfile,
+                                 Tracer, WindowLedger)
 
         # file-backed streams buffer (hot-path budget); '-' streams stay
         # line-flushed — stderr exists for LIVE monitoring, and a buffered
@@ -912,6 +983,13 @@ class _Telemetry:
         self.ledger = (WindowLedger(cfg.ledger_path) if cfg.ledger_path
                        else None)
         self.metrics = MetricsRegistry()
+        # saturation profiler (ISSUE 14): always-on per-stage feeder
+        # accounting — timers cost two perf_counter calls per stage per pile
+        # (measured << the 2% budget), and emission rides the existing
+        # snapshot cadence, so there is no profiler on/off switch to drift.
+        # `threads` is corrected once the run knows whether the threaded
+        # feeder actually engages (native path present).
+        self.stage = StageProfile(threads=max(1, cfg.feeder_threads))
         self.run_span = self.tracer.open("run")
 
     def close(self) -> None:
@@ -1179,6 +1257,12 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                        depth=int(f.depth), pages=int(f.pages),
                        page_len=int(f.page_len), pool_pages=int(f.budget))
     clamp_solve = None   # governor esc-cap-clamp rung (JAX async ladder only)
+    # saturation accounting (ISSUE 14): a synchronous engine solves INSIDE
+    # the dispatch call (native ladder, host-routed solve_tiered, plain
+    # callables), so its device-busy wall is the dispatch wall and its
+    # "host blocked on device" includes it; an async engine's busy window is
+    # the in-flight occupancy integral and only the fetch blocks the host
+    sync_engine = False
     if solver is not None:
         if hasattr(solver, "dispatch") and hasattr(solver, "fetch"):
             # async solver (e.g. the mesh-sharded ladder): pipeline batches
@@ -1187,6 +1271,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             fetch_many_fn = getattr(solver, "fetch_many", None)
         else:
             dispatch_fn, fetch_fn = solver, (lambda h: h)
+            sync_engine = True
         if mesh_solver is not None:
             # the mesh gets the full governor ladder: its clamp rung is the
             # single-device clamped program + host completion — byte-
@@ -1209,6 +1294,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                       "ladder (scan path used); use the tpu backend or --mesh",
                       file=sys.stderr)
             dispatch_fn, fetch_fn = (lambda b: solve_tiered(b, ladder)), (lambda h: h)
+            sync_engine = True
         else:
             # async device ladder: one dispatch per batch, fetched a batch
             # later so host windowing overlaps device compute + tunnel RTT
@@ -1512,6 +1598,26 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     # this costs nothing extra under the default supervised config)
     inflight: deque = deque()
 
+    # device-occupancy integral + dispatch wall (saturation profiler,
+    # ISSUE 14). Async engines: `t0` opens when a dispatch finds the
+    # in-flight window empty and closes at the drain that empties it again —
+    # busy_s integrates "the device has work". Sync engines solve inside the
+    # dispatch call, so busy_s accrues the dispatch wall directly and t0
+    # stays unused. All dispatch/drain happens on the pipeline thread, so no
+    # lock is needed.
+    dev = {"busy_s": 0.0, "t0": None, "dispatch_s": 0.0}
+
+    def timed_dispatch(batch):
+        t_d = time.time()
+        if not sync_engine and dev["t0"] is None:
+            dev["t0"] = t_d
+        handle = dispatch_fn(batch)
+        dt = time.time() - t_d
+        dev["dispatch_s"] += dt
+        if sync_engine:
+            dev["busy_s"] += dt
+        return handle
+
     # split-ladder rescue pools, one per bucket shape (Stream B inputs):
     # tier-0 failures and top-M-overflow windows accumulate here until a
     # full dense batch (or the flush deadline / final drain) dispatches them
@@ -1743,7 +1849,8 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
 
             dense_seqs = batch.seqs
             pb = paging.pack_paged(batch, families[bi],
-                                   target_rows=cfg.batch_size)
+                                   target_rows=cfg.batch_size,
+                                   prof=tel.stage)
             # payload-cell accounting, symmetric with the dense metric
             # (which counts seqs only — never lens/nsegs metadata); the
             # table's byte cost is reported on the batch.paged event
@@ -1762,7 +1869,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             # iterates real rows and would just walk PAD, and a
             # partial-capable solver (serve batcher) pads its own merged
             # batches after pooling rows across jobs
-            batch = pad_batch(batch, cfg.batch_size)
+            batch = pad_batch(batch, cfg.batch_size, prof=tel.stage)
         stats.pad_cells += batch.seqs.size
         stats.used_cells += int(batch.lens.sum())
         return batch, (batch.seqs, batch.lens, batch.nsegs)
@@ -1791,6 +1898,11 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         # (in-flight batches overlap, so summing dispatch->fetch spans
         # would double-count and can exceed wall time)
         stats.device_s += now - t_f
+        if not inflight and dev["t0"] is not None:
+            # the in-flight window just emptied: close the device-busy
+            # occupancy interval (saturation gauges)
+            dev["busy_s"] += now - dev["t0"]
+            dev["t0"] = None
         metrics.counter("fetch_calls").inc()
         for (handle, rid, widx, take, t0, rows_ctx, bi, stream, b_sp), out \
                 in zip(entries, outs):
@@ -1898,7 +2010,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                    rows=take, bucket=bi)
                 d_sp = tracer.open("dispatch", parent=b_sp, stream="rescue")
                 _prof_on_dispatch()
-                handle = dispatch_fn(batch)
+                handle = timed_dispatch(batch)
                 tracer.close(d_sp)
                 metrics.counter("dispatches").inc()
                 metrics.histogram("flush_rows").observe(take)
@@ -1952,7 +2064,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 d_sp = tracer.open("dispatch", parent=b_sp,
                                    stream=batch.stream)
                 _prof_on_dispatch()
-                handle = dispatch_fn(batch)
+                handle = timed_dispatch(batch)
                 tracer.close(d_sp)
                 metrics.counter("dispatches").inc()
                 if split_ladder:
@@ -1991,6 +2103,11 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         print("daccord-tpu: feeder_threads ignored (native host path "
               "unavailable or disabled)", file=sys.stderr)
         log.log("warn", msg="feeder_threads ignored: no native host path")
+    # the stage profile records the ACTUAL feeder pool width (prof --check
+    # scales its reconciliation by it: thread-summed stage walls legitimately
+    # exceed the pipeline-visible feeder wall under a pool)
+    tel.stage.threads = (cfg.feeder_threads
+                         if native_ok and cfg.feeder_threads > 0 else 1)
 
     def monster_guard(aread, n_overlaps) -> bool:
         """Capacity governor's monster-pile budget, consulted once per pile
@@ -2011,9 +2128,10 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         if native_ok and cfg.feeder_threads > 0:
             return _iter_pile_blocks_threaded(db, las, cfg, s, e,
                                               cfg.feeder_threads, qvr,
-                                              monster=monster_guard)
+                                              monster=monster_guard,
+                                              prof=tel.stage)
         return _iter_pile_blocks(db, las, cfg, s, e, native_ok, qvr,
-                                 monster=monster_guard)
+                                 monster=monster_guard, prof=tel.stage)
 
     qfh = None
 
@@ -2048,6 +2166,19 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     else:
         blocks = _block_iter(start, end)
 
+    # pipeline-visible feeder wall (saturation profiler): what the pile loop
+    # actually BLOCKED on the feeder iterator — under a threaded feeder this
+    # is smaller than the thread-summed stage walls, and it is the anchor
+    # daccord-prof reconciles the sub-stage table against
+    feeder_wall = [0.0]
+    # injected feeder slowdown (DACCORD_FAULT=feeder_stall:MS, ISSUE 14):
+    # the A/B lever that flips the verdict to host_feeder — booked under
+    # the profiler's `stall` stage so the attribution names it honestly
+    stall_s = (plan.feeder_stall_ms() if plan is not None else 0.0) / 1e3
+    if stall_s:
+        ev_log.log("sup_fault", kind="feeder_stall", op="feeder",
+                   n=int(stall_s * 1e3))
+
     def _timed_blocks():
         # feeder spans bracket the host windowing wall per pile block (the
         # block generator's __next__ — decode, k-mer extraction,
@@ -2056,11 +2187,16 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         it = iter(blocks)
         while True:
             f_sp = tracer.open("feeder")
+            t_f0 = time.perf_counter()
             try:
                 blk = next(it)
             except StopIteration:
                 tracer.close(f_sp, status="end")
                 return
+            if stall_s:
+                time.sleep(stall_s)
+                tel.stage.add("stall", stall_s)
+            feeder_wall[0] += time.perf_counter() - t_f0
             tracer.close(f_sp)
             yield blk
 
@@ -2090,9 +2226,32 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                        dispatch_wall_s=round(row["dispatch_wall_s"], 4),
                        rows=int(row["rows"]),
                        hbm_peak_bytes=row["hbm_peak_bytes"],
+                       # per-member starvation gauge (ISSUE 14)
+                       idle_frac=row.get("idle_frac"),
                        **({"rung_rows": int(rung_rows)}
                           if rung_rows is not None else {}))
         return hm
+
+    def _saturation():
+        """Live (gauges, stage summary, verdict) triple — the saturation
+        profiler's one computation, shared by the periodic snapshot and the
+        end-of-run stamp so they can never disagree on the rules."""
+        from ..utils.obs import bottleneck_verdict, saturation_gauges
+
+        now = time.time()
+        el = max(now - t_start, 1e-9)
+        busy = dev["busy_s"]
+        if dev["t0"] is not None:
+            busy += now - dev["t0"]   # open occupancy interval
+        blocked = stats.device_s
+        if sync_engine:
+            # the solve happens inside dispatch: the host is blocked there,
+            # and that same wall is the engine's busy time
+            blocked += dev["dispatch_s"]
+            busy += stats.device_s
+        gs = saturation_gauges(el, blocked, busy)
+        summ = tel.stage.summary()
+        return gs, summ, bottleneck_verdict(gs, summ["stages"])
 
     def _metrics_snap(final: bool = False):
         # registry update + periodic snapshot event: derived rates from the
@@ -2111,6 +2270,30 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         g("n_reads").set(float(stats.n_reads))
         g("n_windows").set(float(stats.n_windows))
         g("n_solved").set(float(stats.n_solved))
+        # saturation profiler (ISSUE 14): starvation/overlap gauges, the
+        # blocked-on-feeder wall, and one stage_<name>_s gauge per feeder
+        # sub-stage ride every snapshot AND the durable rollup/prom — plus
+        # a stage.profile event carrying the full table + live verdict
+        gs, summ, bver = _saturation()
+        for k, v in gs.items():
+            g(k).set(v)
+        g("feeder_s").set(feeder_wall[0])
+        g("dispatch_s").set(dev["dispatch_s"])
+        # the pool width rides the rollup so a committed *.metrics.json is
+        # self-describing for daccord-prof's reconciliation (thread-summed
+        # stage walls only reconcile serially when threads == 1)
+        g("stage_threads").set(float(summ["threads"]))
+        for name, row in summ["stages"].items():
+            g(f"stage_{name}_s").set(row["wall_s"])
+        ev_log.log("stage.profile", stages=summ["stages"],
+                   threads=summ["threads"],
+                   feeder_s=round(feeder_wall[0], 4),
+                   dispatch_s=round(dev["dispatch_s"], 4),
+                   verdict=bver["verdict"],
+                   stage=bver["stage"] or "",
+                   device_idle_frac=bver["device_idle_frac"],
+                   host_blocked_frac=bver["host_blocked_frac"],
+                   overlap_frac=bver["overlap_frac"], final=final)
         if ladder is not None and not native_dispatch:
             from ..utils.obs import device_peak_bytes
 
@@ -2309,6 +2492,17 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         ev_log.log("sup_done", state=sup.state, degraded=sup.failed_over,
                    **sup.counters,
                    **{f"gov_{k}": v for k, v in gov.counters.items()})
+    # saturation profiler final stamp (ISSUE 14): gauges + stage table +
+    # verdict computed ONCE from the finalized walls, then surfaced through
+    # every channel — stats fields (bench/serve read them), shard_done,
+    # the metrics rollup (launch.py renders it into the .prom exposition),
+    # and the stage.profile event the final snapshot emits
+    sat_g, sat_summ, sat_verdict = _saturation()
+    stats.feeder_s = round(feeder_wall[0], 4)
+    stats.dispatch_s = round(dev["dispatch_s"], 4)
+    stats.stage_profile = sat_summ
+    stats.verdict = sat_verdict["verdict"]
+    stats.bottleneck = sat_verdict
     # end-of-run metrics rollup: final gauge refresh, one last snapshot
     # event, and the registry dict on stats — run_shard commits it durably
     # beside the shard manifest
@@ -2316,6 +2510,9 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     metrics.snapshot(ev_log, final=True,
                      **({"mesh": hm_final} if hm_final else {}))
     stats.metrics = metrics.rollup()
+    # the verdict string rides the rollup so render_prom (the durable
+    # *.metrics.prom and the serve scrape) exposes it as a labeled gauge
+    stats.metrics["verdict"] = stats.verdict
     done = dict(
         reads=stats.n_reads, windows=stats.n_windows,
         solved=stats.n_solved, skipped_shallow=stats.n_skipped_shallow,
@@ -2329,6 +2526,16 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         # wall decomposition anchors (ISSUE 6): daccord-trace reconciles
         # its device/host stage sums against these
         device_s=round(stats.device_s, 4), host_s=round(stats.host_s, 4),
+        # saturation profiler (ISSUE 14): the per-stage feeder table, the
+        # blocked-on-feeder/dispatch anchors, the starvation gauges, and
+        # the committed bottleneck verdict — daccord-prof's primary source.
+        # `mesh` rides along so the sentinel's host_feeder-on-mesh>=4
+        # advisory reads off the one record
+        stages={k: v["wall_s"] for k, v in sat_summ["stages"].items()},
+        stage_threads=sat_summ["threads"],
+        feeder_s=stats.feeder_s, dispatch_s=stats.dispatch_s,
+        verdict=stats.verdict, bottleneck=sat_verdict,
+        mesh=int(ledger_mesh),
         tiers=stats.tier_histogram, native=stats.native_host,
         # two-stream ladder decision counters (ISSUE 4): fused-vs-split
         # rescue tail cost is measurable from these with no chip

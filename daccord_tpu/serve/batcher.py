@@ -175,6 +175,15 @@ class SolveGroup:
         self.counters = {"dispatches": 0, "rows": 0, "batches": 0,
                          "mixed_batches": 0, "demand_flushes": 0,
                          "lag_flushes": 0, "shed_flushes": 0}
+        # saturation accounting (ISSUE 14): dispatch wall, fetch-blocked
+        # wall, and the device-busy occupancy integral over this group's
+        # lifetime — the per-serve-group twin of the pipeline's gauges. A
+        # native group solves INSIDE the dispatch call (sync), so its busy
+        # time IS its dispatch wall; JAX groups are async and busy is the
+        # in-flight occupancy window. All flush/drain runs under _lock.
+        self.sat = {"dispatch_s": 0.0, "fetch_blocked_s": 0.0,
+                    "busy_s": 0.0, "t0": None}
+        self._sync_engine = gcfg.backend == "native"
         self.ladder = None
         self.mesh_solver = None      # set when gcfg.mesh > 1 (JAX backends)
         self._profile = profile
@@ -453,6 +462,28 @@ class SolveGroup:
             self._lock.release()
         return not locked
 
+    def saturation(self) -> dict:
+        """Starvation/overlap gauges over this group's lifetime (ISSUE 14):
+        obs.saturation_gauges plus the raw walls (``busy_s``/``blocked_s``)
+        the service aggregates into its demand-weighted verdict. Lock-free
+        like :meth:`stats` — momentarily-stale floats beat stalling behind
+        a solve."""
+        from ..utils.obs import saturation_gauges
+
+        now = time.time()
+        el = max(now - self.created, 1e-9)
+        busy = self.sat["busy_s"]
+        if self.sat["t0"] is not None:
+            busy += now - self.sat["t0"]
+        blocked = self.sat["fetch_blocked_s"]
+        if self._sync_engine:
+            blocked += self.sat["dispatch_s"]
+            busy += self.sat["fetch_blocked_s"]
+        return {**saturation_gauges(el, blocked, busy),
+                "dispatch_s": round(self.sat["dispatch_s"], 4),
+                "blocked_s": round(blocked, 4),
+                "busy_s": round(busy, 4), "lifetime_s": round(el, 3)}
+
     def stats(self) -> dict:
         """Group stats. NON-BLOCKING on the solve lock (same reasoning as
         :meth:`flush_stale`): during an in-flight solve the counters are
@@ -466,6 +497,7 @@ class SolveGroup:
                     "pooled_rows": pooled, "inflight": len(self._inflight),
                     "width": self._width(), "refs": self.refs,
                     "busy": not locked,
+                    "saturation": self.saturation(),
                     "degraded": self.sup.failed_over,
                     "governor": self.sup.governor.counters.copy()}
         finally:
@@ -605,7 +637,14 @@ class SolveGroup:
         self.log.log("serve.batch", windows=rows, jobs=len(jobs),
                      stream=pool.stream, width=int(merged.size),
                      reason=reason, job="+".join(jobs))
+        t_d = time.time()
+        if not self._sync_engine and self.sat["t0"] is None:
+            self.sat["t0"] = t_d
         dh = self.sup.dispatch(merged)
+        dt = time.time() - t_d
+        self.sat["dispatch_s"] += dt
+        if self._sync_engine:
+            self.sat["busy_s"] += dt
         rowmap = [(h, b.size) for h, b, _ in taken]
         self._inflight.append((dh, rowmap, rows))
 
@@ -653,8 +692,14 @@ class SolveGroup:
         if n_pop <= 0:
             return
         entries = [self._inflight.popleft() for _ in range(n_pop)]
+        t_f = time.time()
         try:
             outs = self.sup.fetch_many([e[0] for e in entries])
+            now = time.time()
+            self.sat["fetch_blocked_s"] += now - t_f
+            if not self._inflight and self.sat["t0"] is not None:
+                self.sat["busy_s"] += now - self.sat["t0"]
+                self.sat["t0"] = None
         except BaseException:
             # the popped entries' handles would otherwise be stranded
             # (neither pooled nor in flight): abort them so cohabiting

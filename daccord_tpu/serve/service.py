@@ -156,6 +156,13 @@ class ConsensusService:
         # bad did it GET", not just "how bad is it now"
         self._peak_rss_mb = 0.0
         self._peak_queue_depth = 0
+        # saturation profiler (ISSUE 14): the serve-plane verdict denominator
+        # is DEMAND wall (ticker-sampled time with >= 1 job queued/running),
+        # not uptime — an always-on server that simply has no traffic is
+        # balanced, not host_feeder-starved
+        self._demand_s = 0.0
+        self._last_demand_tick = time.time()
+        self._verdict = "balanced"
         self.started_ts = time.time()
         self.log_event("serve.start", workdir=cfg.workdir,
                        backend=cfg.backend, batch=int(cfg.batch),
@@ -380,6 +387,9 @@ class ConsensusService:
         return {**self.health(),
                 "admission": self.admission.stats(),
                 "warm": self.warm.stats(),
+                # saturation verdict (ISSUE 14): last computed by
+                # _refresh_gauges over the demand wall
+                "verdict": self._verdict,
                 "metrics": self.metrics.rollup()}
 
     def stats_prom(self) -> str:
@@ -401,6 +411,10 @@ class ConsensusService:
             roll["counters"][f"admission_{k}"] = int(adm.get(k, 0))
         for grp in self.warm.groups():
             g[f"group_busy_{grp.name}"] = float(grp.busy())
+        # the bottleneck verdict rides the rollup so render_prom exposes
+        # daccord_serve_bottleneck_verdict{verdict="..."} — the field the
+        # serve smoke asserts is present in the live exposition (ISSUE 14)
+        roll["verdict"] = self._verdict
         return render_prom(roll, prefix="daccord_serve")
 
     def shutdown(self, drain: bool = True, timeout_s: float = 300.0) -> None:
@@ -505,6 +519,16 @@ class ConsensusService:
                 for g in self.warm.groups():
                     g.flush_stale(self.cfg.flush_lag_s)
                 now = time.time()
+                # demand-wall sampling (ISSUE 14): accrue wall while any
+                # job is queued/running — the saturation verdict's
+                # denominator (see _refresh_gauges)
+                dt = now - self._last_demand_tick
+                self._last_demand_tick = now
+                with self._jobs_lock:
+                    active = any(j.state in (QUEUED, RUNNING)
+                                 for j in self.jobs.values())
+                if active:
+                    self._demand_s += dt
                 if now - last_pressure >= 1.0:
                     last_pressure = now
                     self._pressure_tick()
@@ -623,9 +647,33 @@ class ConsensusService:
         g("queue_depth_peak").set(float(self._peak_queue_depth))
         g("shed_level").set(float(self._shed))
         mixed = rows = 0
+        busy_s = blocked_s = 0.0
         for grp in self.warm.groups():
             s = grp.stats()
             mixed += s["mixed_batches"]
             rows += s["rows"]
+            # per-group starvation gauges (ISSUE 14): each warm group's
+            # device-idle / host-blocked fractions over its own lifetime
+            sat = s.get("saturation") or {}
+            g(f"group_device_idle_frac_{grp.name}").set(
+                float(sat.get("device_idle_frac", 1.0)))
+            g(f"group_host_blocked_frac_{grp.name}").set(
+                float(sat.get("host_blocked_frac", 0.0)))
+            busy_s += float(sat.get("busy_s", 0.0))
+            blocked_s += float(sat.get("blocked_s", 0.0))
         g("batcher_rows").set(float(rows))
         g("batcher_mixed_batches").set(float(mixed))
+        # service-level saturation + verdict over the DEMAND wall: device
+        # gaps while jobs were live mean the feeders (job windowing) starve
+        # the warm groups — the serve-plane form of host_feeder
+        from ..utils.obs import bottleneck_verdict, saturation_gauges
+
+        if self._demand_s > 1e-6:
+            sat = saturation_gauges(self._demand_s, blocked_s, busy_s)
+            self._verdict = bottleneck_verdict(sat)["verdict"]
+        else:
+            sat = saturation_gauges(1.0, 0.0, 1.0)   # no traffic: balanced
+            self._verdict = "balanced"
+        for k, v in sat.items():
+            g(k).set(v)
+        g("demand_s").set(round(self._demand_s, 3))

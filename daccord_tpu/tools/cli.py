@@ -1445,10 +1445,17 @@ def _sentinel_main(argv=None) -> int:
     return sentinel_main(argv)
 
 
+def _prof_main(argv=None) -> int:
+    from .prof import prof_main
+
+    return prof_main(argv)
+
+
 _TOOLS["eventcheck"] = _eventcheck_main
 _TOOLS["trace"] = _trace_main
 _TOOLS["top"] = _top_main
 _TOOLS["sentinel"] = _sentinel_main
+_TOOLS["prof"] = _prof_main
 
 
 def main(argv=None) -> int:

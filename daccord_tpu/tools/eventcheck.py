@@ -98,8 +98,15 @@ EVENT_FIELDS: dict[str, dict] = {
     "governor.restore": {"key": str, "width": int, "ok": bool},
     "governor.backpressure": {"level": str, "rss_mb": _NUM},
     "governor.monster": {"aread": int, "overlaps": int, "budget": int},
+    # saturation profiler (ISSUE 14): stage.profile is the periodic
+    # per-stage feeder snapshot (stages = StageProfile.summary()['stages'],
+    # feeder_s = the pipeline-visible blocked-on-feeder wall, verdict = the
+    # live bottleneck attribution); shard_done carries the committed final
+    # form (stages wall table, verdict string, bottleneck gauge dict)
+    "stage.profile": {"stages": dict, "feeder_s": _NUM, "verdict": str},
     "shard_done": {"reads": int, "windows": int, "solved": int,
-                   "wall_s": _NUM, "degraded": bool},
+                   "wall_s": _NUM, "degraded": bool,
+                   "verdict": str, "bottleneck": dict, "stages": dict},
     # ingest integrity layer (formats/ingest.py, ISSUE 2)
     "ingest.scan": {"path": str, "records": int, "piles": int, "issues": int,
                     "policy": str},

@@ -3,7 +3,14 @@
 The reference's 64-thread CPU becomes this framework's *feeder* (SURVEY.md
 §7.3 item 5): LAS streaming + trace-point refinement + window cutting must
 outrun the device or the chip starves. This tool measures the feeder alone —
-no device work — in windows/sec and (input) bases/sec, for 1..N threads.
+no device work — in windows/sec and (input) bases/sec, for 1..N threads,
+with the saturation profiler's per-stage breakdown (decode / rank / realign
+/ kmer / tensorize) on every line (ISSUE 14).
+
+Each run COMMITS a durable ``FEEDER_r*.json`` sidecar (same r-series wrapper
+format as BENCH_*, with the ``last_real_tpu_ts`` staleness stamp), so the
+feeder trajectory is sentinel-guarded history instead of stdout that
+scrolls away — ``--sidecar-dir ''`` opts out (tests, throwaway runs).
 
 Usage: ``python -m daccord_tpu.tools.feederbench [--threads 1,4,8] [--genome 60000]``
 Prints one JSON line per thread count.
@@ -12,8 +19,49 @@ Prints one JSON line per thread count.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import time
+
+
+def commit_sidecar(lines: list[dict], argv_echo: str,
+                   sidecar_dir: str) -> str:
+    """Commit the run as the next ``FEEDER_rNN.json`` in ``sidecar_dir`` —
+    the BENCH_* r-series wrapper format (``{"n", "cmd", "rc", "parsed"}``)
+    so daccord-sentinel's trajectory checks and daccord-prof's readers
+    consume it with zero special-casing. The headline metric is the best
+    thread count's windows/sec; the per-line stage tables ride in
+    ``lines``. Stamped with the tunnel staleness fields like bench.py, so
+    a feeder number is datable against the last real chip sighting."""
+    from daccord_tpu.tools.trace import last_alive_info
+    from daccord_tpu.utils.aio import durable_write
+
+    existing = glob.glob(os.path.join(sidecar_dir, "FEEDER_r*.json"))
+    idx = 0
+    for p in existing:
+        stem = os.path.basename(p)[len("FEEDER_r"):-len(".json")]
+        if stem.isdigit():
+            idx = max(idx, int(stem))
+    path = os.path.join(sidecar_dir, f"FEEDER_r{idx + 1:02d}.json")
+    best = max(lines, key=lambda ln: ln.get("windows_per_s", 0.0))
+    ts, age_h = last_alive_info(os.path.join(sidecar_dir,
+                                             "TUNNEL_LOG.jsonl"))
+    payload = {
+        "n": idx + 1, "cmd": f"daccord-feederbench {argv_echo}".strip(),
+        "rc": 0,
+        "parsed": {"metric": "feeder_windows_per_sec",
+                   "value": best.get("windows_per_s"), "unit": "windows/s",
+                   "threads": best.get("threads"),
+                   "stages": best.get("stages"),
+                   "stage_threads": max(best.get("threads", 1), 1),
+                   "fallback": False,
+                   "verdict": "host_feeder",   # by construction: no device
+                   "lines": lines,
+                   "ts": round(time.time(), 1),
+                   "last_real_tpu_ts": ts, "last_real_tpu_age_h": age_h}}
+    durable_write(path, lambda fh: json.dump(payload, fh), mode="wt")
+    return path
 
 
 def main(argv=None) -> int:
@@ -29,9 +77,11 @@ def main(argv=None) -> int:
                         "acceptance bound is <= 5%%)")
     p.add_argument("--batch-rows", type=int, default=512,
                    help="rows per packed batch in --paged mode")
+    p.add_argument("--sidecar-dir", default=".",
+                   help="directory for the durable FEEDER_r*.json sidecar "
+                        "(empty string = stdout only, no commit)")
     args = p.parse_args(argv)
 
-    import os
     import tempfile
 
     from daccord_tpu.native import available as native_available
@@ -40,11 +90,13 @@ def main(argv=None) -> int:
     from daccord_tpu.runtime.pipeline import (
         PipelineConfig, _iter_pile_blocks, _iter_pile_blocks_threaded)
     from daccord_tpu.sim import SimConfig, make_dataset
+    from daccord_tpu.utils.obs import StageProfile
 
     if not native_available():
         print(json.dumps({"error": "native host path unavailable"}))
         return 1
 
+    lines: list[dict] = []
     with tempfile.TemporaryDirectory() as d:
         out = make_dataset(d, SimConfig(genome_len=args.genome,
                                         coverage=args.coverage, seed=7), name="fb")
@@ -52,11 +104,14 @@ def main(argv=None) -> int:
         las = LasFile(out["las"])
         for nt in (int(x) for x in args.threads.split(",")):
             cfg = PipelineConfig(feeder_threads=nt)
+            prof = StageProfile(threads=max(nt, 1))
             t0 = time.perf_counter()
             n_win = n_bases = n_reads = 0
             blocks = []
-            it = (_iter_pile_blocks_threaded(db, las, cfg, None, None, nt)
-                  if nt > 0 else _iter_pile_blocks(db, las, cfg, None, None, True))
+            it = (_iter_pile_blocks_threaded(db, las, cfg, None, None, nt,
+                                             prof=prof)
+                  if nt > 0 else _iter_pile_blocks(db, las, cfg, None, None,
+                                                   True, prof=prof))
             for aread, a, seqs, lens, nsegs in it:
                 n_reads += 1
                 n_win += len(nsegs)
@@ -64,11 +119,15 @@ def main(argv=None) -> int:
                 if args.paged and len(nsegs):
                     blocks.append((seqs, lens, nsegs))
             dt = time.perf_counter() - t0
+            summ = prof.summary()
             line = {
                 "threads": nt, "reads": n_reads, "windows": n_win,
                 "wall_s": round(dt, 3),
                 "windows_per_s": round(n_win / dt, 1),
-                "bases_per_s": round(n_bases / dt, 1)}
+                "bases_per_s": round(n_bases / dt, 1),
+                # per-stage feeder decomposition (ISSUE 14): the live
+                # replacement for ARCHITECTURE.md's hand-measured table
+                "stages": summ["stages"]}
             if args.paged and blocks:
                 line.update(_measure_pack(blocks, cfg, dt,
                                           args.batch_rows))
@@ -76,7 +135,17 @@ def main(argv=None) -> int:
                 # zero window blocks (empty/degenerate corpus): report the
                 # feeder numbers rather than abort on an empty concatenate
                 line["paged_windows"] = 0
+            lines.append(line)
             print(json.dumps(line))
+    if lines and args.sidecar_dir:
+        import sys
+
+        # echo the flags argparse actually consumed: console-script and
+        # `python -m` invocations pass argv=None, and an empty cmd would
+        # make r-series entries from different configs indistinguishable
+        flags = argv if argv is not None else sys.argv[1:]
+        path = commit_sidecar(lines, " ".join(flags), args.sidecar_dir)
+        print(json.dumps({"sidecar": path}))
     return 0
 
 

@@ -124,6 +124,67 @@ def check_bench_series(entries: list[tuple[str, dict]],
                             f"{100 * (1 - sc / ref):.0f}% below the series "
                             f"median {ref:g}x")
                 hist_scaling.append(float(sc))
+    issues.extend(check_saturation_series(series, noise))
+    return issues
+
+
+def check_saturation_series(series: dict, noise: float) -> list[str]:
+    """Saturation-profiler drift over the r-series (ISSUE 14):
+
+    - ``device_idle_frac`` RISING beyond the noise band above its series
+      median is a regression (the chip is newly starving — the inverse
+      direction of the throughput rule);
+    - a feeder sub-stage's SHARE of the stage table drifting more than the
+      noise band in either direction flags (a stage quietly doubling its
+      share is the regression the hand-measured anatomy table could never
+      catch);
+    - a ``host_feeder`` verdict on a mesh >= 4 sidecar is an advisory red
+      flag regardless of history: one host visibly cannot feed that mesh,
+      which is exactly the condition ROADMAP item 2 exists to fix.
+    """
+    issues: list[str] = []
+    for _key, items in series.items():
+        hist_idle: list[float] = []
+        hist_share: dict[str, list[float]] = {}
+        for name, d in items:
+            sat = d.get("saturation") or {}
+            idle = sat.get("device_idle_frac")
+            if isinstance(idle, (int, float)) and not isinstance(idle, bool):
+                if hist_idle:
+                    ref = _median(hist_idle)
+                    if idle > ref + noise:
+                        issues.append(
+                            f"{name}: device_idle_frac {idle:g} is "
+                            f"{idle - ref:.2f} above the series median "
+                            f"{ref:g} (noise band {noise:.2f}) — the "
+                            "device is newly starving")
+                hist_idle.append(float(idle))
+            stages = d.get("stages")
+            if isinstance(stages, dict) and stages:
+                walls = {k: (v.get("wall_s") if isinstance(v, dict) else v)
+                         for k, v in stages.items()}
+                walls = {k: float(v) for k, v in walls.items()
+                         if isinstance(v, (int, float))}
+                tot = sum(walls.values())
+                if tot > 0:
+                    for st, w in walls.items():
+                        share = w / tot
+                        prev = hist_share.setdefault(st, [])
+                        if prev:
+                            ref = _median(prev)
+                            if abs(share - ref) > noise:
+                                issues.append(
+                                    f"{name}: stage {st!r} share "
+                                    f"{share:.0%} drifted from the series "
+                                    f"median {ref:.0%} (band {noise:.0%})")
+                        prev.append(share)
+            mesh = d.get("mesh")
+            if (d.get("verdict") == "host_feeder"
+                    and isinstance(mesh, int) and mesh >= 4):
+                issues.append(
+                    f"{name}: host_feeder verdict on a mesh-{mesh} run — "
+                    "one host cannot feed this mesh (advisory: ROADMAP "
+                    "item 2, device-side ingest)")
     return issues
 
 
@@ -159,6 +220,16 @@ def check_rollup(path: str, baseline: dict | None = None,
                 issues.append(f"{path}: {k} {cur:g} is "
                               f"{100 * (1 - cur / ref):.0f}% below baseline "
                               f"{ref:g}")
+        # saturation drift vs baseline (ISSUE 14): idle RISING is the
+        # regression direction here — the device newly starving behind the
+        # same workload
+        cur = (d.get("gauges") or {}).get("device_idle_frac")
+        ref = bg.get("device_idle_frac")
+        if (isinstance(cur, (int, float)) and isinstance(ref, (int, float))
+                and cur > ref + noise):
+            issues.append(f"{path}: device_idle_frac {cur:g} is "
+                          f"{cur - ref:.2f} above baseline {ref:g} — the "
+                          "device is newly starving")
     return issues
 
 
@@ -190,6 +261,13 @@ def scan_events(path: str) -> list[str]:
         elif ev == "shard_done" and rec.get("degraded"):
             issues.append(f"{path}:{ln}: shard completed DEGRADED "
                           f"({rec.get('fallback_reason') or 'fallback engine'})")
+        elif (ev == "shard_done" and rec.get("verdict") == "host_feeder"
+              and isinstance(rec.get("mesh"), int) and rec["mesh"] >= 4):
+            # ISSUE 14: a mesh >= 4 run bottlenecked on the host feeder —
+            # the starvation condition device-side ingest (ROADMAP 2) fixes
+            issues.append(f"{path}:{ln}: host_feeder verdict on a "
+                          f"mesh-{rec['mesh']} run (device starving behind "
+                          "the host feeder)")
         elif ev == "bench_rung" and rec.get("fallback"):
             issues.append(f"{path}:{ln}: bench rung recorded "
                           "fallback: true")
@@ -227,7 +305,7 @@ def _expand(paths: list[str]) -> tuple[list, list[str], list[str], list[str]]:
             events.extend(sorted(glob.glob(os.path.join(p, "*.events.jsonl"))))
             rollups.extend(sorted(glob.glob(os.path.join(p, "*.metrics.json"))))
             proms.extend(sorted(glob.glob(os.path.join(p, "*.prom"))))
-            for pat in ("BENCH_*.json", "MULTICHIP_*.json"):
+            for pat in ("BENCH_*.json", "MULTICHIP_*.json", "FEEDER_r*.json"):
                 for bp in sorted(glob.glob(os.path.join(p, pat))):
                     d = load_bench(bp)
                     if d is not None:

@@ -114,10 +114,14 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
         src = os.path.basename(path).replace(".events.jsonl", "")
         row: dict = {"src": src, "state": None, "metrics": None,
                      "done": None, "slo": None, "shed": None,
-                     "inflight": None, "pool": None}
+                     "inflight": None, "pool": None, "verdict": None}
         for rec in recs:
             ev = rec.get("event")
-            if ev == "metrics":
+            if ev == "stage.profile":
+                # saturation profiler (ISSUE 14): the live verdict — a
+                # later shard_done (committed form) overwrites it below
+                row["verdict"] = rec.get("verdict")
+            elif ev == "metrics":
                 row["metrics"] = rec
                 mesh = rec.get("mesh")
                 if isinstance(mesh, dict):
@@ -135,6 +139,8 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
                 row["engine"] = rec.get("primary")
             elif ev == "shard_done":
                 row["done"] = rec
+                if rec.get("verdict"):
+                    row["verdict"] = rec.get("verdict")
             elif ev == "batch":
                 row["inflight"] = rec.get("inflight")
                 row["pool"] = rec.get("pool")
@@ -177,6 +183,13 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
     return snap
 
 
+def _pct(v) -> str:
+    """A 0..1 fraction as a percent cell ('-' when unreported)."""
+    if not isinstance(v, (int, float)):
+        return "-"
+    return f"{100.0 * float(v):.0f}"
+
+
 def _fmt(v, nd: int = 1) -> str:
     if v is None:
         return "-"
@@ -197,8 +210,12 @@ def render(snap: dict) -> str:
     out.append(f"daccord-top  {t}  ({len(snap['sources'])} source(s))")
     if snap["sources"]:
         out.append("")
+        # IDLE%/BLK%/VERDICT = the saturation column (ISSUE 14): device
+        # idle fraction, host-blocked-on-device fraction, and the committed
+        # (or live) bottleneck verdict per source
         out.append(f"  {'SOURCE':<18}{'STATE':<10}{'WIN/S':>8}{'BASES/S':>10}"
-                   f"{'RSS MB':>8}{'INFL':>6}{'POOL':>6}  OUTCOME")
+                   f"{'RSS MB':>8}{'INFL':>6}{'POOL':>6}{'IDLE%':>7}"
+                   f"{'BLK%':>6}  {'VERDICT':<12}OUTCOME")
         for row in snap["sources"]:
             g = (row["metrics"] or {}).get("gauges", {})
             done = row["done"]
@@ -213,7 +230,9 @@ def render(snap: dict) -> str:
                 f"{_fmt(g.get('bases_per_sec')):>10}"
                 f"{_fmt(g.get('rss_mb')):>8}"
                 f"{_fmt(row['inflight'], 0):>6}{_fmt(row['pool'], 0):>6}"
-                f"  {outcome}")
+                f"{_pct(g.get('device_idle_frac')):>7}"
+                f"{_pct(g.get('host_blocked_frac')):>6}"
+                f"  {(row.get('verdict') or '-'):<12}{outcome}")
     mesh = snap.get("mesh") or {}
     devs = mesh.get("devices") or {}
     if devs:
@@ -226,7 +245,7 @@ def render(snap: dict) -> str:
             hdr += f"  rung {rung} rows/device"
         out.append(hdr)
         out.append(f"  {'DEV':>5} {'PLAT':<6}{'STATE':<9}{'DISP':>7}"
-                   f"{'WALL S':>9}{'ROWS':>9}{'HBM PEAK':>10}")
+                   f"{'WALL S':>9}{'ROWS':>9}{'HBM PEAK':>10}{'IDLE%':>7}")
         for k in sorted(devs, key=lambda x: int(x)):
             d = devs[k]
             out.append(
@@ -235,7 +254,8 @@ def render(snap: dict) -> str:
                 f"{_fmt(d.get('dispatches'), 0):>7}"
                 f"{_fmt(d.get('dispatch_wall_s'), 2):>9}"
                 f"{_fmt(d.get('rows'), 0):>9}"
-                f"{_fmt(d.get('hbm_peak_bytes'), 0):>10}")
+                f"{_fmt(d.get('hbm_peak_bytes'), 0):>10}"
+                f"{_pct(d.get('idle_frac')):>7}")
     serve = snap.get("serve")
     slo = snap.get("slo")
     if serve is not None or slo is not None:
@@ -250,6 +270,8 @@ def render(snap: dict) -> str:
                 line += f"  queue {serve['queue_depth']}"
             if "shed_level" in serve:
                 line += f"  shed {serve['shed_level']}"
+            if serve.get("verdict"):
+                line += f"  verdict {serve['verdict']}"
         out.append(line)
         if slo is not None:
             out.append(f"    SLO burn {slo.get('burn')} "

@@ -174,6 +174,15 @@ def decompose(records: list[dict], src: str = "") -> dict | None:
                 "device_s": done.get("device_s"),
                 "host_s": done.get("host_s"),
                 "stages": stages,
+                # saturation profiler (ISSUE 14): the feeder bucket's
+                # sub-stage decomposition + verdict come from the SAME
+                # shard_done record daccord-prof reads, so the two tools
+                # render one table (prof.stage_table is the one renderer)
+                "feeder_stages": (done.get("stages")
+                                  if isinstance(done.get("stages"), dict)
+                                  else None),
+                "feeder_threads": int(done.get("stage_threads") or 1),
+                "verdict": done.get("verdict"),
                 "device_sum": round(device_sum, 4),
                 "host_sum": round(run_wall - device_sum, 4),
                 "other": round(max(run_wall - accounted, 0.0), 4),
@@ -365,6 +374,8 @@ def trace_main(argv=None) -> int:
     if not args.no_timeline and not args.json:
         print_timeline(merged, out)
     if decomps and not args.json:
+        from .prof import stage_table
+
         print("per-stage wall decomposition:", file=out)
         for d in decomps:
             dev = d.get("device_s")
@@ -377,7 +388,20 @@ def trace_main(argv=None) -> int:
                 v = d["stages"][label]
                 if v > 0:
                     print(f"      {label:<14} {v:9.3f}s", file=out)
+                if label == "feeder" and d.get("feeder_stages"):
+                    # ISSUE 14: the feeder is no longer one opaque host
+                    # bucket — its sub-stage table (the saturation
+                    # profiler's) renders through the SAME renderer
+                    # daccord-prof uses, indented under the feeder line
+                    ft = d.get("feeder_threads", 1)
+                    if ft > 1:
+                        print(f"        (sub-stages thread-summed over "
+                              f"{ft} feeder threads)", file=out)
+                    for ln in stage_table(d["feeder_stages"], v or None):
+                        print("      " + ln, file=out)
             print(f"      {'other(host)':<14} {d['other']:9.3f}s", file=out)
+            if d.get("verdict"):
+                print(f"      verdict: {d['verdict']}", file=out)
     if ledger_lines and not args.json:
         print("outcome ledgers:", file=out)
         for ln in ledger_lines:

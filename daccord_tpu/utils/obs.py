@@ -202,6 +202,155 @@ class _SpanCtx:
         return False
 
 
+# ---------------------------------------------------------------------------
+# Saturation profiler (ISSUE 14): per-stage host-feeder accounting, device
+# starvation gauges, and the automatic bottleneck verdict. The StageProfile
+# is the one registry the pipeline's feeder call sites, the tensorize/paging
+# kernels, feederbench, and daccord-prof all speak.
+# ---------------------------------------------------------------------------
+
+#: canonical feeder sub-stage names, in pipeline order. ``decode`` = LAS/DB
+#: byte decode (ColumnarLas parse, read_bases), ``rank`` = depth-ranking
+#: score+sort, ``realign`` = trace-point refinement / the native pile
+#: processor (which fuses realign + window cut + tensorize in C++ — its wall
+#: books here, so python-path ``kmer``/``tensorize`` read 0 on native runs),
+#: ``kmer`` = cut_windows k-mer extraction (python path), ``tensorize`` =
+#: tensorize_windows packing, ``pack`` = pad_batch / pack_paged at dispatch
+#: assembly, ``stall`` = injected feeder_stall fault delay (faults.py).
+FEEDER_STAGES = ("decode", "rank", "realign", "kmer", "tensorize", "pack",
+                 "stall")
+
+#: verdict thresholds. A run is ``device``-bound when the host spends at
+#: least this fraction of wall blocked on the device (dispatch for inline
+#: engines, fetch for async ones); it is starved (``host_feeder``/``io``)
+#: when the device sits idle at least this fraction of wall. Between the
+#: two: ``balanced``.
+VERDICT_BLOCKED_FRAC = 0.40
+VERDICT_IDLE_FRAC = 0.40
+
+
+class StageProfile:
+    """Per-stage wall-clock accounting of the host feeder.
+
+    Always-on and deliberately tiny: one ``perf_counter`` pair per timed
+    region (per pile / per batch, never per window) folded into a dict under
+    a lock — measured well under the 2% hot-path budget. ``threads`` records
+    the feeder pool width: with N windowing threads the per-stage walls sum
+    ACROSS threads (CPU-time-like), so reconciliation against the pipeline's
+    blocked-on-feeder wall must scale by it (``daccord-prof --check``).
+    """
+
+    __slots__ = ("_lock", "walls", "calls", "threads")
+
+    def __init__(self, threads: int = 1):
+        import threading
+
+        self._lock = threading.Lock()
+        self.walls: dict[str, float] = {}
+        self.calls: dict[str, int] = {}
+        self.threads = max(1, int(threads))
+
+    def add(self, stage: str, wall_s: float, calls: int = 1) -> None:
+        with self._lock:
+            self.walls[stage] = self.walls.get(stage, 0.0) + float(wall_s)
+            self.calls[stage] = self.calls.get(stage, 0) + calls
+
+    def timed(self, stage: str):
+        """Context manager form (perf_counter pair around the block)."""
+        return _StageTimer(self, stage)
+
+    def wall(self, stage: str) -> float:
+        return self.walls.get(stage, 0.0)
+
+    def total(self) -> float:
+        """Summed wall over every stage (thread-summed, see class doc)."""
+        return sum(self.walls.values())
+
+    def dominant(self) -> tuple[str | None, float]:
+        """(stage, wall) of the heaviest stage; (None, 0.0) when empty."""
+        if not self.walls:
+            return None, 0.0
+        name = max(self.walls, key=lambda k: self.walls[k])
+        return name, self.walls[name]
+
+    def summary(self) -> dict:
+        """The committed form: ``{"threads": n, "stages": {name: {"wall_s",
+        "calls"}}}`` — what ``stage.profile`` events, ``shard_done.stages``
+        readers, and the FEEDER_r* sidecars carry."""
+        with self._lock:
+            return {"threads": self.threads,
+                    "stages": {k: {"wall_s": round(self.walls[k], 6),
+                                   "calls": self.calls.get(k, 0)}
+                               for k in sorted(self.walls)}}
+
+
+class _StageTimer:
+    __slots__ = ("_prof", "_stage", "_t0")
+
+    def __init__(self, prof: StageProfile, stage: str):
+        self._prof, self._stage = prof, stage
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._prof.add(self._stage, time.perf_counter() - self._t0)
+        return False
+
+
+def saturation_gauges(wall_s: float, blocked_s: float,
+                      busy_s: float) -> dict:
+    """Device starvation/overlap gauges from three measured walls.
+
+    ``blocked_s`` = host wall spent WAITING on the device (fetch for async
+    engines, plus dispatch for inline/synchronous ones — the feeder can do
+    nothing else then); ``busy_s`` = wall during which the device (or inline
+    solve engine) had work. Derived:
+
+    - ``device_idle_frac`` — device gaps while the host was busy feeding
+      (the starvation signal device-side ingest must close);
+    - ``host_blocked_frac`` — feeder waiting on the device (the signal a
+      bigger batch / deeper in-flight window closes);
+    - ``overlap_frac`` — both sides productive at once (the pipelining win).
+    """
+    w = max(float(wall_s), 1e-9)
+    blocked = min(max(float(blocked_s), 0.0), w)
+    busy = min(max(float(busy_s), 0.0), w)
+    return {"device_idle_frac": round(max(w - busy, 0.0) / w, 4),
+            "host_blocked_frac": round(blocked / w, 4),
+            "overlap_frac": round(max(busy - blocked, 0.0) / w, 4)}
+
+
+def bottleneck_verdict(gauges: dict, stages: dict | None = None) -> dict:
+    """The automatic per-run bottleneck attribution (ISSUE 14).
+
+    ``gauges`` is a :func:`saturation_gauges` dict; ``stages`` the
+    ``StageProfile.summary()['stages']`` table (optional — gauge-only
+    callers like the serve plane pass None). Returns ``{"verdict":
+    'host_feeder'|'device'|'io'|'balanced', "stage": <dominant feeder
+    sub-stage or None>, **gauges}``. Rules, in precedence order:
+
+    - host blocked on the device >= :data:`VERDICT_BLOCKED_FRAC` of wall:
+      the DEVICE is the bottleneck;
+    - device idle >= :data:`VERDICT_IDLE_FRAC` of wall: the host side is —
+      ``io`` when the dominant feeder sub-stage is byte decode (the disk /
+      decompression path), else ``host_feeder`` (compute: realign, k-mer,
+      tensorize, pack, or an injected stall);
+    - otherwise ``balanced``.
+    """
+    dom = None
+    if stages:
+        dom = max(stages, key=lambda k: stages[k].get("wall_s", 0.0))
+    if gauges.get("host_blocked_frac", 0.0) >= VERDICT_BLOCKED_FRAC:
+        verdict = "device"
+    elif gauges.get("device_idle_frac", 0.0) >= VERDICT_IDLE_FRAC:
+        verdict = "io" if dom == "decode" else "host_feeder"
+    else:
+        verdict = "balanced"
+    return {"verdict": verdict, "stage": dom, **gauges}
+
+
 class _Counter:
     __slots__ = ("n",)
 
@@ -356,9 +505,18 @@ def render_prom(rollup: dict, prefix: str = "daccord",
     gauges as ``<prefix>_<name>``, histograms as summaries (``_count``,
     ``_sum``, and ``quantile`` series from the reservoir p50/p95/p99).
     ``labels`` (e.g. ``{"shard": 3}``) ride every sample, so fleet-merged
-    scrapes keep per-shard attribution."""
+    scrapes keep per-shard attribution. A rollup carrying a ``verdict``
+    string (the ISSUE 14 bottleneck attribution) renders it as
+    ``<prefix>_bottleneck_verdict{verdict="..."} 1`` — the field the serve
+    smoke asserts is present in the live exposition."""
     lab = _prom_labels(labels)
     lines: list[str] = []
+    verdict = rollup.get("verdict")
+    if isinstance(verdict, str) and verdict:
+        mn = f"{_prom_name(prefix)}_bottleneck_verdict"
+        vl = _prom_labels(dict(labels or {}, verdict=verdict))
+        lines.append(f"# TYPE {mn} gauge")
+        lines.append(f"{mn}{vl} 1")
     for name, v in (rollup.get("counters") or {}).items():
         mn = f"{_prom_name(prefix)}_{_prom_name(name)}_total"
         lines.append(f"# TYPE {mn} counter")

@@ -1,0 +1,454 @@
+"""Saturation profiler tests (ISSUE 14): StageProfile accounting, starvation
+gauges + verdict rules, the pipeline's committed stage tables, the
+feeder_stall A/B flip (byte-identical), daccord-prof render/check/diff, the
+FEEDER_r* sidecar, and the sentinel's saturation drift rules.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "data", "obs")
+
+try:
+    from daccord_tpu.native import available as _nat_avail
+
+    _HAVE_NATIVE = _nat_avail()
+except Exception:
+    _HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(not _HAVE_NATIVE,
+                                  reason="native library unavailable")
+
+
+# ---------------------------------------------------------------------------
+# StageProfile + gauges + verdict units
+# ---------------------------------------------------------------------------
+
+
+def test_stage_profile_accounting():
+    from daccord_tpu.utils.obs import StageProfile
+
+    p = StageProfile(threads=2)
+    p.add("decode", 0.5)
+    p.add("decode", 0.25, calls=3)
+    with p.timed("realign"):
+        pass
+    s = p.summary()
+    assert s["threads"] == 2
+    assert s["stages"]["decode"]["wall_s"] == 0.75
+    assert s["stages"]["decode"]["calls"] == 4
+    assert s["stages"]["realign"]["calls"] == 1
+    assert p.dominant()[0] == "decode"
+    assert p.total() >= 0.75
+
+
+def test_stage_profile_thread_safety():
+    import threading
+
+    from daccord_tpu.utils.obs import StageProfile
+
+    p = StageProfile()
+
+    def work():
+        for _ in range(2000):
+            p.add("x", 0.001)
+
+    ts = [threading.Thread(target=work) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert p.calls["x"] == 8000
+    assert abs(p.walls["x"] - 8.0) < 1e-6
+
+
+def test_saturation_gauges_and_verdict_rules():
+    from daccord_tpu.utils.obs import bottleneck_verdict, saturation_gauges
+
+    # host blocked most of the wall -> device-bound
+    g = saturation_gauges(10.0, blocked_s=6.0, busy_s=9.0)
+    assert g["host_blocked_frac"] == 0.6
+    assert bottleneck_verdict(g)["verdict"] == "device"
+    # device mostly idle, compute stage dominant -> host_feeder
+    g = saturation_gauges(10.0, blocked_s=0.5, busy_s=2.0)
+    assert g["device_idle_frac"] == 0.8
+    stages = {"realign": {"wall_s": 5.0}, "decode": {"wall_s": 1.0}}
+    v = bottleneck_verdict(g, stages)
+    assert v["verdict"] == "host_feeder" and v["stage"] == "realign"
+    # same starvation but decode-dominant -> io
+    stages = {"realign": {"wall_s": 1.0}, "decode": {"wall_s": 5.0}}
+    assert bottleneck_verdict(g, stages)["verdict"] == "io"
+    # neither side saturated -> balanced; overlap accounts the rest
+    g = saturation_gauges(10.0, blocked_s=2.0, busy_s=9.0)
+    assert bottleneck_verdict(g)["verdict"] == "balanced"
+    assert g["overlap_frac"] == 0.7
+
+
+def test_render_prom_verdict_metric():
+    from daccord_tpu.utils.obs import parse_prom, render_prom
+
+    roll = {"counters": {}, "gauges": {"device_idle_frac": 0.3},
+            "verdict": "host_feeder"}
+    text = render_prom(roll, labels={"shard": 1})
+    samples, errs = parse_prom(text)
+    assert errs == []
+    labels, val = samples["daccord_bottleneck_verdict"][0]
+    assert 'verdict="host_feeder"' in labels and val == 1.0
+
+
+def test_eventcheck_stage_profile_schema(tmp_path):
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    good = tmp_path / "good.jsonl"
+    good.write_text(
+        '{"t": 0.0, "ts": 1.0, "event": "stage.profile", "stages": {}, '
+        '"feeder_s": 0.5, "verdict": "balanced"}\n')
+    assert validate_events(str(good), strict=True) == []
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(
+        '{"t": 0.0, "ts": 1.0, "event": "stage.profile", "stages": {}}\n')
+    msgs = "\n".join(validate_events(str(bad), strict=True))
+    assert "missing field 'verdict'" in msgs
+    assert "missing field 'feeder_s'" in msgs
+
+
+# ---------------------------------------------------------------------------
+# pipeline integration: committed tables + the feeder_stall A/B (tentpole)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    from daccord_tpu.formats import LasFile, read_db
+    from daccord_tpu.runtime import PipelineConfig
+    from daccord_tpu.runtime.pipeline import estimate_profile_for_shard
+    from daccord_tpu.sim import SimConfig, make_dataset
+
+    d = str(tmp_path_factory.mktemp("profcorpus"))
+    out = make_dataset(d, SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=500, min_overlap=200,
+                                    seed=5), name="pf")
+    db = read_db(out["db"])
+    las = LasFile(out["las"])
+    profile = estimate_profile_for_shard(db, las,
+                                         PipelineConfig(batch_size=64))
+    return {"db": db, "las": las, "profile": profile, "dir": d}
+
+
+def _run(corpus, tmp_path, name, **kw):
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+
+    ev = str(tmp_path / f"{name}.events.jsonl")
+    cfg = PipelineConfig(batch_size=64, events_path=ev, **kw)
+    st = None
+    res = []
+    for rid, frags, s in correct_shard(corpus["db"], corpus["las"], cfg,
+                                       profile=corpus["profile"]):
+        st = s
+        res.append((rid, [f.tobytes() for f in frags]))
+    return res, st, ev
+
+
+@needs_native
+def test_pipeline_stamps_stage_profile_and_verdict(corpus, tmp_path):
+    """A native run commits the full saturation record: shard_done carries
+    stages/verdict/bottleneck/feeder_s/mesh, stage.profile snapshots land,
+    the rollup carries the gauges + verdict, the prom rendering exposes the
+    labeled verdict metric, and daccord-prof reconciles it all."""
+    res, st, ev = _run(corpus, tmp_path, "base", native_solver=True)
+    assert res and st is not None
+    assert st.verdict in ("host_feeder", "device", "io", "balanced")
+    assert st.stage_profile["stages"], "no feeder stages recorded"
+    # native path: the fused C++ pile processor books under realign
+    assert "realign" in st.stage_profile["stages"]
+    assert st.bottleneck["device_idle_frac"] + \
+        st.bottleneck["overlap_frac"] <= 1.0 + 1e-9
+    g = st.metrics["gauges"]
+    for k in ("device_idle_frac", "host_blocked_frac", "overlap_frac",
+              "feeder_s"):
+        assert k in g, k
+    assert any(k.startswith("stage_") for k in g)
+    assert st.metrics["verdict"] == st.verdict
+    from daccord_tpu.utils.obs import parse_prom, render_prom
+
+    samples, errs = parse_prom(render_prom(st.metrics))
+    assert errs == [] and "daccord_bottleneck_verdict" in samples
+
+    evs = [json.loads(x) for x in open(ev)]
+    done = [e for e in evs if e["event"] == "shard_done"][-1]
+    for k in ("stages", "verdict", "bottleneck", "feeder_s",
+              "stage_threads", "mesh"):
+        assert k in done, k
+    assert done["mesh"] == 0
+    assert [e for e in evs if e["event"] == "stage.profile"]
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    assert validate_events(ev, strict=True) == []
+    # daccord-prof: load, render, reconcile — the pounce gate must be green
+    from daccord_tpu.tools.prof import (check_profile, load_profiles,
+                                        prof_main, render_profile)
+
+    profs, warns = load_profiles([ev])
+    assert warns == [] and len(profs) == 1
+    assert check_profile(profs[0]) == []
+    assert "verdict" in render_profile(profs[0]).lower()
+    assert prof_main(["--check", ev]) == 0
+
+
+@needs_native
+def test_feeder_stall_flips_verdict_bytes_identical(corpus, tmp_path,
+                                                    monkeypatch):
+    """The acceptance A/B: DACCORD_FAULT=feeder_stall:N slows every pile,
+    flips the committed verdict to host_feeder with `stall` the named
+    dominant sub-stage — and the FASTA bytes do not move."""
+    base_res, base_st, _ = _run(corpus, tmp_path, "ab-base",
+                                native_solver=True)
+    monkeypatch.setenv("DACCORD_FAULT", "feeder_stall:40")
+    stall_res, stall_st, ev = _run(corpus, tmp_path, "ab-stall",
+                                   native_solver=True)
+    assert stall_res == base_res, "injected stall changed bytes"
+    assert stall_st.verdict == "host_feeder", stall_st.bottleneck
+    assert stall_st.bottleneck["stage"] == "stall", stall_st.bottleneck
+    assert stall_st.bottleneck["device_idle_frac"] > \
+        base_st.bottleneck["device_idle_frac"]
+    # the stall books as feeder time, so reconciliation still holds
+    from daccord_tpu.tools.prof import check_profile, load_profiles
+
+    profs, _ = load_profiles([ev])
+    assert check_profile(profs[0]) == []
+    # sentinel advisory: host_feeder on a mesh>=4 record flags, mesh 0 not
+    from daccord_tpu.tools.sentinel import scan_events
+
+    assert scan_events(ev) == []
+
+
+@needs_native
+def test_prof_diff_names_the_moved_stage(corpus, tmp_path, monkeypatch):
+    _, _, ev_a = _run(corpus, tmp_path, "diff-a", native_solver=True)
+    monkeypatch.setenv("DACCORD_FAULT", "feeder_stall:40")
+    _, _, ev_b = _run(corpus, tmp_path, "diff-b", native_solver=True)
+    monkeypatch.delenv("DACCORD_FAULT")
+    from daccord_tpu.tools.prof import diff_profiles, load_profiles, prof_main
+
+    profs, _ = load_profiles([ev_a, ev_b])
+    lines = "\n".join(diff_profiles(profs[0], profs[1]))
+    assert "stall" in lines and "new" in lines
+    assert "verdict" in lines
+    assert prof_main(["--diff", ev_a, ev_b]) == 0
+
+
+def test_prof_check_flags_drifted_anchors(tmp_path):
+    """A torn/dishonest record fails --check: stage sums exceeding host_s,
+    feeder sub-stages disagreeing with feeder_s, missing verdict."""
+    from daccord_tpu.tools.prof import check_profile
+
+    bad = {"src": "x", "wall_s": 10.0, "host_s": 2.0, "device_s": 8.0,
+           "feeder_s": 0.5, "threads": 1,
+           "stages": {"decode": 4.0}, "verdict": None, "gauges": {}}
+    msgs = "\n".join(check_profile(bad))
+    assert "no bottleneck verdict" in msgs
+    assert "does not reconcile with the blocked-on-feeder wall" in msgs
+    assert "exceeds host_s" in msgs
+    good = {"src": "x", "wall_s": 10.0, "host_s": 6.0, "device_s": 4.0,
+            "feeder_s": 5.0, "threads": 1,
+            "stages": {"decode": 2.0, "realign": 2.95, "pack": 0.5},
+            "verdict": "balanced", "gauges": {}}
+    assert check_profile(good) == []
+    # anchors that do not add up flag too
+    torn = dict(good, device_s=1.0)
+    assert any("does not reconcile with wall_s" in m
+               for m in check_profile(torn))
+
+
+@needs_native
+def test_prof_check_explicit_profile_less_file_fails(tmp_path):
+    from daccord_tpu.tools.prof import prof_main
+
+    p = tmp_path / "empty.events.jsonl"
+    p.write_text('{"t": 0.0, "ts": 1.0, "event": "fleet.init", '
+                 '"nshards": 1, "workers": 1, "host": "h"}\n')
+    assert prof_main(["--check", str(p)]) == 1
+    # swept from a directory, the same file is silently skipped
+    assert prof_main(["--check", str(tmp_path)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# trace satellite: feeder bucket splits by the sub-stage table
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_trace_decompose_splits_feeder(corpus, tmp_path, capsys):
+    from daccord_tpu.tools.trace import decompose, trace_main
+
+    _, _, ev = _run(corpus, tmp_path, "trace", native_solver=True)
+    recs = [json.loads(x) for x in open(ev)]
+    d = decompose(recs, "trace")
+    assert d is not None and d["feeder_stages"], d
+    assert d["verdict"] in ("host_feeder", "device", "io", "balanced")
+    assert trace_main([ev, "--no-timeline"]) == 0
+    err = capsys.readouterr().err
+    assert "verdict:" in err
+    # a feeder sub-stage line rendered under the feeder bucket
+    assert "realign" in err
+
+
+# ---------------------------------------------------------------------------
+# feederbench satellite: durable FEEDER_r* sidecar
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_feederbench_commits_sidecar(tmp_path, capsys):
+    from daccord_tpu.tools.feederbench import main as fb_main
+
+    rc = fb_main(["--threads", "0", "--genome", "1500", "--coverage", "6",
+                  "--sidecar-dir", str(tmp_path)])
+    assert rc == 0
+    side = tmp_path / "FEEDER_r01.json"
+    assert side.exists()
+    payload = json.load(open(side))
+    assert payload["n"] == 1 and "parsed" in payload
+    parsed = payload["parsed"]
+    assert parsed["metric"] == "feeder_windows_per_sec"
+    assert parsed["stages"] and "realign" in parsed["stages"]
+    assert "last_real_tpu_ts" in parsed
+    # the r-series unwraps through the sentinel's loader, and prof reads it
+    from daccord_tpu.tools.prof import load_profiles
+    from daccord_tpu.tools.sentinel import load_bench
+
+    assert load_bench(str(side))["metric"] == "feeder_windows_per_sec"
+    profs, _ = load_profiles([str(side)])
+    assert profs and profs[0]["stages"]
+    # a second run appends r02, never overwrites
+    assert fb_main(["--threads", "0", "--genome", "1500", "--coverage", "6",
+                    "--sidecar-dir", str(tmp_path)]) == 0
+    assert (tmp_path / "FEEDER_r02.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# sentinel satellite: saturation drift + mesh>=4 host_feeder advisory
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_flags_rising_idle_and_stage_drift():
+    from daccord_tpu.tools.sentinel import check_bench_series
+
+    entries = [
+        ("r1.json", {"metric": "m", "value": 100.0, "batch": 64,
+                     "saturation": {"device_idle_frac": 0.1},
+                     "stages": {"decode": 1.0, "realign": 8.0}}),
+        ("r2.json", {"metric": "m", "value": 100.0, "batch": 64,
+                     "saturation": {"device_idle_frac": 0.12},
+                     "stages": {"decode": 1.1, "realign": 8.2}}),
+    ]
+    assert check_bench_series(entries, noise=0.15) == []
+    entries.append(
+        ("r3.json", {"metric": "m", "value": 100.0, "batch": 64,
+                     "saturation": {"device_idle_frac": 0.55},
+                     "stages": {"decode": 6.0, "realign": 3.0}}))
+    issues = check_bench_series(entries, noise=0.15)
+    joined = "\n".join(issues)
+    assert "device_idle_frac" in joined and "newly starving" in joined
+    assert "share" in joined and "drifted" in joined
+
+
+def test_sentinel_mesh4_host_feeder_advisory(tmp_path):
+    from daccord_tpu.tools.sentinel import check_bench_series, scan_events
+
+    entries = [("m.json", {"metric": "multichip_windows_per_sec", "mesh": 8,
+                           "batch": 64, "verdict": "host_feeder"})]
+    issues = check_bench_series(entries, noise=0.15)
+    assert any("host_feeder verdict on a mesh-8 run" in i for i in issues)
+    # same rule over an events sidecar's shard_done
+    ev = tmp_path / "m.events.jsonl"
+    ev.write_text(
+        '{"t": 1.0, "ts": 2.0, "event": "shard_done", "reads": 1, '
+        '"windows": 2, "solved": 2, "wall_s": 1.0, "degraded": false, '
+        '"verdict": "host_feeder", "mesh": 8}\n')
+    assert any("mesh-8" in i for i in scan_events(str(ev)))
+    # mesh < 4 (or non-mesh) does not flag
+    ev2 = tmp_path / "s.events.jsonl"
+    ev2.write_text(
+        '{"t": 1.0, "ts": 2.0, "event": "shard_done", "reads": 1, '
+        '"windows": 2, "solved": 2, "wall_s": 1.0, "degraded": false, '
+        '"verdict": "host_feeder", "mesh": 0}\n')
+    assert scan_events(str(ev2)) == []
+
+
+def test_sentinel_baseline_idle_rise(tmp_path):
+    from daccord_tpu.tools.sentinel import check_rollup
+
+    cur = tmp_path / "a.metrics.json"
+    cur.write_text(json.dumps({"counters": {}, "gauges": {
+        "windows_per_sec": 100.0, "device_idle_frac": 0.6}}))
+    baseline = {"counters": {}, "gauges": {"windows_per_sec": 100.0,
+                                           "device_idle_frac": 0.1}}
+    issues = check_rollup(str(cur), baseline, noise=0.15)
+    assert any("above baseline" in i for i in issues)
+
+
+# ---------------------------------------------------------------------------
+# top satellite: saturation columns over the committed fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_top_renders_saturation_columns():
+    from daccord_tpu.tools.top import collect, render
+
+    snap = collect([os.path.join(FIXTURES, "run"),
+                    os.path.join(FIXTURES, "srv")])
+    screen = render(snap)
+    assert "IDLE%" in screen and "BLK%" in screen and "VERDICT" in screen
+    # fixture gauges: 30% idle / 35% blocked / balanced verdict
+    assert "30" in screen and "35" in screen and "balanced" in screen
+    # mesh member idle column from the health map
+    assert "MESH 4/8" in screen
+
+
+def test_fixture_events_pass_new_schema():
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    p = os.path.join(FIXTURES, "run", "shard0000.events.jsonl")
+    assert validate_events(p, strict=True) == []
+
+
+# ---------------------------------------------------------------------------
+# serve plane: group saturation + service verdict in stats/prom
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_serve_stats_carry_saturation_and_verdict(tmp_path):
+    from daccord_tpu.serve import ConsensusService, ServeConfig
+    from daccord_tpu.sim import SimConfig, make_dataset
+    from daccord_tpu.utils.obs import parse_prom
+
+    d = str(tmp_path / "corpus")
+    out = make_dataset(d, SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=500, min_overlap=200,
+                                    seed=5), name="sv")
+    svc = ConsensusService(ServeConfig(
+        workdir=str(tmp_path / "srv"), backend="native",
+        backend_explicit=True, batch=64, workers=1, flush_lag_s=0.02))
+    try:
+        j = svc.submit({"db": out["db"], "las": out["las"], "tenant": "a"})
+        svc.wait(j["job"], 300)
+        st = svc.stats()
+        assert st["verdict"] in ("host_feeder", "device", "io", "balanced")
+        # per-group saturation rode the group stats
+        grp = svc.warm.groups()[0]
+        sat = grp.saturation()
+        for k in ("device_idle_frac", "host_blocked_frac", "overlap_frac",
+                  "busy_s", "blocked_s"):
+            assert k in sat, k
+        text = svc.stats_prom()
+        samples, errs = parse_prom(text)
+        assert errs == []
+        assert "daccord_serve_bottleneck_verdict" in samples
+    finally:
+        svc.shutdown()

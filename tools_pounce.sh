@@ -90,6 +90,10 @@ python -m daccord_tpu.tools.cli eventcheck --strict "$fuzzdir/fuzz.events.jsonl"
 python -m daccord_tpu.tools.cli trace --check --no-timeline \
     "$fuzzdir/fuzz.events.jsonl" "$fuzzdir/fuzz.ledger.jsonl" \
   || { echo "tools_pounce: fuzz sidecars failed daccord-trace lint" >&2; exit 1; }
+# saturation-profiler reconciliation (ISSUE 14): stage sums must agree with
+# the run's own feeder_s/host_s/device_s anchors within 5%/50 ms
+python -m daccord_tpu.tools.cli prof --check "$fuzzdir/fuzz.events.jsonl" \
+  || { echo "tools_pounce: fuzz sidecar failed daccord-prof reconciliation" >&2; exit 1; }
 # regression sentinel, strict (ISSUE 13): a failover/degraded outcome in the
 # fuzz smoke would otherwise land as a green exit code
 python -m daccord_tpu.tools.cli sentinel --strict "$fuzzdir/fuzz.events.jsonl" \
@@ -132,6 +136,10 @@ python -m daccord_tpu.tools.cli eventcheck --strict \
 python -m daccord_tpu.tools.cli trace --check --no-timeline \
     "$fleetdir/ref" "$fleetdir/crash" \
   || { echo "tools_pounce: fleet sidecars failed daccord-trace lint" >&2; exit 1; }
+# per-worker saturation profiles must reconcile (ISSUE 14; directory sweep
+# skips the orchestrator sidecar, which has no shard_done by design)
+python -m daccord_tpu.tools.cli prof --check "$fleetdir/ref" "$fleetdir/crash" \
+  || { echo "tools_pounce: fleet sidecars failed daccord-prof reconciliation" >&2; exit 1; }
 grep -q '"event": "fleet.retry"' "$fleetdir/crash/fleet.events.jsonl" \
   || { echo "tools_pounce: injected worker crash was never requeued" >&2; exit 1; }
 # sentinel strict over both fleet dirs: no shard may finish degraded, and the
@@ -174,6 +182,8 @@ python -m daccord_tpu.tools.cli eventcheck --strict "$govdir/oom.events.jsonl" \
   || { echo "tools_pounce: governor events failed schema lint" >&2; exit 1; }
 python -m daccord_tpu.tools.cli trace --check --no-timeline "$govdir/oom.events.jsonl" \
   || { echo "tools_pounce: governor sidecar failed daccord-trace lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli prof --check "$govdir/oom.events.jsonl" \
+  || { echo "tools_pounce: governor sidecar failed daccord-prof reconciliation" >&2; exit 1; }
 grep -q '"event": "governor.classify"' "$govdir/oom.events.jsonl" \
   || { echo "tools_pounce: injected OOM was never classified" >&2; exit 1; }
 python -m daccord_tpu.tools.cli sentinel --strict "$govdir/oom.events.jsonl" \
@@ -191,6 +201,8 @@ python -m daccord_tpu.tools.cli eventcheck --strict "$govdir/mon.events.jsonl" \
   || { echo "tools_pounce: monster events failed schema lint" >&2; exit 1; }
 python -m daccord_tpu.tools.cli trace --check --no-timeline "$govdir/mon.events.jsonl" \
   || { echo "tools_pounce: monster sidecar failed daccord-trace lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli prof --check "$govdir/mon.events.jsonl" \
+  || { echo "tools_pounce: monster sidecar failed daccord-prof reconciliation" >&2; exit 1; }
 python - "$govdir" <<'EOF' || { echo "tools_pounce: monster quarantine parity FAILED" >&2; exit 1; }
 import json, sys
 from daccord_tpu.formats.fasta import read_fasta
@@ -246,6 +258,8 @@ python -m daccord_tpu.tools.cli eventcheck --strict "$pagedir/paged.events.jsonl
   || { echo "tools_pounce: paged events failed schema lint" >&2; exit 1; }
 python -m daccord_tpu.tools.cli trace --check --no-timeline "$pagedir/paged.events.jsonl" \
   || { echo "tools_pounce: paged sidecar failed daccord-trace lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli prof --check "$pagedir/paged.events.jsonl" \
+  || { echo "tools_pounce: paged sidecar failed daccord-prof reconciliation" >&2; exit 1; }
 grep -q '"event": "paging.family"' "$pagedir/paged.events.jsonl" \
   || { echo "tools_pounce: paged run derived no shape families" >&2; exit 1; }
 python - "$pagedir" <<'EOF' || { echo "tools_pounce: paged pad-waste check FAILED" >&2; exit 1; }
@@ -291,6 +305,8 @@ python -m daccord_tpu.tools.cli eventcheck --strict "$meshdir/mesh.events.jsonl"
   || { echo "tools_pounce: mesh events failed schema lint" >&2; exit 1; }
 python -m daccord_tpu.tools.cli trace --check --no-timeline "$meshdir/mesh.events.jsonl" \
   || { echo "tools_pounce: mesh sidecar failed daccord-trace lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli prof --check "$meshdir/mesh.events.jsonl" \
+  || { echo "tools_pounce: mesh sidecar failed daccord-prof reconciliation" >&2; exit 1; }
 grep -q '"event": "mesh.init"' "$meshdir/mesh.events.jsonl" \
   || { echo "tools_pounce: mesh run never initialized a mesh" >&2; exit 1; }
 # per-device flight recorder (ISSUE 13): the clean mesh smoke must emit the
@@ -360,6 +376,10 @@ assert "job_latency_s" in hists and hists["job_latency_s"]["p50"] is not None, \
 # the one production actually serves, fetched over the wire
 prom = req("GET", "/v1/metrics?format=prom")
 assert b"daccord_serve_" in prom, "prom exposition empty"
+# saturation profiler (ISSUE 14): the bottleneck verdict must be present
+# in the LIVE exposition as a labeled gauge
+assert b"daccord_serve_bottleneck_verdict" in prom, \
+    "bottleneck verdict missing from the live prom exposition"
 with open(f"{d}/metrics.prom", "wb") as fh:
     fh.write(prom)
 # lock-free healthz now answers the on-call checklist
@@ -379,6 +399,9 @@ python -m daccord_tpu.tools.cli trace --check --no-timeline \
     "$servedir/srv/serve.events.jsonl" "$servedir"/srv/g*.events.jsonl \
     "$servedir"/srv/jobs/*/events.jsonl "$servedir"/srv/jobs/*/ledger.jsonl \
   || { echo "tools_pounce: serve sidecars failed daccord-trace lint" >&2; exit 1; }
+# every job's pipeline stage profile must reconcile (ISSUE 14)
+python -m daccord_tpu.tools.cli prof --check "$servedir"/srv/jobs/*/events.jsonl \
+  || { echo "tools_pounce: serve job sidecars failed daccord-prof reconciliation" >&2; exit 1; }
 # scrape-parse the live prom exposition + the durable serve.metrics.prom,
 # and run the sentinel strict over the whole serve workdir (ISSUE 13)
 python -m daccord_tpu.tools.cli sentinel --strict "$servedir/srv" \
