@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -90,6 +92,16 @@ LADDER_MAX_BATCHES = int(_lmb) if _lmb else None
 # built, else cpu — the serving plane benches chip-free).
 BENCH_SERVE = os.environ.get("DACCORD_BENCH_SERVE") == "1"
 BENCH_SERVE_TRACE = os.environ.get("DACCORD_BENCH_SERVE_TRACE")
+# chaos soak (ISSUE 15): DACCORD_BENCH_SERVE_SOAK=1 runs a sustained,
+# seeded job-arrival trace against TWO daccord-serve processes sharing a
+# peer-takeover dir, under a deterministic serve_crash + device_lost fault
+# storm (dead processes are restarted), and asserts the crash-durability
+# contract at the end: every admitted job reached COMMITTED or
+# client-ABORTED exactly once, every committed FASTA is byte-identical to
+# the solo control, and no quota charge or spool dir leaked. Commits
+# BENCH_SERVE_SOAK.json. DACCORD_BENCH_SERVE_SOAK_JOBS overrides the job
+# count (default 20).
+BENCH_SERVE_SOAK = os.environ.get("DACCORD_BENCH_SERVE_SOAK") == "1"
 # multichip mesh arm (ISSUE 12): DACCORD_BENCH_MESH=1 measures mesh-N
 # windows/sec scaling vs single-device ON THIS HOST through the sharded
 # ladder (parallel/mesh.py) and commits the next MULTICHIP_r*.json sidecar —
@@ -1073,6 +1085,342 @@ def run_serve_bench(ev) -> dict:
     return line
 
 
+def run_serve_soak(root: str | None = None, n_jobs: int = 20,
+                   seed: int = 0x5E12, ev=None, backend: str | None = None,
+                   timeout_s: float = 900.0,
+                   commit_sidecar: bool = True) -> dict:
+    """Chaos soak (ISSUE 15): a sustained seeded arrival trace against TWO
+    ``daccord-serve`` subprocesses sharing a peer-takeover dir, under a
+    deterministic ``serve_crash`` + ``device_lost`` fault storm. Dead
+    processes are restarted (replaying their journals); in-flight jobs are
+    recovered by replay or peer takeover — the driver only routes around
+    dead listeners, it never resubmits work except through idempotency keys.
+
+    Asserts the crash-durability contract at the end (AssertionError = the
+    contract broke — the slow test and the soak bench both ride this):
+
+    - every admitted job reached COMMITTED or client-ABORTED exactly once;
+    - every committed FASTA is byte-identical to the solo control;
+    - no quota charge leaked (all tenant balances zero at the end);
+    - no spool dir leaked (every jobs/<id> dir maps to a journaled job).
+    """
+    import random as _random
+    import shutil
+    import socket
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from daccord_tpu.serve.journal import replay as j_replay
+    from daccord_tpu.sim.synth import SimConfig, make_dataset
+
+    if backend is None:
+        backend = os.environ.get("DACCORD_BENCH_SERVE_BACKEND")
+    if not backend:
+        try:
+            from daccord_tpu.native import available as _nat
+
+            backend = "native" if _nat() else "cpu"
+        except Exception:
+            backend = "cpu"
+    rng = _random.Random(seed)
+    owns_root = root is None
+    root = root or tempfile.mkdtemp(prefix="daccord-serve-soak-")
+    data = make_dataset(root, SimConfig(genome_len=1500, coverage=10,
+                                        read_len_mean=500, min_overlap=200,
+                                        seed=5), name="sv")
+    # solo control through the same config builder the serve jobs use
+    import dataclasses as _dc
+
+    from daccord_tpu.runtime.pipeline import correct_to_fasta
+    from daccord_tpu.serve.jobs import JobSpec, build_job_config
+
+    spec = JobSpec.from_json({"db": data["db"], "las": data["las"]}, root)
+    ccfg = build_job_config(spec, backend, True, 64, "fused", root, "solo")
+    ccfg = _dc.replace(ccfg, native_solver=backend == "native",
+                       supervise=True, events_path=None, ledger_path=None,
+                       job_tag=None, quarantine_path=None)
+    solo = os.path.join(root, "solo.fasta")
+    correct_to_fasta(data["db"], data["las"], solo, ccfg)
+    with open(solo, "rb") as fh:
+        solo_bytes = fh.read()
+
+    peer = os.path.join(root, "peer")
+    pkg_root = os.path.dirname(os.path.abspath(
+        __import__("daccord_tpu").__file__))
+    pkg_root = os.path.dirname(pkg_root)
+
+    # the seeded storm: each incarnation of each server gets its fault spec
+    # here — deterministic, so two soak runs crash at the same journal
+    # appends and the trajectory compares like-for-like
+    storms = {
+        "srvA": [f"serve_crash:{rng.randint(5, 12)}",
+                 f"serve_crash:{rng.randint(18, 30)}", ""],
+        "srvB": [f"device_lost:{rng.randint(2, 4)}"
+                 f",serve_crash:{rng.randint(10, 20)}", ""],
+    }
+    servers = {name: {"workdir": os.path.join(root, name), "proc": None,
+                      "port": None, "inc": 0, "crashes": 0}
+               for name in ("srvA", "srvB")}
+
+    def spawn(name: str) -> None:
+        s = servers[name]
+        fault = ""
+        sched = storms[name]
+        if s["inc"] < len(sched):
+            fault = sched[s["inc"]]
+        ready = os.path.join(root, f"{name}.ready.{s['inc']}.json")
+        argv = [sys.executable, "-m", "daccord_tpu.tools.cli", "serve",
+                "--workdir", s["workdir"], "--backend", backend, "-b", "64",
+                "--workers", "2", "--port", "0", "--ready-file", ready,
+                "--peer-dir", peer, "--lease-ttl-s", "6",
+                "--heartbeat-s", "0.5", "--checkpoint-reads", "4",
+                "--flush-lag-ms", "20", "--metrics-snapshot-s", "5",
+                "--drain-deadline-s", "120"]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        if fault:
+            env["DACCORD_FAULT"] = fault
+        else:
+            env.pop("DACCORD_FAULT", None)
+        log = open(os.path.join(root, f"{name}.{s['inc']}.log"), "wb")
+        s["proc"] = subprocess.Popen(argv, env=env, stdout=log, stderr=log)
+        s["inc"] += 1
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if os.path.exists(ready):
+                try:
+                    s["port"] = json.load(open(ready))["port"]
+                    return
+                except (OSError, json.JSONDecodeError, ValueError):
+                    pass
+            if s["proc"].poll() is not None:
+                # died during startup (an early serve_crash): restart with
+                # the next incarnation's spec
+                s["crashes"] += 1
+                return spawn(name)
+            time.sleep(0.05)
+        raise RuntimeError(f"soak: {name} never wrote its ready file")
+
+    def ensure_alive(name: str) -> None:
+        s = servers[name]
+        if s["proc"] is None or s["proc"].poll() is not None:
+            if s["proc"] is not None:
+                s["crashes"] += 1
+            spawn(name)
+
+    def req(name: str, method: str, path: str, body=None, timeout=60):
+        s = servers[name]
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{s['port']}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+
+    t0 = time.time()
+    for name in servers:
+        spawn(name)
+    # seeded arrival trace; idempotency keys make mid-crash submits safe to
+    # retry (an admitted-but-unanswered submit dedupes on the retry)
+    arrivals = []
+    t = 0.0
+    for i in range(n_jobs):
+        t += rng.uniform(0.05, 0.35)
+        arrivals.append(t)
+    abort_idx = {2, n_jobs // 2} if n_jobs >= 6 else set()
+    jobs = {}   # idem key -> {"home": name, "job": id, "abort": bool}
+    for i, at in enumerate(arrivals):
+        dt = at - (time.time() - t0)
+        if dt > 0:
+            time.sleep(dt)
+        name = "srvA" if i % 2 == 0 else "srvB"
+        idem = f"soak-{seed}-{i}"
+        sub_deadline = time.time() + 180
+        while True:
+            ensure_alive(name)
+            try:
+                code, st = req(name, "POST", "/v1/jobs",
+                               {"db": data["db"], "las": data["las"],
+                                "tenant": f"t{i % 3}",
+                                "idempotency_key": idem})
+                if code in (200, 201):
+                    jobs[idem] = {"home": name, "job": st["job"],
+                                  "abort": i in abort_idx}
+                    break
+            except (urllib.error.URLError, ConnectionError, socket.timeout,
+                    OSError):
+                pass
+            if time.time() > sub_deadline:
+                raise RuntimeError(f"soak: submit {idem} never admitted")
+            time.sleep(0.2)
+        if i in abort_idx:
+            try:
+                req(name, "DELETE", f"/v1/jobs/{jobs[idem]['job']}")
+            except (urllib.error.URLError, ConnectionError, socket.timeout,
+                    OSError):
+                pass   # the abort may race a crash; the contract check
+                       # below accepts committed OR aborted for these
+
+    def terminal(entry) -> str | None:
+        """done|aborted|failed when the job is terminal, else None — via
+        HTTP when the home server knows it, else the durable manifest (a
+        peer may have finished it), else the journals."""
+        name, jid = entry["home"], entry["job"]
+        try:
+            code, st = req(name, "GET", f"/v1/jobs/{jid}", timeout=20)
+            if code == 200 and st.get("state") in ("done", "failed",
+                                                   "aborted"):
+                return st["state"]
+            if code == 200:
+                return None
+        except (urllib.error.URLError, ConnectionError, socket.timeout,
+                OSError):
+            pass
+        jdir = os.path.join(servers[name]["workdir"], "jobs", jid)
+        if os.path.exists(os.path.join(jdir, "manifest.json")):
+            return "done"
+        ents, _ = j_replay(os.path.join(servers[name]["workdir"],
+                                        "journal.jsonl"))
+        e = ents.get(jid)
+        if e is not None and e.terminal:
+            return {"committed": "done"}.get(e.state, e.state)
+        return None
+
+    poll_deadline = time.time() + timeout_s
+    states = {}
+    while time.time() < poll_deadline:
+        for name in servers:
+            ensure_alive(name)
+        states = {k: terminal(v) for k, v in jobs.items()}
+        if all(states.values()):
+            break
+        time.sleep(0.5)
+    assert all(states.values()), \
+        f"soak: jobs never terminal: {[k for k, v in states.items() if not v]}"
+
+    # quota balances BEFORE shutdown: nothing queued, nothing charged
+    admissions = {}
+    for name in servers:
+        ensure_alive(name)
+        _, m = req(name, "GET", "/v1/metrics", timeout=60)
+        admissions[name] = m["admission"]
+    for name, adm in admissions.items():
+        for tname, tstat in adm.get("tenants", {}).items():
+            assert tstat["queued"] == 0 and tstat["bytes"] == 0, \
+                f"soak: leaked quota charge on {name}/{tname}: {tstat}"
+
+    for name in servers:
+        try:
+            req(name, "POST", "/v1/shutdown", timeout=60)
+        except (urllib.error.URLError, ConnectionError, socket.timeout,
+                OSError):
+            pass
+        rc = servers[name]["proc"].wait(timeout=180)
+        assert rc == 0, f"soak: {name} final incarnation exited {rc}"
+
+    # ---- the contract ----------------------------------------------------
+    # exactly-once: count serve.commit events per GLOBAL job key
+    # (<origin-service>.<id>) across every incarnation of every server — a
+    # local commit logs the short id (origin = the logging server), a
+    # takeover commits under the global key. Real-run commits carry
+    # fragments >= 0; recovery re-emissions (replay finalize / manifest
+    # found) carry fragments == -1 — the exactly-once form is: AT MOST one
+    # real run committed, AT LEAST one commit record total per done job.
+    commits: dict[str, int] = {}
+    commits_real: dict[str, int] = {}
+    recoveries = {"replay_orphans": 0, "takeovers": 0, "replays": 0}
+    for name in servers:
+        evp = os.path.join(servers[name]["workdir"], "serve.events.jsonl")
+        with open(evp) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                evk = rec.get("event")
+                if evk == "serve.commit":
+                    jid = str(rec.get("job", ""))
+                    key = jid if "." in jid else f"{name}.{jid}"
+                    commits[key] = commits.get(key, 0) + 1
+                    if int(rec.get("fragments", 0)) >= 0:
+                        commits_real[key] = commits_real.get(key, 0) + 1
+                elif evk == "serve.takeover":
+                    recoveries["takeovers"] += 1
+                elif evk == "serve.replay":
+                    recoveries["replays"] += 1
+                    recoveries["replay_orphans"] += int(
+                        rec.get("orphans", 0))
+    n_done = n_aborted = 0
+    for idem, entry in jobs.items():
+        st = states[idem]
+        jid = entry["job"]
+        gkey = f"{entry['home']}.{jid}"
+        jdir = os.path.join(servers[entry["home"]]["workdir"], "jobs", jid)
+        assert st in ("done", "aborted"), \
+            f"soak: job {gkey} terminal state {st!r} (never 'failed')"
+        if st == "done":
+            n_done += 1
+            with open(os.path.join(jdir, "out.fasta"), "rb") as fh:
+                got = fh.read()
+            assert got == solo_bytes, \
+                f"soak: job {gkey} FASTA diverged from the solo control"
+            assert commits_real.get(gkey, 0) <= 1, \
+                f"soak: job {gkey} committed by " \
+                f"{commits_real[gkey]} distinct runs"
+            assert commits.get(gkey, 0) >= 1, \
+                f"soak: done job {gkey} has no commit record"
+        else:
+            n_aborted += 1
+            assert commits.get(gkey, 0) == 0, \
+                f"soak: aborted job {gkey} has {commits[gkey]} commits"
+            assert not os.path.exists(os.path.join(jdir, "out.fasta")), \
+                f"soak: aborted job {gkey} left a committed FASTA"
+    # spool-dir leak check: every jobs/<id> dir maps to a journaled admit
+    for name in servers:
+        w = servers[name]["workdir"]
+        ents, _ = j_replay(os.path.join(w, "journal.jsonl"))
+        journaled = {jid.rsplit(".", 1)[-1] for jid in ents}
+        # terminal entries without an idempotency key compact away, so the
+        # event stream is the complete admit record
+        with open(os.path.join(w, "serve.events.jsonl")) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("event") == "serve.journal" \
+                        and rec.get("rec") == "admitted":
+                    journaled.add(str(rec.get("job", "")).rsplit(".", 1)[-1])
+        dirs = set(os.listdir(os.path.join(w, "jobs")))
+        strays = dirs - journaled
+        assert not strays, f"soak: leaked spool dirs on {name}: {strays}"
+        tmp_litter = [p for p in os.listdir(w) if ".tmp." in p]
+        assert not tmp_litter, f"soak: tmp litter on {name}: {tmp_litter}"
+    crashes = sum(s["crashes"] for s in servers.values())
+    line = {
+        "metric": "serve_soak", "backend": backend, "seed": seed,
+        "jobs": n_jobs, "done": n_done, "aborted": n_aborted,
+        "crashes": crashes,
+        "incarnations": {n: s["inc"] for n, s in servers.items()},
+        "storm": storms,
+        **recoveries,
+        "commit_events": sum(commits.values()),
+        "wall_s": round(time.time() - t0, 3),
+        "parity": True, "leaks": 0,
+        **_tunnel_staleness(),
+    }
+    if ev is not None:
+        ev.log("bench_done", wall_s=line["wall_s"])
+    if commit_sidecar:
+        _commit_sidecar("BENCH_SERVE_SOAK.json", line)
+    if owns_root:
+        shutil.rmtree(root, ignore_errors=True)
+    return line
+
+
 def main() -> None:
     import argparse
 
@@ -1092,6 +1440,13 @@ def main() -> None:
     # tunnel's last real life sign before any measurement prints
     _echo_staleness()
     enable_compilation_cache()
+    if BENCH_SERVE_SOAK:
+        # chaos soak (ISSUE 15): 2 serve processes + seeded fault storm;
+        # the asserts ARE the stage — a contract break exits nonzero
+        ev.log("bench_start", batch=0, soak=True)
+        n = int(os.environ.get("DACCORD_BENCH_SERVE_SOAK_JOBS", "20"))
+        print(json.dumps(run_serve_soak(ev=ev, n_jobs=n)))
+        return
     if BENCH_SERVE:
         # serving-plane stage: self-contained (synth corpus + real HTTP
         # server), chip-free by default — runs before any window build

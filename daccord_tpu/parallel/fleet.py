@@ -79,7 +79,7 @@ import time
 from dataclasses import dataclass
 
 from ..runtime.faults import FaultPlan, non_fleet_spec
-from ..utils import aio
+from ..utils import aio, lease
 from ..utils.obs import JsonlLogger, NullLogger
 from .launch import _write_manifest_durable, load_shard_manifest, shard_paths
 
@@ -97,81 +97,33 @@ def lease_path(outdir: str, shard: int) -> str:
     return os.path.join(outdir, "leases", f"shard{shard:04d}.lease")
 
 
+# The lease protocol itself (O_EXCL claim, re-read-before-renew heartbeat,
+# holder-checked release, stale takeover) lives in utils/lease.py — shared
+# verbatim with the serve tier's per-job leases (ISSUE 15). These wrappers
+# keep the fleet's (outdir, shard) addressing.
+
 def claim_lease(outdir: str, shard: int, host: str,
                 ttl_s: float) -> tuple[bool, dict | None]:
-    """Try to claim ``shard``'s lease for ``host``.
-
-    Returns ``(claimed, takeover)``: ``takeover`` carries the previous
-    holder's identity and the lease's staleness when the claim displaced a
-    stale lease. A fresh (live) lease loses the race: ``(False, None)``.
-    Takeover is race-safe on a POSIX shared FS: ``os.replace`` of the stale
-    file succeeds for exactly one taker (the loser's replace raises), and
-    the subsequent ``O_EXCL`` create arbitrates any claim/claim race.
-    """
-    path = lease_path(outdir, shard)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    payload = json.dumps({"host": host, "pid": os.getpid(), "shard": shard,
-                          "claimed_t": time.time()}).encode()
-    if aio.exclusive_create(path, payload):
-        return True, None
-    try:
-        stale_s = time.time() - os.path.getmtime(path)
-    except OSError:
-        # holder released between our create and stat: claim the vacancy
-        return aio.exclusive_create(path, payload), None
-    if stale_s <= ttl_s:
-        return False, None
-    prev = {}
-    try:
-        with open(path) as fh:
-            prev = json.load(fh)
-    except (OSError, json.JSONDecodeError):
-        pass  # torn lease from a killed claimer: still takeover-able
-    grave = f"{path}.stale.{os.getpid()}"
-    try:
-        os.replace(path, grave)
-    except FileNotFoundError:
-        return False, None  # another taker won the replace race
-    try:
-        os.remove(grave)
-    except OSError:
-        pass
-    if not aio.exclusive_create(path, payload):
-        return False, None
-    return True, {"prev_host": str(prev.get("host", "?")),
-                  "stale_s": round(stale_s, 3)}
+    """Try to claim ``shard``'s lease for ``host`` (see ``utils.lease.claim``
+    for the race-safety contract)."""
+    return lease.claim(lease_path(outdir, shard), host, ttl_s,
+                       extra={"shard": shard})
 
 
 def read_lease(outdir: str, shard: int) -> dict | None:
     """The lease's payload, or None when absent/torn."""
-    try:
-        with open(lease_path(outdir, shard)) as fh:
-            return json.load(fh)
-    except (OSError, json.JSONDecodeError):
-        return None
+    return lease.read(lease_path(outdir, shard))
 
 
 def renew_lease(outdir: str, shard: int) -> None:
     """Heartbeat: bump the lease mtime (the staleness clock other hosts read)."""
-    try:
-        os.utime(lease_path(outdir, shard), None)
-    except OSError:
-        pass  # taken over / released: the reaper will notice soon enough
+    lease.renew(lease_path(outdir, shard))
 
 
 def release_lease(outdir: str, shard: int, host: str | None = None) -> None:
     """Remove the lease; with ``host`` given, only while it still names that
-    host — a holder that was taken over must not delete the taker's live
-    lease (the read/remove race that remains is the fencing-free protocol's
-    inherent window, bounded by the heartbeat ownership re-check)."""
-    if host is not None:
-        prev = read_lease(outdir, shard)
-        if prev is not None and prev.get("host") != host:
-            return
-    try:
-        os.remove(lease_path(outdir, shard))
-    except OSError:
-        pass
+    host (holder-checked release — see ``utils.lease.release``)."""
+    lease.release(lease_path(outdir, shard), host=host)
 
 
 def backdate_lease(outdir: str, shard: int, age_s: float) -> None:
@@ -179,11 +131,7 @@ def backdate_lease(outdir: str, shard: int, age_s: float) -> None:
     makes a wedged host's lease stale deterministically instead of burning
     ``lease_ttl_s`` of CI wall-clock (also the test hook for simulating a
     host that died right after claiming)."""
-    t = time.time() - age_s
-    try:
-        os.utime(lease_path(outdir, shard), (t, t))
-    except OSError:
-        pass
+    lease.backdate(lease_path(outdir, shard), age_s)
 
 
 def flag_stragglers(throughputs: dict[int, float],

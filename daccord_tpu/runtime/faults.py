@@ -86,6 +86,34 @@ before tensorization (:meth:`FaultPlan.monster_check`). The ceiling left by
 doomed shape must keep failing (that is the failure mode under test), while
 a bisected one fits.
 
+Serve-tier kinds (the crash-durability twins, ISSUE 15) sabotage a
+``daccord-serve`` process the way the fleet kinds sabotage worker
+subprocesses — from inside, deterministically, so the whole journal-replay
+and peer-takeover machinery runs on CPU in CI::
+
+    DACCORD_FAULT=serve_crash:3           # the process dies HARD (exit 137,
+                                          # no cleanup) right after its 3rd
+                                          # journal append becomes durable
+    DACCORD_FAULT=serve_hang:1            # the 1st job run wedges forever
+                                          # (a group thread stuck in a solve)
+
+Counter domains: ``serve_crash`` counts fsync'd journal appends
+(:meth:`FaultPlan.serve_crash_check`, consumed by ``serve/journal.py`` —
+the append is durable FIRST, then the process dies, so every record the
+journal claims to hold survives the injected crash exactly like a real
+SIGKILL between syscalls); ``serve_hang`` counts job runs
+(:meth:`FaultPlan.serve_hang_check`, consumed by ``serve/jobs.run_job``).
+Because the journal appends in lifecycle order (admitted, running,
+progress..., committing, committed), ``serve_crash:N`` lands the death at
+an exact lifecycle point: N=1 dies post-admit pre-queue, N=3 with a small
+checkpoint stride dies running mid-batch, N=3 with checkpoints off dies
+mid-commit — after the FASTA fsync, before the publishing rename. The kill
+matrix in tests/test_serve_durability.py and the chaos soak
+(``DACCORD_BENCH_SERVE_SOAK``) are built on exactly this determinism.
+Like the fleet kinds, serve kinds never reach the per-job pipeline — the
+pipeline's own FaultPlan parses the same spec, so the kinds are known
+everywhere but consumed only by the serve layer.
+
 The saturation-profiler kind (ISSUE 14) deliberately breaks the index
 grammar: ``feeder_stall:N`` reads N as MILLISECONDS of artificial delay
 injected into EVERY feeder pile block (booked under the profiler's
@@ -148,7 +176,7 @@ _KINDS = ("fetch_hang", "dispatch_error", "device_lost", "compile_stall",
           "crash", "las_bitflip", "las_truncate", "db_garbage",
           "worker_crash", "worker_hang", "lease_stall",
           "device_oom", "host_rss", "monster_pile", "worker_oom",
-          "feeder_stall")
+          "feeder_stall", "serve_crash", "serve_hang")
 
 #: fleet-orchestrator kinds: they sabotage worker spawns / lease renewal at
 #: the fleet layer (parallel/fleet.py) and are stripped from the worker
@@ -195,6 +223,9 @@ class FaultPlan:
     # capacity counters (advance once per watermark check / inspected pile)
     n_rss: int = 0
     n_pile: int = 0
+    # serve counters (advance once per fsync'd journal append / job run)
+    n_journal: int = 0
+    n_jobrun: int = 0
 
     @classmethod
     def parse(cls, text: str) -> "FaultPlan":
@@ -340,6 +371,25 @@ class FaultPlan:
             if s.kind == "feeder_stall":
                 return float(s.at)
         return 0.0
+
+    def serve_crash_check(self) -> bool:
+        """Advance the serve journal-append counter (``serve/journal.py``
+        calls this AFTER each append is fsync'd); True when the process must
+        now die hard — the journal responds with an ``os._exit(137)``,
+        simulating a SIGKILL landing between syscalls. The durable-first
+        ordering is the point: every record the journal holds at death is a
+        record replay will see, exactly the real-crash contract."""
+        self.n_journal += 1
+        return self._take("serve_crash", self.n_journal) is not None
+
+    def serve_hang_check(self) -> bool:
+        """Advance the serve job-run counter (``serve/jobs.run_job`` calls
+        this as a job starts); True when this run must wedge forever — the
+        stand-in for a group thread stuck in a solve, exercising the bounded
+        drain deadline (jobs journal-marked INTERRUPTED, nonzero exit) and
+        the peer takeover of a hung process's lease."""
+        self.n_jobrun += 1
+        return self._take("serve_hang", self.n_jobrun) is not None
 
     def monster_check(self) -> bool:
         """Advance the inspected-pile counter (the monster guard runs once

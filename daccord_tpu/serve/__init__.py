@@ -24,22 +24,30 @@ serving scale):
     batcher.py    SolveGroup (shared supervised solve path per solve
                   fingerprint) + the cross-job row pools
     state.py      WarmState: solve-group cache with idle eviction
+    journal.py    write-ahead job journal (ISSUE 15): fsync'd lifecycle
+                  records, torn-tail-tolerant replay, idempotency-key
+                  memory, startup/shutdown compaction — the crash-
+                  durability spine behind restart replay and the
+                  per-job-lease peer takeover (utils/lease.py)
 
 Byte contract: every job's FASTA is byte-identical to a solo ``daccord``
 run over the same inputs and config — enforced by tests/test_serve.py under
 the fault/capacity matrix (device_lost, device_oom bisect of mixed-job
-batches, mid-job aborts).
+batches, mid-job aborts) and by tests/test_serve_durability.py under the
+crash matrix (SIGKILL at every lifecycle point, journal replay, peer
+takeover, the 2-process chaos soak).
 """
 
 from .admission import AdmissionConfig, AdmissionController, AdmissionReject
 from .batcher import JobAborted, JobSolver, SolveGroup
 from .jobs import Job, JobSpec, build_job_config, solve_fingerprint
+from .journal import JobJournal, JournalEntry
 from .service import ConsensusService, ServeConfig
 from .state import WarmState
 
 __all__ = [
     "AdmissionConfig", "AdmissionController", "AdmissionReject",
-    "ConsensusService", "Job", "JobAborted", "JobSolver", "JobSpec",
-    "ServeConfig", "SolveGroup", "WarmState", "build_job_config",
-    "solve_fingerprint",
+    "ConsensusService", "Job", "JobAborted", "JobJournal", "JobSolver",
+    "JobSpec", "JournalEntry", "ServeConfig", "SolveGroup", "WarmState",
+    "build_job_config", "solve_fingerprint",
 ]

@@ -6,7 +6,12 @@ doctrine. The API surface:
     POST   /v1/jobs             submit a job (JSON: db/las paths or
                                 base64 ``files`` upload + config knobs);
                                 201 {job, state} | 400 bad spec/ingest |
-                                429 quota | 503 pressure/draining
+                                429 quota | 503 pressure/draining.
+                                ``idempotency_key`` (ISSUE 15): a seen key
+                                answers 200 with the EXISTING job — the
+                                retry path for clients whose 201 was lost
+                                to a server crash (keys ride the journal,
+                                so dedupe survives restarts)
     GET    /v1/jobs             all jobs' status
     GET    /v1/jobs/<id>        one job's status (404 unknown)
     GET    /v1/jobs/<id>/result the committed FASTA; ``?wait=1`` blocks to
@@ -112,7 +117,9 @@ class ServeHandler(BaseHTTPRequestHandler):
                 # as a JSON string): a malformed request must get a 400,
                 # never a dropped connection
                 return self._send(400, {"error": str(e)})
-            return self._send(201, st)
+            # an idempotency_key replay answers with the EXISTING job
+            # (200, not 201 — nothing was created); see service.submit
+            return self._send(200 if st.get("idempotent") else 201, st)
         if path == "/v1/shutdown":
             # drain in a side thread: the response must make it out before
             # the listener stops accepting

@@ -224,15 +224,39 @@ class Job:
     fragments: int = 0
     bases_out: int = 0
     group: str | None = None
+    # crash-durable tier (ISSUE 15): a watch job is one a live PEER holds
+    # (its lease is fresh) — registered so clients polling this process see
+    # it, never queued or quota-charged here; the ticker flips it DONE when
+    # the peer's manifest lands, or re-admits it if the peer's lease goes
+    # stale
+    watch: bool = False
+    # True while a local run_job thread is executing this job: the takeover
+    # scan must never re-queue a job whose demoted straggler is still
+    # unwinding (it will exit at its next abort_event check; the reclaim
+    # waits for that — two threads on one job would race the commit)
+    running_local: bool = False
+    # the CURRENT attempt's private part file (set by run_job): every
+    # attempt writes its own file, so a demoted straggler's O_APPEND
+    # writes can never splice into a taker's (or a reclaimer's) stream —
+    # the resume path COPIES the checkpointed prefix instead of sharing
+    # the inode. None = the pre-run default name (streaming falls back).
+    part_path: str | None = None
     abort_event: threading.Event = field(default_factory=threading.Event)
 
     @property
     def fasta_part(self) -> str:
-        return os.path.join(self.dir, "out.fasta.part")
+        return self.part_path or os.path.join(self.dir, "out.fasta.part")
 
     @property
     def fasta(self) -> str:
         return os.path.join(self.dir, "out.fasta")
+
+    @property
+    def progress_path(self) -> str:
+        """Per-job pipeline checkpoint (ISSUE 15): emitted-read count + the
+        durable ``out.fasta.part`` byte size at that point — the resume
+        point a journal replay (or peer takeover) restarts the run from."""
+        return os.path.join(self.dir, "progress.json")
 
     def status(self) -> dict:
         now = time.time()
@@ -263,10 +287,34 @@ def run_job(job: Job, service) -> None:
     from ..utils.bases import ints_to_seq
 
     scfg = service.cfg
+    if os.path.exists(os.path.join(job.dir, "manifest.json")):
+        # a peer (or a prior incarnation) already committed this job
+        # durably — the exactly-once contract says never run it again
+        # (reachable when a takeover claim raced the committer's last
+        # milliseconds: the claim won, the manifest still landed)
+        job.state = DONE
+        job.done_ts = time.time()
+        service.journal_mark("committed", job.id, by="manifest")
+        service.log_event("serve.job", job=job.id, state=DONE,
+                          tenant=job.tenant)
+        service.admission.release(job.tenant, job.spec.nbytes)
+        service.release_job_lease(job.id)
+        return
     job.state = RUNNING
     job.started_ts = time.time()
+    job.running_local = True
+    service.journal_mark("running", job.id)
     service.log_event("serve.job", job=job.id, state=RUNNING,
                       tenant=job.tenant)
+    if service.faults is not None and service.faults.serve_hang_check():
+        # injected wedge (ISSUE 15): the stand-in for a group thread stuck
+        # in a solve — ignores aborts and shutdown, exactly like the real
+        # thing. The bounded drain deadline (journal INTERRUPTED + nonzero
+        # exit) and the peer lease takeover are what recover from this.
+        service.log_event("serve.job", job=job.id, state="hang",
+                          tenant=job.tenant)
+        while True:
+            time.sleep(0.25)
     key = None
     group = None
     gen = None
@@ -294,25 +342,92 @@ def run_job(job: Job, service) -> None:
         job.group = group.name
         solver = group.job_solver(job.id)
         t_first = None
-        with open(job.fasta_part, "wt") as fh:
+        # per-job checkpoint resume (ISSUE 15): a replayed (or taken-over)
+        # job resumes from its progress manifest. Every attempt writes its
+        # OWN part file (pid+tid-named) and the resume COPIES the
+        # checkpointed prefix into it — sharing the inode would let a
+        # demoted straggler's O_APPEND writes splice into this attempt's
+        # stream. The first `skip` reads re-solve without re-writing
+        # (emission order is deterministic, so the committed bytes are
+        # identical to an uninterrupted run; torn progress JSON reads as
+        # absent, like every manifest in the repo).
+        skip = part_pos = 0
+        ck_every = int(getattr(scfg, "checkpoint_reads", 0) or 0)
+        prior_part = None
+        try:
+            with open(job.progress_path) as ph:
+                prog = json.load(ph)
+            emitted = int(prog.get("emitted", 0))
+            pb = int(prog.get("part_bytes", 0))
+            pp = os.path.join(job.dir, os.path.basename(
+                str(prog.get("part", "out.fasta.part"))))
+            if emitted > 0 and os.path.exists(pp) \
+                    and os.path.getsize(pp) >= pb:
+                skip, part_pos, prior_part = emitted, pb, pp
+        except (OSError, json.JSONDecodeError, ValueError, TypeError):
+            pass
+        my_part = os.path.join(
+            job.dir,
+            f"out.fasta.part.{os.getpid()}.{threading.get_ident()}")
+        if prior_part is not None:
+            with open(prior_part, "rb") as src, open(my_part, "wb") as dst:
+                dst.write(src.read(part_pos))
+        job.part_path = my_part
+        with open(my_part, "at" if part_pos else "wt") as fh:
+            fh.seek(0, os.SEEK_END)
             gen = correct_shard(db, las, cfg, profile=profile, solver=solver,
                                 ingest_report=report)
+            n_seen = 0
             for rid, frags, st in gen:
-                if t_first is None and frags:
-                    t_first = time.time()
-                    job.first_emit_ts = t_first
-                write_fasta(fh, [(f"read{rid}/{fi}", ints_to_seq(f))
-                                 for fi, f in enumerate(frags)])
-                fh.flush()
+                if job.abort_event.is_set():
+                    # checked BEFORE writing: a demoted straggler must not
+                    # emit one more read after losing ownership
+                    raise JobAbortRequested()
+                n_seen += 1
+                if n_seen > skip:
+                    if t_first is None and frags:
+                        t_first = time.time()
+                        job.first_emit_ts = t_first
+                    write_fasta(fh, [(f"read{rid}/{fi}", ints_to_seq(f))
+                                     for fi, f in enumerate(frags)])
+                    fh.flush()
                 job.reads = st.n_reads
                 job.windows = st.n_windows
                 job.fragments = st.n_fragments
                 job.bases_out = st.bases_out
-                if job.abort_event.is_set():
-                    raise JobAbortRequested()
+                if ck_every and n_seen > skip and n_seen % ck_every == 0:
+                    # checkpoint ordering contract (PR 2): the part bytes
+                    # fsync FIRST, then the manifest that points at them
+                    # commits durably — a checkpoint never points past the
+                    # durable bytes
+                    os.fsync(fh.fileno())
+                    part_sz = fh.tell()
+                    durable_write(job.progress_path,
+                                  lambda mh, n=n_seen, b=part_sz: json.dump(
+                                      {"emitted": n, "part_bytes": b,
+                                       "part": os.path.basename(my_part)},
+                                      mh),
+                                  mode="wt")
+                    service.journal_mark("progress", job.id, emitted=n_seen,
+                                         bytes=part_sz,
+                                         part=os.path.basename(my_part))
             fh.flush()
             os.fsync(fh.fileno())
-        os.replace(job.fasta_part, job.fasta)
+            if not service.still_owns(job.id):
+                # our lease was taken over while we solved (heartbeat
+                # stalled past the TTL under load): the taker owns the
+                # commit — stand down and watch its manifest instead of
+                # double-committing
+                job.watch = True
+                job.abort_event.set()
+                raise JobAbortRequested()
+            # the WAL commit point: after this record the bytes are durable
+            # and replay finishes the rename/manifest WITHOUT re-running —
+            # the mid-commit crash window (fsync'd FASTA, un-renamed part)
+            # recovers to the identical committed output
+            service.journal_mark("committing", job.id, bytes=fh.tell(),
+                                 part=os.path.basename(my_part))
+        os.replace(my_part, job.fasta)
         job.done_ts = time.time()
         job.state = DONE
         durable_write(os.path.join(job.dir, "manifest.json"),
@@ -321,38 +436,71 @@ def run_job(job: Job, service) -> None:
                            "fasta": job.fasta,
                            "fasta_bytes": os.path.getsize(job.fasta)}, mh),
                       mode="wt")
+        import glob as _glob
+
+        for leftover in (job.progress_path,
+                         *_glob.glob(os.path.join(job.dir,
+                                                  "out.fasta.part*"))):
+            # prior attempts' private part files are orphans now (deleting
+            # an open file is safe — a straggler's fd stays valid until it
+            # stands down)
+            try:
+                os.remove(leftover)
+            except OSError:
+                pass
+        # commit EVENT before the terminal journal record: a crash between
+        # the two leaves a committing+manifest orphan whose replay re-emits
+        # a recovery commit (fragments=-1) — so every done job has >= 1
+        # commit event and <= 1 REAL-run one, the soak's exactly-once form.
+        # (serve.commit is a DURABLE_EVENTS flush-through, so once logged
+        # it survives the very next crash.)
         service.log_event("serve.commit", job=job.id,
                           fragments=job.fragments,
                           bytes=os.path.getsize(job.fasta))
+        service.journal_mark("committed", job.id)
         service.observe_latency(job)
     except JobAbortRequested:
-        job.state = ABORTED
-        job.done_ts = time.time()
-        service.log_event("serve.abort", job=job.id, reason="client")
+        if job.watch:
+            # lease ownership lost mid-run (serve._lease_tick demoted us):
+            # the taker owns the job now — this run stands down and the
+            # registry entry reverts to watching the taker's manifest (the
+            # journal already holds the demoted record, never an abort)
+            job.state = RUNNING
+        else:
+            job.state = ABORTED
+            job.done_ts = time.time()
+            service.journal_mark("aborted", job.id, reason="client")
+            service.log_event("serve.abort", job=job.id, reason="client")
     except BaseException as e:  # noqa: BLE001 — job isolation boundary
         # ABORTED only when the CLIENT asked (abort event): a JobAborted
         # surfacing without it means the shared solve path died under this
         # job's rows (drain failure) — that is a FAILURE with a reason,
         # not an abort
-        if job.abort_event.is_set():
+        if job.watch and job.abort_event.is_set():
+            job.state = RUNNING    # demoted (see JobAbortRequested above)
+        elif job.abort_event.is_set():
             job.state = ABORTED
+            service.journal_mark("aborted", job.id, reason="client")
             service.log_event("serve.abort", job=job.id,
                               reason="client")
         else:
             job.state = FAILED
             job.error = f"{type(e).__name__}: {e}"[:500]
+            service.journal_mark("failed", job.id, error=job.error[:200])
             service.log_event("serve.job", job=job.id, state=FAILED,
                               tenant=job.tenant, error=job.error)
         job.done_ts = time.time()
         if not isinstance(e, Exception):
             raise   # KeyboardInterrupt/SystemExit must still unwind
     finally:
+        job.running_local = False
         if gen is not None:
             gen.close()     # unwinds the pipeline's telemetry bundle
         if group is not None:
             group.release_job(job.id)
             service.warm.release(key)
         service.admission.release(job.tenant, job.spec.nbytes)
+        service.release_job_lease(job.id)
         if job.state == DONE:
             service.log_event("serve.job", job=job.id, state=DONE,
                               tenant=job.tenant)

@@ -16,6 +16,13 @@ machines never interleave):
     <workdir>/jobs/<id>/events.jsonl  the job's own pipeline telemetry
                                       (shard_start, spans, shard_done)
     <workdir>/jobs/<id>/ledger.jsonl  per-window outcome ledger, job-tagged
+    <workdir>/journal.jsonl           write-ahead job journal (ISSUE 15):
+                                      NOT an events file — fsync'd
+                                      lifecycle records replayed at
+                                      restart (serve/journal.py); mirrored
+                                      into serve.events as serve.journal
+    <workdir>/jobs/<id>/progress.json per-job pipeline checkpoint (the
+                                      replay/takeover resume point)
 
 All of it passes ``eventcheck --strict`` and ``daccord-trace --check`` — the
 serve smoke in tools_pounce.sh enforces that before any chip time.
@@ -98,6 +105,35 @@ class ServeConfig:
     slo_window_s: float = 60.0
     slo_shed_burn: float = 0.8
     slo_clear_burn: float = 0.5
+    # crash-durable tier (ISSUE 15): the write-ahead job journal + per-job
+    # pipeline checkpoints + (optional) peer lease takeover
+    journal: bool = True             # fsync'd WAL under <workdir>/journal.jsonl
+    checkpoint_reads: int = 16       # per-job progress checkpoint stride
+                                     # (emitted reads between durable
+                                     # progress manifests; 0 = off — a
+                                     # replayed job then re-runs from its
+                                     # first read, still byte-identical)
+    peer_dir: str | None = None      # shared-FS root for per-job lease files
+                                     # (leases/ beneath it): serve processes
+                                     # pointing at the SAME peer_dir form a
+                                     # takeover group — any of them finishes
+                                     # a dead peer's journaled jobs. None =
+                                     # solo durability (journal replay only).
+                                     # Peers' WORKDIR BASENAMES must be
+                                     # unique within a group (the stable
+                                     # lease namespace); a live collision is
+                                     # refused at submit (lease_conflict)
+    peer_name: str = ""              # lease holder identity; default
+                                     # <workdir-basename>:<pid>
+    lease_ttl_s: float = 15.0        # older per-job lease is stale (takeover)
+    heartbeat_s: float = 1.0         # lease renewal + takeover-scan cadence
+    drain_deadline_s: float = 0.0    # bounded graceful shutdown: >0 means a
+                                     # drain that outlives this many seconds
+                                     # journal-marks in-flight jobs
+                                     # INTERRUPTED (resumable on restart)
+                                     # and shutdown reports unclean (the
+                                     # serve CLI exits nonzero). 0 = legacy
+                                     # unbounded-ish drain (timeout_s)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     events_path: str | None = None   # default: <workdir>/serve.events.jsonl
 
@@ -128,14 +164,42 @@ class ConsensusService:
         self.warm = WarmState(cfg.idle_evict_s, log=self.events)
         self.jobs: dict[str, Job] = {}
         self._jobs_lock = threading.Lock()
+        # crash-durable tier (ISSUE 15): stable service identity (lease file
+        # namespace + foreign job keys), the per-job lease registry, and the
+        # client idempotency-key map (rebuilt from the journal at replay)
+        import socket
+
+        self.service_id = os.path.basename(
+            os.path.abspath(cfg.workdir)) or "serve"
+        # holder identity includes the hostname (fleet convention): two
+        # hosts' processes must never read each other's leases as their own
+        # — `still_owns` is the double-commit gate and keys on this string
+        self.peer = cfg.peer_name or \
+            f"{self.service_id}@{socket.gethostname()}:{os.getpid()}"
+        self._lease_lock = threading.Lock()
+        self._owned_leases: dict[str, str] = {}   # job id -> lease path
+        self._idem: dict[str, str | None] = {}    # idem key -> job id
+        self.clean = True                         # last shutdown's verdict
         # resume the id sequence past any job dirs already in the (durable)
-        # workdir: a restarted server must never reuse jNNNNN — the old
-        # run's committed out.fasta would be served as (or clobbered by)
-        # the new job's
+        # workdir — or named by the journal (a post-admit crash can journal
+        # an id whose spool dir never landed): a restarted server must never
+        # reuse jNNNNN — the old run's committed out.fasta would be served
+        # as (or clobbered by) the new job's
         last = 0
         for name in os.listdir(os.path.join(cfg.workdir, "jobs")):
             if name.startswith("j") and name[1:].isdigit():
                 last = max(last, int(name[1:]))
+        self._journal_path = os.path.join(cfg.workdir, "journal.jsonl")
+        replayed = {}
+        torn = 0
+        if cfg.journal:
+            from .journal import replay as journal_replay
+
+            replayed, torn = journal_replay(self._journal_path)
+            for jid in replayed:
+                short = jid.rsplit(".", 1)[-1]
+                if short.startswith("j") and short[1:].isdigit():
+                    last = max(last, int(short[1:]))
         self._job_ids = itertools.count(last + 1)
         self._group_ids = itertools.count(0)
         self._queue: queue.Queue = queue.Queue()
@@ -167,6 +231,16 @@ class ConsensusService:
         self.log_event("serve.start", workdir=cfg.workdir,
                        backend=cfg.backend, batch=int(cfg.batch),
                        workers=int(cfg.workers), pid=os.getpid())
+        # the write-ahead journal opens AFTER replay folded (and compacted)
+        # the previous incarnation's records — compaction rewrites the file
+        # via rename, so it must finish before the append fd is taken
+        self.journal = None
+        if cfg.journal:
+            from .journal import JobJournal, compact
+
+            compact(self._journal_path, replayed)
+            self.journal = JobJournal(self._journal_path, faults=self.faults)
+            self._replay(replayed, torn)
         self._workers = [
             threading.Thread(target=self._worker_loop, daemon=True,
                              name=f"daccord-serve-worker-{i}")
@@ -226,6 +300,245 @@ class ConsensusService:
                 job.windows / run_s)
 
     # ------------------------------------------------------------------
+    # crash durability (ISSUE 15): journal, replay, per-job leases
+    # ------------------------------------------------------------------
+
+    def journal_mark(self, rec: str, job_id: str, **fields) -> None:
+        """Durably append one lifecycle record (no-op with the journal off)
+        and mirror it into the events stream (``serve.journal``) + the
+        ``journal_records`` counter, so recovery is observable without
+        reading the journal file itself."""
+        j = self.journal   # racing shutdown's None-swap: read once
+        if j is None:
+            return
+        j.append(rec, job_id, **fields)
+        self.metrics.counter("journal_records").inc()
+        self.log_event("serve.journal", rec=rec, job=job_id)
+
+    def _lease_file(self, job_id: str) -> str | None:
+        """The per-job lease path under the peer dir (None with takeover
+        off). Local ids (jNNNNN) are namespaced by this service's identity;
+        a foreign key (``<service>.<jobid>``, from a takeover) already is."""
+        if not self.cfg.peer_dir:
+            return None
+        key = job_id if "." in job_id else f"{self.service_id}.{job_id}"
+        return os.path.join(self.cfg.peer_dir, "leases", f"{key}.lease")
+
+    def _claim_job_lease(self, job, nbytes: int,
+                         idem: str | None = None) -> bool:
+        """Claim (or re-claim) the job's lease with the full job descriptor
+        as payload, so a peer takeover is self-contained — the taker needs
+        nothing from this process but the lease file and the shared-FS
+        jobdir. Returns False ONLY when a live claim race was lost (a peer
+        owns the job now); True with takeover off (no lease to lose)."""
+        import dataclasses
+
+        from ..utils import lease
+
+        path = self._lease_file(job.id)
+        if path is None:
+            return True
+        short = job.id.rsplit(".", 1)[-1]
+        svc = job.id.rsplit(".", 1)[0] if "." in job.id else self.service_id
+        extra = {"service": svc, "job": short,
+                 "jobdir": os.path.abspath(job.dir),
+                 "tenant": job.tenant, "nbytes": int(nbytes),
+                 "spec": dataclasses.asdict(job.spec), "idem": idem}
+        ok, _ = lease.claim(path, self.peer, self.cfg.lease_ttl_s,
+                            extra=extra)
+        if ok:
+            with self._lease_lock:
+                self._owned_leases[job.id] = path
+        return ok
+
+    def still_owns(self, job_id: str) -> bool:
+        """Pre-commit ownership re-check (the fencing-free protocol's last
+        gate): True when this process still holds the job's lease — or
+        takeover is off entirely. A long GIL-bound solve can stall the
+        heartbeat past the TTL; if a peer claimed the lease meanwhile, the
+        PEER owns the commit and the runner must stand down rather than
+        double-commit (the sub-heartbeat window that remains is the
+        protocol's documented inherent race, now read-to-rename instead of
+        solve-length)."""
+        if not self.cfg.peer_dir:
+            return True
+        from ..utils import lease
+
+        info = lease.read(self._lease_file(job_id))
+        return info is not None and info.get("host") == self.peer
+
+    def release_job_lease(self, job_id: str) -> None:
+        """Holder-checked release of a finished job's lease (no-op when we
+        hold none — e.g. solo mode, or ownership already lost to a taker)."""
+        from ..utils import lease
+
+        with self._lease_lock:
+            path = self._owned_leases.pop(job_id, None)
+        if path is not None:
+            lease.release(path, host=self.peer)
+
+    def _durable_status(self, job_id: str) -> dict | None:
+        """A committed job's status straight from its durable manifest —
+        how an idempotent resubmission is answered after the in-memory
+        registry pruned (or never held, across a restart) the job."""
+        p = os.path.join(self.cfg.workdir, "jobs", job_id, "manifest.json")
+        try:
+            with open(p) as fh:
+                st = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return None
+        return st if isinstance(st, dict) else None
+
+    def _replay(self, entries: dict, torn: int) -> None:
+        """Fold the previous incarnation's journal back into live state
+        (called once, before the workers start):
+
+        - terminal jobs contribute their idempotency keys only;
+        - orphans whose jobdir already holds a committed manifest (a peer
+          — or the pre-crash rename — finished them) are journal-marked
+          committed, never re-run;
+        - a ``committing`` orphan whose part file matches the recorded
+          byte count is FINISHED in place (rename + manifest), no recompute;
+        - an orphan whose lease a live peer holds becomes a *watch* job
+          (the peer is running it; the ticker flips it DONE when the
+          manifest lands, or re-admits it if the lease goes stale);
+        - every other orphan is re-admitted through the NORMAL quota path
+          (an admission refusal journals ``failed``) and re-queued,
+          resuming from its per-job checkpoint.
+        """
+        from ..utils import lease
+        from ..utils.aio import durable_write
+        from .jobs import JobSpec
+
+        n_orphan = n_finished = n_watch = n_failed = 0
+        for e in entries.values():
+            if e.terminal:
+                if e.idem:
+                    self._idem[e.idem] = e.job
+                continue
+            if e.idem:
+                self._idem[e.idem] = e.job
+            jobdir = e.dir or os.path.join(self.cfg.workdir, "jobs", e.job)
+            manifest = os.path.join(jobdir, "manifest.json")
+            part = os.path.join(jobdir, "out.fasta.part")
+            def _register_done(entry, jdir):
+                # recovered-to-done jobs join the registry so clients keep
+                # GETting status/result across the restart (pruned on the
+                # normal retention schedule)
+                if entry.spec is None:
+                    return
+                sp = JobSpec(**entry.spec)
+                sp.nbytes = entry.nbytes
+                jb = Job(id=entry.job, tenant=entry.tenant, spec=sp,
+                         dir=jdir, state=DONE)
+                jb.done_ts = time.time()
+                with self._jobs_lock:
+                    self.jobs.setdefault(entry.job, jb)
+
+            if os.path.exists(manifest):
+                # finished by a peer (or this process, pre-crash): the
+                # durable commit is the truth — record it, never re-run.
+                # A NON-terminal entry means the committer died between its
+                # serve.commit flush-through and the committed journal
+                # append (or a peer committed): re-emit the recovery form
+                # (fragments=-1) so every done job keeps >= 1 commit event
+                # — terminal entries already logged theirs (event-before-
+                # journal ordering in run_job), so re-emitting would double
+                self.journal_mark("committed", e.job, by="manifest")
+                try:
+                    fb = int(json.load(open(manifest)).get("fasta_bytes", 0))
+                except (OSError, json.JSONDecodeError, ValueError,
+                        TypeError):
+                    fb = 0
+                self.log_event("serve.commit", job=e.job, fragments=-1,
+                               bytes=fb)
+                _register_done(e, jobdir)
+                n_finished += 1
+                continue
+            if e.spec is None:
+                self.journal_mark("failed", e.job, error="replay: no spec")
+                n_failed += 1
+                continue
+            spec = JobSpec(**e.spec)
+            spec.nbytes = e.nbytes
+            job = Job(id=e.job, tenant=e.tenant, spec=spec, dir=jobdir)
+            lp = self._lease_file(e.job)
+            if lp is not None:
+                info = lease.read(lp)
+                age = lease.stale_s(lp)
+                fresh_foreign = (info is not None
+                                 and info.get("host") != self.peer
+                                 and age is not None
+                                 and age <= self.cfg.lease_ttl_s)
+                # exactly-once gate — BEFORE any recovery action, including
+                # the mid-commit finalize below: the lease CLAIM decides who
+                # recovers the orphan. A fresh foreign lease, or losing the
+                # claim race on a stale one to a peer mid-takeover, means
+                # the job is someone else's now: watch their manifest,
+                # never run (or finalize) it ourselves.
+                if fresh_foreign or not self._claim_job_lease(
+                        job, e.nbytes, idem=e.idem):
+                    job.state, job.watch = RUNNING, True
+                    with self._jobs_lock:
+                        self.jobs[e.job] = job
+                    n_watch += 1
+                    continue
+            if e.part_name:
+                # attempts write private part files; the committing record
+                # names the one whose bytes are fsync'd
+                part = os.path.join(jobdir, e.part_name)
+            if (e.state == "committing" and os.path.exists(part)
+                    and os.path.getsize(part) >= e.part_bytes
+                    and e.part_bytes > 0):
+                # the crash landed between the FASTA fsync and the
+                # publishing rename: every byte is durable — finish the
+                # commit in place, byte-identical, zero recompute
+                os.truncate(part, e.part_bytes)
+                fasta = os.path.join(jobdir, "out.fasta")
+                os.replace(part, fasta)
+                durable_write(manifest,
+                              lambda mh, j=e.job, f=fasta: json.dump(
+                                  {"job": j, "state": "done", "fasta": f,
+                                   "fasta_bytes": os.path.getsize(f),
+                                   "recovered": True}, mh),
+                              mode="wt")
+                self.journal_mark("committed", e.job, by="replay")
+                self.log_event("serve.commit", job=e.job, fragments=-1,
+                               bytes=os.path.getsize(fasta))
+                self.release_job_lease(e.job)
+                _register_done(e, jobdir)
+                n_finished += 1
+                continue
+            try:
+                self.admission.admit(e.tenant, e.nbytes, job=e.job)
+            except Exception as exc:
+                if lp is not None:
+                    # same rule as the takeover scan: no headroom here
+                    # means hand the lease back for a peer WITH headroom —
+                    # a quota-tight restart must not convert recoverable
+                    # orphans into permanent failures
+                    self.release_job_lease(e.job)
+                    job.state, job.watch = RUNNING, True
+                    with self._jobs_lock:
+                        self.jobs[e.job] = job
+                    n_watch += 1
+                    continue
+                self.journal_mark("failed", e.job,
+                                  error=f"replay admission: {exc}"[:200])
+                n_failed += 1
+                continue
+            with self._jobs_lock:
+                self.jobs[e.job] = job
+            self.journal_mark("replayed", e.job)
+            self.metrics.counter("replay_orphans").inc()
+            n_orphan += 1
+            self._queue.put(e.job)
+        if entries or torn:
+            self.log_event("serve.replay", jobs=len(entries),
+                           orphans=n_orphan, finished=n_finished,
+                           watch=n_watch, failed=n_failed, torn=torn)
+
+    # ------------------------------------------------------------------
     # front-end API (HTTP layer calls these)
     # ------------------------------------------------------------------
 
@@ -272,9 +585,58 @@ class ConsensusService:
         AdmissionReject (→ 429/503). Admission is charged FIRST, on the
         pre-spool byte estimate; any later refusal releases the charge and
         removes the job's spool directory, so rejected requests leave no
-        disk residue."""
+        disk residue.
+
+        ``idempotency_key`` (ISSUE 15): a client that lost its connection
+        mid-submit (the server crashed after journaling ADMITTED but before
+        answering) retries with the same key and gets the EXISTING job —
+        whatever state it reached, including done — instead of a second
+        run. The key rides the journal, so dedupe survives restarts."""
         if not isinstance(body, dict):
             raise ValueError("body must be a JSON object")
+        body = dict(body)
+        idem = body.pop("idempotency_key", None)
+        if idem is not None and (not isinstance(idem, str) or not idem):
+            raise ValueError("idempotency_key must be a non-empty string")
+        if idem is not None:
+            from .admission import AdmissionReject
+
+            with self._jobs_lock:
+                seen = self._idem.get(idem, "")
+                if seen is None:
+                    # a concurrent submit with the same key is mid-admission
+                    raise AdmissionReject("idempotent_in_flight",
+                                          f"key {idem!r} is being admitted",
+                                          retryable=True)
+                if not seen:
+                    self._idem[idem] = None   # reserve
+            if seen:
+                # outside the jobs lock: status() takes it too
+                st = self.status(seen) or self._durable_status(seen)
+                if st is not None:
+                    self.metrics.counter("idempotent_hits").inc()
+                    return {**st, "idempotent": True}
+                # journaled key whose job left no trace (failed replay):
+                # run it fresh under the same key. Compare-and-set the
+                # reservation — two concurrent traceless retries must not
+                # both win (the loser gets the retryable 429)
+                with self._jobs_lock:
+                    if self._idem.get(idem) != seen:
+                        raise AdmissionReject(
+                            "idempotent_in_flight",
+                            f"key {idem!r} is being admitted",
+                            retryable=True)
+                    self._idem[idem] = None
+        try:
+            return self._submit_new(body, idem)
+        except BaseException:
+            if idem is not None:
+                with self._jobs_lock:
+                    if self._idem.get(idem) is None:
+                        del self._idem[idem]
+            raise
+
+    def _submit_new(self, body: dict, idem: str | None) -> dict:
         job_id = f"j{next(self._job_ids):05d}"
         jobdir = os.path.join(self.cfg.workdir, "jobs", job_id)
         tenant = str(body.get("tenant", "default"))
@@ -312,6 +674,36 @@ class ConsensusService:
         job = Job(id=job_id, tenant=tenant, spec=spec, dir=jobdir)
         with self._jobs_lock:
             self.jobs[job_id] = job
+            if idem is not None:
+                self._idem[idem] = job_id
+        # WRITE-AHEAD: the admitted record (spec + charge + idempotency
+        # key) is durable before the job is queued or the client answered —
+        # a crash from here on is recoverable by replay
+        import dataclasses
+
+        self.journal_mark("admitted", job_id, tenant=tenant,
+                          nbytes=int(spec.nbytes),
+                          spec=dataclasses.asdict(spec),
+                          dir=os.path.abspath(jobdir), idem=idem)
+        if not self._claim_job_lease(job, spec.nbytes, idem=idem):
+            # a FRESH job's lease already exists and is live: another
+            # service in the peer group shares our workdir basename (the
+            # lease namespace) and minted the same id. Running unleased
+            # would dodge every exactly-once gate — refuse loudly instead;
+            # the operator must give peers distinct workdir basenames.
+            from .admission import AdmissionReject
+
+            with self._jobs_lock:
+                self.jobs.pop(job_id, None)
+                if idem is not None and self._idem.get(idem) == job_id:
+                    del self._idem[idem]
+            self.admission.release(tenant, spec.nbytes)
+            self.journal_mark("failed", job_id, error="lease conflict")
+            raise AdmissionReject(
+                "lease_conflict",
+                f"lease for {self.service_id}.{job_id} is held by another "
+                "service — peer-group workdir basenames must be unique",
+                retryable=False)
         self.metrics.counter("jobs_submitted").inc()
         self.log_event("serve.job", job=job_id, state=QUEUED,
                        tenant=spec.tenant)
@@ -328,6 +720,12 @@ class ConsensusService:
             job = self.jobs.get(job_id)
         if job is None or job.state in (DONE, FAILED, ABORTED):
             return False
+        if job.watch:
+            # a peer owns and runs this job: an abort here could not stop
+            # it (and setting the local abort_event would be silently
+            # dropped on a takeover reclaim) — refuse honestly (409)
+            # rather than claim an abort nothing will honor
+            return False
         job.abort_event.set()
         # a QUEUED job aborts synchronously: its quota charge releases NOW
         # (a tenant cancelling its backlog must get its slots back without
@@ -341,6 +739,8 @@ class ConsensusService:
         if was_queued:
             self.admission.release(job.tenant, job.spec.nbytes)
             self.metrics.counter("jobs_aborted").inc()
+            self.journal_mark("aborted", job_id, reason=reason)
+            self.release_job_lease(job_id)
         # otherwise outcome counting happens ONCE in the worker loop
         # (jobs_<state>); counting the request here too would double-bill
         self.log_event("serve.abort", job=job_id, reason=reason)
@@ -372,12 +772,19 @@ class ConsensusService:
             states: dict[str, int] = {}
             for j in self.jobs.values():
                 states[j.state] = states.get(j.state, 0) + 1
+        with self._lease_lock:
+            held = sorted(self._owned_leases)
         return {"ok": True,
                 "uptime_s": round(time.time() - self.started_ts, 3),
                 "jobs": states, "shed_level": self._shed,
                 "queue_depth": self._queue.qsize(),
                 "groups_busy": {g.name: g.busy()
                                 for g in self.warm.groups()},
+                # crash-durable tier (ISSUE 15): this process's lease
+                # identity + the jobs it currently owns — the per-process
+                # ownership state daccord-top renders
+                "peer": self.peer,
+                "leases": held,
                 "rss_mb": round(host_rss_mb(), 1)}
 
     def stats(self) -> dict:
@@ -417,17 +824,43 @@ class ConsensusService:
         roll["verdict"] = self._verdict
         return render_prom(roll, prefix="daccord_serve")
 
-    def shutdown(self, drain: bool = True, timeout_s: float = 300.0) -> None:
+    def shutdown(self, drain: bool = True, timeout_s: float = 300.0) -> bool:
         """Graceful stop: admission closes, queued+running jobs finish
-        (``drain``), pools drain, telemetry commits durably."""
+        (``drain``), pools drain, telemetry commits durably.
+
+        Bounded drain (ISSUE 15 satellite): with ``drain_deadline_s`` set, a
+        drain that outlives it — a group thread wedged in a solve — stops
+        waiting: every in-flight job is journal-marked INTERRUPTED (an
+        orphan the next restart replays and resumes) and the method returns
+        False (the serve CLI exits nonzero). Returns True on a clean drain;
+        the verdict also lands on ``self.clean``."""
         self.admission.drain()
+        clean = True
         if drain:
-            deadline = time.time() + timeout_s
-            while time.time() < deadline:
+            bound = self.cfg.drain_deadline_s or 0.0
+            deadline = time.time() + (bound if bound > 0 else timeout_s)
+            while True:
                 with self._jobs_lock:
-                    busy = any(j.state in (QUEUED, RUNNING)
+                    busy = any(j.state in (QUEUED, RUNNING) and not j.watch
                                for j in self.jobs.values())
                 if not busy and self._queue.empty():
+                    break
+                if time.time() >= deadline:
+                    if bound > 0:
+                        clean = False
+                        with self._jobs_lock:
+                            stuck = [j for j in self.jobs.values()
+                                     if j.state in (QUEUED, RUNNING)
+                                     and not j.watch]
+                        for j in stuck:
+                            # resumable on restart: the journal keeps the
+                            # job live, the per-job checkpoint bounds the
+                            # recompute; the lease is deliberately NOT
+                            # released — a peer takes it over once stale
+                            self.journal_mark("interrupted", j.id)
+                            self.log_event("serve.job", job=j.id,
+                                           state="interrupted",
+                                           tenant=j.tenant)
                     break
                 time.sleep(0.05)
         self._stop.set()
@@ -435,9 +868,15 @@ class ConsensusService:
             self._queue.put(None)
         for t in self._workers:
             t.join(timeout=10.0)
+        if any(t.is_alive() for t in self._workers):
+            clean = False   # a wedged worker thread cannot be drained
         self._ticker.join(timeout=10.0)
-        for g in self.warm.groups():
-            g.drain_all()
+        if clean:
+            # a wedged solve could hold a group lock forever — only a clean
+            # drain flushes residual pools (the unclean path is exiting: the
+            # journal already holds everything a restart needs)
+            for g in self.warm.groups():
+                g.drain_all()
         self._refresh_gauges()
         self.metrics.snapshot(self.events, final=True)
         from ..utils.aio import durable_write
@@ -455,7 +894,27 @@ class ConsensusService:
         self.log_event("serve.done", jobs=len(self.jobs), done=n_done,
                        wall_s=round(time.time() - self.started_ts, 3))
         self.warm.close()
+        if self.journal is not None:
+            # close the append fd, then compact: terminal jobs collapse to
+            # their idempotency memory, so a long-lived service's journal
+            # (and the next restart's replay) stays bounded
+            from .journal import compact, replay as journal_replay
+
+            self.journal.close()
+            self.journal = None
+            entries, _ = journal_replay(self._journal_path)
+            compact(self._journal_path, entries)
+        # release still-held leases ONLY on a clean exit: an unclean one
+        # leaves them for peer takeover / our own restart (holder-checked,
+        # so a taker that already claimed is never disturbed)
+        if clean:
+            with self._lease_lock:
+                held = list(self._owned_leases)
+            for jid in held:
+                self.release_job_lease(jid)
         self.events.close()
+        self.clean = clean
+        return clean
 
     # ------------------------------------------------------------------
     # background threads
@@ -499,7 +958,11 @@ class ConsensusService:
                 job.done_ts = job.done_ts or time.time()
                 self.log_event("serve.job", job=job.id, state=FAILED,
                                tenant=job.tenant, error=job.error)
-            self.metrics.counter(f"jobs_{job.state}").inc()
+            if not job.watch:
+                # a demoted run returns non-terminal (RUNNING-watch): its
+                # outcome is the TAKER's to count — the watch resolution
+                # counts jobs_done when the peer's manifest lands
+                self.metrics.counter(f"jobs_{job.state}").inc()
             with self._jobs_lock:
                 running = sum(1 for j in self.jobs.values()
                               if j.state == RUNNING)
@@ -508,6 +971,7 @@ class ConsensusService:
     def _tick_loop(self) -> None:
         last_snap = time.time()
         last_pressure = 0.0
+        last_beat = 0.0
         while not self._stop.wait(self.cfg.flush_lag_s):
             # EVERY housekeeping step is guarded: the single ticker thread
             # dying (full disk on the events file, a group close raising)
@@ -533,6 +997,13 @@ class ConsensusService:
                     last_pressure = now
                     self._pressure_tick()
                     self._prune_jobs(now)
+                if self.cfg.peer_dir \
+                        and now - last_beat >= self.cfg.heartbeat_s:
+                    # watch jobs only exist when peer_dir is set, so the
+                    # lease tick (and its O(jobs) scans) stays off entirely
+                    # for solo deployments
+                    last_beat = now
+                    self._lease_tick()
                 self.warm.evict_idle()
                 if (self.cfg.metrics_snapshot_s
                         and now - last_snap >= self.cfg.metrics_snapshot_s):
@@ -560,6 +1031,163 @@ class ConsensusService:
                 if (j.state in (DONE, FAILED, ABORTED) and j.done_ts
                         and now - j.done_ts >= ttl):
                     del self.jobs[jid]
+
+    def _lease_tick(self) -> None:
+        """The peer-takeover heartbeat (ISSUE 15), at ``heartbeat_s``
+        cadence so a serve fleet never storms the shared FS:
+
+        1. renew every lease we hold — with the fleet's re-read-before-
+           renew ownership check: if a taker claimed our stale lease during
+           a pause, renewing would keep THE TAKER'S lease fresh while two
+           processes run one job. We stand down (abort our run, watch the
+           taker) instead.
+        2. resolve watch jobs: a peer-held job whose manifest landed is
+           DONE here too; one whose lease went stale re-enters the takeover
+           scan below.
+        3. scan the shared lease dir for stale leases of dead peers, claim
+           them (race-safe), and re-admit their journaled jobs through the
+           normal quota path — the byte contract is unchanged because the
+           job runs through the same pipeline against the same shared-FS
+           inputs, resuming from the dead peer's per-job checkpoint.
+        """
+        import glob as _glob
+
+        from ..utils import lease
+        from .jobs import JobSpec
+
+        ttl = self.cfg.lease_ttl_s
+        # 1. renew (ownership-checked)
+        with self._lease_lock:
+            held = list(self._owned_leases.items())
+        for jid, path in held:
+            with self._jobs_lock:
+                job = self.jobs.get(jid)
+            if job is None or job.state in (DONE, FAILED, ABORTED):
+                self.release_job_lease(jid)
+                continue
+            info = lease.read(path)
+            if info is not None and info.get("host") != self.peer:
+                # ownership lost: never renew the taker's lease; our run
+                # stands down and the job becomes a watch (the taker's
+                # manifest will flip it DONE). A still-QUEUED job flips to
+                # RUNNING-watch under the lock so the worker's dequeue
+                # skips it (state != QUEUED) instead of misreading the
+                # demotion abort_event as a client abort — and its quota
+                # charge releases NOW (the taker charged its own).
+                with self._lease_lock:
+                    self._owned_leases.pop(jid, None)
+                with self._jobs_lock:
+                    was_queued = job.state == QUEUED
+                    if was_queued:
+                        job.state = RUNNING
+                    job.watch = True
+                job.abort_event.set()
+                if was_queued:
+                    self.admission.release(job.tenant, job.spec.nbytes)
+                self.journal_mark("demoted", jid,
+                                  to=str(info.get("host", "?")))
+                continue
+            lease.renew(path)
+        # 2. watch jobs: peer finished, or peer died
+        with self._jobs_lock:
+            watches = [j for j in self.jobs.values()
+                       if j.watch and j.state not in (DONE, FAILED, ABORTED)]
+        for job in watches:
+            if os.path.exists(os.path.join(job.dir, "manifest.json")):
+                with self._jobs_lock:
+                    job.state = DONE
+                    job.done_ts = job.done_ts or time.time()
+                    job.watch = False
+                self.metrics.counter("jobs_done").inc()
+                self.journal_mark("committed", job.id, by="peer")
+                self.log_event("serve.job", job=job.id, state=DONE,
+                               tenant=job.tenant)
+        # 3. takeover scan
+        if not self.cfg.peer_dir:
+            return
+        with self._lease_lock:
+            mine = set(self._owned_leases.values())
+        for path in _glob.glob(os.path.join(self.cfg.peer_dir, "leases",
+                                            "*.lease")):
+            if path in mine:
+                continue
+            age = lease.stale_s(path)
+            if age is None or age <= ttl:
+                continue
+            info = lease.read(path)
+            if not info or not info.get("jobdir") or not info.get("spec"):
+                # torn lease from a killed claimer: clear it once stale so
+                # the dir doesn't accrete litter (the job itself is in the
+                # dead process's journal; its restart replays it)
+                lease.release(path)
+                continue
+            jobdir = info["jobdir"]
+            if os.path.exists(os.path.join(jobdir, "manifest.json")):
+                # committed, then the committer died before releasing
+                lease.release(path)
+                continue
+            key = (info["job"] if info.get("service") == self.service_id
+                   else f"{info.get('service', '?')}.{info['job']}")
+            with self._jobs_lock:
+                existing = self.jobs.get(key)
+                if existing is not None and not existing.watch \
+                        and existing.state in (QUEUED, RUNNING):
+                    continue   # already ours (replay got here first)
+                if existing is not None and existing.running_local:
+                    # a demoted straggler thread is still unwinding this
+                    # job: re-queueing now would put two local threads on
+                    # one job. It exits at its next abort check; until
+                    # then the lease stays stale — a later tick (or a
+                    # peer) reclaims
+                    continue
+            ok, tk = lease.claim(path, self.peer, ttl,
+                                 extra={k: info.get(k) for k in
+                                        ("service", "job", "jobdir",
+                                         "tenant", "nbytes", "spec",
+                                         "idem")})
+            if not ok:
+                continue   # another peer won the race
+            tenant = str(info.get("tenant", "default"))
+            nbytes = int(info.get("nbytes", 0) or 0)
+            try:
+                # the NORMAL quota path: a loaded peer refuses the orphan
+                # and hands the lease back for someone with headroom
+                self.admission.admit(tenant, nbytes, job=key)
+            except Exception:
+                lease.release(path, host=self.peer)
+                continue
+            try:
+                spec = JobSpec(**info["spec"])
+            except TypeError:
+                self.admission.release(tenant, nbytes)
+                lease.release(path, host=self.peer)
+                continue
+            spec.nbytes = nbytes
+            with self._jobs_lock:
+                job = self.jobs.get(key)
+                if job is not None:
+                    # our own watch job whose peer died: reclaim it
+                    job.state = QUEUED
+                    job.watch = False
+                    job.abort_event = threading.Event()
+                else:
+                    job = Job(id=key, tenant=tenant, spec=spec, dir=jobdir)
+                    self.jobs[key] = job
+            with self._lease_lock:
+                self._owned_leases[key] = path
+            idem = info.get("idem")
+            if idem:
+                with self._jobs_lock:
+                    self._idem[idem] = key
+            self.journal_mark("admitted", key, tenant=tenant, nbytes=nbytes,
+                              spec=info["spec"], dir=jobdir, idem=idem,
+                              takeover=True)
+            self.metrics.counter("takeovers").inc()
+            self.log_event(
+                "serve.takeover", job=key,
+                prev_host=str((tk or {}).get("prev_host", "?")),
+                stale_s=float((tk or {}).get("stale_s", round(age, 3))))
+            self._queue.put(key)
 
     def _slo_tick(self) -> None:
         """SLO burn tracking (ISSUE 13): rolling p99 job latency over the
@@ -646,6 +1274,8 @@ class ConsensusService:
         g("queue_depth").set(float(qd))
         g("queue_depth_peak").set(float(self._peak_queue_depth))
         g("shed_level").set(float(self._shed))
+        with self._lease_lock:
+            g("leases_held").set(float(len(self._owned_leases)))
         mixed = rows = 0
         busy_s = blocked_s = 0.0
         for grp in self.warm.groups():

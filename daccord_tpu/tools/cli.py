@@ -1074,6 +1074,35 @@ def serve_main(argv=None) -> int:
                         "breach (0 = off)")
     p.add_argument("--slo-window-s", type=float, default=60.0,
                    help="rolling window the SLO p99 is computed over")
+    # crash-durable tier (ISSUE 15)
+    p.add_argument("--no-journal", action="store_true",
+                   help="disable the write-ahead job journal (jobs queued "
+                        "or running at a crash are then LOST; the default "
+                        "journal makes them replay on restart)")
+    p.add_argument("--checkpoint-reads", type=int, default=16,
+                   help="per-job progress checkpoint stride (emitted reads "
+                        "between durable progress manifests — the resume "
+                        "point for replay/takeover; 0 = off)")
+    p.add_argument("--peer-dir", default=None, metavar="DIR",
+                   help="shared-FS lease root: serve processes pointing at "
+                        "the SAME dir form a takeover group — any of them "
+                        "detects a dead peer's stale per-job lease, claims "
+                        "the journaled job, and finishes it byte-identically "
+                        "(default: off — journal replay only)")
+    p.add_argument("--peer-name", default="", metavar="NAME",
+                   help="lease holder identity (default "
+                        "<workdir-basename>:<pid>)")
+    p.add_argument("--lease-ttl-s", type=float, default=15.0,
+                   help="a per-job lease older than this is stale "
+                        "(peer takeover fires)")
+    p.add_argument("--heartbeat-s", type=float, default=1.0,
+                   help="lease renewal + takeover-scan cadence")
+    p.add_argument("--drain-deadline-s", type=float, default=0.0,
+                   help="bounded graceful shutdown: a drain outliving this "
+                        "journal-marks in-flight jobs INTERRUPTED "
+                        "(resumable on restart) and exits NONZERO — a "
+                        "wedged group thread can no longer hang shutdown "
+                        "forever (0 = unbounded)")
     args = p.parse_args(argv)
 
     backend_explicit = args.backend != "auto"
@@ -1119,6 +1148,11 @@ def serve_main(argv=None) -> int:
         idle_evict_s=args.idle_evict_s,
         metrics_snapshot_s=args.metrics_snapshot_s,
         slo_p99_s=args.slo_p99_s, slo_window_s=args.slo_window_s,
+        journal=not args.no_journal,
+        checkpoint_reads=args.checkpoint_reads,
+        peer_dir=args.peer_dir, peer_name=args.peer_name,
+        lease_ttl_s=args.lease_ttl_s, heartbeat_s=args.heartbeat_s,
+        drain_deadline_s=args.drain_deadline_s,
         admission=AdmissionConfig(
             max_queued_jobs=args.max_queued,
             tenant_max_queued=args.tenant_max_queued,
@@ -1155,7 +1189,11 @@ def serve_main(argv=None) -> int:
     signal.signal(signal.SIGINT, _stop)
     # serve_forever runs on the daemon thread; block until shutdown()
     _t.join()
-    return 0
+    # bounded-drain contract (ISSUE 15 satellite): an unclean drain — a
+    # wedged group thread outliving --drain-deadline-s, with its in-flight
+    # jobs journal-marked INTERRUPTED — exits nonzero so supervisors
+    # (systemd, the soak driver) know to restart-and-replay
+    return 0 if getattr(svc, "clean", True) else 1
 
 
 def merge_main(argv=None) -> int:

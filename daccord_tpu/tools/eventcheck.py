@@ -152,6 +152,18 @@ EVENT_FIELDS: dict[str, dict] = {
     # batch-width shed ladder BEFORE breach; >= 1 is a breach), n = jobs in
     # the window. Emitted by the serve ticker when burn changes band.
     "serve.slo": {"target_s": _NUM, "burn": _NUM, "n": int},
+    # crash-durable serve tier (ISSUE 15): serve.journal mirrors each
+    # write-ahead journal append (rec = admitted | running | progress |
+    # committing | committed | aborted | failed | interrupted | replayed |
+    # demoted) into the events stream; serve.replay summarizes a restart's
+    # journal fold (orphans re-admitted through the quota path, finished =
+    # commits recovered without a re-run, torn = tolerated torn-tail
+    # lines); serve.takeover is a peer claiming a dead process's stale
+    # per-job lease and finishing its journaled job.
+    "serve.journal": {"rec": str, "job": str},
+    "serve.replay": {"jobs": int, "orphans": int, "finished": int,
+                     "torn": int},
+    "serve.takeover": {"job": str, "prev_host": str, "stale_s": _NUM},
     "bench_start": {"batch": int},
     "bench_compile": {"batch": int, "cached": bool, "expected_wall_s": _NUM},
     # self-staging bench ladder: one row per completed rung (sidecar

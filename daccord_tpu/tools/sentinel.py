@@ -244,6 +244,14 @@ def scan_events(path: str) -> list[str]:
             lines = fh.readlines()
     except OSError as e:
         return [f"{path}: unreadable ({e})"]
+    # crash-durable serve tier (ISSUE 15): jobs a restart replayed must
+    # reach a terminal journal record in the same events stream — a
+    # replayed-without-commit orphan means recovery started work it never
+    # finished (or the stream was cut again: either way, look). Repeated
+    # takeovers of one job mean peers are trading a lease without anyone
+    # finishing — a crash loop or a TTL set below real job latency.
+    replayed_open: dict[str, int] = {}
+    takeovers: dict[str, int] = {}
     for ln, line in enumerate(lines, start=1):
         line = line.strip()
         if not line:
@@ -276,6 +284,24 @@ def scan_events(path: str) -> list[str]:
             if isinstance(burn, (int, float)) and burn >= 1.0:
                 issues.append(f"{path}:{ln}: SLO BREACH (burn {burn:g}, "
                               f"p99 vs target {rec.get('target_s')}s)")
+        elif ev == "serve.journal":
+            jid, rk = str(rec.get("job")), rec.get("rec")
+            if rk == "replayed":
+                replayed_open[jid] = ln
+            elif rk in ("committed", "aborted", "failed"):
+                replayed_open.pop(jid, None)
+        elif ev == "serve.takeover":
+            jid = str(rec.get("job"))
+            takeovers[jid] = takeovers.get(jid, 0) + 1
+    for jid, ln in sorted(replayed_open.items()):
+        issues.append(f"{path}:{ln}: job {jid} replayed but never reached "
+                      "a terminal journal record (orphan re-admitted, "
+                      "recovery incomplete)")
+    for jid, n in sorted(takeovers.items()):
+        if n >= 2:
+            issues.append(f"{path}: job {jid} taken over {n} times (peers "
+                          "trading the lease without finishing — crash "
+                          "loop, or lease TTL below real job latency)")
     return issues
 
 

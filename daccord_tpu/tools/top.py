@@ -79,12 +79,15 @@ def _load_json(path: str) -> dict | None:
         return None
 
 
-def _expand_sources(paths: list[str]) -> tuple[list[str], list[str]]:
-    """(event files, json sidecars) the snapshot reads: a directory
-    contributes its ``*.events.jsonl`` plus the durable metrics/fleet/serve
-    JSON sidecars."""
+def _expand_sources(paths: list[str]) -> tuple[list[str], list[str], list[str]]:
+    """(event files, json sidecars, lease files) the snapshot reads: a
+    directory contributes its ``*.events.jsonl``, the durable
+    metrics/fleet/serve JSON sidecars, and any ``leases/*.lease`` beneath it
+    (a fleet outdir or a serve peer dir — the per-process ownership state,
+    ISSUE 15)."""
     events: list[str] = []
     sidecars: list[str] = []
+    leases: list[str] = []
     for p in paths:
         if os.path.isdir(p):
             events.extend(sorted(glob.glob(os.path.join(p, "*.events.jsonl"))))
@@ -93,11 +96,15 @@ def _expand_sources(paths: list[str]) -> tuple[list[str], list[str]]:
                 fp = os.path.join(p, name)
                 if os.path.exists(fp) and fp not in sidecars:
                     sidecars.append(fp)
+            leases.extend(sorted(glob.glob(os.path.join(p, "leases",
+                                                        "*.lease"))))
+        elif p.endswith(".lease"):
+            leases.append(p)
         elif p.endswith(".json"):
             sidecars.append(p)
         else:
             events.append(p)
-    return events, sidecars
+    return events, sidecars, leases
 
 
 def collect(paths: list[str], tail_kb: int = 256) -> dict:
@@ -105,10 +112,27 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
     events file (latest metrics/state/outcome), the merged mesh device
     table, the latest serve health, active governor ratchets, and recent
     fault milestones."""
-    events, sidecars = _expand_sources(paths)
+    events, sidecars, lease_files = _expand_sources(paths)
     snap: dict = {"ts": time.time(), "sources": [], "mesh": {},
                   "serve": None, "ratchets": {}, "faults": [],
-                  "slo": None, "fleet": None}
+                  "slo": None, "fleet": None, "leases": []}
+    # per-process lease/ownership state (ISSUE 15): who holds which
+    # shard/job right now, and how stale each heartbeat is — the takeover
+    # question ("is anyone going to pick this up?") answered at a glance
+    now = time.time()
+    for lp in lease_files:
+        info = _load_json(lp) or {}
+        try:
+            age = now - os.path.getmtime(lp)
+        except OSError:
+            continue
+        unit = info.get("job") if info.get("job") is not None else \
+            info.get("shard")
+        snap["leases"].append(
+            {"name": os.path.basename(lp).rsplit(".lease", 1)[0],
+             "holder": str(info.get("host", "?")),
+             "unit": "-" if unit is None else str(unit),
+             "age_s": round(age, 1)})
     for path in events:
         recs = _tail_records(path, tail_kb)
         src = os.path.basename(path).replace(".events.jsonl", "")
@@ -155,12 +179,17 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
             elif ev in ("sup_fault", "sup_failover", "sup_failback",
                         "mesh.shrink", "mesh.degrade", "mesh.restore",
                         "fleet.poison", "fleet.capacity",
-                        "governor.classify"):
+                        "governor.classify",
+                        # crash-durable serve tier (ISSUE 15): recovery
+                        # milestones belong on the operator screen
+                        "serve.replay", "serve.takeover"):
                 snap["faults"].append(
                     {"src": src, "event": ev,
                      **{k: v for k, v in rec.items()
                         if k in ("kind", "reason", "key", "nd_from", "nd_to",
-                                 "culprit", "shard", "op")}})
+                                 "culprit", "shard", "op", "job",
+                                 "prev_host", "stale_s", "orphans",
+                                 "finished")}})
         snap["sources"].append(row)
     for path in sidecars:
         d = _load_json(path)
@@ -272,6 +301,9 @@ def render(snap: dict) -> str:
                 line += f"  shed {serve['shed_level']}"
             if serve.get("verdict"):
                 line += f"  verdict {serve['verdict']}"
+            if serve.get("peer"):
+                line += (f"  peer {serve['peer']}"
+                         f"  owns {len(serve.get('leases') or [])}")
         out.append(line)
         if slo is not None:
             out.append(f"    SLO burn {slo.get('burn')} "
@@ -291,6 +323,15 @@ def render(snap: dict) -> str:
         out.append(f"  FLEET  done {len(fleet.get('done', []))} "
                    f"poison {len(fleet.get('poison', []))} "
                    f"capacity-requeued {len(fleet.get('capacity_requeued', []))}")
+    if snap.get("leases"):
+        # per-process ownership (ISSUE 15): which process holds which
+        # job/shard, and how stale each heartbeat is — a row past its TTL
+        # is takeover bait
+        out.append("")
+        out.append(f"  {'LEASE':<28}{'HOLDER':<24}{'UNIT':<14}{'AGE S':>7}")
+        for l in snap["leases"]:
+            out.append(f"  {l['name']:<28}{l['holder']:<24}"
+                       f"{l['unit']:<14}{_fmt(l['age_s']):>7}")
     if snap["ratchets"]:
         out.append("")
         out.append("  GOVERNOR ratchets:")

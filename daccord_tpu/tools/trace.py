@@ -72,6 +72,9 @@ MILESTONES = frozenset({
     # (the per-snapshot mesh.device gauge rows are summarized only)
     "mesh.init", "mesh.shrink", "mesh.restore", "mesh.degrade",
     "serve.slo", "profile.capture",
+    # crash-durable serve tier (ISSUE 15): recovery milestones — the
+    # per-append serve.journal mirror rows are summarized only
+    "serve.replay", "serve.takeover",
 })
 
 
@@ -118,10 +121,15 @@ def check_spans(records: list[dict], src: str = "") -> tuple[list[str], dict]:
     shard_start segment: every open must close (the telemetry bundle's
     ``finally`` unwind makes that hold even for aborted attempts — an
     unclosed span means lost telemetry, e.g. a SIGKILLed worker's unflushed
-    buffer, and is flagged)."""
+    buffer, and is flagged). Exception (ISSUE 15): a SUPERSEDED segment —
+    one followed by a later shard_start — with unclosed spans is the
+    expected signature of a killed attempt whose successor appended (fleet
+    requeue, serve journal replay); only the FINAL segment's unclosed spans
+    mean telemetry was lost from a run nothing recovered."""
     errs: list[str] = []
     walls: dict[str, float] = {}
-    for si, seg in enumerate(_segments(records)):
+    segs = _segments(records)
+    for si, seg in enumerate(segs):
         open_spans: dict[str, str] = {}
         for rec in seg:
             ev = rec.get("event")
@@ -142,9 +150,10 @@ def check_spans(records: list[dict], src: str = "") -> tuple[list[str], dict]:
                     if isinstance(w, (int, float)):
                         name = str(rec.get("name"))
                         walls[name] = walls.get(name, 0.0) + float(w)
-        for sid, name in open_spans.items():
-            errs.append(f"{src}: span {sid} ({name}) never closed "
-                        f"(segment {si}: telemetry lost mid-flight?)")
+        if si == len(segs) - 1:
+            for sid, name in open_spans.items():
+                errs.append(f"{src}: span {sid} ({name}) never closed "
+                            f"(segment {si}: telemetry lost mid-flight?)")
     return errs, walls
 
 
@@ -271,7 +280,10 @@ def _expand(paths: list[str]) -> tuple[list[str], list[str], list[str]]:
             dirs.append(p)
             events.extend(sorted(glob.glob(os.path.join(p, "*.events.jsonl"))))
             ledgers.extend(sorted(glob.glob(os.path.join(p, "*.ledger.jsonl"))))
-        elif p.endswith(".ledger.jsonl"):
+        elif p.endswith("ledger.jsonl"):
+            # covers shardNNNN.ledger.jsonl AND the serve tier's per-job
+            # jobs/<id>/ledger.jsonl — a ledger linted as an event stream
+            # would fail strict monotonicity on every appended resume
             ledgers.append(p)
         else:
             events.append(p)

@@ -1,0 +1,139 @@
+"""Shared-filesystem lease protocol (extracted from ``parallel/fleet.py``).
+
+One file = one lease. A claimant atomically creates the lease file
+(``O_CREAT|O_EXCL``, :func:`aio.exclusive_create`) with a JSON payload
+naming the holder; of N processes racing, exactly one wins. The holder
+renews by bumping the file's mtime every heartbeat; a lease whose mtime is
+older than the TTL is *stale* — its holder died or wedged — and any process
+may take it over by removing the stale file and re-claiming. No coordinator,
+no network protocol: the shared filesystem IS the control plane.
+
+The four protocol rules, hardened by the fleet's production history and now
+shared verbatim by the serve tier's per-job leases (ISSUE 15):
+
+- **claim**: ``O_EXCL`` create arbitrates every race; takeover of a stale
+  lease goes through ``os.replace`` to a grave name, which succeeds for
+  exactly one taker (the loser's replace raises ``FileNotFoundError``).
+- **heartbeat re-read-before-renew**: a holder must re-read the payload
+  before renewing — if its lease went stale during a host pause and another
+  process took over, renewing would keep THE TAKER'S lease fresh while two
+  processes run the same work. Ownership loss means stand down, never renew.
+  (:func:`read` is the primitive; the stand-down policy lives with each
+  caller — the fleet kills its worker, the serve tier aborts its run.)
+- **holder-checked release**: a releasing holder that was taken over must
+  not delete the taker's live lease; :func:`release` with ``host`` given
+  only removes while the payload still names that host.
+- **stale takeover**: :func:`claim` on a stale lease reports the previous
+  holder's identity and staleness, so the takeover is attributable in the
+  event log.
+
+The TTL must exceed a few heartbeats plus worst-case shared-FS mtime
+propagation and host clock skew. :func:`backdate` is the deterministic test
+hook (and fault-injection lever) that makes a lease stale without burning
+TTL wall-clock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from . import aio
+
+
+def claim(path: str, host: str, ttl_s: float,
+          extra: dict | None = None) -> tuple[bool, dict | None]:
+    """Try to claim the lease at ``path`` for ``host``.
+
+    Returns ``(claimed, takeover)``: ``takeover`` carries the previous
+    holder's identity and the lease's staleness when the claim displaced a
+    stale lease. A fresh (live) lease loses the race: ``(False, None)``.
+    ``extra`` fields join the payload (the serve tier stores the full job
+    descriptor there, so a takeover is self-contained). Takeover is
+    race-safe on a POSIX shared FS: ``os.replace`` of the stale file
+    succeeds for exactly one taker (the loser's replace raises), and the
+    subsequent ``O_EXCL`` create arbitrates any claim/claim race.
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = json.dumps({"host": host, "pid": os.getpid(),
+                          "claimed_t": time.time(),
+                          **(extra or {})}).encode()
+    if aio.exclusive_create(path, payload):
+        return True, None
+    try:
+        stale_s = time.time() - os.path.getmtime(path)
+    except OSError:
+        # holder released between our create and stat: claim the vacancy
+        return aio.exclusive_create(path, payload), None
+    if stale_s <= ttl_s:
+        return False, None
+    prev = read(path) or {}
+    grave = f"{path}.stale.{os.getpid()}"
+    try:
+        os.replace(path, grave)
+    except FileNotFoundError:
+        return False, None  # another taker won the replace race
+    try:
+        os.remove(grave)
+    except OSError:
+        pass
+    if not aio.exclusive_create(path, payload):
+        return False, None
+    return True, {"prev_host": str(prev.get("host", "?")),
+                  "stale_s": round(stale_s, 3)}
+
+
+def read(path: str) -> dict | None:
+    """The lease's payload, or None when absent/torn (a torn lease from a
+    killed claimer is still takeover-able once stale)."""
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def renew(path: str) -> None:
+    """Heartbeat: bump the lease mtime (the staleness clock other processes
+    read). Callers must :func:`read`-check ownership first (see module doc);
+    a vanished lease is tolerated — the owner's reaper notices soon enough."""
+    try:
+        os.utime(path, None)
+    except OSError:
+        pass
+
+
+def release(path: str, host: str | None = None) -> None:
+    """Remove the lease; with ``host`` given, only while the payload still
+    names that host — a holder that was taken over must not delete the
+    taker's live lease (the read/remove race that remains is the
+    fencing-free protocol's inherent window, bounded by the heartbeat
+    ownership re-check)."""
+    if host is not None:
+        prev = read(path)
+        if prev is not None and prev.get("host") != host:
+            return
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
+def backdate(path: str, age_s: float) -> None:
+    """Set the lease's mtime ``age_s`` into the past — how fault injection
+    (``lease_stall``, the serve kill matrix) makes a wedged holder's lease
+    stale deterministically instead of burning TTL wall-clock."""
+    t = time.time() - age_s
+    try:
+        os.utime(path, (t, t))
+    except OSError:
+        pass
+
+
+def stale_s(path: str) -> float | None:
+    """Seconds since the lease's last heartbeat, or None when absent."""
+    try:
+        return time.time() - os.path.getmtime(path)
+    except OSError:
+        return None

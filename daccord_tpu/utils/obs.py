@@ -45,6 +45,11 @@ DURABLE_EVENTS = frozenset({
     "ingest.fault", "ingest.commit", "ingest.quarantine",
     "fleet.fault", "fleet.poison", "fleet.capacity", "fleet.takeover",
     "governor.classify", "governor.monster",
+    # crash-durable serve tier (ISSUE 15): recovery milestones must hit
+    # disk at line granularity — they are exactly the records a post-crash
+    # investigation reads (the journal itself fsyncs per record; these are
+    # its event-stream mirrors)
+    "serve.replay", "serve.takeover", "serve.commit", "serve.abort",
 })
 
 

@@ -114,25 +114,10 @@ def test_fleet_idempotent_rerun(dataset, tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# lease protocol
+# lease protocol — the claim/renew/takeover/release units moved to
+# tests/test_lease.py alongside the extracted utils/lease.py (ISSUE 15);
+# what stays here is the fleet-level integration behavior.
 # ---------------------------------------------------------------------------
-
-def test_lease_claim_renew_takeover_units(tmp_path):
-    d = str(tmp_path)
-    ok, takeover = fleet_mod.claim_lease(d, 0, "hostA", ttl_s=60.0)
-    assert ok and takeover is None
-    # a live lease loses the race
-    ok, takeover = fleet_mod.claim_lease(d, 0, "hostB", ttl_s=60.0)
-    assert not ok and takeover is None
-    # a stale lease is taken over, reporting the previous holder
-    fleet_mod.backdate_lease(d, 0, age_s=120.0)
-    ok, takeover = fleet_mod.claim_lease(d, 0, "hostB", ttl_s=60.0)
-    assert ok and takeover["prev_host"] == "hostA"
-    assert takeover["stale_s"] > 60.0
-    fleet_mod.release_lease(d, 0)
-    ok, _ = fleet_mod.claim_lease(d, 0, "hostC", ttl_s=60.0)
-    assert ok
-
 
 def test_lease_takeover_by_second_orchestrator(dataset, tmp_path):
     """Orchestrator A (a real second OS process) claims shard 0 and dies

@@ -1,0 +1,118 @@
+"""Shared-FS lease protocol units (utils/lease.py, ISSUE 15 satellite).
+
+The protocol was extracted from parallel/fleet.py so the serve tier's
+per-job leases (serve/service.py peer takeover) and the fleet's per-shard
+leases run the SAME claim/heartbeat/release/takeover code; these are the
+fleet's original protocol units moved alongside, now speaking the
+path-based API directly, plus the payload/holder-check rules the serve
+tier leans on.
+"""
+
+import json
+import os
+
+from daccord_tpu.utils import lease
+
+
+def test_lease_claim_renew_takeover_units(tmp_path):
+    p = str(tmp_path / "leases" / "job.lease")
+    ok, takeover = lease.claim(p, "hostA", ttl_s=60.0)
+    assert ok and takeover is None
+    # a live lease loses the race
+    ok, takeover = lease.claim(p, "hostB", ttl_s=60.0)
+    assert not ok and takeover is None
+    # a stale lease is taken over, reporting the previous holder
+    lease.backdate(p, age_s=120.0)
+    ok, takeover = lease.claim(p, "hostB", ttl_s=60.0)
+    assert ok and takeover["prev_host"] == "hostA"
+    assert takeover["stale_s"] > 60.0
+    lease.release(p)
+    ok, _ = lease.claim(p, "hostC", ttl_s=60.0)
+    assert ok
+
+
+def test_lease_payload_extra_and_read(tmp_path):
+    """The payload carries host/pid/claimed_t plus caller extras — the serve
+    tier stores the whole job descriptor so a takeover is self-contained."""
+    p = str(tmp_path / "j.lease")
+    ok, _ = lease.claim(p, "me", 60.0, extra={"job": "j00001",
+                                              "nbytes": 42})
+    assert ok
+    info = lease.read(p)
+    assert info["host"] == "me" and info["pid"] == os.getpid()
+    assert info["job"] == "j00001" and info["nbytes"] == 42
+    assert isinstance(info["claimed_t"], float)
+
+
+def test_holder_checked_release(tmp_path):
+    """A holder that was taken over must not delete the taker's lease."""
+    p = str(tmp_path / "j.lease")
+    lease.claim(p, "old", 60.0)
+    lease.backdate(p, 120.0)
+    ok, tk = lease.claim(p, "taker", 60.0)
+    assert ok and tk["prev_host"] == "old"
+    lease.release(p, host="old")       # old holder's release: refused
+    assert lease.read(p)["host"] == "taker"
+    lease.release(p, host="taker")     # the taker's own release: allowed
+    assert lease.read(p) is None
+
+
+def test_torn_lease_still_takeover_able(tmp_path):
+    """A killed claimer's torn (non-JSON) lease file reads as None and is
+    taken over once stale, with an unknown previous holder."""
+    p = str(tmp_path / "j.lease")
+    os.makedirs(tmp_path, exist_ok=True)
+    with open(p, "w") as fh:
+        fh.write('{"host": "torn')
+    assert lease.read(p) is None
+    lease.backdate(p, 120.0)
+    ok, tk = lease.claim(p, "taker", 60.0)
+    assert ok and tk["prev_host"] == "?"
+    assert lease.read(p)["host"] == "taker"
+
+
+def test_renew_and_stale_s(tmp_path):
+    p = str(tmp_path / "j.lease")
+    assert lease.stale_s(p) is None
+    lease.claim(p, "me", 60.0)
+    lease.backdate(p, 30.0)
+    s = lease.stale_s(p)
+    assert s is not None and 29.0 < s < 35.0
+    lease.renew(p)
+    assert lease.stale_s(p) < 5.0
+    # renew of a vanished lease is tolerated (taken over mid-heartbeat)
+    lease.release(p)
+    lease.renew(p)
+
+
+def test_fleet_wrappers_delegate(tmp_path):
+    """The fleet's (outdir, shard) wrappers ride the shared protocol: a
+    claim made through the fleet API is visible (and holder-checked)
+    through the shared one, and the payload keeps the shard field."""
+    from daccord_tpu.parallel import fleet as fleet_mod
+
+    d = str(tmp_path)
+    ok, _ = fleet_mod.claim_lease(d, 3, "orchA", ttl_s=60.0)
+    assert ok
+    p = fleet_mod.lease_path(d, 3)
+    info = lease.read(p)
+    assert info["host"] == "orchA" and info["shard"] == 3
+    ok, _ = lease.claim(p, "orchB", 60.0)
+    assert not ok
+    fleet_mod.release_lease(d, 3, host="orchB")   # not the holder: refused
+    assert fleet_mod.read_lease(d, 3)["host"] == "orchA"
+    fleet_mod.release_lease(d, 3, host="orchA")
+    assert fleet_mod.read_lease(d, 3) is None
+
+
+def test_vacancy_claim_after_release_race(tmp_path):
+    """A lease released between another claimant's failed O_EXCL create and
+    its stat is a vacancy: the claim retries and wins (the claim-the-vacancy
+    branch), exercised here by simply claiming an absent path twice."""
+    p = str(tmp_path / "j.lease")
+    ok, _ = lease.claim(p, "a", 60.0)
+    assert ok
+    lease.release(p, host="a")
+    ok, tk = lease.claim(p, "b", 60.0)
+    assert ok and tk is None
+    assert json.load(open(p))["host"] == "b"

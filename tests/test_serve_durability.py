@@ -1,0 +1,619 @@
+"""Crash-durable serve tier (ISSUE 15): write-ahead job journal, replay,
+peer lease takeover, bounded drain, and the SIGKILL lifecycle matrix.
+
+The contract under test: a ``daccord-serve`` process that dies — at ANY
+lifecycle point — loses no admitted job. On restart the journal replays:
+orphans re-admit through the normal quota path and resume from their
+per-job checkpoints; a mid-commit crash finalizes without recompute; a
+duplicate submission bearing a seen idempotency key dedupes onto the
+existing job. With a shared ``peer_dir``, a live peer detects the dead
+process's stale per-job lease and finishes the job instead. Everything is
+byte-identical to the solo run, quota balances restore, and no spool dir or
+charge leaks.
+
+The kill matrix SIGKILLs real server subprocesses (``serve_crash`` fires
+``os._exit(137)`` after a chosen journal append — a SIGKILL landing between
+syscalls); the in-process arms cover the replay/takeover/drain machinery
+without subprocess overhead. The full 2-process chaos soak is the slow arm.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from daccord_tpu.sim import SimConfig, make_dataset
+
+try:
+    from daccord_tpu.native import available as _native_available
+
+    HAVE_NATIVE = _native_available()
+except Exception:
+    HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(not HAVE_NATIVE,
+                                  reason="native host path unavailable")
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("servedur"))
+    cfg = SimConfig(genome_len=1500, coverage=10, read_len_mean=500,
+                    min_overlap=200, seed=5)
+    return make_dataset(d, cfg, name="sv"), d
+
+
+def _solo_bytes(out, d):
+    import dataclasses
+
+    from daccord_tpu.runtime.pipeline import correct_to_fasta
+    from daccord_tpu.serve.jobs import JobSpec, build_job_config
+
+    spec = JobSpec.from_json({"db": out["db"], "las": out["las"]}, d)
+    cfg = build_job_config(spec, "native", True, 64, "fused", d, "solo")
+    cfg = dataclasses.replace(cfg, native_solver=True, supervise=True,
+                              events_path=None, ledger_path=None,
+                              job_tag=None, quarantine_path=None)
+    ref = os.path.join(d, "solo-native.fasta")
+    if not os.path.exists(ref):
+        correct_to_fasta(out["db"], out["las"], ref, cfg)
+    with open(ref, "rb") as fh:
+        return fh.read()
+
+
+def _svc(workdir, fault=None, **kw):
+    """In-process service; ``fault`` sets DACCORD_FAULT for THIS service's
+    FaultPlan (cleared right after construction)."""
+    from daccord_tpu.serve import ConsensusService, ServeConfig
+
+    kw.setdefault("backend", "native")
+    kw.setdefault("backend_explicit", True)
+    kw.setdefault("batch", 64)
+    kw.setdefault("workers", 2)
+    kw.setdefault("flush_lag_s", 0.02)
+    kw.setdefault("checkpoint_reads", 4)
+    old = os.environ.pop("DACCORD_FAULT", None)
+    if fault:
+        os.environ["DACCORD_FAULT"] = fault
+    try:
+        return ConsensusService(ServeConfig(workdir=str(workdir), **kw))
+    finally:
+        os.environ.pop("DACCORD_FAULT", None)
+        if old is not None:
+            os.environ["DACCORD_FAULT"] = old
+
+
+def _poll(svc, job_id, timeout=180.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = svc.status(job_id)
+        if st and st["state"] in ("done", "failed", "aborted"):
+            return st
+        time.sleep(0.05)
+    return svc.status(job_id)
+
+
+def _lint(paths):
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    for p in paths:
+        errs = validate_events(p, strict=True)
+        assert not errs, (p, errs[:5])
+
+
+def _journal(workdir):
+    from daccord_tpu.serve.journal import replay
+
+    return replay(os.path.join(str(workdir), "journal.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# journal units
+# ---------------------------------------------------------------------------
+
+def test_journal_append_replay_units(tmp_path):
+    from daccord_tpu.serve.journal import JobJournal, replay
+
+    p = str(tmp_path / "journal.jsonl")
+    j = JobJournal(p)
+    j.append("admitted", "j00001", tenant="a", nbytes=100,
+             spec={"db": "x", "las": "y"}, dir="/tmp/j1", idem="k1")
+    j.append("running", "j00001")
+    j.append("progress", "j00001", emitted=8, bytes=512)
+    j.append("admitted", "j00002", tenant="b", nbytes=7, spec={})
+    j.append("committing", "j00001", bytes=900)
+    j.append("aborted", "j00002")
+    j.close()
+    ents, torn = replay(p)
+    assert torn == 0 and set(ents) == {"j00001", "j00002"}
+    e1 = ents["j00001"]
+    assert e1.state == "committing" and e1.part_bytes == 900
+    assert e1.tenant == "a" and e1.nbytes == 100 and e1.idem == "k1"
+    assert e1.dir == "/tmp/j1" and not e1.terminal
+    assert ents["j00002"].terminal and ents["j00002"].state == "aborted"
+
+
+def test_journal_torn_tail_tolerated(tmp_path):
+    """A crash mid-append tears the last line; replay trusts exactly the
+    records that fsync'd before it — like every torn manifest in the repo."""
+    from daccord_tpu.serve.journal import JobJournal, replay
+
+    p = str(tmp_path / "journal.jsonl")
+    j = JobJournal(p)
+    j.append("admitted", "j00001", tenant="a", nbytes=1, spec={})
+    j.append("running", "j00001")
+    j.close()
+    with open(p, "ab") as fh:
+        fh.write(b'{"rec": "committed", "job": "j000')   # torn mid-write
+    ents, torn = replay(p)
+    assert torn == 1
+    assert ents["j00001"].state == "running"   # the torn commit never counts
+
+
+def test_journal_compact_keeps_idem_memory(tmp_path):
+    """Compaction collapses terminal jobs to their idempotency memory and
+    drops keyless terminal jobs entirely — the file stays bounded while
+    duplicate submissions keep deduping."""
+    from daccord_tpu.serve.journal import JobJournal, compact, replay
+
+    p = str(tmp_path / "journal.jsonl")
+    j = JobJournal(p)
+    j.append("admitted", "j00001", tenant="a", nbytes=1, spec={}, idem="k1")
+    j.append("committed", "j00001")
+    j.append("admitted", "j00002", tenant="a", nbytes=1, spec={})
+    j.append("committed", "j00002")
+    j.append("admitted", "j00003", tenant="a", nbytes=1, spec={})
+    j.append("running", "j00003")
+    j.close()
+    ents, _ = replay(p)
+    compact(p, ents)
+    ents2, torn = replay(p)
+    assert torn == 0
+    assert set(ents2) == {"j00001", "j00003"}    # j00002: terminal, keyless
+    assert ents2["j00001"].terminal and ents2["j00001"].idem == "k1"
+    assert ents2["j00003"].state == "running"    # live jobs keep their state
+
+
+def test_serve_fault_kinds_parse_and_count():
+    from daccord_tpu.runtime.faults import FaultPlan
+
+    plan = FaultPlan.parse("serve_crash:3,serve_hang:2")
+    assert not plan.serve_crash_check()        # append 1
+    assert not plan.serve_crash_check()        # append 2
+    assert plan.serve_crash_check()            # append 3 fires
+    assert not plan.serve_crash_check()        # one-shot
+    assert not plan.serve_hang_check()
+    assert plan.serve_hang_check()
+    assert not plan.serve_hang_check()
+    # unknown-to-serve kinds still parse everywhere (pipeline plans see
+    # the same spec); fleet stripping leaves serve kinds alone
+    from daccord_tpu.runtime.faults import non_fleet_spec
+
+    assert non_fleet_spec("serve_crash:1,worker_hang:2") == "serve_crash:1"
+
+
+# ---------------------------------------------------------------------------
+# replay + idempotency + bounded drain (in-process)
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_replay_requeues_and_resumes(dataset, tmp_path):
+    """A dead service's queued AND running jobs replay on restart: the
+    running orphan resumes from its per-job checkpoint, the queued one runs
+    fresh — both byte-identical, quota balances restored, idempotency keys
+    surviving the restart, and exactly one commit per job."""
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    w = tmp_path / "srv"
+    # worker 1 wedges on job 1 (serve_hang): job 2 queues behind it; the
+    # abandoned service stands in for a crashed process (the journal holds
+    # everything fsync'd — in-process we simply never call shutdown)
+    svc1 = _svc(w, fault="serve_hang:1", workers=1)
+    j1 = svc1.submit({"db": out["db"], "las": out["las"], "tenant": "a",
+                      "idempotency_key": "k1"})
+    j2 = svc1.submit({"db": out["db"], "las": out["las"], "tenant": "b"})
+    time.sleep(0.6)
+    dup = svc1.submit({"db": out["db"], "las": out["las"], "tenant": "a",
+                       "idempotency_key": "k1"})
+    assert dup["job"] == j1["job"] and dup.get("idempotent")
+    svc1._stop.set()     # "crash": no drain, no journal close
+    svc2 = _svc(w)
+    s1 = _poll(svc2, j1["job"])
+    s2 = _poll(svc2, j2["job"])
+    assert s1["state"] == "done" and s2["state"] == "done", (s1, s2)
+    for j in (j1, j2):
+        got = open(os.path.join(str(w), "jobs", j["job"], "out.fasta"),
+                   "rb").read()
+        assert got == ref
+    # idempotency survived the restart (rebuilt from the journal)
+    dup2 = svc2.submit({"db": out["db"], "las": out["las"],
+                        "idempotency_key": "k1"})
+    assert dup2["job"] == j1["job"] and dup2.get("idempotent")
+    st = svc2.stats()
+    for t in st["admission"]["tenants"].values():
+        assert t["queued"] == 0 and t["bytes"] == 0
+    assert svc2.shutdown() is True
+    ev = [json.loads(l) for l in
+          open(os.path.join(str(w), "serve.events.jsonl"))]
+    assert any(e["event"] == "serve.replay" and e["orphans"] == 2
+               for e in ev)
+    commits = [e for e in ev if e["event"] == "serve.commit"]
+    assert sorted(e["job"] for e in commits) == sorted(
+        [j1["job"], j2["job"]])
+    _lint([os.path.join(str(w), "serve.events.jsonl")]
+          + glob.glob(os.path.join(str(w), "g*.events.jsonl")))
+    # journal folded terminal; no duplicate job dirs
+    ents, torn = _journal(w)
+    assert torn == 0
+    assert sorted(os.listdir(os.path.join(str(w), "jobs"))) == sorted(
+        [j1["job"], j2["job"]])
+
+
+@needs_native
+def test_mid_commit_crash_finalizes_without_rerun(dataset, tmp_path):
+    """A ``committing`` journal record + an intact part file = the crash
+    landed between the FASTA fsync and the publishing rename: replay
+    finishes the commit in place — rename + manifest, NO recompute — and
+    the job answers done."""
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    w = tmp_path / "srv"
+    # run one job cleanly to get real bytes + a real spec payload
+    svc1 = _svc(w)
+    j1 = svc1.submit({"db": out["db"], "las": out["las"], "tenant": "a"})
+    assert _poll(svc1, j1["job"])["state"] == "done"
+    assert svc1.shutdown() is True
+    jobdir = os.path.join(str(w), "jobs", j1["job"])
+    fasta = os.path.join(jobdir, "out.fasta")
+    # rewind the commit: fasta back to part, manifest gone, journal ends
+    # at `committing` — exactly the mid-commit crash window
+    data = open(fasta, "rb").read()
+    os.replace(fasta, os.path.join(jobdir, "out.fasta.part"))
+    os.remove(os.path.join(jobdir, "manifest.json"))
+    import dataclasses
+
+    from daccord_tpu.serve.jobs import JobSpec
+    from daccord_tpu.serve.journal import JobJournal
+
+    spec = JobSpec.from_json({"db": out["db"], "las": out["las"]}, jobdir)
+    jj = JobJournal(os.path.join(str(w), "journal.jsonl"))
+    jj.append("admitted", j1["job"], tenant="a", nbytes=1,
+              spec=dataclasses.asdict(spec), dir=jobdir)
+    jj.append("running", j1["job"])
+    jj.append("committing", j1["job"], bytes=len(data))
+    jj.close()
+    svc2 = _svc(w)
+    # the finalize happens AT replay (before workers pick anything up):
+    # no recompute means the fasta/manifest already exist at construction
+    assert os.path.exists(fasta) and open(fasta, "rb").read() == data == ref
+    man = json.load(open(os.path.join(jobdir, "manifest.json")))
+    assert man.get("recovered") is True
+    ents, _ = _journal(w)
+    assert ents[j1["job"]].state == "committed"
+    assert svc2.shutdown() is True
+
+
+@needs_native
+def test_bounded_drain_marks_interrupted_and_resumes(dataset, tmp_path):
+    """A wedged group thread no longer hangs shutdown forever: past the
+    drain deadline the in-flight job is journal-marked INTERRUPTED
+    (resumable) and shutdown reports unclean — and the next incarnation
+    replays it to a byte-identical commit."""
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    w = tmp_path / "srv"
+    svc1 = _svc(w, fault="serve_hang:1", workers=1, drain_deadline_s=0.5)
+    j1 = svc1.submit({"db": out["db"], "las": out["las"], "tenant": "a"})
+    time.sleep(0.5)
+    t0 = time.time()
+    assert svc1.shutdown() is False          # bounded: unclean, not hung
+    assert time.time() - t0 < 30
+    ents, _ = _journal(w)
+    assert ents[j1["job"]].state == "interrupted"
+    svc2 = _svc(w)
+    st = _poll(svc2, j1["job"])
+    assert st["state"] == "done"
+    got = open(os.path.join(str(w), "jobs", j1["job"], "out.fasta"),
+               "rb").read()
+    assert got == ref
+    assert svc2.shutdown() is True
+
+
+@needs_native
+def test_peer_takeover_finishes_dead_peers_job(dataset, tmp_path):
+    """The tentpole's (b): a peer on the shared FS detects the dead
+    process's stale per-job lease, claims the journaled job, and finishes
+    it byte-identically — observable via serve.takeover + the takeovers
+    counter; the owner's restart then sees the peer's manifest and re-runs
+    nothing."""
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    peer = str(tmp_path / "peer")
+    from daccord_tpu.utils import lease
+
+    A = _svc(tmp_path / "srvA", fault="serve_hang:1", workers=1,
+             peer_dir=peer, lease_ttl_s=2.0, heartbeat_s=0.2)
+    j = A.submit({"db": out["db"], "las": out["las"], "tenant": "a"})
+    time.sleep(0.6)
+    A._stop.set()      # A "dies": heartbeats stop
+    time.sleep(0.3)
+    lp = glob.glob(os.path.join(peer, "leases", "*.lease"))
+    assert len(lp) == 1 and lp[0].endswith(f"srvA.{j['job']}.lease")
+    lease.backdate(lp[0], 10.0)   # don't burn TTL wall-clock
+    B = _svc(tmp_path / "srvB", workers=2, peer_dir=peer, lease_ttl_s=2.0,
+             heartbeat_s=0.2)
+    key = f"srvA.{j['job']}"
+    deadline = time.time() + 120
+    st = None
+    while time.time() < deadline:
+        st = B.status(key)
+        if st and st["state"] in ("done", "failed", "aborted"):
+            break
+        time.sleep(0.05)
+    assert st and st["state"] == "done", st
+    got = open(os.path.join(str(tmp_path / "srvA"), "jobs", j["job"],
+                            "out.fasta"), "rb").read()
+    assert got == ref
+    # the dead owner restarts: replay sees the peer's manifest — finished,
+    # zero re-runs (the exactly-once half of the contract)
+    C = _svc(tmp_path / "srvA", workers=1, peer_dir=peer, lease_ttl_s=2.0,
+             heartbeat_s=0.2)
+    evA = [json.loads(l) for l in
+           open(os.path.join(str(tmp_path / "srvA"), "serve.events.jsonl"))]
+    rep = [e for e in evA if e["event"] == "serve.replay"]
+    assert rep and rep[-1]["finished"] == 1 and rep[-1]["orphans"] == 0
+    assert B.shutdown() is True and C.shutdown() is True
+    roll = json.load(open(os.path.join(str(tmp_path / "srvB"),
+                                       "serve.metrics.json")))
+    assert roll["metrics"]["counters"].get("takeovers") == 1
+    evB = [json.loads(l) for l in
+           open(os.path.join(str(tmp_path / "srvB"), "serve.events.jsonl"))]
+    tk = [e for e in evB if e["event"] == "serve.takeover"]
+    assert len(tk) == 1 and tk[0]["job"] == key
+    assert tk[0]["prev_host"].startswith("srvA@")   # service@host:pid
+    _lint([os.path.join(str(tmp_path / "srvB"), "serve.events.jsonl"),
+           os.path.join(str(tmp_path / "srvA"), "serve.events.jsonl")])
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL lifecycle matrix (real subprocesses)
+# ---------------------------------------------------------------------------
+
+def _spawn_serve(workdir, root, tag, fault=None, checkpoint_reads=4,
+                 extra=()):
+    ready = os.path.join(str(root), f"ready-{tag}.json")
+    argv = [sys.executable, "-m", "daccord_tpu.tools.cli", "serve",
+            "--workdir", str(workdir), "--backend", "native", "-b", "64",
+            "--workers", "2", "--port", "0", "--ready-file", ready,
+            "--checkpoint-reads", str(checkpoint_reads), "--flush-lag-ms",
+            "20", *extra]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__import__("daccord_tpu").__file__)))
+    env["PYTHONPATH"] = pkg_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    if fault:
+        env["DACCORD_FAULT"] = fault
+    else:
+        env.pop("DACCORD_FAULT", None)
+    log = open(os.path.join(str(root), f"serve-{tag}.log"), "wb")
+    proc = subprocess.Popen(argv, env=env, stdout=log, stderr=log)
+    deadline = time.time() + 120
+    port = None
+    while time.time() < deadline:
+        if os.path.exists(ready):
+            try:
+                port = json.load(open(ready))["port"]
+                break
+            except (OSError, json.JSONDecodeError, ValueError):
+                pass
+        if proc.poll() is not None:
+            break
+        time.sleep(0.05)
+    return proc, port
+
+
+def _req(port, method, path, body=None, timeout=120):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+@needs_native
+@pytest.mark.parametrize("point,fault,ck,stream", [
+    # journal appends run in lifecycle order, so serve_crash:N pins the
+    # SIGKILL to an exact point: 1 = the admitted append (post-admit,
+    # pre-queue — the 201 may never even reach the client), 3 with a
+    # 4-read checkpoint stride = the first progress append (running
+    # mid-batch; also the mid-stream client arm), 3 with checkpoints off =
+    # the committing append (between the FASTA fsync and the rename)
+    ("post_admit", "serve_crash:1", 4, False),
+    ("running_mid_batch", "serve_crash:3", 4, True),
+    ("mid_commit", "serve_crash:3", 0, False),
+])
+def test_kill_matrix_sigkill_restart_parity(dataset, tmp_path, point,
+                                            fault, ck, stream):
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    w = tmp_path / "srv"
+    proc, port = _spawn_serve(w, tmp_path, "a", fault=fault,
+                              checkpoint_reads=ck)
+    assert port is not None or proc.poll() is not None
+    job_id = None
+    if port is not None:
+        try:
+            code, raw = _req(port, "POST", "/v1/jobs",
+                             {"db": out["db"], "las": out["las"],
+                              "idempotency_key": f"km-{point}"},
+                             timeout=60)
+            job_id = json.loads(raw)["job"]
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass   # post_admit: the crash can beat the 201 — idempotency
+                   # key recovers the identity below
+        if stream and job_id:
+            # a client mid-stream when the server dies: the disconnect is
+            # the client's problem; the job itself must survive
+            import http.client
+
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/jobs/{job_id}/stream",
+                    timeout=5).read()
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    TimeoutError, http.client.HTTPException):
+                pass
+    rc = proc.wait(timeout=180)
+    assert rc == 137, f"{point}: expected the injected SIGKILL, got {rc}"
+    # restart clean: replay must finish the job
+    proc2, port2 = _spawn_serve(w, tmp_path, "b", fault=None,
+                                checkpoint_reads=ck)
+    assert port2 is not None
+    # identity via idempotency key (covers the lost-201 case)
+    code, raw = _req(port2, "POST", "/v1/jobs",
+                     {"db": out["db"], "las": out["las"],
+                      "idempotency_key": f"km-{point}"}, timeout=120)
+    st = json.loads(raw)
+    if job_id is None:
+        job_id = st["job"]
+    assert st["job"] == job_id
+    assert code == 200 and st.get("idempotent"), (code, st)
+    code, raw = _req(port2, "GET", f"/v1/jobs/{job_id}/result?wait=1",
+                     timeout=300)
+    assert code == 200 and raw == ref, f"{point}: resumed FASTA diverged"
+    # quota restored + no duplicate job dirs + journal terminal exactly once
+    code, raw = _req(port2, "GET", "/v1/metrics", timeout=60)
+    m = json.loads(raw)
+    for t in m["admission"]["tenants"].values():
+        assert t["queued"] == 0 and t["bytes"] == 0
+    _req(port2, "POST", "/v1/shutdown", timeout=60)
+    assert proc2.wait(timeout=180) == 0
+    assert os.listdir(os.path.join(str(w), "jobs")) == [job_id]
+    ents, _ = _journal(w)
+    assert ents[job_id].state == "committed"
+    ev = [json.loads(l) for l in
+          open(os.path.join(str(w), "serve.events.jsonl"))]
+    commits = [e for e in ev if e["event"] == "serve.commit"]
+    assert len(commits) == 1 and commits[0]["job"] == job_id
+    if point == "mid_commit":
+        # the fsync'd part finalized in place: the recovery manifest marks
+        # zero-recompute (commit event carries fragments=-1 at replay)
+        man = json.load(open(os.path.join(str(w), "jobs", job_id,
+                                          "manifest.json")))
+        assert man.get("recovered") is True
+    _lint([os.path.join(str(w), "serve.events.jsonl")])
+
+
+# ---------------------------------------------------------------------------
+# tooling: sentinel red flags + top lease table
+# ---------------------------------------------------------------------------
+
+def _write_events(path, recs):
+    t0 = time.time()
+    with open(path, "w") as fh:
+        for i, r in enumerate(recs):
+            fh.write(json.dumps({"t": 0.001 * i, "ts": t0 + 0.001 * i,
+                                 **r}) + "\n")
+
+
+def test_sentinel_flags_replay_without_commit(tmp_path):
+    from daccord_tpu.tools.sentinel import scan_events
+
+    p = str(tmp_path / "serve.events.jsonl")
+    _write_events(p, [
+        {"event": "serve.journal", "rec": "replayed", "job": "j00001"},
+        {"event": "serve.journal", "rec": "replayed", "job": "j00002"},
+        {"event": "serve.journal", "rec": "committed", "job": "j00002"},
+    ])
+    issues = scan_events(p)
+    assert any("j00001" in i and "replayed" in i for i in issues)
+    assert not any("j00002" in i for i in issues)
+
+
+def test_sentinel_flags_repeated_takeover(tmp_path):
+    from daccord_tpu.tools.sentinel import scan_events
+
+    p = str(tmp_path / "serve.events.jsonl")
+    _write_events(p, [
+        {"event": "serve.takeover", "job": "srvA.j00001",
+         "prev_host": "srvA:1", "stale_s": 5.0},
+        {"event": "serve.takeover", "job": "srvA.j00001",
+         "prev_host": "srvB:2", "stale_s": 5.0},
+        {"event": "serve.journal", "rec": "committed", "job": "srvA.j00001"},
+        {"event": "serve.takeover", "job": "srvA.j00002",
+         "prev_host": "srvA:1", "stale_s": 5.0},
+    ])
+    issues = scan_events(p)
+    assert any("taken over 2 times" in i for i in issues)
+    assert not any("j00002" in i and "taken over" in i for i in issues)
+
+
+def test_top_renders_lease_ownership(tmp_path):
+    from daccord_tpu.tools.top import collect, render
+    from daccord_tpu.utils import lease
+
+    peer = tmp_path / "peer"
+    lease.claim(str(peer / "leases" / "srvA.j00001.lease"), "srvA:42", 15.0,
+                extra={"job": "j00001", "service": "srvA"})
+    lease.claim(str(peer / "leases" / "srvB.j00007.lease"), "srvB:43", 15.0,
+                extra={"job": "j00007", "service": "srvB"})
+    lease.backdate(str(peer / "leases" / "srvB.j00007.lease"), 120.0)
+    snap = collect([str(peer)])
+    assert len(snap["leases"]) == 2
+    by_name = {l["name"]: l for l in snap["leases"]}
+    assert by_name["srvA.j00001"]["holder"] == "srvA:42"
+    assert by_name["srvB.j00007"]["age_s"] > 60
+    text = render(snap)
+    assert "LEASE" in text and "srvA.j00001" in text and "srvB:43" in text
+
+
+def test_eventcheck_accepts_and_rejects_new_kinds(tmp_path):
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    good = str(tmp_path / "good.jsonl")
+    _write_events(good, [
+        {"event": "serve.journal", "rec": "admitted", "job": "j00001"},
+        {"event": "serve.replay", "jobs": 3, "orphans": 1, "finished": 1,
+         "torn": 0},
+        {"event": "serve.takeover", "job": "srvA.j00001",
+         "prev_host": "srvA:7", "stale_s": 4.5},
+    ])
+    assert validate_events(good, strict=True) == []
+    bad = str(tmp_path / "bad.jsonl")
+    _write_events(bad, [
+        {"event": "serve.takeover", "job": "srvA.j00001"},   # missing fields
+    ])
+    assert validate_events(bad, strict=True)
+
+
+# ---------------------------------------------------------------------------
+# chaos soak (slow): the acceptance gate
+# ---------------------------------------------------------------------------
+
+@needs_native
+@pytest.mark.slow
+def test_chaos_soak_two_processes(tmp_path):
+    """The ISSUE 15 acceptance run: 2 serve processes sharing a peer dir,
+    >= 20 jobs on a seeded arrival trace, deterministic serve_crash +
+    device_lost storm with restarts. run_serve_soak ASSERTS the contract
+    (terminal exactly once, byte parity vs solo, zero leaked quota/spool)
+    and raises on any violation."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    line = bench.run_serve_soak(root=str(tmp_path / "soak"), n_jobs=20,
+                                commit_sidecar=False)
+    assert line["jobs"] == 20 and line["parity"] is True
+    assert line["done"] + line["aborted"] == 20
+    assert line["crashes"] >= 1
+    assert line["takeovers"] + line["replay_orphans"] >= 1

@@ -414,6 +414,104 @@ python -m daccord_tpu.tools.cli top --once "$servedir/srv" \
 echo "tools_pounce: serving-plane smoke OK" >&2
 rm -rf "$servedir"
 
+# serve-crash smoke (ISSUE 15): kill -9 the server mid-job (deterministic
+# serve_crash injection at a progress-checkpoint journal append), restart it
+# on the same workdir, and require the journal replay to resume the job to a
+# FASTA byte-identical to the solo run — with strict eventcheck + trace
+# --check over the journal-bearing sidecars and a sentinel pass proving the
+# recovery closed (a replayed-without-commit orphan trips it). This is the
+# crash-durability contract gated before any chip time.
+crashdir=$(mktemp -d)
+python - "$crashdir" <<'EOF' || { echo "tools_pounce: crash-smoke synth failed" >&2; exit 1; }
+import sys
+from daccord_tpu.sim.synth import SimConfig, make_dataset
+make_dataset(sys.argv[1], SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=500, min_overlap=200,
+                                    seed=5), name="sv")
+EOF
+python -m daccord_tpu.tools.cli daccord "$crashdir/sv.db" "$crashdir/sv.las" \
+    --backend native -b 64 -o "$crashdir/solo.fasta" \
+  || { echo "tools_pounce: crash-smoke solo reference FAILED" >&2; exit 1; }
+env DACCORD_FAULT=serve_crash:4 \
+  python -m daccord_tpu.tools.cli serve --workdir "$crashdir/srv" \
+    --backend native -b 64 --port 0 --ready-file "$crashdir/ready1.json" \
+    --checkpoint-reads 2 \
+    > "$crashdir/serve1.log" 2>&1 &
+CRASH_PID=$!
+python - "$crashdir" <<'EOF' || { echo "tools_pounce: crash-smoke submit FAILED" >&2; kill "$CRASH_PID" 2>/dev/null; exit 1; }
+import json, os, sys, time, urllib.request
+d = sys.argv[1]
+for _ in range(300):
+    if os.path.exists(f"{d}/ready1.json"):
+        break
+    time.sleep(0.1)
+else:
+    raise SystemExit("crash-smoke serve never wrote its ready file")
+port = json.load(open(f"{d}/ready1.json"))["port"]
+r = urllib.request.Request(f"http://127.0.0.1:{port}/v1/jobs", method="POST",
+                           data=json.dumps({"db": f"{d}/sv.db",
+                                            "las": f"{d}/sv.las",
+                                            "idempotency_key": "crash-smoke"}).encode(),
+                           headers={"Content-Type": "application/json"})
+with urllib.request.urlopen(r, timeout=60) as resp:
+    st = json.loads(resp.read())
+open(f"{d}/job.txt", "w").write(st["job"])
+EOF
+wait "$CRASH_PID"; CRASH_RC=$?
+[ "$CRASH_RC" -eq 137 ] \
+  || { echo "tools_pounce: crash-smoke server exited $CRASH_RC (expected injected 137)" >&2; exit 1; }
+python -m daccord_tpu.tools.cli serve --workdir "$crashdir/srv" \
+    --backend native -b 64 --port 0 --ready-file "$crashdir/ready2.json" \
+    --checkpoint-reads 2 \
+    > "$crashdir/serve2.log" 2>&1 &
+CRASH_PID=$!
+python - "$crashdir" <<'EOF' || { echo "tools_pounce: crash-smoke resume/parity FAILED" >&2; kill "$CRASH_PID" 2>/dev/null; exit 1; }
+import json, os, sys, time, urllib.request
+d = sys.argv[1]
+for _ in range(300):
+    if os.path.exists(f"{d}/ready2.json"):
+        break
+    time.sleep(0.1)
+else:
+    raise SystemExit("crash-smoke restart never wrote its ready file")
+port = json.load(open(f"{d}/ready2.json"))["port"]
+job = open(f"{d}/job.txt").read().strip()
+base = f"http://127.0.0.1:{port}"
+# an idempotent resubmission must dedupe onto the replayed job, not rerun
+r = urllib.request.Request(base + "/v1/jobs", method="POST",
+                           data=json.dumps({"db": f"{d}/sv.db",
+                                            "las": f"{d}/sv.las",
+                                            "idempotency_key": "crash-smoke"}).encode(),
+                           headers={"Content-Type": "application/json"})
+with urllib.request.urlopen(r, timeout=60) as resp:
+    dup = json.loads(resp.read())
+    assert resp.status == 200 and dup["job"] == job, (resp.status, dup, job)
+with urllib.request.urlopen(base + f"/v1/jobs/{job}/result?wait=1",
+                            timeout=300) as resp:
+    got = resp.read()
+solo = open(f"{d}/solo.fasta", "rb").read()
+assert got == solo, "resumed job FASTA diverged from the solo run"
+urllib.request.urlopen(urllib.request.Request(base + "/v1/shutdown",
+                                              method="POST"), timeout=60).read()
+print("serve-crash smoke: resumed job byte-identical after kill -9")
+EOF
+wait "$CRASH_PID" \
+  || { echo "tools_pounce: restarted serve did not shut down cleanly" >&2; exit 1; }
+grep -q '"event": "serve.replay"' "$crashdir/srv/serve.events.jsonl" \
+  || { echo "tools_pounce: restart emitted no serve.replay event" >&2; exit 1; }
+python -m daccord_tpu.tools.cli eventcheck --strict \
+    "$crashdir/srv/serve.events.jsonl" "$crashdir"/srv/g*.events.jsonl \
+    "$crashdir"/srv/jobs/*/events.jsonl \
+  || { echo "tools_pounce: crash-smoke events failed schema lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli trace --check --no-timeline \
+    "$crashdir/srv/serve.events.jsonl" "$crashdir"/srv/g*.events.jsonl \
+    "$crashdir"/srv/jobs/*/events.jsonl "$crashdir"/srv/jobs/*/ledger.jsonl \
+  || { echo "tools_pounce: crash-smoke sidecars failed daccord-trace lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli sentinel --strict "$crashdir/srv" \
+  || { echo "tools_pounce: crash-smoke tripped the regression sentinel (replay without commit?)" >&2; exit 1; }
+echo "tools_pounce: serve-crash smoke OK" >&2
+rm -rf "$crashdir"
+
 # serve bench stage (ISSUE 10 satellite): replay the default job-arrival
 # trace against the server and commit the latency sidecar — the first
 # serving number (p50/p99 + windows/sec) lands beside the rung ladder
