@@ -102,6 +102,16 @@ BENCH_SERVE_TRACE = os.environ.get("DACCORD_BENCH_SERVE_TRACE")
 # BENCH_SERVE_SOAK.json. DACCORD_BENCH_SERVE_SOAK_JOBS overrides the job
 # count (default 20).
 BENCH_SERVE_SOAK = os.environ.get("DACCORD_BENCH_SERVE_SOAK") == "1"
+# front door (ISSUE 16): DACCORD_BENCH_ROUTER=1 commits BENCH_ROUTER.json
+# with two arms: (a) cold-peer TTFR — time from fresh solve path to the
+# first fetched batch result — WITH the fleet-shared AOT executable cache
+# (deserialize) vs WITHOUT (cold jit compile), measured at the dispatcher
+# under a fresh jax compilation-cache dir so the cold number is honest;
+# (b) p99 job latency through a live daccord-router while the SLO-burn
+# autoscaler scales the fleet out under a bursty arrival trace (spawned
+# daccord-serve subprocesses join via announce leases + the shared AOT
+# cache). Chip-free: both arms run on the CPU/native backends.
+BENCH_ROUTER = os.environ.get("DACCORD_BENCH_ROUTER") == "1"
 # multichip mesh arm (ISSUE 12): DACCORD_BENCH_MESH=1 measures mesh-N
 # windows/sec scaling vs single-device ON THIS HOST through the sharded
 # ladder (parallel/mesh.py) and commits the next MULTICHIP_r*.json sidecar —
@@ -1085,6 +1095,181 @@ def run_serve_bench(ev) -> dict:
     return line
 
 
+def run_router_bench(ev) -> dict:
+    """Front-door stage (DACCORD_BENCH_ROUTER=1, ISSUE 16). Two arms:
+
+    **cold-peer TTFR** — the executable-acquisition latency a freshly
+    spawned peer pays before its first solve result: WITHOUT the AOT cache
+    that is the cold jit compile of the packed ladder program; WITH it, a
+    deserialize of the fleet-published executable. Both timed at the
+    dispatcher over the same real window batch, under a FRESH jax
+    compilation-cache dir (otherwise a prior bench run's persistent XLA
+    cache would silently deflate the cold number), with byte-identity of
+    the fetched results asserted.
+
+    **p99 during scale-out** — a bursty multi-tenant arrival trace through
+    a live ``daccord-router`` fronting one warm peer with a deliberately
+    tiny SLO target, so burn goes red and the autoscaler spawns a second
+    ``daccord-serve`` subprocess mid-trace (announce-lease discovery +
+    shared AOT cache). The sidecar records per-job latency, p99, spill and
+    scale tallies — the latency cost of scaling out, measured."""
+    import tempfile
+    import urllib.request
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # -- arm (a): cold-peer TTFR, dispatcher-level -----------------------
+    data = build_windows()
+    from daccord_tpu.kernels import BatchShape
+    from daccord_tpu.kernels.tiers import TierLadder, stream_dispatcher
+    from daccord_tpu.kernels.tiers import fetch as t_fetch
+    from daccord_tpu.oracle.consensus import ConsensusConfig
+    from daccord_tpu.oracle.profile import ErrorProfile
+    from daccord_tpu.serve.aotcache import AotCache
+
+    cc_dir = tempfile.mkdtemp(prefix="daccord-router-bench-cc-")
+    jax.config.update("jax_compilation_cache_dir", cc_dir)
+    prof = ErrorProfile(float(data["p_ins"]), float(data["p_del"]),
+                        float(data["p_sub"]))
+    ladder = TierLadder.from_config(prof, _bench_consensus_config())
+    shape = BatchShape(depth=DEPTH, seg_len=SEG_LEN, wlen=WLEN)
+    batch = _make_batch(data, 0, 64, shape)
+    # without: a fresh peer's first dispatch = cold jit compile + exec
+    cold_fn = stream_dispatcher(ladder, use_pallas=False,
+                                pallas_interpret=False)
+    t0 = time.perf_counter()
+    out_cold = t_fetch(cold_fn(batch))
+    ttfr_cold = time.perf_counter() - t0
+    # publish to a fresh fleet cache (untimed: the XLA cache above makes
+    # this second compile cheap; only its serialized artifact matters)
+    aot_dir = tempfile.mkdtemp(prefix="daccord-router-bench-aot-")
+    AotCache(aot_dir).dispatcher(ladder, use_pallas=False,
+                                 pallas_interpret=False,
+                                 fp_prefix="cpu:")(batch)
+    # with: a DIFFERENT fresh AotCache instance = the spawned peer's first
+    # dispatch — disk load + deserialize + exec, no compile
+    warm_fn = AotCache(aot_dir).dispatcher(ladder, use_pallas=False,
+                                           pallas_interpret=False,
+                                           fp_prefix="cpu:")
+    t0 = time.perf_counter()
+    out_warm = t_fetch(warm_fn(batch))
+    ttfr_warm = time.perf_counter() - t0
+    import numpy as _np
+
+    aot_identical = all(
+        _np.asarray(out_cold[k]).tobytes() == _np.asarray(out_warm[k]).tobytes()
+        for k in ("cons", "cons_len", "solved"))
+    ev.log("bench_compile", batch=64, cached=False,
+           expected_wall_s=round(ttfr_cold, 3))
+
+    # -- arm (b): p99 through the router during a live scale-out ---------
+    from daccord_tpu.serve import (AdmissionConfig, AutoscaleConfig,
+                                   Autoscaler, ConsensusService, RouterConfig,
+                                   ServeConfig)
+    from daccord_tpu.serve.http import start_server
+    from daccord_tpu.serve.router import Router, start_router
+    from daccord_tpu.sim.synth import SimConfig, make_dataset
+
+    backend = os.environ.get("DACCORD_BENCH_SERVE_BACKEND")
+    if not backend:
+        try:
+            from daccord_tpu.native import available as _nat
+
+            backend = "native" if _nat() else "cpu"
+        except Exception:
+            backend = "cpu"
+    d = tempfile.mkdtemp(prefix="daccord-router-bench-")
+    ds = make_dataset(d, SimConfig(genome_len=3000, coverage=12,
+                                   read_len_mean=600, min_overlap=250,
+                                   seed=11), name="sv")
+    peer_dir = os.path.join(d, "fleet")
+    sbatch = 64 if backend != "native" else 256
+    slo_s = 0.05        # deliberately tiny: every real job burns red
+    svc = ConsensusService(ServeConfig(
+        workdir=os.path.join(d, "peer0"), backend=backend,
+        backend_explicit=True, batch=sbatch, workers=2, flush_lag_s=0.05,
+        metrics_snapshot_s=0.0, slo_p99_s=slo_s, slo_window_s=60.0,
+        peer_dir=peer_dir,
+        admission=AdmissionConfig(max_queued_jobs=64, tenant_max_queued=64)))
+    httpd, port, _t = start_server(svc, "127.0.0.1", 0)
+    svc.announce(f"http://127.0.0.1:{port}")
+    router = Router(RouterConfig(workdir=os.path.join(d, "router"),
+                                 peer_dir=peer_dir, poll_s=0.2,
+                                 spill_burn=1.0))
+    router.autoscaler = Autoscaler(AutoscaleConfig(
+        peer_dir=peer_dir, root=os.path.join(d, "autopeers"),
+        max_peers=2, min_peers=1, spawn_burn=1.0, sustain_s=0.5,
+        cooldown_s=3600.0, idle_ttl_s=0.0, backend=backend, batch=sbatch,
+        workers=2, slo_p99_s=slo_s,
+        spawn_env={"JAX_PLATFORMS": "cpu"}), router.log)
+    rhttpd, rport, _rt = start_router(router)
+    base = f"http://127.0.0.1:{rport}"
+
+    def req(method, path, body=None):
+        r = urllib.request.Request(
+            base + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=600) as resp:
+            return json.loads(resp.read())
+
+    deadline = time.time() + 30.0
+    while time.time() < deadline:      # router must discover the warm peer
+        if req("GET", "/v1/router").get("ready"):
+            break
+        time.sleep(0.1)
+    arrivals = [0.0, 0.1, 0.2, 0.5, 0.8, 1.2, 2.0, 2.2, 2.5, 3.0, 3.5, 4.0]
+    t0 = time.perf_counter()
+    ids = []
+    for i, at in enumerate(arrivals):
+        dt = at - (time.perf_counter() - t0)
+        if dt > 0:
+            time.sleep(dt)
+        st = req("POST", "/v1/jobs", {"db": ds["db"], "las": ds["las"],
+                                      "tenant": f"t{i % 4}",
+                                      "idempotency_key": f"rb{i}"})
+        ids.append(st["job"])
+    rows = []
+    for j in ids:
+        urllib.request.urlopen(
+            urllib.request.Request(base + f"/v1/jobs/{j}/result?wait=1"),
+            timeout=600).read()
+        rows.append(req("GET", f"/v1/jobs/{j}"))
+    wall = time.perf_counter() - t0
+    rstats = req("GET", "/v1/router")
+    router.shutdown()
+    rhttpd.shutdown()
+    svc.shutdown(drain=True)
+    httpd.shutdown()
+    lat = sorted(r["latency"]["total_s"] for r in rows)
+
+    def q(v, p):
+        return round(v[min(int(p * len(v)), len(v) - 1)], 4) if v else None
+
+    line = {
+        "metric": "router_scaleout_p99_s",
+        "backend": backend, "batch": sbatch, "jobs": len(rows),
+        "done": sum(1 for r in rows if r["state"] == "done"),
+        "p50_s": q(lat, 0.50), "p99_s": q(lat, 0.99), "max_s": q(lat, 1.0),
+        "wall_s": round(wall, 3),
+        "routes": rstats["routes"], "spills": rstats["spills"],
+        "proxy_errors": rstats["proxy_errors"],
+        "peers_final": len(rstats["peers"]),
+        "scale": rstats.get("autoscale"),
+        # arm (a): the AOT acceptance metric (>= 5x is the ISSUE 16 bar)
+        "aot": {"ttfr_cold_s": round(ttfr_cold, 3),
+                "ttfr_warm_s": round(ttfr_warm, 3),
+                "speedup": round(ttfr_cold / ttfr_warm, 1)
+                if ttfr_warm > 0 else None,
+                "byte_identical": aot_identical},
+        **_tunnel_staleness(),
+    }
+    _commit_sidecar("BENCH_ROUTER.json", line)
+    ev.log("bench_done", wall_s=round(time.perf_counter() - t0, 3))
+    return line
+
+
 def run_serve_soak(root: str | None = None, n_jobs: int = 20,
                    seed: int = 0x5E12, ev=None, backend: str | None = None,
                    timeout_s: float = 900.0,
@@ -1452,6 +1637,12 @@ def main() -> None:
         # server), chip-free by default — runs before any window build
         ev.log("bench_start", batch=0, serve=True)
         print(json.dumps(run_serve_bench(ev)))
+        return
+    if BENCH_ROUTER:
+        # front-door stage (ISSUE 16): cold-peer TTFR with/without the AOT
+        # cache + p99 through a live router during an autoscaler scale-out
+        ev.log("bench_start", batch=0, router=True)
+        print(json.dumps(run_router_bench(ev)))
         return
     data = build_windows()
     ev.log("bench_start", batch=BATCH, precompile=BENCH_PRECOMPILE)

@@ -217,6 +217,42 @@ class _SupHandle:
         self.result = result
 
 
+def shape_key(batch, fp_prefix: str, mesh_suffix: str = "") -> str:
+    """The compile-shape identity of a batch: the registry/ratchet/AOT key.
+
+    Module-level (ISSUE 16) so the supervisor's fingerprint registry and the
+    serve tier's fleet-shared AOT executable cache can never disagree about
+    which program a batch dispatches."""
+    if getattr(batch, "pool", None) is not None:
+        # paged wire format (kernels/paging.py): pool rows + table width
+        # + lens depth are the jit shape dims; the :pg suffix keeps
+        # paged and dense programs of the same batch width classifying
+        # (and fingerprinting) separately — a warm dense shape must not
+        # rob the paged cold compile of its long deadline
+        b, ppw = batch.table.shape
+        key = (f"{fp_prefix}B{b}xD{batch.lens.shape[1]}"
+               f"xL{batch.shape.seg_len}"
+               f"xP{ppw}x{batch.family.page_len}"
+               f"xN{batch.pool.shape[0]}:pg")
+        if getattr(batch, "stream", "full") == "tier0":
+            key += ":t0"
+        return key + mesh_suffix
+    seqs = getattr(batch, "seqs", None)
+    if seqs is None:
+        return fp_prefix + "opaque" + mesh_suffix
+    b, d, l = seqs.shape
+    key = f"{fp_prefix}B{b}xD{d}xL{l}"
+    # the two-stream ladder dispatches TWO distinct programs at the same
+    # batch shape: tier0-only (Stream A, cheap compile) and the full
+    # rescue ladder (Stream B — same program as a fused dispatch, so
+    # "rescue"/"full" share a fingerprint). Without the suffix the first
+    # program's warm fingerprint would rob the second cold compile of
+    # its long deadline and heartbeats.
+    if getattr(batch, "stream", "full") == "tier0":
+        key += ":t0"
+    return key + mesh_suffix
+
+
 class DeviceSupervisor:
     """Wraps a solver's ``dispatch``/``fetch``(/``fetch_many``) callables in
     the watchdog + classification + failover state machine. Exposes the same
@@ -348,34 +384,7 @@ class DeviceSupervisor:
         return f":m{self._mesh.nd}" if self._mesh is not None else ""
 
     def _shape_key(self, batch) -> str:
-        if getattr(batch, "pool", None) is not None:
-            # paged wire format (kernels/paging.py): pool rows + table width
-            # + lens depth are the jit shape dims; the :pg suffix keeps
-            # paged and dense programs of the same batch width classifying
-            # (and fingerprinting) separately — a warm dense shape must not
-            # rob the paged cold compile of its long deadline
-            b, ppw = batch.table.shape
-            key = (f"{self._fp_prefix}B{b}xD{batch.lens.shape[1]}"
-                   f"xL{batch.shape.seg_len}"
-                   f"xP{ppw}x{batch.family.page_len}"
-                   f"xN{batch.pool.shape[0]}:pg")
-            if getattr(batch, "stream", "full") == "tier0":
-                key += ":t0"
-            return key + self._mesh_suffix()
-        seqs = getattr(batch, "seqs", None)
-        if seqs is None:
-            return self._fp_prefix + "opaque" + self._mesh_suffix()
-        b, d, l = seqs.shape
-        key = f"{self._fp_prefix}B{b}xD{d}xL{l}"
-        # the two-stream ladder dispatches TWO distinct programs at the same
-        # batch shape: tier0-only (Stream A, cheap compile) and the full
-        # rescue ladder (Stream B — same program as a fused dispatch, so
-        # "rescue"/"full" share a fingerprint). Without the suffix the first
-        # program's warm fingerprint would rob the second cold compile of
-        # its long deadline and heartbeats.
-        if getattr(batch, "stream", "full") == "tier0":
-            key += ":t0"
-        return key + self._mesh_suffix()
+        return shape_key(batch, self._fp_prefix, self._mesh_suffix())
 
     def _is_fresh(self, key: str) -> bool:
         """Cold-compile classification: not yet dispatched this process AND

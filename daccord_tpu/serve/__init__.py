@@ -29,6 +29,17 @@ serving scale):
                   memory, startup/shutdown compaction — the crash-
                   durability spine behind restart replay and the
                   per-job-lease peer takeover (utils/lease.py)
+    router.py     stateless front door (ISSUE 16): rendezvous tenant →
+                  peer stickiness over announce-lease discovery + healthz
+                  ``ready`` polls, burn/shed spill, verbatim proxying
+                  (idempotency keys pass through = exactly-once retries)
+    autoscale.py  SLO-burn autoscaler riding the router's poll loop:
+                  sustained red burn spawns daccord-serve peers (bounded,
+                  cooled-down), idle spawned peers drain gracefully
+    aotcache.py   fleet-shared AOT executable cache: serialized compiled
+                  programs keyed by registry shape keys + static digest +
+                  jax/jaxlib/backend versions — a fresh peer's cold TTFR
+                  becomes a deserialize, not a jit compile
 
 Byte contract: every job's FASTA is byte-identical to a solo ``daccord``
 run over the same inputs and config — enforced by tests/test_serve.py under
@@ -39,15 +50,19 @@ takeover, the 2-process chaos soak).
 """
 
 from .admission import AdmissionConfig, AdmissionController, AdmissionReject
+from .aotcache import AotCache
+from .autoscale import AutoscaleConfig, Autoscaler
 from .batcher import JobAborted, JobSolver, SolveGroup
 from .jobs import Job, JobSpec, build_job_config, solve_fingerprint
 from .journal import JobJournal, JournalEntry
+from .router import Router, RouterConfig
 from .service import ConsensusService, ServeConfig
 from .state import WarmState
 
 __all__ = [
     "AdmissionConfig", "AdmissionController", "AdmissionReject",
+    "AotCache", "AutoscaleConfig", "Autoscaler",
     "ConsensusService", "Job", "JobAborted", "JobJournal", "JobSolver",
-    "JobSpec", "JournalEntry", "ServeConfig", "SolveGroup", "WarmState",
-    "build_job_config", "solve_fingerprint",
+    "JobSpec", "JournalEntry", "Router", "RouterConfig", "ServeConfig",
+    "SolveGroup", "WarmState", "build_job_config", "solve_fingerprint",
 ]

@@ -1,0 +1,267 @@
+"""Fleet-shared AOT executable cache (ISSUE 16).
+
+The registry records 900 s-class cold jit walls for the fused ladder; a
+freshly spawned serve peer paying that before its first job makes autoscale
+nominal rather than real. This module closes the gap: the FIRST peer to
+compile a (shape, program) pair serializes the compiled executable via JAX
+AOT export (``jitted.lower(*args).compile()`` + ``serialize_executable``)
+into a cache directory on the shared filesystem beside the lease dir, and
+every later peer — including one the autoscaler spawned seconds ago —
+deserializes it in well under a second instead of recompiling.
+
+Entries are keyed by the SAME shape key the compile-fingerprint registry
+uses (``runtime.supervisor.shape_key``: ``B..xD..xL..`` with the ``:t0`` /
+``:pg`` stream/wire suffixes), so the observability chain lines up: a
+``aot.miss`` on a key the registry already holds means a peer recompiled
+something the fleet had — exactly the regression ``daccord-sentinel``
+flags. Because two ladders can share a batch shape while lowering different
+programs (different tier params, table widths, pallas mode), the on-disk
+entry name also folds in a static-config digest; the registry key stays the
+human-readable identity, the digest keeps colliding programs in separate
+files.
+
+Wire format of an entry (single file, atomic tmp+fsync+rename publish):
+
+    DACAOT01 <sha256 of body> <pickle body>
+
+where the body is ``{"key", "meta", "payload", "in_tree", "out_tree"}``
+and ``meta`` pins jax/jaxlib versions + backend. A torn or bit-flipped
+entry fails the checksum and is *rejected* (``aot.reject`` reason=corrupt),
+never trusted; a version-mismatched entry is rejected with reason=version.
+Both fall back to the cold jit path — the cache can only ever cost a
+rejected read, never correctness (byte parity vs the cold compile is
+asserted by tests/test_router.py).
+
+Scope: single-device JAX groups only. Mesh groups (``shard_map`` closures)
+and the native/C++ and host-routed ``solve_tiered`` paths never reach the
+jitted stream dispatcher, so :meth:`AotCache.dispatcher` is wired only on
+the ``stream_dispatcher`` branch of ``SolveGroup._build_solver``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import time
+
+from ..utils.obs import NullLogger
+
+_MAGIC = b"DACAOT01"
+_SHA_LEN = 32
+
+
+def _versions() -> dict:
+    import jax
+    import jaxlib
+
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__,
+            "backend": jax.default_backend()}
+
+
+def static_digest(ladder, stream: str, use_pallas: bool,
+                  pallas_interpret: bool) -> str:
+    """Digest of everything that changes the lowered program at a fixed
+    batch shape: tier params, wide-p0 rescue config, pallas mode, and the
+    k-mer table shapes/dtypes. Two processes with the same profile produce
+    the same digest (dataclass reprs are deterministic); two different
+    ladders at the same batch shape get different entry files."""
+    tabs = tuple((int(k),) + tuple(ladder.tables[k].shape)
+                 + (str(ladder.tables[k].dtype),)
+                 for k in sorted(ladder.tables))
+    sig = repr((stream, tuple(ladder.params), ladder.wide_p0,
+                bool(use_pallas), bool(pallas_interpret), tabs))
+    return hashlib.sha256(sig.encode()).hexdigest()[:16]
+
+
+class AotCache:
+    """Load/publish serialized executables in a fleet-shared directory.
+
+    Thread-safe: the in-memory map is lock-guarded; disk publishes go
+    through tmp+fsync+rename so concurrent peers racing to publish the same
+    entry both succeed (last rename wins, both bodies identical-in-meaning).
+    """
+
+    def __init__(self, cache_dir: str, log=None):
+        self.dir = cache_dir
+        self.log = log if log is not None else NullLogger()
+        self._mem: dict[tuple[str, str], object] = {}
+        self._lock = threading.Lock()
+        self.counters = {"hits": 0, "mem_hits": 0, "misses": 0,
+                         "publishes": 0, "rejects": 0}
+        os.makedirs(cache_dir, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    # entry IO
+    # ------------------------------------------------------------------
+
+    def _path(self, key: str, digest: str) -> str:
+        name = hashlib.sha256(f"{key}|{digest}".encode()).hexdigest()[:32]
+        return os.path.join(self.dir, name + ".aot")
+
+    def load(self, key: str, digest: str):
+        """The cached executable for ``(key, digest)``, or None.
+
+        Memory first, then disk. A disk hit is deserialized and memoized;
+        corrupt/torn entries and version mismatches are rejected with an
+        ``aot.reject`` event and left in place (another peer's re-publish
+        heals them — removal would race the publisher's rename)."""
+        with self._lock:
+            exe = self._mem.get((key, digest))
+        if exe is not None:
+            self.counters["mem_hits"] += 1
+            return exe
+        path = self._path(key, digest)
+        try:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+        except OSError:
+            return None
+        t0 = time.perf_counter()
+        if (len(raw) < len(_MAGIC) + _SHA_LEN
+                or raw[:len(_MAGIC)] != _MAGIC):
+            return self._reject(key, "corrupt")
+        body = raw[len(_MAGIC) + _SHA_LEN:]
+        if hashlib.sha256(body).digest() != \
+                raw[len(_MAGIC):len(_MAGIC) + _SHA_LEN]:
+            return self._reject(key, "corrupt")
+        try:
+            ent = pickle.loads(body)
+        except Exception:
+            return self._reject(key, "corrupt")
+        if ent.get("meta") != _versions():
+            return self._reject(key, "version")
+        try:
+            from jax.experimental import serialize_executable as se
+
+            exe = se.deserialize_and_load(ent["payload"], ent["in_tree"],
+                                          ent["out_tree"])
+        except Exception as e:
+            return self._reject(key, f"load:{type(e).__name__}")
+        with self._lock:
+            self._mem[(key, digest)] = exe
+        self.counters["hits"] += 1
+        self.log.log("aot.hit", key=key,
+                     wall_s=round(time.perf_counter() - t0, 3))
+        return exe
+
+    def _reject(self, key: str, reason: str):
+        self.counters["rejects"] += 1
+        self.log.log("aot.reject", key=key, reason=reason)
+        return None
+
+    def publish(self, key: str, digest: str, compiled, wall_s: float) -> None:
+        """Serialize ``compiled`` and install it durably; failures only log
+        (a peer that cannot publish still serves from memory)."""
+        with self._lock:
+            self._mem[(key, digest)] = compiled
+        try:
+            from jax.experimental import serialize_executable as se
+
+            from ..utils.aio import durable_write
+
+            payload, in_tree, out_tree = se.serialize(compiled)
+            body = pickle.dumps({"key": key, "meta": _versions(),
+                                 "payload": payload, "in_tree": in_tree,
+                                 "out_tree": out_tree})
+            blob = _MAGIC + hashlib.sha256(body).digest() + body
+            durable_write(self._path(key, digest),
+                          lambda fh: fh.write(blob))
+        except Exception as e:
+            self._reject(key, f"publish:{type(e).__name__}")
+            return
+        self.counters["publishes"] += 1
+        self.log.log("aot.publish", key=key, bytes=len(blob),
+                     wall_s=round(wall_s, 3))
+
+    def stats(self) -> dict:
+        return dict(self.counters)
+
+    # ------------------------------------------------------------------
+    # the dispatch wrap (stream_dispatcher's AOT twin)
+    # ------------------------------------------------------------------
+
+    def dispatcher(self, ladder, use_pallas: bool = False,
+                   pallas_interpret: bool = False, fp_prefix: str = ""):
+        """A drop-in for ``kernels.tiers.stream_dispatcher`` that routes
+        each batch shape through the cache: disk hit → deserialize once and
+        run warm; miss → ONE ``lower().compile()`` (the same compile the
+        jit path would have paid) that is then both executed and published.
+        Cache machinery failures fall back to the plain jit dispatch; real
+        device errors from the executable call propagate untouched so the
+        supervisor's fault classification still sees them."""
+        import jax.numpy as jnp
+
+        from ..kernels import tiers as T
+        from ..runtime.supervisor import shape_key
+
+        inner = T.stream_dispatcher(ladder, use_pallas=use_pallas,
+                                    pallas_interpret=pallas_interpret)
+        digests = {
+            "full": static_digest(ladder, "full", use_pallas,
+                                  pallas_interpret),
+            "tier0": static_digest(ladder, "tier0", use_pallas,
+                                   pallas_interpret),
+        }
+
+        def _assemble(batch):
+            """(jit_fn, dynamic args, static args, cons_len) for this
+            batch — the exact assembly of ``solve_ladder_async`` /
+            ``solve_tier0_async``, shared so the two can't diverge."""
+            stream = getattr(batch, "stream", "full")
+            tier0 = stream == "tier0"
+            p0 = ladder.params[0]
+            cl = p0.cons_len
+            if getattr(batch, "pool", None) is not None:
+                dyn = (jnp.asarray(batch.pool), jnp.asarray(batch.table),
+                       jnp.asarray(batch.lens), jnp.asarray(batch.nsegs))
+                if tier0:
+                    return (T._tier0_packed_paged_jit,
+                            dyn + (ladder.tables[p0.k],),
+                            (p0, batch.family.page_len, batch.shape.seg_len,
+                             use_pallas, pallas_interpret), cl)
+                tables = tuple(ladder.tables[p.k] for p in ladder.params)
+                return (T._ladder_packed_paged_jit, dyn + (tables,),
+                        (tuple(ladder.params), int(batch.size),
+                         batch.family.page_len, batch.shape.seg_len,
+                         use_pallas, pallas_interpret, ladder.wide_p0), cl)
+            dyn = (jnp.asarray(batch.seqs), jnp.asarray(batch.lens),
+                   jnp.asarray(batch.nsegs))
+            if tier0:
+                return (T._tier0_packed_jit, dyn + (ladder.tables[p0.k],),
+                        (p0, use_pallas, pallas_interpret), cl)
+            tables = tuple(ladder.tables[p.k] for p in ladder.params)
+            return (T._ladder_packed_jit, dyn + (tables,),
+                    (tuple(ladder.params), int(batch.size), use_pallas,
+                     pallas_interpret, ladder.wide_p0), cl)
+
+        def dispatch(batch):
+            stream = getattr(batch, "stream", "full")
+            digest = digests["tier0" if stream == "tier0" else "full"]
+            try:
+                key = shape_key(batch, fp_prefix)
+                fn, dyn, statics, cl = _assemble(batch)
+                exe = self.load(key, digest)
+            except Exception as e:
+                self._reject("?", f"keying:{type(e).__name__}")
+                return inner(batch)
+            if exe is None:
+                self.counters["misses"] += 1
+                self.log.log("aot.miss", key=key)
+                try:
+                    t0 = time.perf_counter()
+                    exe = fn.lower(*dyn, *statics).compile()
+                    self.publish(key, digest, exe,
+                                 time.perf_counter() - t0)
+                except Exception as e:
+                    # a failed AOT lower/compile (e.g. an executable that
+                    # refuses serialization on this backend) must not take
+                    # the solve down with it: the jit path is the answer
+                    self._reject(key, f"compile:{type(e).__name__}")
+                    return inner(batch)
+            # device faults from here MUST propagate: the supervisor owns
+            # retry/failover classification, not the cache
+            return T._PackedHandle(exe(*dyn), cl)
+
+        return dispatch

@@ -1,0 +1,206 @@
+"""SLO-burn autoscaler for the serve fleet (ISSUE 16).
+
+Runs inside the router process (the only place with a fleet-wide view) and
+is driven by the router's poll loop: every sweep hands it the fresh peer
+table and it decides spawn / drain / nothing.
+
+Policy, deliberately simple and bounded:
+
+- **signal**: fleet burn = max burn over READY peers (the router already
+  spills around a hot owner, so the scale trigger is "even the spill
+  targets are hot"). Band changes emit ``scale.burn`` — the audit trail
+  that lets the sentinel correlate scale-outs with their p99 outcome.
+- **scale-out**: burn >= ``spawn_burn`` sustained for ``sustain_s``
+  (instantaneous spikes don't buy hardware) AND past ``cooldown_s`` since
+  the last spawn (a cold peer takes a while to turn ready — spawning again
+  before the first one warms is the spawn-storm failure mode the cooldown
+  exists to prevent) AND live < ``max_peers``. Spawns one
+  ``daccord-serve`` subprocess with the same peer-dir (so it announces
+  itself and joins the takeover group) and the fleet-shared AOT cache dir
+  (so its cold TTFR is a deserialize, not a compile).
+- **scale-in**: a peer this autoscaler spawned (never a peer someone else
+  owns) that has been idle — no queued/running jobs — past ``idle_ttl_s``
+  while the fleet holds more than ``min_peers`` gets a graceful
+  ``POST /v1/shutdown`` (``scale.drain``). The drain path releases its job
+  leases; if it dies unclean instead, the PR 15 takeover path re-homes its
+  jobs — reaping is safe either way. Process exit emits ``scale.reap``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+
+@dataclass
+class AutoscaleConfig:
+    peer_dir: str                     # shared lease root (joins the group)
+    root: str                         # new peers live at <root>/peer<N>/
+    max_peers: int = 4
+    min_peers: int = 1
+    spawn_burn: float = 1.0           # fleet burn >= this arms the trigger
+    sustain_s: float = 5.0            # ... for this long
+    cooldown_s: float = 30.0          # min gap between spawns
+    idle_ttl_s: float = 120.0         # idle spawned peer older than this
+                                      # drains (0 = never scale in)
+    backend: str = "native"
+    batch: int = 64
+    workers: int = 2
+    slo_p99_s: float = 0.0            # forwarded so new peers burn-report
+    extra_args: tuple = field(default_factory=tuple)
+    spawn_env: dict = field(default_factory=dict)
+
+
+class Autoscaler:
+    """Owns the peers it spawned (pid + workdir); everything else in the
+    fleet is read-only to it."""
+
+    def __init__(self, cfg: AutoscaleConfig, log):
+        self.cfg = cfg
+        self.log = log
+        os.makedirs(cfg.root, exist_ok=True)
+        self._spawned: dict[str, dict] = {}   # peer name -> {proc, ...}
+        self._seq = 0
+        self._burn_since: float | None = None
+        self._last_spawn_ts = 0.0
+        self._band = -1
+        self._idle_since: dict[str, float] = {}
+        self.counters = {"spawns": 0, "drains": 0, "reaps": 0}
+
+    # -- helpers -----------------------------------------------------------
+
+    def _peer_name(self, workdir: str) -> str:
+        # must match ConsensusService.service_id (announce lease basename)
+        return os.path.basename(os.path.abspath(workdir))
+
+    def _spawn(self) -> None:
+        self._seq += 1
+        workdir = os.path.join(self.cfg.root, f"autopeer{self._seq}")
+        ready = os.path.join(workdir, "ready.port")
+        os.makedirs(workdir, exist_ok=True)
+        cmd = [sys.executable, "-m", "daccord_tpu.tools.cli", "serve",
+               "--workdir", workdir,
+               "--backend", self.cfg.backend,
+               "-b", str(self.cfg.batch),
+               "--workers", str(self.cfg.workers),
+               "--port", "0",
+               "--ready-file", ready,
+               "--peer-dir", self.cfg.peer_dir]
+        if self.cfg.slo_p99_s:
+            cmd += ["--slo-p99-s", str(self.cfg.slo_p99_s)]
+        cmd += list(self.cfg.extra_args)
+        env = dict(os.environ, **self.cfg.spawn_env)
+        proc = subprocess.Popen(
+            cmd, env=env,
+            stdout=open(os.path.join(workdir, "serve.out"), "wb"),
+            stderr=subprocess.STDOUT)
+        name = self._peer_name(workdir)
+        self._spawned[name] = {"proc": proc, "workdir": workdir,
+                               "spawn_ts": time.time()}
+        self._last_spawn_ts = time.time()
+        self.counters["spawns"] += 1
+        self.log.log("scale.spawn", peer=name, pid=proc.pid,
+                     workdir=workdir, n_spawned=len(self._spawned))
+
+    def _drain(self, name: str, url: str) -> None:
+        try:
+            req = urllib.request.Request(url + "/v1/shutdown", method="POST",
+                                         data=b"{}")
+            with urllib.request.urlopen(req, timeout=10.0):
+                pass
+        except Exception:
+            # unreachable: the process is likely already dead; the reap
+            # sweep below collects it and takeover re-homes any jobs
+            pass
+        self.counters["drains"] += 1
+        self.log.log("scale.drain", peer=name, reason="idle_ttl")
+
+    def _reap(self) -> None:
+        for name, info in list(self._spawned.items()):
+            rc = info["proc"].poll()
+            if rc is None:
+                continue
+            del self._spawned[name]
+            self._idle_since.pop(name, None)
+            self.counters["reaps"] += 1
+            self.log.log("scale.reap", peer=name, rc=int(rc),
+                         life_s=round(time.time() - info["spawn_ts"], 3))
+
+    # -- the per-sweep decision -------------------------------------------
+
+    def tick(self, peers: list) -> None:
+        """One decision pass over the router's freshly-polled peer table
+        (``peers`` are router.Peer objects)."""
+        now = time.time()
+        self._reap()
+        ready = [p for p in peers if p.ready]
+        live = [p for p in peers if p.alive]
+
+        # burn signal + band audit trail
+        burn = max((p.burn for p in ready), default=0.0)
+        band = int(min(burn, 5.0) * 10)
+        if band != self._band:
+            self._band = band
+            self.log.log("scale.burn", burn=round(burn, 4), band=band,
+                         n_ready=len(ready), n_live=len(live))
+
+        # scale-out: sustained burn, cooled down, under the cap
+        if burn >= self.cfg.spawn_burn and ready:
+            if self._burn_since is None:
+                self._burn_since = now
+            sustained = now - self._burn_since >= self.cfg.sustain_s
+            cooled = now - self._last_spawn_ts >= self.cfg.cooldown_s
+            capacity = len(live) + self._n_pending() < self.cfg.max_peers
+            if sustained and cooled and capacity:
+                self._spawn()
+        else:
+            self._burn_since = None
+
+        # scale-in: OUR idle peers past TTL, keeping min_peers alive
+        if self.cfg.idle_ttl_s <= 0:
+            return
+        by_name = {p.name: p for p in peers}
+        for name in list(self._spawned):
+            p = by_name.get(name)
+            if p is None or not p.alive:
+                continue
+            idle = p.jobs_active == 0 and p.queue_depth == 0
+            if not idle:
+                self._idle_since.pop(name, None)
+                continue
+            first = self._idle_since.setdefault(name, now)
+            if now - first >= self.cfg.idle_ttl_s and \
+                    len(live) > self.cfg.min_peers:
+                self._idle_since.pop(name, None)
+                self._drain(name, p.url)
+
+    def _n_pending(self) -> int:
+        """Spawned processes that haven't announced/turned ready yet still
+        count against max_peers — that's the spawn-storm guard."""
+        return sum(1 for i in self._spawned.values()
+                   if i["proc"].poll() is None)
+
+    def stats(self) -> dict:
+        return {"spawned": sorted(self._spawned),
+                "burn_band": self._band, **self.counters}
+
+    def shutdown(self) -> None:
+        """Drain every peer we own (router shutdown): graceful stop, then
+        a bounded wait; a peer that won't die is left for takeover."""
+        for name, info in list(self._spawned.items()):
+            proc = info["proc"]
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.time() + 15.0
+        for info in self._spawned.values():
+            proc = info["proc"]
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self._reap()

@@ -146,6 +146,11 @@ class GroupConfig:
                                  # dispatch at batch >> shed_levels — the
                                  # batch ladder as the overload policy
                                  # (ISSUE 10 (c)); mutated via set_shed
+    aot_dir: str | None = None   # fleet-shared AOT executable cache dir
+                                 # (ISSUE 16, serve/aotcache.py): jitted
+                                 # stream dispatches load/publish serialized
+                                 # executables here so a fresh peer's first
+                                 # solve skips the cold compile; None = off
     governor: GovernorConfig = field(default_factory=GovernorConfig.from_env)
 
 
@@ -186,6 +191,7 @@ class SolveGroup:
         self._sync_engine = gcfg.backend == "native"
         self.ladder = None
         self.mesh_solver = None      # set when gcfg.mesh > 1 (JAX backends)
+        self.aot = None              # AotCache when gcfg.aot_dir (ISSUE 16)
         self._profile = profile
         self._hp_ols = None          # lazy; native groups set it at build
         self._build_solver(profile, cfg)
@@ -260,7 +266,12 @@ class SolveGroup:
                              devices=self.mesh_solver.describe(),
                              esc_cap=int(
                                  self.mesh_solver._esc_cap_for(g.batch)))
-            elif is_cpu and g.ladder_mode != "split" and not g.paged:
+            elif is_cpu and g.ladder_mode != "split" and not g.paged \
+                    and not g.aot_dir:
+                # with an AOT cache configured the CPU group falls through
+                # to the packed-jit dispatcher below instead: solve_tiered
+                # solves eagerly per tier and has no whole-program
+                # executable to serialize (same ladder numerics either way)
                 from ..kernels.tiers import solve_tiered
 
                 dispatch = (lambda b: solve_tiered(b, ladder))
@@ -273,8 +284,22 @@ class SolveGroup:
                 from ..kernels.window_kernel import pallas_needs_interpret
 
                 interp = g.use_pallas and pallas_needs_interpret()
-                dispatch = stream_dispatcher(ladder, use_pallas=g.use_pallas,
-                                             pallas_interpret=interp)
+                if g.aot_dir:
+                    # fleet-shared AOT executable cache (ISSUE 16): the
+                    # same routing as stream_dispatcher, but each shape's
+                    # program loads from / publishes to the shared cache —
+                    # a freshly spawned peer's first dispatch deserializes
+                    # in <1 s instead of paying the cold jit compile
+                    from .aotcache import AotCache
+
+                    self.aot = AotCache(g.aot_dir, log=self.log)
+                    dispatch = self.aot.dispatcher(
+                        ladder, use_pallas=g.use_pallas,
+                        pallas_interpret=interp, fp_prefix=prefix)
+                else:
+                    dispatch = stream_dispatcher(
+                        ladder, use_pallas=g.use_pallas,
+                        pallas_interpret=interp)
                 fetch = _fetch
                 fetch_many = _fetch_many
                 clamp = _make_clamp_solve(ladder, g.use_pallas, interp,
@@ -499,7 +524,8 @@ class SolveGroup:
                     "busy": not locked,
                     "saturation": self.saturation(),
                     "degraded": self.sup.failed_over,
-                    "governor": self.sup.governor.counters.copy()}
+                    "governor": self.sup.governor.counters.copy(),
+                    **({"aot": self.aot.stats()} if self.aot else {})}
         finally:
             if locked:
                 self._lock.release()

@@ -137,6 +137,11 @@ class ServeHandler(BaseHTTPRequestHandler):
         if path == "/v1/healthz":
             # lock-free-ish liveness: must never queue behind a group's
             # solve lock (a jit compile holds it for minutes)
+            if self.headers.get("X-Daccord-Router"):
+                # a front-door router is polling us (ISSUE 16): arm the
+                # evict-vs-route grace so the idle sweep defers evicting
+                # groups the router's stickiness still points at
+                self.svc.warm.note_router_heartbeat()
             return self._send(200, self.svc.health())
         if path == "/v1/metrics":
             if self._query().get("format") == "prom":

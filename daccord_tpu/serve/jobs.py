@@ -339,6 +339,7 @@ def run_job(job: Job, service) -> None:
                                 mesh=scfg.group_mesh())
         group = service.warm.acquire(
             key, lambda: service.build_group(key, profile, cfg))
+        service.note_tenant_key(job.tenant, key)
         job.group = group.name
         solver = group.job_solver(job.id)
         t_first = None

@@ -1,0 +1,531 @@
+"""Stateless tenant router: the serve fleet's front door (ISSUE 16).
+
+PR 15 made a single peer crash-durable and PR 13 gave it an SLO burn
+signal, but clients still picked peers by hand and a saturated peer shed
+instead of spilling. The router is the thin stateless tier in front
+(ParaFold's split of routing/admission from stateful solve, applied at the
+fleet boundary):
+
+- **discovery**: peers are read from the takeover group's shared lease dir
+  — every ``daccord-serve --peer-dir`` process announces its URL at
+  ``<peer_dir>/peers/<service_id>.lease`` (``ConsensusService.announce``)
+  and renews it on the job-lease heartbeat, so a dead peer's announce goes
+  stale on the same clock as its job leases. A lock-free ``/v1/healthz``
+  poll (with the ``X-Daccord-Router`` header that arms the peers'
+  evict-vs-route grace) layers liveness + the ``ready`` flag on top:
+  ``ready`` distinguishes warm from mid-compile, because a peer minutes
+  into a cold jit is alive and yet a terrible routing target.
+- **stickiness**: rendezvous (highest-random-weight) hashing of tenant →
+  ready peer. Warmth — compiled programs, governor ratchets, shape
+  families — lives per peer, so a tenant bouncing between peers pays N
+  cold builds for N peers; rendezvous keeps the map stable under peer
+  arrival/departure with no coordination and no state to lose (a restarted
+  router computes the identical map, which is what "stateless" buys).
+- **spill**: when the owner's admission is paused (shed level > 0), it is
+  not ready, or its SLO burn band is red (>= ``spill_burn``), the job
+  spills to the least-loaded ready peer instead of queuing behind the
+  burn. Stickiness is a preference, not a cage.
+- **proxying**: submit/result/stream/abort forward verbatim — including
+  the client's ``idempotency_key``, which is what makes a mid-proxy router
+  or peer crash already-exactly-once: the client retries the SAME key and
+  the fleet dedupes (journal-backed), whether the retry lands on the same
+  peer or, after a takeover, on its successor. The router holds no job
+  state a crash could lose; its job→peer map is a cache rebuilt by
+  fan-out on miss.
+
+The router's own telemetry (``router.events.jsonl``: ``router.*`` routing
+milestones + ``scale.*`` from the optional autoscaler) rides the same
+eventcheck/trace/sentinel chain as every other sidecar in the repo.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils import lease
+from .service import _LockedLogger
+
+# hop-by-hop headers a proxy must not forward (RFC 9110 §7.6.1)
+_HOP_HEADERS = {"connection", "keep-alive", "proxy-authenticate",
+                "proxy-authorization", "te", "trailer",
+                "transfer-encoding", "upgrade", "host", "content-length"}
+
+
+@dataclass
+class RouterConfig:
+    workdir: str = "daccord-router"
+    peer_dir: str = ""               # the takeover group's shared lease root
+    poll_s: float = 1.0              # healthz poll cadence
+    lease_ttl_s: float = 15.0        # announce lease older than this = down
+    spill_burn: float = 1.0          # owner burn >= this (red band) → spill
+    proxy_timeout_s: float = 600.0   # per proxied request (result?wait=1
+                                     # legitimately blocks for minutes)
+    events_path: str | None = None   # default <workdir>/router.events.jsonl
+
+
+@dataclass
+class Peer:
+    name: str                        # service_id (announce lease basename)
+    url: str
+    alive: bool = False              # lease fresh + healthz answering
+    ready: bool = False              # healthz.ready (warm, replay done)
+    shed_level: int = 0
+    queue_depth: int = 0
+    burn: float = 0.0
+    jobs_active: int = 0             # queued+running (healthz.jobs)
+    last_ok_ts: float = 0.0
+    health: dict = field(default_factory=dict)
+
+    def load(self) -> tuple:
+        """Least-loaded ordering key for spill targets."""
+        return (self.jobs_active + self.queue_depth, self.burn)
+
+
+class Router:
+    """Peer table + routing policy + the proxy core. The HTTP tier
+    (:func:`start_router`) is a thin shell over :meth:`proxy` /
+    :meth:`route`; everything testable lives here."""
+
+    def __init__(self, cfg: RouterConfig, log=None):
+        if not cfg.peer_dir:
+            raise ValueError("router needs a peer_dir (the takeover "
+                             "group's shared lease root) to discover peers")
+        self.cfg = cfg
+        os.makedirs(cfg.workdir, exist_ok=True)
+        ev = cfg.events_path or os.path.join(cfg.workdir,
+                                             "router.events.jsonl")
+        self.log = log if log is not None else \
+            _LockedLogger(ev, buffer_lines=16, flush_s=1.0)
+        self._lock = threading.Lock()
+        self.peers: dict[str, Peer] = {}
+        self._job_map: dict[str, str] = {}    # job id -> peer name (cache)
+        self.counters = {"routes": 0, "spills": 0, "proxied": 0,
+                         "proxy_errors": 0, "fanouts": 0}
+        self.autoscaler = None                # attached by start_router
+        self._stop = threading.Event()
+        self.started_ts = time.time()
+        self.log.log("router.start", workdir=cfg.workdir,
+                     peer_dir=cfg.peer_dir, pid=os.getpid())
+        self._poller = threading.Thread(target=self._poll_loop, daemon=True,
+                                        name="daccord-router-poll")
+        self._poller.start()
+
+    # ------------------------------------------------------------------
+    # discovery: announce leases + healthz polls
+    # ------------------------------------------------------------------
+
+    def _scan_announces(self) -> dict[str, str]:
+        """name -> url from fresh announce leases (stale = peer presumed
+        dead; its job leases are going stale on the same clock and the
+        takeover path owns recovery — the router only stops routing there)."""
+        import glob as _glob
+
+        out: dict[str, str] = {}
+        for path in _glob.glob(os.path.join(self.cfg.peer_dir, "peers",
+                                            "*.lease")):
+            age = lease.stale_s(path)
+            if age is None or age > self.cfg.lease_ttl_s:
+                continue
+            info = lease.read(path)
+            if info and info.get("url"):
+                name = os.path.basename(path).rsplit(".lease", 1)[0]
+                out[name] = str(info["url"])
+        return out
+
+    def _poll_one(self, peer: Peer) -> None:
+        """One lock-free healthz poll; the X-Daccord-Router header arms the
+        peer's evict-vs-route grace window."""
+        try:
+            req = urllib.request.Request(
+                peer.url + "/v1/healthz",
+                headers={"X-Daccord-Router": "1"})
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                h = json.loads(resp.read())
+        except Exception:
+            peer.alive = False
+            peer.ready = False
+            return
+        peer.alive = bool(h.get("ok"))
+        peer.ready = bool(h.get("ready"))
+        peer.shed_level = int(h.get("shed_level", 0) or 0)
+        peer.queue_depth = int(h.get("queue_depth", 0) or 0)
+        peer.burn = float(h.get("burn", 0.0) or 0.0)
+        jobs = h.get("jobs") or {}
+        peer.jobs_active = int(jobs.get("queued", 0)) + \
+            int(jobs.get("running", 0))
+        peer.last_ok_ts = time.time()
+        peer.health = h
+
+    def refresh(self) -> None:
+        """One discovery+poll sweep (the poll loop's body; tests call it
+        directly for determinism)."""
+        announced = self._scan_announces()
+        with self._lock:
+            known = dict(self.peers)
+        for name, url in announced.items():
+            p = known.get(name)
+            if p is None:
+                p = Peer(name=name, url=url)
+                with self._lock:
+                    self.peers[name] = p
+            p.url = url
+        for name, p in list(known.items()):
+            if name not in announced:
+                # stale/released announce: the peer is gone
+                if p.alive:
+                    self.log.log("router.peer_down", peer=name,
+                                 reason="lease_stale")
+                with self._lock:
+                    self.peers.pop(name, None)
+        with self._lock:
+            peers = list(self.peers.values())
+        for p in peers:
+            was = p.alive
+            self._poll_one(p)
+            if p.alive and not was:
+                self.log.log("router.peer_up", peer=p.name, url=p.url,
+                             ready=p.ready)
+            elif was and not p.alive:
+                self.log.log("router.peer_down", peer=p.name,
+                             reason="healthz")
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.cfg.poll_s):
+            try:
+                self.refresh()
+                if self.autoscaler is not None:
+                    self.autoscaler.tick(self.snapshot_peers())
+            except Exception as e:  # noqa: BLE001 — the poller must survive
+                try:
+                    self.log.log("router.proxy_error", peer="-",
+                                 error=f"poll:{type(e).__name__}"[:200])
+                except Exception:
+                    pass
+
+    def snapshot_peers(self) -> list[Peer]:
+        with self._lock:
+            return list(self.peers.values())
+
+    # ------------------------------------------------------------------
+    # routing policy
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _score(tenant: str, peer_name: str) -> int:
+        """Rendezvous weight: every router instance (and every restart)
+        ranks peers identically from the hash alone — the stateless
+        stickiness that keeps a tenant on the peer whose groups are warm."""
+        h = hashlib.sha256(f"{tenant}|{peer_name}".encode()).digest()
+        return int.from_bytes(h[:8], "big")
+
+    def owner_of(self, tenant: str, peers: list[Peer] | None = None) -> Peer | None:
+        """The rendezvous owner among ALIVE peers. Readiness deliberately
+        does NOT move ownership (the map must stay stable while a peer is
+        briefly mid-compile) — :meth:`route` spills off a not-ready owner
+        instead, and comes back when it warms."""
+        peers = self.snapshot_peers() if peers is None else peers
+        pool = [p for p in peers if p.alive]
+        if not pool:
+            return None
+        return max(pool, key=lambda p: self._score(tenant, p.name))
+
+    def route(self, tenant: str, job: str | None = None) -> Peer | None:
+        """The peer ``tenant``'s next job should land on: the rendezvous
+        owner unless its admission is pausing (shed), it lost readiness, or
+        its burn band is red — then the least-loaded OTHER ready peer
+        (spill). Returns None when the fleet is empty/unreachable."""
+        peers = self.snapshot_peers()
+        owner = self.owner_of(tenant, peers)
+        if owner is None:
+            return None
+        chosen, spilled, reason = owner, False, None
+        if not owner.ready:
+            reason = "not_ready"
+        elif owner.shed_level > 0:
+            reason = "shed"
+        elif self.cfg.spill_burn and owner.burn >= self.cfg.spill_burn:
+            reason = "burn"
+        if reason is not None:
+            others = [p for p in peers if p.ready and p.name != owner.name]
+            if others:
+                chosen = min(others, key=Peer.load)
+                spilled = True
+            # nobody to spill to: the owner (alive, maybe shedding) still
+            # beats a refusal — its admission plane is the backstop
+        self.counters["routes"] += 1
+        if spilled:
+            self.counters["spills"] += 1
+            self.log.log("router.spill", tenant=tenant, owner=owner.name,
+                         to=chosen.name, reason=reason)
+        self.log.log("router.route", tenant=tenant, peer=chosen.name,
+                     spilled=spilled, **({"job": job} if job else {}))
+        return chosen
+
+    # ------------------------------------------------------------------
+    # proxy core
+    # ------------------------------------------------------------------
+
+    def mark_dead(self, peer: Peer, reason: str = "proxy_error") -> None:
+        """A proxy just failed against ``peer``: stop routing there NOW
+        (the next healthz poll re-checks). Logging the transition here —
+        not in the poll loop — keeps ``router.peer_down`` exact when the
+        proxy error is what discovered the death."""
+        if peer.alive:
+            self.log.log("router.peer_down", peer=peer.name, reason=reason)
+        peer.alive = False
+        peer.ready = False
+
+    def note_job(self, job_id: str, peer_name: str) -> None:
+        with self._lock:
+            self._job_map[job_id] = peer_name
+
+    def peer_for_job(self, job_id: str) -> Peer | None:
+        """The peer owning ``job_id``: the cached mapping when fresh, else
+        a fan-out probe of every live peer (the cache is just a cache — a
+        restarted router, or a job that moved by takeover, rebuilds it)."""
+        with self._lock:
+            name = self._job_map.get(job_id)
+            p = self.peers.get(name) if name else None
+        if p is not None and p.alive:
+            return p
+        self.counters["fanouts"] += 1
+        for p in self.snapshot_peers():
+            if not p.alive:
+                continue
+            try:
+                req = urllib.request.Request(p.url + f"/v1/jobs/{job_id}")
+                with urllib.request.urlopen(req, timeout=5.0):
+                    self.note_job(job_id, p.name)
+                    return p
+            except urllib.error.HTTPError:
+                continue
+            except Exception:
+                continue
+        return None
+
+    def proxy(self, peer: Peer, method: str, path: str,
+              body: bytes | None = None,
+              headers: dict | None = None) -> tuple[int, bytes, str]:
+        """Forward one request; returns (status, body, content_type).
+        Raises URLError/OSError on transport failure (the caller maps that
+        to 502 + retryable, and the client's idempotency key makes the
+        retry exactly-once)."""
+        req = urllib.request.Request(
+            peer.url + path, method=method, data=body,
+            headers={k: v for k, v in (headers or {}).items()
+                     if k.lower() not in _HOP_HEADERS})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.cfg.proxy_timeout_s) as resp:
+                self.counters["proxied"] += 1
+                return (resp.status, resp.read(),
+                        resp.headers.get("Content-Type",
+                                         "application/json"))
+        except urllib.error.HTTPError as e:
+            # an HTTP-level refusal (429/503/404...) is a valid answer,
+            # not a transport failure — forward it verbatim
+            self.counters["proxied"] += 1
+            return (e.code, e.read(),
+                    e.headers.get("Content-Type", "application/json"))
+
+    def stats(self) -> dict:
+        peers = self.snapshot_peers()
+        with self._lock:
+            jmap = dict(self._job_map)
+        out = {"ok": True, "ready": any(p.ready for p in peers),
+               "uptime_s": round(time.time() - self.started_ts, 3),
+               "peers": [{"name": p.name, "url": p.url, "alive": p.alive,
+                          "ready": p.ready, "shed": p.shed_level,
+                          "queue_depth": p.queue_depth, "burn": p.burn,
+                          "jobs_active": p.jobs_active}
+                         for p in sorted(peers, key=lambda p: p.name)],
+               "jobs": jmap, **self.counters}
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.stats()
+        return out
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._poller.join(timeout=5.0)
+        if self.autoscaler is not None:
+            self.autoscaler.shutdown()
+        self.log.log("router.done",
+                     wall_s=round(time.time() - self.started_ts, 3),
+                     **self.counters)
+        self.log.close()
+
+
+class RouterHandler(BaseHTTPRequestHandler):
+    """The proxy shell: tenant-routed submits, job-mapped result/stream/
+    abort forwards, the router's own healthz/stats. HTTP/1.1 with explicit
+    Content-Length (keep-alive safe), like the serve handler it fronts."""
+
+    protocol_version = "HTTP/1.1"
+    server_version = "daccord-router/0.1"
+
+    @property
+    def rt(self) -> Router:
+        return self.server.router  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # noqa: A002
+        pass
+
+    def _send(self, code: int, obj=None, body: bytes | None = None,
+              ctype: str = "application/json") -> None:
+        if body is None:
+            body = (json.dumps(obj) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        return self.rfile.read(n) if n > 0 else b""
+
+    def _forward(self, peer, method: str, body: bytes | None = None):
+        """Proxy + map transport failure to a retryable 502 (the client's
+        idempotency key carries exactly-once across the retry)."""
+        try:
+            code, data, ctype = self.rt.proxy(peer, method, self.path, body,
+                                              dict(self.headers))
+        except Exception as e:
+            self.rt.counters["proxy_errors"] += 1
+            self.rt.log.log("router.proxy_error", peer=peer.name,
+                            error=f"{type(e).__name__}: {e}"[:200])
+            self.rt.mark_dead(peer)
+            return self._send(502, {"error": f"peer {peer.name} unreachable",
+                                    "peer": peer.name, "retryable": True})
+        return self._send(code, body=data, ctype=ctype)
+
+    def _job_route(self):
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        if len(parts) >= 3 and parts[0] == "v1" and parts[1] == "jobs":
+            return parts[2], (parts[3] if len(parts) > 3 else None)
+        return None, None
+
+    def do_POST(self) -> None:  # noqa: N802
+        path = self.path.split("?")[0]
+        if path == "/v1/jobs":
+            raw = self._read_body()
+            try:
+                body = json.loads(raw) if raw else {}
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, json.JSONDecodeError) as e:
+                return self._send(400, {"error": f"bad body: {e}"})
+            tenant = str(body.get("tenant", "default"))
+            peer = self.rt.route(tenant)
+            if peer is None:
+                return self._send(503, {"error": "no ready peers",
+                                        "retryable": True})
+            try:
+                code, data, ctype = self.rt.proxy(peer, "POST", self.path,
+                                                  raw, dict(self.headers))
+            except Exception as e:
+                self.rt.counters["proxy_errors"] += 1
+                self.rt.log.log("router.proxy_error", peer=peer.name,
+                                error=f"{type(e).__name__}: {e}"[:200])
+                self.rt.mark_dead(peer)
+                return self._send(502, {"error":
+                                        f"peer {peer.name} unreachable",
+                                        "peer": peer.name,
+                                        "retryable": True})
+            if code in (200, 201):
+                try:
+                    jid = json.loads(data).get("job")
+                    if jid:
+                        self.rt.note_job(str(jid), peer.name)
+                except (ValueError, json.JSONDecodeError):
+                    pass
+            return self._send(code, body=data, ctype=ctype)
+        if path == "/v1/shutdown":
+            threading.Thread(target=self._shutdown_later,
+                             daemon=True).start()
+            return self._send(200, {"state": "draining"})
+        self._send(404, {"error": "unknown route"})
+
+    def _shutdown_later(self) -> None:
+        self.rt.shutdown()
+        self.server.shutdown()  # type: ignore[attr-defined]
+
+    def do_GET(self) -> None:  # noqa: N802
+        path = self.path.split("?")[0]
+        if path == "/v1/healthz" or path == "/v1/router":
+            # the router's own state (daccord-top's ROUTER panel): peer
+            # table + ownership cache + spill/scale counters
+            return self._send(200, self.rt.stats())
+        job_id, sub = self._job_route()
+        if job_id is None:
+            return self._send(404, {"error": "unknown route"})
+        peer = self.rt.peer_for_job(job_id)
+        if peer is None:
+            return self._send(404, {"error": f"unknown job {job_id!r}"})
+        if sub == "stream":
+            return self._proxy_stream(peer)
+        return self._forward(peer, "GET")
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        job_id, _sub = self._job_route()
+        if job_id is None:
+            return self._send(404, {"error": "unknown route"})
+        peer = self.rt.peer_for_job(job_id)
+        if peer is None:
+            return self._send(404, {"error": f"unknown job {job_id!r}"})
+        return self._forward(peer, "DELETE")
+
+    def _proxy_stream(self, peer) -> None:
+        """Chunked passthrough of a live FASTA stream. A peer death
+        mid-stream surfaces to the client as a torn stream (exactly what a
+        direct connection would do); the job itself survives via the peer's
+        journal, and the client re-fetches the result."""
+        try:
+            req = urllib.request.Request(peer.url + self.path)
+            resp = urllib.request.urlopen(req,
+                                          timeout=self.rt.cfg.proxy_timeout_s)
+        except Exception as e:
+            self.rt.counters["proxy_errors"] += 1
+            self.rt.log.log("router.proxy_error", peer=peer.name,
+                            error=f"{type(e).__name__}: {e}"[:200])
+            self.rt.mark_dead(peer)
+            return self._send(502, {"error": f"peer {peer.name} unreachable",
+                                    "retryable": True})
+        self.send_response(resp.status)
+        self.send_header("Content-Type",
+                         resp.headers.get("Content-Type", "text/x-fasta"))
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            with resp:
+                while True:
+                    data = resp.read(1 << 16)
+                    if not data:
+                        break
+                    self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            self.close_connection = True
+
+
+def start_router(router: Router, host: str = "127.0.0.1", port: int = 0):
+    """Bind + start the router front-end on a daemon thread; returns
+    ``(httpd, bound_port, thread)`` — the serve tier's start_server shape."""
+    httpd = ThreadingHTTPServer((host, port), RouterHandler)
+    httpd.daemon_threads = True
+    httpd.router = router  # type: ignore[attr-defined]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="daccord-router-http")
+    t.start()
+    return httpd, httpd.server_address[1], t

@@ -127,6 +127,15 @@ class ServeConfig:
                                      # <workdir-basename>:<pid>
     lease_ttl_s: float = 15.0        # older per-job lease is stale (takeover)
     heartbeat_s: float = 1.0         # lease renewal + takeover-scan cadence
+    aot_dir: str | None = None       # fleet-shared AOT executable cache
+                                     # (ISSUE 16, serve/aotcache.py): jitted
+                                     # solve groups load serialized
+                                     # executables from / publish them to
+                                     # this shared-FS dir, so a freshly
+                                     # spawned peer answers its first job
+                                     # warm. Conventionally
+                                     # <peer_dir>/aotcache (the serve CLI
+                                     # defaults it there). None = off
     drain_deadline_s: float = 0.0    # bounded graceful shutdown: >0 means a
                                      # drain that outlives this many seconds
                                      # journal-marks in-flight jobs
@@ -179,6 +188,14 @@ class ConsensusService:
         self._lease_lock = threading.Lock()
         self._owned_leases: dict[str, str] = {}   # job id -> lease path
         self._idem: dict[str, str | None] = {}    # idem key -> job id
+        # front door (ISSUE 16): the announce lease (peer discovery for the
+        # router — <peer_dir>/peers/<service_id>.lease carrying our URL),
+        # readiness (journal replay finished AND no group build in flight),
+        # and the tenant -> group-key map behind the evict-vs-route guard
+        self._announce_url: str | None = None
+        self._announce_path: str | None = None
+        self._replay_done = not cfg.journal
+        self._tenant_keys: dict[str, set] = {}
         self.clean = True                         # last shutdown's verdict
         # resume the id sequence past any job dirs already in the (durable)
         # workdir — or named by the journal (a post-admit crash can journal
@@ -216,6 +233,8 @@ class ConsensusService:
         self._lat_lock = threading.Lock()
         self._slo_shed = 0
         self._slo_band: int | None = None
+        self._slo_burn_last = 0.0    # last computed burn (healthz: the
+                                     # router's spill + autoscaler signal)
         # lifetime peaks (ISSUE 13 satellite): the rollup must answer "how
         # bad did it GET", not just "how bad is it now"
         self._peak_rss_mb = 0.0
@@ -241,6 +260,7 @@ class ConsensusService:
             compact(self._journal_path, replayed)
             self.journal = JobJournal(self._journal_path, faults=self.faults)
             self._replay(replayed, torn)
+        self._replay_done = True
         self._workers = [
             threading.Thread(target=self._worker_loop, daemon=True,
                              name=f"daccord-serve-worker-{i}")
@@ -273,11 +293,20 @@ class ConsensusService:
                            page_len=scfg.page_len,
                            mesh=scfg.group_mesh(),
                            use_pallas=scfg.use_pallas,
-                           shed_levels=self._shed)
+                           shed_levels=self._shed,
+                           aot_dir=scfg.aot_dir)
         g = SolveGroup(key, profile, cfg, gcfg, log=glog, name=name)
         self.log_event("serve.group", group=name, key=key[:16],
                        backend=scfg.backend, batch=int(scfg.batch))
         return g
+
+    def note_tenant_key(self, tenant: str, key: str) -> None:
+        """Record that ``tenant``'s jobs solve on group ``key`` (called by
+        run_job at acquire time) and stamp the route — the tenant→warmth
+        map behind the evict-vs-route guard (ISSUE 16)."""
+        with self._jobs_lock:
+            self._tenant_keys.setdefault(tenant, set()).add(key)
+        self.warm.note_route(key)
 
     def observe_latency(self, job: Job) -> None:
         """Per-job latency histograms (p50/p95/p99 ride the snapshots)."""
@@ -705,6 +734,14 @@ class ConsensusService:
                 "service — peer-group workdir basenames must be unique",
                 retryable=False)
         self.metrics.counter("jobs_submitted").inc()
+        # evict-vs-route guard (ISSUE 16): a submit IS a route landing —
+        # stamp every group key this tenant has solved on, so the idle
+        # sweep cannot evict the group the router's stickiness sent this
+        # job to while its profile/fingerprint is still being computed
+        with self._jobs_lock:
+            keys = list(self._tenant_keys.get(tenant, ()))
+        for k in keys:
+            self.warm.note_route(k)
         self.log_event("serve.job", job=job_id, state=QUEUED,
                        tenant=spec.tenant)
         self._queue.put(job_id)
@@ -775,9 +812,19 @@ class ConsensusService:
         with self._lease_lock:
             held = sorted(self._owned_leases)
         return {"ok": True,
+                # ready != ok (ISSUE 16): up-but-mid-compile is alive yet a
+                # terrible routing target — the journal has replayed AND no
+                # group build (minutes of jit on a real chip) is in flight.
+                # WarmState.building() is a brief map scan, never a group
+                # lock, so the no-blocking contract above holds
+                "ready": bool(self._replay_done
+                              and self.warm.building() == 0),
                 "uptime_s": round(time.time() - self.started_ts, 3),
                 "jobs": states, "shed_level": self._shed,
                 "queue_depth": self._queue.qsize(),
+                # the router's spill/least-loaded signal (0.0 = no SLO
+                # tracking or an empty window)
+                "burn": self._slo_burn_last,
                 "groups_busy": {g.name: g.busy()
                                 for g in self.warm.groups()},
                 # crash-durable tier (ISSUE 15): this process's lease
@@ -912,6 +959,14 @@ class ConsensusService:
                 held = list(self._owned_leases)
             for jid in held:
                 self.release_job_lease(jid)
+        # the announce lease drops on ANY shutdown verdict: a draining-but-
+        # unclean peer is equally gone from the router's point of view (an
+        # unreleased announce would cost the router a TTL of proxy errors)
+        if self._announce_path is not None:
+            from ..utils import lease
+
+            lease.release(self._announce_path, host=self.peer)
+            self._announce_path = None
         self.events.close()
         self.clean = clean
         return clean
@@ -1032,6 +1087,29 @@ class ConsensusService:
                         and now - j.done_ts >= ttl):
                     del self.jobs[jid]
 
+    def announce(self, url: str) -> None:
+        """Publish this peer's HTTP address for front-door discovery
+        (ISSUE 16): an announce lease at
+        ``<peer_dir>/peers/<service_id>.lease`` carrying the URL, renewed
+        every ``_lease_tick`` — the job-lease protocol reused verbatim, so
+        a dead peer's announce goes stale on exactly the same clock as its
+        job leases and the router needs no second liveness protocol. No-op
+        without a peer_dir (solo deployments have no router)."""
+        if not self.cfg.peer_dir:
+            return
+        from ..utils import lease
+
+        path = os.path.join(self.cfg.peer_dir, "peers",
+                            f"{self.service_id}.lease")
+        # our service_id namespace (unique-basename rule): a previous
+        # incarnation's leftover announce is ours to replace
+        lease.release(path)
+        lease.claim(path, self.peer, self.cfg.lease_ttl_s,
+                    extra={"url": url, "service": self.service_id})
+        self._announce_url = url
+        self._announce_path = path
+        self.log_event("serve.announce", url=url, peer=self.peer)
+
     def _lease_tick(self) -> None:
         """The peer-takeover heartbeat (ISSUE 15), at ``heartbeat_s``
         cadence so a serve fleet never storms the shared FS:
@@ -1056,6 +1134,17 @@ class ConsensusService:
         from .jobs import JobSpec
 
         ttl = self.cfg.lease_ttl_s
+        # 0. renew the announce lease (router discovery, ISSUE 16) — same
+        # re-read-before-renew discipline as job leases; a vanished file
+        # (an operator rm) is simply re-announced
+        if self._announce_path is not None:
+            info = lease.read(self._announce_path)
+            if info is None:
+                lease.claim(self._announce_path, self.peer, ttl,
+                            extra={"url": self._announce_url,
+                                   "service": self.service_id})
+            elif info.get("host") == self.peer:
+                lease.renew(self._announce_path)
         # 1. renew (ownership-checked)
         with self._lease_lock:
             held = list(self._owned_leases.items())
@@ -1210,10 +1299,12 @@ class ConsensusService:
         if p99 is None:
             # an empty window (traffic stopped) must still release a held
             # rung per tick, or a past burst pins the shed ladder forever
+            self._slo_burn_last = 0.0
             if self._slo_shed:
                 self._slo_shed -= 1
             return
         burn = round(p99 / cfg.slo_p99_s, 3)
+        self._slo_burn_last = burn
         self.metrics.gauge("slo_burn").set(burn)
         self.metrics.gauge("slo_p99_s").set(round(p99, 4))
         if burn >= cfg.slo_shed_burn:

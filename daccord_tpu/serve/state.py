@@ -8,6 +8,16 @@ all survive from job to job here. Groups are keyed by solve fingerprint
 (``jobs.solve_fingerprint``); a hit means the Nth job starts solving
 immediately. Idle groups (refcount zero past the TTL) evict so a long-lived
 server's memory tracks its live workload mix, not its history.
+
+Front-door interplay (ISSUE 16): the router's rendezvous stickiness exists
+to keep a tenant's jobs landing on the peer whose groups are already warm —
+which the idle TTL can defeat by evicting the exact group the router is
+about to route to (the tenant paused just past the TTL; the router still
+owns them). When a router heartbeat is live (``note_router_heartbeat``, set
+by the proxied ``/v1/healthz`` poll), eviction therefore consults the
+last-routed timestamps the service records at submit time (``note_route``):
+a group whose key was routed within the grace window survives the sweep.
+Without a router (solo peer), behavior is exactly the pre-16 TTL.
 """
 
 from __future__ import annotations
@@ -17,14 +27,27 @@ import time
 
 
 class WarmState:
-    def __init__(self, idle_evict_s: float = 600.0, log=None):
+    # a router poll within this window counts as "a router is alive" (the
+    # default healthz cadence is ~1 s; 10 s tolerates a slow poll loop
+    # without keeping grace armed long after the router died)
+    ROUTER_FRESH_S = 10.0
+
+    def __init__(self, idle_evict_s: float = 600.0, log=None,
+                 route_grace_s: float = 30.0):
         from ..utils.obs import NullLogger
 
         self.idle_evict_s = float(idle_evict_s)
         self.log = log if log is not None else NullLogger()
         self._lock = threading.Lock()
         self._groups: dict[str, object] = {}
-        self.counters = {"hits": 0, "misses": 0, "evicted": 0}
+        self.counters = {"hits": 0, "misses": 0, "evicted": 0,
+                         "evict_deferred": 0}
+        # evict-vs-route race guard (ISSUE 16): last router heartbeat +
+        # per-key last-routed stamps; grace = how long a routed-to key
+        # outlives its idle TTL while a router is alive
+        self.route_grace_s = float(route_grace_s)
+        self._router_seen_ts = 0.0
+        self._last_routed: dict[str, float] = {}
 
     def acquire(self, key: str, factory):
         """The group for ``key`` (built via ``factory()`` on miss), with its
@@ -80,17 +103,53 @@ class WarmState:
                 g.refs = max(0, g.refs - 1)
                 g.last_used = time.time()
 
+    def note_router_heartbeat(self, now: float | None = None) -> None:
+        """A front-door router just polled this peer (the healthz handler
+        calls this on the ``X-Daccord-Router`` header) — arm the
+        evict-vs-route grace window."""
+        self._router_seen_ts = time.time() if now is None else now
+
+    def note_route(self, key: str, now: float | None = None) -> None:
+        """A job routed here was admitted for ``key`` — stamp it so the
+        idle sweep knows the router's stickiness still points at this
+        group even if no solve has touched it yet."""
+        with self._lock:
+            self._last_routed[key] = time.time() if now is None else now
+
+    def router_live(self, now: float | None = None) -> bool:
+        now = time.time() if now is None else now
+        return (now - self._router_seen_ts) < self.ROUTER_FRESH_S
+
     def evict_idle(self, now: float | None = None) -> int:
         """Close and drop groups idle (refcount 0) past the TTL; returns the
-        eviction count. A TTL of 0 evicts every idle group (tests/shutdown)."""
+        eviction count. A TTL of 0 evicts every idle group (tests/shutdown).
+
+        The evict-vs-route race (ISSUE 16): between the router choosing this
+        peer for a tenant (stickiness = this group is warm HERE) and that
+        tenant's next submit arriving, the TTL can expire and this sweep
+        would evict the exact group the router is routing to — the next job
+        then pays a cold build the whole front door exists to avoid. While a
+        router heartbeat is fresh, a key routed within ``route_grace_s``
+        therefore survives the sweep (deferred, not exempted: once the
+        router dies or the grace lapses, the TTL wins again)."""
         now = time.time() if now is None else now
         n = 0
+        router = self.router_live(now)
         with self._lock:
             for key, g in list(self._groups.items()):
                 if not self._built(g):
                     continue
                 if g.refs == 0 and now - g.last_used >= self.idle_evict_s:
+                    routed = self._last_routed.get(key)
+                    if (router and routed is not None
+                            and now - routed < self.route_grace_s):
+                        self.counters["evict_deferred"] += 1
+                        self.log.log("serve.evict_defer", group=g.name,
+                                     key=key[:16],
+                                     routed_s=round(now - routed, 3))
+                        continue
                     del self._groups[key]
+                    self._last_routed.pop(key, None)
                     self.counters["evicted"] += 1
                     n += 1
                     idle = now - g.last_used
@@ -98,6 +157,14 @@ class WarmState:
                                  idle_s=round(idle, 3))
                     g.close()
         return n
+
+    def building(self) -> int:
+        """In-progress group builds (the ``ready`` denominator: a peer with
+        a build in flight is up but not warm — the router should not
+        rendezvous new tenants onto it)."""
+        with self._lock:
+            return sum(1 for g in self._groups.values()
+                       if not self._built(g))
 
     def groups(self) -> list:
         with self._lock:

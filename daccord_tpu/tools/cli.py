@@ -1103,6 +1103,15 @@ def serve_main(argv=None) -> int:
                         "(resumable on restart) and exits NONZERO — a "
                         "wedged group thread can no longer hang shutdown "
                         "forever (0 = unbounded)")
+    # front door (ISSUE 16)
+    p.add_argument("--aot-cache", default=None, metavar="DIR",
+                   help="fleet-shared AOT executable cache: jitted solve "
+                        "groups load serialized compiled programs from (and "
+                        "publish to) this shared-FS dir, so a freshly "
+                        "spawned peer answers its first job warm instead of "
+                        "paying the cold jit compile. Default: "
+                        "$DACCORD_AOT_CACHE, else <peer-dir>/aotcache when "
+                        "--peer-dir is set; 'off' disables")
     args = p.parse_args(argv)
 
     backend_explicit = args.backend != "auto"
@@ -1139,6 +1148,13 @@ def serve_main(argv=None) -> int:
     from ..serve import AdmissionConfig, ConsensusService, ServeConfig
     from ..serve.http import start_server
 
+    aot_dir = args.aot_cache or os.environ.get("DACCORD_AOT_CACHE")
+    if not aot_dir and args.peer_dir:
+        # fleet convention (ISSUE 16): the executable cache lives beside
+        # the lease dir — every peer of a takeover group shares it
+        aot_dir = os.path.join(args.peer_dir, "aotcache")
+    if aot_dir in ("off", "none", "0"):
+        aot_dir = None
     cfg = ServeConfig(
         workdir=args.workdir, backend=args.backend,
         backend_explicit=backend_explicit, batch=args.batch,
@@ -1152,7 +1168,7 @@ def serve_main(argv=None) -> int:
         checkpoint_reads=args.checkpoint_reads,
         peer_dir=args.peer_dir, peer_name=args.peer_name,
         lease_ttl_s=args.lease_ttl_s, heartbeat_s=args.heartbeat_s,
-        drain_deadline_s=args.drain_deadline_s,
+        drain_deadline_s=args.drain_deadline_s, aot_dir=aot_dir,
         admission=AdmissionConfig(
             max_queued_jobs=args.max_queued,
             tenant_max_queued=args.tenant_max_queued,
@@ -1161,6 +1177,9 @@ def serve_main(argv=None) -> int:
         events_path=args.events)
     svc = ConsensusService(cfg)
     httpd, port, _t = start_server(svc, args.host, args.port)
+    # router discovery (ISSUE 16): publish our URL as an announce lease
+    # beside the job leases — no-op without --peer-dir
+    svc.announce(f"http://{args.host}:{port}")
     if args.ready_file:
         from ..utils.aio import durable_write
 
@@ -1194,6 +1213,117 @@ def serve_main(argv=None) -> int:
     # jobs journal-marked INTERRUPTED — exits nonzero so supervisors
     # (systemd, the soak driver) know to restart-and-replay
     return 0 if getattr(svc, "clean", True) else 1
+
+
+def router_main(argv=None) -> int:
+    """daccord-router: stateless front door for a serve fleet (ISSUE 16) —
+    discovers peers from the shared lease dir's announce leases, rendezvous-
+    hashes tenants to warm-group-owning peers (stickiness), spills around
+    shedding/red-burn owners, proxies submit/result/stream/abort with
+    idempotency keys passing through, and (optionally) runs the SLO-burn
+    autoscaler that spawns/reaps daccord-serve peers."""
+    p = argparse.ArgumentParser(prog="daccord-router",
+                                description=router_main.__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8946,
+                   help="listen port (0 = ephemeral; pair with --ready-file)")
+    p.add_argument("--workdir", required=True,
+                   help="router state root: router.events.jsonl telemetry")
+    p.add_argument("--peer-dir", required=True, metavar="DIR",
+                   help="the serve fleet's shared lease root (the SAME dir "
+                        "every daccord-serve --peer-dir points at): peers "
+                        "are discovered from its announce leases")
+    p.add_argument("--poll-s", type=float, default=1.0,
+                   help="healthz poll + discovery sweep cadence")
+    p.add_argument("--lease-ttl-s", type=float, default=15.0,
+                   help="an announce lease older than this = peer down")
+    p.add_argument("--spill-burn", type=float, default=1.0,
+                   help="owner SLO burn >= this (red band) spills the "
+                        "tenant to the least-loaded ready peer (0 = never "
+                        "spill on burn)")
+    p.add_argument("--proxy-timeout-s", type=float, default=600.0)
+    p.add_argument("--events", default=None, metavar="PATH",
+                   help="router events jsonl (router.* + scale.*; default "
+                        "WORKDIR/router.events.jsonl)")
+    p.add_argument("--ready-file", default=None, metavar="PATH",
+                   help="write {port, pid} JSON here once bound")
+    # SLO-burn autoscaler (off unless --autoscale-max > 0)
+    p.add_argument("--autoscale-max", type=int, default=0, metavar="N",
+                   help="enable the autoscaler with this fleet-size cap: "
+                        "sustained fleet burn spawns daccord-serve peers "
+                        "into --autoscale-root, idle spawned peers drain "
+                        "after --autoscale-idle-s (0 = autoscaler off)")
+    p.add_argument("--autoscale-min", type=int, default=1)
+    p.add_argument("--autoscale-root", default=None, metavar="DIR",
+                   help="workdir root for spawned peers (default "
+                        "WORKDIR/peers)")
+    p.add_argument("--autoscale-burn", type=float, default=1.0,
+                   help="fleet burn (max over ready peers) >= this arms the "
+                        "scale-out trigger")
+    p.add_argument("--autoscale-sustain-s", type=float, default=5.0)
+    p.add_argument("--autoscale-cooldown-s", type=float, default=30.0)
+    p.add_argument("--autoscale-idle-s", type=float, default=120.0,
+                   help="an idle spawned peer older than this drains "
+                        "(graceful shutdown; 0 = never scale in)")
+    p.add_argument("--autoscale-backend",
+                   choices=("auto", "cpu", "tpu", "native"), default="native")
+    p.add_argument("--autoscale-batch", type=int, default=64)
+    p.add_argument("--autoscale-workers", type=int, default=2)
+    p.add_argument("--autoscale-slo-p99-s", type=float, default=0.0,
+                   help="forwarded to spawned peers so they report burn")
+    p.add_argument("--autoscale-arg", action="append", default=[],
+                   metavar="ARG", help="extra daccord-serve flag for "
+                        "spawned peers (repeatable)")
+    args = p.parse_args(argv)
+
+    from ..serve.router import Router, RouterConfig, start_router
+
+    rcfg = RouterConfig(workdir=args.workdir, peer_dir=args.peer_dir,
+                        poll_s=args.poll_s, lease_ttl_s=args.lease_ttl_s,
+                        spill_burn=args.spill_burn,
+                        proxy_timeout_s=args.proxy_timeout_s,
+                        events_path=args.events)
+    router = Router(rcfg)
+    if args.autoscale_max > 0:
+        from ..serve.autoscale import AutoscaleConfig, Autoscaler
+
+        acfg = AutoscaleConfig(
+            peer_dir=args.peer_dir,
+            root=args.autoscale_root or os.path.join(args.workdir, "peers"),
+            max_peers=args.autoscale_max, min_peers=args.autoscale_min,
+            spawn_burn=args.autoscale_burn,
+            sustain_s=args.autoscale_sustain_s,
+            cooldown_s=args.autoscale_cooldown_s,
+            idle_ttl_s=args.autoscale_idle_s,
+            backend=args.autoscale_backend, batch=args.autoscale_batch,
+            workers=args.autoscale_workers,
+            slo_p99_s=args.autoscale_slo_p99_s,
+            extra_args=tuple(args.autoscale_arg))
+        router.autoscaler = Autoscaler(acfg, router.log)
+    httpd, port, _t = start_router(router, args.host, args.port)
+    if args.ready_file:
+        from ..utils.aio import durable_write
+
+        durable_write(args.ready_file,
+                      lambda fh: json.dump({"port": port,
+                                            "pid": os.getpid()}, fh),
+                      mode="wt")
+    print(json.dumps({"routing": f"http://{args.host}:{port}",
+                      "peer_dir": args.peer_dir,
+                      "autoscale_max": args.autoscale_max}), file=sys.stderr)
+    import signal
+
+    def _stop(signum, frame):
+        import threading
+
+        threading.Thread(target=lambda: (router.shutdown(),
+                                         httpd.shutdown()),
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    _t.join()
+    return 0
 
 
 def merge_main(argv=None) -> int:
@@ -1437,6 +1567,7 @@ _TOOLS = {
     "shard": shard_main,
     "fleet": fleet_main,
     "serve": serve_main,
+    "router": router_main,
     "merge": merge_main,
     "inqual": intrinsicqv_main,
     "repeats": detectrepeats_main,

@@ -164,6 +164,42 @@ EVENT_FIELDS: dict[str, dict] = {
     "serve.replay": {"jobs": int, "orphans": int, "finished": int,
                      "torn": int},
     "serve.takeover": {"job": str, "prev_host": str, "stale_s": _NUM},
+    # front door (ISSUE 16). serve.announce = a peer publishing its URL as
+    # an announce lease for router discovery; serve.evict_defer = the idle
+    # sweep deferring a warm-group eviction because a live router's
+    # stickiness still points a recently-routed tenant at it (the
+    # evict-vs-route race fix).
+    "serve.announce": {"url": str, "peer": str},
+    "serve.evict_defer": {"group": str, "key": str, "routed_s": _NUM},
+    # fleet-shared AOT executable cache (serve/aotcache.py): hit = a warm
+    # load (memory or deserialize) skipping a jit compile, publish = a
+    # fresh compile serialized for the fleet, reject = a cache entry
+    # refused (reason = corrupt | version | deserialize | ...) with cold
+    # fallback — a reject on a registry-held fingerprint is a sentinel
+    # finding, never a correctness event.
+    "aot.hit": {"key": str, "wall_s": _NUM},
+    "aot.miss": {"key": str},
+    "aot.publish": {"key": str, "bytes": int, "wall_s": _NUM},
+    "aot.reject": {"key": str, "reason": str},
+    # stateless tenant router (serve/router.py): route = one admission
+    # decision (spilled = stickiness overridden), spill = why + where,
+    # peer_up/peer_down = discovery transitions (announce lease + healthz),
+    # proxy_error = transport failure answered 502-retryable (the client's
+    # idempotency key makes the retry exactly-once).
+    "router.start": {"workdir": str, "peer_dir": str, "pid": int},
+    "router.route": {"tenant": str, "peer": str, "spilled": bool},
+    "router.spill": {"tenant": str, "owner": str, "to": str, "reason": str},
+    "router.proxy_error": {"peer": str, "error": str},
+    "router.peer_up": {"peer": str, "url": str, "ready": bool},
+    "router.peer_down": {"peer": str, "reason": str},
+    "router.done": {"wall_s": _NUM, "routes": int, "spills": int},
+    # SLO-burn autoscaler (serve/autoscale.py): burn = fleet band change
+    # audit trail, spawn/drain/reap = the bounded scale-out/in lifecycle.
+    "scale.burn": {"burn": _NUM, "band": int, "n_ready": int, "n_live": int},
+    "scale.spawn": {"peer": str, "pid": int, "workdir": str,
+                    "n_spawned": int},
+    "scale.drain": {"peer": str, "reason": str},
+    "scale.reap": {"peer": str, "rc": int, "life_s": _NUM},
     "bench_start": {"batch": int},
     "bench_compile": {"batch": int, "cached": bool, "expected_wall_s": _NUM},
     # self-staging bench ladder: one row per completed rung (sidecar
@@ -228,7 +264,10 @@ def validate_events(path: str, strict: bool = False) -> list[str]:
                 # daccord-serve appends to the same serve.events.jsonl
                 # with a fresh relative clock (same contract as a
                 # requeued shard's sidecar)
-                ev_name in ("sup_init", "bench_start", "serve.start")
+                # router.start likewise: a restarted daccord-router
+                # appends to the same router.events.jsonl
+                ev_name in ("sup_init", "bench_start", "serve.start",
+                            "router.start")
                 and not in_shard_segment):
             # stream boundary: JsonlLogger appends with a per-process
             # relative clock, so a rerun against the same --events path (or

@@ -252,6 +252,15 @@ def scan_events(path: str) -> list[str]:
     # finishing — a crash loop or a TTL set below real job latency.
     replayed_open: dict[str, int] = {}
     takeovers: dict[str, int] = {}
+    # front door (ISSUE 16): a scale-out that never relieved the burn it
+    # was spawned for is capacity that cost money and helped nobody — track
+    # each scale.spawn's ambient burn and whether any later sample dropped
+    # below it. And an aot.miss on a key this stream already PUBLISHED
+    # means the fleet cache lost an entry it held (evicted, torn, or a
+    # version skew) — the cold compile quietly came back.
+    last_burn: float | None = None
+    spawns_open: list[tuple[int, float, float]] = []  # (ln, burn@spawn, min since)
+    aot_published: set[str] = set()
     for ln, line in enumerate(lines, start=1):
         line = line.strip()
         if not line:
@@ -293,6 +302,36 @@ def scan_events(path: str) -> list[str]:
         elif ev == "serve.takeover":
             jid = str(rec.get("job"))
             takeovers[jid] = takeovers.get(jid, 0) + 1
+        elif ev == "scale.spawn":
+            spawns_open.append((ln, last_burn if last_burn is not None
+                                else float("inf"), float("inf")))
+        if ev in ("serve.slo", "scale.burn"):
+            burn = rec.get("burn")
+            if isinstance(burn, (int, float)) and not isinstance(burn, bool):
+                last_burn = float(burn)
+                spawns_open = [(sl, b0, min(mn, last_burn))
+                               for sl, b0, mn in spawns_open]
+        elif ev == "aot.publish":
+            aot_published.add(str(rec.get("key")))
+        elif ev == "aot.hit":
+            aot_published.add(str(rec.get("key")))
+        elif ev == "aot.miss":
+            key = str(rec.get("key"))
+            if key in aot_published:
+                issues.append(f"{path}:{ln}: AOT cache MISS on fingerprint "
+                              f"{key!r} this stream already held (entry "
+                              "lost/torn/version-skewed — the cold compile "
+                              "is back)")
+        elif ev == "aot.reject" and rec.get("reason") == "corrupt":
+            issues.append(f"{path}:{ln}: corrupt AOT cache entry for "
+                          f"{rec.get('key')!r} (torn publish or shared-FS "
+                          "damage; cold fallback engaged)")
+    for sl, b0, mn in spawns_open:
+        if b0 != float("inf") and mn >= b0:
+            issues.append(f"{path}:{sl}: scale-out spawned at burn {b0:g} "
+                          "but burn never dropped below it afterwards — "
+                          "added capacity did not relieve the p99 it was "
+                          "bought for")
     for jid, ln in sorted(replayed_open.items()):
         issues.append(f"{path}:{ln}: job {jid} replayed but never reached "
                       "a terminal journal record (orphan re-admitted, "

@@ -98,6 +98,10 @@ def _expand_sources(paths: list[str]) -> tuple[list[str], list[str], list[str]]:
                     sidecars.append(fp)
             leases.extend(sorted(glob.glob(os.path.join(p, "leases",
                                                         "*.lease"))))
+            # announce leases (ISSUE 16): a peer dir's peers/*.lease rows
+            # are the router's discovery inputs — same LEASE panel
+            leases.extend(sorted(glob.glob(os.path.join(p, "peers",
+                                                        "*.lease"))))
         elif p.endswith(".lease"):
             leases.append(p)
         elif p.endswith(".json"):
@@ -115,7 +119,7 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
     events, sidecars, lease_files = _expand_sources(paths)
     snap: dict = {"ts": time.time(), "sources": [], "mesh": {},
                   "serve": None, "ratchets": {}, "faults": [],
-                  "slo": None, "fleet": None, "leases": []}
+                  "slo": None, "fleet": None, "leases": [], "router": None}
     # per-process lease/ownership state (ISSUE 15): who holds which
     # shard/job right now, and how stale each heartbeat is — the takeover
     # question ("is anyone going to pick this up?") answered at a glance
@@ -176,6 +180,38 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
                 snap["slo"] = rec
             elif ev == "serve.shed":
                 row["shed"] = rec.get("level")
+            elif isinstance(ev, str) and (ev.startswith("router.")
+                                          or ev.startswith("scale.")):
+                # front door (ISSUE 16): fold the router's event stream
+                # into the ROUTER panel — peer table (up/down + ready),
+                # tenant ownership map, spill/scale tallies
+                r = snap["router"]
+                if r is None:
+                    r = snap["router"] = {"peers": {}, "owners": {},
+                                          "routes": 0, "spills": 0,
+                                          "proxy_errors": 0, "scale": []}
+                if ev == "router.peer_up":
+                    r["peers"][rec.get("peer")] = {
+                        "up": True, "ready": rec.get("ready"),
+                        "url": rec.get("url")}
+                elif ev == "router.peer_down":
+                    p_ = r["peers"].setdefault(rec.get("peer"), {})
+                    p_["up"] = False
+                    p_["ready"] = False
+                    p_["reason"] = rec.get("reason")
+                elif ev == "router.route":
+                    r["routes"] += 1
+                    r["owners"][rec.get("tenant")] = rec.get("peer")
+                elif ev == "router.spill":
+                    r["spills"] += 1
+                elif ev == "router.proxy_error":
+                    r["proxy_errors"] += 1
+                elif ev in ("scale.spawn", "scale.drain", "scale.reap"):
+                    r["scale"].append(
+                        {"event": ev, "peer": rec.get("peer"),
+                         **{k: v for k, v in rec.items()
+                            if k in ("rc", "reason", "n_spawned")}})
+                    r["scale"] = r["scale"][-6:]
             elif ev in ("sup_fault", "sup_failover", "sup_failback",
                         "mesh.shrink", "mesh.degrade", "mesh.restore",
                         "fleet.poison", "fleet.capacity",
@@ -317,6 +353,32 @@ def render(snap: dict) -> str:
                            f"p95 {_fmt(h.get('p95'), 3)}s "
                            f"p99 {_fmt(h.get('p99'), 3)}s "
                            f"({h.get('count')} jobs)")
+    router = snap.get("router")
+    if router is not None:
+        # front door (ISSUE 16): peer table + tenant ownership + spill and
+        # scale tallies from router.events.jsonl
+        out.append("")
+        out.append(f"  ROUTER  routes {router['routes']} "
+                   f"spills {router['spills']} "
+                   f"proxy-errs {router['proxy_errors']}")
+        if router["peers"]:
+            out.append(f"    {'PEER':<26}{'UP':<5}{'READY':<7}URL")
+            for name in sorted(router["peers"]):
+                d = router["peers"][name]
+                ready = d.get("ready")
+                out.append(
+                    f"    {str(name):<26}"
+                    f"{('yes' if d.get('up') else 'NO'):<5}"
+                    f"{('yes' if ready else ('-' if ready is None else 'NO')):<7}"
+                    f"{d.get('url') or d.get('reason') or '-'}")
+        if router["owners"]:
+            owners = " ".join(f"{t}->{p_}" for t, p_ in
+                              sorted(router["owners"].items()))
+            out.append(f"    owners: {owners}"[:100])
+        for s in router["scale"]:
+            detail = " ".join(f"{k}={v}" for k, v in s.items()
+                              if k not in ("event", "peer"))
+            out.append(f"    {s['event']} {s.get('peer')} {detail}".rstrip())
     fleet = snap.get("fleet")
     if fleet is not None:
         out.append("")

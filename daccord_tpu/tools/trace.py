@@ -75,6 +75,14 @@ MILESTONES = frozenset({
     # crash-durable serve tier (ISSUE 15): recovery milestones — the
     # per-append serve.journal mirror rows are summarized only
     "serve.replay", "serve.takeover",
+    # front door (ISSUE 16): routing/scale transitions are milestones
+    # (the per-request router.route rows are summarized only, like
+    # serve.batch); aot.publish/reject are the cache's rare, load-bearing
+    # moments — hits and misses are summarized
+    "router.start", "router.spill", "router.proxy_error",
+    "router.peer_up", "router.peer_down", "router.done",
+    "scale.burn", "scale.spawn", "scale.drain", "scale.reap",
+    "serve.announce", "serve.evict_defer", "aot.publish", "aot.reject",
 })
 
 

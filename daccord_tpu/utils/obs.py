@@ -50,6 +50,12 @@ DURABLE_EVENTS = frozenset({
     # investigation reads (the journal itself fsyncs per record; these are
     # its event-stream mirrors)
     "serve.replay", "serve.takeover", "serve.commit", "serve.abort",
+    # front door (ISSUE 16): discovery transitions, spills, scale
+    # lifecycle, and AOT publish/reject are exactly what a fleet
+    # post-mortem replays — all low-rate control-plane rows
+    "router.spill", "router.proxy_error", "router.peer_up",
+    "router.peer_down", "scale.spawn", "scale.drain", "scale.reap",
+    "aot.publish", "aot.reject",
 })
 
 

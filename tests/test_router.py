@@ -1,0 +1,867 @@
+"""Front door (ISSUE 16): tenant router, SLO-burn autoscaler, AOT cache.
+
+The contracts under test:
+
+- **stickiness is stateless**: rendezvous hashing gives every router
+  instance (and every restart) the identical tenant→peer map; removing a
+  non-owner peer never moves a tenant.
+- **spill is a preference override, not a cage**: a shed / not-ready /
+  burn-red owner spills to the least-loaded OTHER ready peer; with nobody
+  to spill to, the owner's own admission plane is the backstop.
+- **evict-vs-route race** (the WarmState regression): while a router
+  heartbeat is fresh, a group key routed-to within the grace window
+  survives the idle-TTL sweep (deferred, not exempted).
+- **the AOT cache can only ever cost a rejected read**: corrupt, torn and
+  version-mismatched entries are rejected (``aot.reject``) and the cold
+  path answers; a published entry round-trips into a FRESH process
+  byte-identical to the cold compile (slow arm).
+- **exactly-once through the front door**: a peer SIGKILLed mid-job is
+  routed around; the client's retry with the SAME idempotency key lands on
+  the survivor exactly once, byte-identical to the solo run.
+"""
+
+import hashlib
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from daccord_tpu.sim import SimConfig, make_dataset
+
+try:
+    from daccord_tpu.native import available as _native_available
+
+    HAVE_NATIVE = _native_available()
+except Exception:
+    HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(not HAVE_NATIVE,
+                                  reason="native host path unavailable")
+
+
+class _CapLog:
+    """Capture logger matching the obs logger surface."""
+
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **kw):
+        self.events.append((event, kw))
+
+    def __getitem__(self, name):
+        return [kw for ev, kw in self.events if ev == name]
+
+    def close(self):
+        pass
+
+
+def _lint(paths):
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    for p in paths:
+        errs = validate_events(p, strict=True)
+        assert not errs, (p, errs[:5])
+
+
+def _mk_peer(name, **kw):
+    from daccord_tpu.serve.router import Peer
+
+    kw.setdefault("alive", True)
+    kw.setdefault("ready", True)
+    return Peer(name=name, url=kw.pop("url", f"http://127.0.0.1:1/{name}"),
+                **kw)
+
+
+def _mk_router(tmp_path, **kw):
+    """A Router with its poll thread effectively parked (tests drive
+    refresh()/route() directly for determinism)."""
+    from daccord_tpu.serve.router import Router, RouterConfig
+
+    kw.setdefault("poll_s", 3600.0)
+    kw.setdefault("peer_dir", str(tmp_path / "fleet"))
+    kw.setdefault("workdir", str(tmp_path / "router"))
+    os.makedirs(kw["peer_dir"], exist_ok=True)
+    return Router(RouterConfig(**kw))
+
+
+# ---------------------------------------------------------------------------
+# routing policy units
+# ---------------------------------------------------------------------------
+
+def test_rendezvous_owner_deterministic_and_stable(tmp_path):
+    rt = _mk_router(tmp_path)
+    try:
+        names = ["peer-a", "peer-b", "peer-c", "peer-d"]
+        peers = [_mk_peer(n) for n in names]
+        tenants = [f"tenant{i}" for i in range(40)]
+        owners = {t: rt.owner_of(t, peers).name for t in tenants}
+        # a second pass (and a "restarted router" = a fresh instance) maps
+        # identically: the stickiness is pure hash, no state to lose
+        assert {t: rt.owner_of(t, peers).name for t in tenants} == owners
+        # every peer owns someone (4 peers, 40 tenants: astronomically
+        # unlikely to miss one unless the hash is broken)
+        assert set(owners.values()) == set(names)
+        # rendezvous minimal-disruption: dropping a NON-owner peer never
+        # moves a tenant
+        for t in tenants:
+            for drop in names:
+                if drop == owners[t]:
+                    continue
+                rest = [p for p in peers if p.name != drop]
+                assert rt.owner_of(t, rest).name == owners[t], (t, drop)
+        # readiness does NOT move ownership (route() spills off a not-ready
+        # owner; the map itself must stay put while a peer warms)
+        peers[0].ready = False
+        assert {t: rt.owner_of(t, peers).name for t in tenants} == owners
+        # dead peers DO: ownership is computed over alive peers only
+        peers[0].alive = False
+        assert all(rt.owner_of(t, peers).name != "peer-a" for t in tenants)
+        for p in peers:
+            p.alive = False
+        assert rt.owner_of("tenant0", peers) is None
+    finally:
+        rt.shutdown()
+    _lint([os.path.join(str(tmp_path / "router"), "router.events.jsonl")])
+
+
+def test_route_spills_on_shed_notready_and_burn(tmp_path):
+    rt = _mk_router(tmp_path, spill_burn=1.0)
+    try:
+        a, b, c = _mk_peer("pa"), _mk_peer("pb"), _mk_peer("pc")
+        rt.peers = {"pa": a, "pb": b, "pc": c}
+        tenant = next(t for t in (f"t{i}" for i in range(1000))
+                      if rt.owner_of(t).name == "pa")
+        assert rt.route(tenant).name == "pa"          # healthy owner: sticky
+
+        # shed owner spills to the least-loaded OTHER ready peer
+        a.shed_level = 1
+        b.jobs_active, c.jobs_active = 5, 1
+        assert rt.route(tenant).name == "pc"
+        # burn tie-breaks equal queue loads
+        b.jobs_active = c.jobs_active = 2
+        b.burn, c.burn = 0.1, 0.9
+        assert rt.route(tenant).name == "pb"
+        a.shed_level = 0
+
+        # not-ready owner spills
+        a.ready = False
+        assert rt.route(tenant).name in ("pb", "pc")
+        a.ready = True
+
+        # burn-red owner spills; below the band it does not
+        a.burn = 2.0
+        assert rt.route(tenant).name != "pa"
+        a.burn = 0.5
+        assert rt.route(tenant).name == "pa"
+
+        # nobody to spill to: the shedding owner still beats a refusal
+        a.shed_level = 2
+        rt.peers = {"pa": a}
+        assert rt.route(tenant).name == "pa"
+        # empty fleet: route refuses
+        rt.peers = {}
+        assert rt.route(tenant) is None
+
+        spills = [kw for ev, kw in
+                  ((e["event"], e) for e in _events(rt))
+                  if ev == "router.spill"]
+        assert {s["reason"] for s in spills} == {"shed", "not_ready", "burn"}
+        assert all(s["owner"] == "pa" for s in spills)
+        assert rt.counters["spills"] == len(spills)
+    finally:
+        rt.shutdown()
+    _lint([os.path.join(str(tmp_path / "router"), "router.events.jsonl")])
+
+
+def _events(rt):
+    rt.log.flush()
+    path = os.path.join(rt.cfg.workdir, "router.events.jsonl")
+    with open(path) as fh:
+        return [json.loads(l) for l in fh if l.strip()]
+
+
+# ---------------------------------------------------------------------------
+# discovery: announce leases + healthz polls
+# ---------------------------------------------------------------------------
+
+class _FakeHealthz(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        self.server.router_headers.append(
+            self.headers.get("X-Daccord-Router"))
+        body = json.dumps(self.server.payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # noqa: A002
+        pass
+
+
+def test_discovery_announce_up_down(tmp_path):
+    from daccord_tpu.utils import lease
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _FakeHealthz)
+    httpd.daemon_threads = True
+    httpd.payload = {"ok": True, "ready": True, "shed_level": 1,
+                     "queue_depth": 3, "burn": 0.25,
+                     "jobs": {"queued": 2, "running": 1}}
+    httpd.router_headers = []
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    rt = _mk_router(tmp_path, lease_ttl_s=5.0)
+    fleet = rt.cfg.peer_dir
+    os.makedirs(os.path.join(fleet, "peers"), exist_ok=True)
+    lp = os.path.join(fleet, "peers", "peer-x.lease")
+    lease.claim(lp, "peer-x@test", 5.0, extra={"url": url,
+                                               "service": "peer-x"})
+    try:
+        rt.refresh()
+        p = rt.peers["peer-x"]
+        assert p.alive and p.ready and p.shed_level == 1
+        assert p.queue_depth == 3 and p.burn == 0.25 and p.jobs_active == 3
+        # the poll arms the peers' evict-vs-route grace window
+        assert httpd.router_headers and httpd.router_headers[0] == "1"
+
+        # healthz death: peer stays discovered (lease fresh) but down
+        httpd.shutdown()
+        rt.refresh()
+        assert "peer-x" in rt.peers and not rt.peers["peer-x"].alive
+        assert rt.owner_of("anyone") is None
+
+        # stale announce: the peer vanishes from the table entirely
+        lease.backdate(lp, 60.0)
+        rt.refresh()
+        assert "peer-x" not in rt.peers
+
+        evs = _events(rt)
+        ups = [e for e in evs if e["event"] == "router.peer_up"]
+        downs = [e for e in evs if e["event"] == "router.peer_down"]
+        assert ups and ups[0]["peer"] == "peer-x" and ups[0]["ready"]
+        assert downs and downs[0]["reason"] == "healthz"
+    finally:
+        rt.shutdown()
+        httpd.server_close()
+    _lint([os.path.join(str(tmp_path / "router"), "router.events.jsonl")])
+
+
+# ---------------------------------------------------------------------------
+# WarmState evict-vs-route regression (ISSUE 16 bugfix)
+# ---------------------------------------------------------------------------
+
+class _FakeGroup:
+    def __init__(self, name):
+        self.name = name
+        self.refs = 0
+        self.last_used = 0.0
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+    def stats(self):
+        return {"name": self.name}
+
+
+def test_warmstate_defers_eviction_for_routed_key():
+    from daccord_tpu.serve.state import WarmState
+
+    log = _CapLog()
+    ws = WarmState(idle_evict_s=10.0, log=log, route_grace_s=30.0)
+    g = ws.acquire("k1", lambda: _FakeGroup("g1"))
+    ws.release("k1")
+    idle_at = g.last_used + 11.0          # past the TTL, refs == 0
+
+    # the race: the router's stickiness points here (fresh heartbeat +
+    # recent route stamp) — the sweep must defer, not evict the exact
+    # group the next submit is about to hit
+    ws.note_router_heartbeat(now=idle_at - 1.0)
+    ws.note_route("k1", now=idle_at - 5.0)
+    assert ws.evict_idle(now=idle_at) == 0
+    assert not g.closed and ws.counters["evict_deferred"] == 1
+    defer = log["serve.evict_defer"]
+    assert defer and defer[0]["group"] == "g1" \
+        and defer[0]["routed_s"] == pytest.approx(5.0)
+
+    # grace lapsed (router still alive): the TTL wins again
+    late = idle_at + 40.0
+    ws.note_router_heartbeat(now=late - 1.0)
+    assert ws.evict_idle(now=late) == 1 and g.closed
+    assert ws.counters["evicted"] == 1
+
+
+def test_warmstate_evicts_when_no_router_or_no_route():
+    from daccord_tpu.serve.state import WarmState
+
+    ws = WarmState(idle_evict_s=10.0, route_grace_s=30.0)
+    # no heartbeat ever: plain TTL behaviour (solo deployments unchanged)
+    g1 = ws.acquire("k1", lambda: _FakeGroup("g1"))
+    ws.release("k1")
+    assert ws.evict_idle(now=g1.last_used + 11.0) == 1 and g1.closed
+
+    # routed recently but the router DIED (stale heartbeat): grace disarms
+    g2 = ws.acquire("k2", lambda: _FakeGroup("g2"))
+    ws.release("k2")
+    idle_at = g2.last_used + 11.0
+    ws.note_router_heartbeat(now=idle_at - 60.0)
+    ws.note_route("k2", now=idle_at - 1.0)
+    assert ws.evict_idle(now=idle_at) == 1 and g2.closed
+
+    # router alive but the key was never routed to: evicted
+    g3 = ws.acquire("k3", lambda: _FakeGroup("g3"))
+    ws.release("k3")
+    idle_at = g3.last_used + 11.0
+    ws.note_router_heartbeat(now=idle_at - 1.0)
+    assert ws.evict_idle(now=idle_at) == 1 and g3.closed
+    assert ws.counters["evict_deferred"] == 0
+
+
+# ---------------------------------------------------------------------------
+# AOT cache: reject taxonomy (no compile needed — synthetic entries)
+# ---------------------------------------------------------------------------
+
+def _write_entry(cache, key, digest, body: bytes, sha: bytes | None = None):
+    from daccord_tpu.serve.aotcache import _MAGIC
+
+    sha = hashlib.sha256(body).digest() if sha is None else sha
+    with open(cache._path(key, digest), "wb") as fh:
+        fh.write(_MAGIC + sha + body)
+
+
+def test_aot_rejects_corrupt_torn_and_version_mismatch(tmp_path):
+    from daccord_tpu.serve.aotcache import AotCache, _versions
+
+    log = _CapLog()
+    cache = AotCache(str(tmp_path / "aot"), log=log)
+    key, digest = "cpu:B8xD8xL32", "0" * 16
+    good = pickle.dumps({"key": key, "meta": _versions(),
+                         "payload": b"not-an-executable",
+                         "in_tree": None, "out_tree": None})
+
+    # bit-flip: checksum fails → corrupt, never unpickled
+    flipped = bytearray(good)
+    flipped[-1] ^= 0xFF
+    _write_entry(cache, key, digest, bytes(flipped),
+                 sha=hashlib.sha256(good).digest())
+    assert cache.load(key, digest) is None
+
+    # torn write: shorter than the header → corrupt
+    with open(cache._path(key, digest), "wb") as fh:
+        fh.write(b"DACAOT01trunc")
+    assert cache.load(key, digest) is None
+
+    # checksum-valid garbage that fails deserialization → load:<type>
+    _write_entry(cache, key, digest, good)
+    assert cache.load(key, digest) is None
+
+    # version-pin mismatch: a different jax/jaxlib/backend is SKIPPED (a
+    # stale fleet dir after an upgrade must not poison new peers)
+    meta = dict(_versions())
+    meta["jax"] = "0.0.0-somethingelse"
+    _write_entry(cache, key, digest, pickle.dumps(
+        {"key": key, "meta": meta, "payload": b"x",
+         "in_tree": None, "out_tree": None}))
+    assert cache.load(key, digest) is None
+
+    reasons = [kw["reason"] for kw in log["aot.reject"]]
+    assert reasons[:2] == ["corrupt", "corrupt"]
+    assert reasons[2].startswith("load:") and reasons[3] == "version"
+    assert cache.stats()["rejects"] == 4 and cache.stats()["hits"] == 0
+
+
+# ---------------------------------------------------------------------------
+# AOT cache: real round-trip (slow arm — one XLA compile)
+# ---------------------------------------------------------------------------
+
+_SUBPROC_LOAD = r"""
+import hashlib, json, os, pickle, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+prof, ccfg, lad_kw, batch = pickle.load(open(sys.argv[1], "rb"))
+from daccord_tpu.kernels.tiers import TierLadder, fetch
+from daccord_tpu.serve.aotcache import AotCache
+ladder = TierLadder.from_config(prof, ccfg, **lad_kw)
+cache = AotCache(sys.argv[2])
+out = fetch(cache.dispatcher(ladder)(batch))
+import numpy as np
+h = "".join(hashlib.sha256(np.asarray(out[k]).tobytes()).hexdigest()
+            for k in ("cons", "cons_len", "solved"))
+json.dump({"hash": h, "counters": cache.stats()}, sys.stdout)
+"""
+
+
+def _out_hash(out):
+    import numpy as np
+
+    return "".join(hashlib.sha256(np.asarray(out[k]).tobytes()).hexdigest()
+                   for k in ("cons", "cons_len", "solved"))
+
+
+@pytest.mark.slow
+def test_aot_roundtrip_fresh_process_and_corrupt_fallback(tmp_path):
+    """publish → FRESH-process load → byte-identical vs the cold compile;
+    then a corrupted entry falls back to the cold path (and heals it)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from daccord_tpu.kernels import BatchShape, TierLadder, tensorize_windows
+    from daccord_tpu.kernels.tiers import fetch
+    from daccord_tpu.oracle import (ConsensusConfig, cut_windows,
+                                    estimate_profile_two_pass, refine_overlap)
+    from daccord_tpu.serve.aotcache import AotCache, static_digest
+    from daccord_tpu.sim import simulate
+
+    cfg = SimConfig(genome_len=1200, coverage=10, read_len_mean=400, seed=7)
+    res = simulate(cfg)
+    aread = max(range(len(res.reads)), key=lambda i: len(res.reads[i].seq))
+    a = res.reads[aread].seq
+    refined = [refine_overlap(o, a, res.reads[o.bread].seq, cfg.tspace)
+               for o in res.overlaps if o.aread == aread]
+    ccfg = ConsensusConfig()
+    windows = cut_windows(a, refined, w=ccfg.w, adv=ccfg.adv)
+    prof = estimate_profile_two_pass(refined, windows, ccfg, sample=8)
+    lad_kw = {"max_kmers": 24, "rescue_max_kmers": 48}
+    ladder = TierLadder.from_config(prof, ccfg, **lad_kw)
+    batch = tensorize_windows([(aread, ws) for ws in windows],
+                              BatchShape(depth=16, seg_len=64, wlen=40))
+
+    aot_dir = str(tmp_path / "aot")
+    log = _CapLog()
+    cache = AotCache(aot_dir, log=log)
+    # cold: miss → ONE lower().compile() → publish
+    out_cold = fetch(cache.dispatcher(ladder)(batch))
+    assert cache.stats()["misses"] == 1 and cache.stats()["publishes"] == 1
+    assert log["aot.miss"] and log["aot.publish"]
+    entries = [f for f in os.listdir(aot_dir) if f.endswith(".aot")]
+    assert len(entries) == 1
+    want = _out_hash(out_cold)
+
+    # fresh process: rebuilds the (deterministic) ladder, loads the fleet
+    # entry — zero compiles — and answers byte-identically
+    pkl = str(tmp_path / "case.pkl")
+    with open(pkl, "wb") as fh:
+        pickle.dump((prof, ccfg, lad_kw, batch), fh)
+    r = subprocess.run([sys.executable, "-c", _SUBPROC_LOAD, pkl, aot_dir],
+                       capture_output=True, text=True, timeout=300,
+                       env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    got = json.loads(r.stdout)
+    assert got["hash"] == want
+    assert got["counters"]["hits"] == 1 and got["counters"]["misses"] == 0
+
+    # corrupt the published entry: a fresh cache must fall back to the
+    # cold compile (same bytes), reject the entry, and heal it by
+    # re-publishing — the cache can only ever cost a rejected read
+    epath = os.path.join(aot_dir, entries[0])
+    blob = bytearray(open(epath, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(epath, "wb") as fh:
+        fh.write(bytes(blob))
+    log2 = _CapLog()
+    cache2 = AotCache(aot_dir, log=log2)
+    out_fb = fetch(cache2.dispatcher(ladder)(batch))
+    assert _out_hash(out_fb) == want
+    assert [kw["reason"] for kw in log2["aot.reject"]] == ["corrupt"]
+    assert cache2.stats()["misses"] == 1 and cache2.stats()["publishes"] == 1
+    # healed: the re-published entry loads clean again
+    digest = static_digest(ladder, "full", False, False)
+    from daccord_tpu.runtime.supervisor import shape_key
+
+    assert AotCache(aot_dir).load(shape_key(batch, ""), digest) is not None
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: spawn / cooldown / capacity / drain / reap (deterministic)
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def time(self):
+        return self.t
+
+
+class _FakeProc:
+    _pid = 50000
+
+    def __init__(self, cmd):
+        _FakeProc._pid += 1
+        self.pid = _FakeProc._pid
+        self.cmd = cmd
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.rc = -signal.SIGTERM
+
+    def kill(self):
+        self.rc = -signal.SIGKILL
+
+    def wait(self, timeout=None):
+        if self.rc is None:
+            raise subprocess.TimeoutExpired("fake", timeout)
+        return self.rc
+
+
+def test_autoscaler_bursty_trace_spawn_drain_reap(tmp_path, monkeypatch):
+    import daccord_tpu.serve.autoscale as asc
+    from daccord_tpu.serve import AutoscaleConfig, Autoscaler
+
+    procs = []
+
+    class _FakeSub:
+        TimeoutExpired = subprocess.TimeoutExpired
+        STDOUT = subprocess.STDOUT
+
+        @staticmethod
+        def Popen(cmd, env=None, stdout=None, stderr=None):
+            if stdout is not None:
+                stdout.close()
+            p = _FakeProc(cmd)
+            procs.append((p, env))
+            return p
+
+    clock = _Clock(1000.0)
+    monkeypatch.setattr(asc, "subprocess", _FakeSub)
+    monkeypatch.setattr(asc, "time", clock)
+    log = _CapLog()
+    sc = Autoscaler(AutoscaleConfig(
+        peer_dir=str(tmp_path / "fleet"), root=str(tmp_path / "autopeers"),
+        max_peers=2, min_peers=1, spawn_burn=1.0, sustain_s=2.0,
+        cooldown_s=5.0, idle_ttl_s=4.0, backend="native",
+        slo_p99_s=0.25, spawn_env={"JAX_PLATFORMS": "cpu"}), log)
+
+    hot = [_mk_peer("p0", burn=3.0)]
+    sc.tick(hot)                                   # burst arrives
+    assert sc.counters["spawns"] == 0              # spike != sustained
+    clock.t = 1001.0
+    sc.tick(hot)
+    assert sc.counters["spawns"] == 0
+    clock.t = 1002.5                               # sustained >= 2 s → spawn
+    sc.tick(hot)
+    assert sc.counters["spawns"] == 1
+    cmd, env = procs[0][0].cmd, procs[0][1]
+    assert "serve" in cmd and "--peer-dir" in cmd and "--slo-p99-s" in cmd
+    assert env["JAX_PLATFORMS"] == "cpu"
+    spawn_ev = log["scale.spawn"][0]
+    assert spawn_ev["peer"] == "autopeer1" and spawn_ev["n_spawned"] == 1
+
+    clock.t = 1003.0                               # still red: cooldown holds
+    sc.tick(hot)
+    assert sc.counters["spawns"] == 1
+    clock.t = 1009.0      # cooled AND sustained — but live+pending hits the
+    sc.tick(hot)          # cap: the spawn-storm guard
+    assert sc.counters["spawns"] == 1
+
+    # burn collapses; the spawned peer turns up ready and idle
+    spawned = _mk_peer("autopeer1")
+    quiet = [_mk_peer("p0", burn=0.0), spawned]
+    clock.t = 1010.0
+    sc.tick(quiet)                                 # idle clock starts
+    assert sc.counters["drains"] == 0
+    clock.t = 1012.0
+    sc.tick([_mk_peer("p0"), _mk_peer("autopeer1", jobs_active=1)])
+    clock.t = 1013.0                               # activity reset the clock
+    sc.tick(quiet)
+    clock.t = 1016.0
+    sc.tick(quiet)
+    assert sc.counters["drains"] == 0
+    clock.t = 1017.5                               # idle >= 4 s → drain
+    sc.tick(quiet)
+    assert sc.counters["drains"] == 1
+    assert log["scale.drain"][0] == {"peer": "autopeer1",
+                                     "reason": "idle_ttl"}
+
+    procs[0][0].rc = 0                             # the drained peer exits
+    clock.t = 1018.0
+    sc.tick([_mk_peer("p0")])
+    assert sc.counters["reaps"] == 1
+    reap = log["scale.reap"][0]
+    assert reap["peer"] == "autopeer1" and reap["rc"] == 0
+    assert sc.stats()["spawned"] == []
+
+    # burn-band audit trail moved red → quiet exactly once each
+    bands = [kw["band"] for kw in log["scale.burn"]]
+    assert bands == [30, 0]
+    sc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# live e2e: two in-process peers behind the router
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("router"))
+    cfg = SimConfig(genome_len=1500, coverage=10, read_len_mean=500,
+                    min_overlap=200, seed=5)
+    return make_dataset(d, cfg, name="sv"), d
+
+
+def _solo_bytes(out, d):
+    import dataclasses
+
+    from daccord_tpu.runtime.pipeline import correct_to_fasta
+    from daccord_tpu.serve.jobs import JobSpec, build_job_config
+
+    spec = JobSpec.from_json({"db": out["db"], "las": out["las"]}, d)
+    cfg = build_job_config(spec, "native", True, 64, "fused", d, "solo")
+    cfg = dataclasses.replace(cfg, native_solver=True, supervise=True,
+                              events_path=None, ledger_path=None,
+                              job_tag=None, quarantine_path=None)
+    ref = os.path.join(d, "solo-native.fasta")
+    if not os.path.exists(ref):
+        correct_to_fasta(out["db"], out["las"], ref, cfg)
+    with open(ref, "rb") as fh:
+        return fh.read()
+
+
+def _rreq(port, method, path, body=None, timeout=180):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+@needs_native
+def test_router_e2e_sticky_idempotent_spill(dataset, tmp_path):
+    from daccord_tpu.serve import ConsensusService, ServeConfig
+    from daccord_tpu.serve.http import start_server
+    from daccord_tpu.serve.router import Router, RouterConfig, start_router
+
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    peer_dir = str(tmp_path / "fleet")
+    svcs, servers = {}, []
+    for i in range(2):
+        w = str(tmp_path / f"p{i}")
+        svc = ConsensusService(ServeConfig(
+            workdir=w, backend="native", backend_explicit=True, batch=64,
+            workers=2, flush_lag_s=0.02, peer_dir=peer_dir))
+        httpd, port, _ = start_server(svc, "127.0.0.1", 0)
+        svc.announce(f"http://127.0.0.1:{port}")
+        svcs[f"p{i}"] = svc
+        servers.append((svc, httpd))
+    rt = Router(RouterConfig(workdir=str(tmp_path / "router"),
+                             peer_dir=peer_dir, poll_s=3600.0,
+                             spill_burn=1.0))
+    rhttpd, rport, _ = start_router(rt)
+    try:
+        rt.refresh()
+        st, raw = _rreq(rport, "GET", "/v1/router")
+        rs = json.loads(raw)
+        assert rs["ready"] and len(rs["peers"]) == 2
+        assert all(p["alive"] and p["ready"] for p in rs["peers"])
+
+        # three same-tenant submits land on ONE peer (warmth stays put)
+        jobs = []
+        for i in range(3):
+            st, raw = _rreq(rport, "POST", "/v1/jobs",
+                            {"db": out["db"], "las": out["las"],
+                             "tenant": "alice",
+                             "idempotency_key": f"rt-e2e-{i}"})
+            assert st == 201, raw
+            jobs.append(json.loads(raw)["job"])
+        owners = {rt.stats()["jobs"][j] for j in jobs}
+        assert len(owners) == 1
+        owner = owners.pop()
+
+        # idempotent replay THROUGH the router: same key → same job, no
+        # second admission
+        st, raw = _rreq(rport, "POST", "/v1/jobs",
+                        {"db": out["db"], "las": out["las"],
+                         "tenant": "alice", "idempotency_key": "rt-e2e-0"})
+        assert st == 200 and json.loads(raw)["job"] == jobs[0]
+        assert json.loads(raw).get("idempotent") is True
+
+        # proxied result + stream, byte-identical to the solo run
+        st, body = _rreq(rport, "GET", f"/v1/jobs/{jobs[0]}/result?wait=1")
+        assert st == 200 and body == ref
+        st, sbody = _rreq(rport, "GET", f"/v1/jobs/{jobs[0]}/stream")
+        assert st == 200 and sbody == ref
+        for j in jobs[1:]:
+            _rreq(rport, "GET", f"/v1/jobs/{j}/result?wait=1")
+
+        # burn goes red on the owner → the next route spills off it
+        svcs[owner]._slo_burn_last = 5.0
+        rt.refresh()
+        spilled = rt.route("alice")
+        assert spilled.name != owner
+        assert rt.counters["spills"] >= 1
+        svcs[owner]._slo_burn_last = 0.0
+
+        # unknown job: clean 404 from the router itself
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _rreq(rport, "GET", "/v1/jobs/j99999")
+        assert ei.value.code == 404
+    finally:
+        rt.shutdown()
+        rhttpd.shutdown()
+        for svc, httpd in servers:
+            svc.shutdown(drain=True)
+            httpd.shutdown()
+    _lint([os.path.join(str(tmp_path / "router"), "router.events.jsonl"),
+           os.path.join(str(tmp_path / "p0"), "serve.events.jsonl"),
+           os.path.join(str(tmp_path / "p1"), "serve.events.jsonl")])
+
+
+# ---------------------------------------------------------------------------
+# live e2e: SIGKILL mid-job, retry through the router lands exactly once
+# ---------------------------------------------------------------------------
+
+def _spawn_peer(workdir, root, tag, peer_dir, fault=None):
+    ready = os.path.join(str(root), f"ready-{tag}.json")
+    argv = [sys.executable, "-m", "daccord_tpu.tools.cli", "serve",
+            "--workdir", str(workdir), "--backend", "native", "-b", "64",
+            "--workers", "2", "--port", "0", "--ready-file", ready,
+            "--checkpoint-reads", "4", "--flush-lag-ms", "20",
+            "--peer-dir", str(peer_dir), "--lease-ttl-s", "600"]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__import__("daccord_tpu").__file__)))
+    env["PYTHONPATH"] = pkg_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    if fault:
+        env["DACCORD_FAULT"] = fault
+    else:
+        env.pop("DACCORD_FAULT", None)
+    log = open(os.path.join(str(root), f"serve-{tag}.log"), "wb")
+    proc = subprocess.Popen(argv, env=env, stdout=log, stderr=log)
+    deadline = time.time() + 120
+    port = None
+    while time.time() < deadline:
+        if os.path.exists(ready):
+            try:
+                port = json.load(open(ready))["port"]
+                break
+            except (OSError, json.JSONDecodeError, ValueError):
+                pass
+        if proc.poll() is not None:
+            break
+        time.sleep(0.05)
+    return proc, port
+
+
+def _journal(workdir):
+    from daccord_tpu.serve.journal import replay
+
+    return replay(os.path.join(str(workdir), "journal.jsonl"))
+
+
+@needs_native
+def test_kill_mid_proxy_retry_lands_exactly_once(dataset, tmp_path):
+    """Two real peers behind the router; the job's owner SIGKILLs itself at
+    the first progress append (running mid-batch, mid-proxy from the
+    client's view). The client's retry with the SAME idempotency key rides
+    the router to the survivor and lands exactly once, byte-identical."""
+    from daccord_tpu.serve.router import Router, RouterConfig, start_router
+
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    peer_dir = str(tmp_path / "fleet")
+    os.makedirs(peer_dir, exist_ok=True)
+    # pin the doomed peer: pick the tenant whose rendezvous owner is pA,
+    # and give ONLY pA the deterministic SIGKILL (serve_crash:3 with a
+    # 4-read checkpoint stride = the first progress append)
+    tenant = next(t for t in (f"kt{i}" for i in range(1000))
+                  if Router._score(t, "pA") > Router._score(t, "pB"))
+    procA, portA = _spawn_peer(tmp_path / "pA", tmp_path, "a", peer_dir,
+                               fault="serve_crash:3")
+    procB, portB = _spawn_peer(tmp_path / "pB", tmp_path, "b", peer_dir)
+    assert portA and portB
+    rt = Router(RouterConfig(workdir=str(tmp_path / "router"),
+                             peer_dir=peer_dir, poll_s=0.3,
+                             lease_ttl_s=600.0))
+    rhttpd, rport, _ = start_router(rt)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            rs = rt.stats()
+            if sum(1 for p in rs["peers"]
+                   if p["alive"] and p["ready"]) == 2:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail(f"peers never turned ready: {rt.stats()['peers']}")
+
+        body = {"db": out["db"], "las": out["las"], "tenant": tenant,
+                "idempotency_key": "kill-once"}
+        st, raw = _rreq(rport, "POST", "/v1/jobs", body)
+        assert st == 201
+        jid1 = json.loads(raw)["job"]
+        assert rt.stats()["jobs"][jid1] == "pA"
+
+        # the owner dies at its first progress append
+        rc = procA.wait(timeout=180)
+        assert rc == 137
+
+        # retry the SAME key through the router until it lands; early
+        # attempts may see 502 (dead proxy target) or 503 — both retryable
+        jid2 = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                st, raw = _rreq(rport, "POST", "/v1/jobs", body, timeout=30)
+                if st in (200, 201):
+                    jid2 = json.loads(raw)["job"]
+                    break
+            except urllib.error.HTTPError as e:
+                # dead proxy target (502) or a fleet mid-discovery (503):
+                # both declare themselves retryable
+                assert e.code in (502, 503), (e.code, e.read())
+                assert json.loads(e.read()).get("retryable") is True
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.3)
+        assert jid2 is not None, "retry never landed on the survivor"
+        assert rt.stats()["jobs"][jid2] == "pB"
+
+        st, got = _rreq(rport, "GET", f"/v1/jobs/{jid2}/result?wait=1")
+        assert st == 200 and got == ref
+
+        # exactly once: a further replay of the key dedupes onto the same
+        # job — and the survivor's journal admitted the key ONCE
+        st, raw = _rreq(rport, "POST", "/v1/jobs", body)
+        assert st == 200 and json.loads(raw)["job"] == jid2
+        entsB, _ = _journal(tmp_path / "pB")
+        hitsB = [e for e in entsB.values() if e.idem == "kill-once"]
+        assert len(hitsB) == 1 and hitsB[0].state == "committed"
+        # fleet-wide: exactly one COMMITTED job ever carried the key (the
+        # dead owner admitted it but never finished)
+        entsA, _ = _journal(tmp_path / "pA")
+        committed = [e for e in list(entsA.values()) + list(entsB.values())
+                     if e.idem == "kill-once" and e.state == "committed"]
+        assert len(committed) == 1
+
+        # the router observed the death and said so
+        evs = _events(rt)
+        downs = [e for e in evs if e["event"] == "router.peer_down"]
+        assert any(e["peer"] == "pA" for e in downs)
+    finally:
+        rt.shutdown()
+        rhttpd.shutdown()
+        for proc in (procA, procB):
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+    _lint([os.path.join(str(tmp_path / "router"), "router.events.jsonl"),
+           os.path.join(str(tmp_path / "pB"), "serve.events.jsonl")])

@@ -333,7 +333,8 @@ def test_warm_state_reuse_and_eviction(dataset, tmp_path):
     svc.wait(j1["job"], 300)
     j2 = svc.submit({"db": out["db"], "las": out["las"]})
     svc.wait(j2["job"], 300)
-    assert svc.warm.counters == {"hits": 1, "misses": 1, "evicted": 0}
+    assert svc.warm.counters == {"hits": 1, "misses": 1, "evicted": 0,
+                                 "evict_deferred": 0}
     assert len(svc.warm.groups()) == 1
     svc.warm.idle_evict_s = 0.0
     # The ticker also calls evict_idle(); once the TTL drops to 0 either

@@ -512,6 +512,159 @@ python -m daccord_tpu.tools.cli sentinel --strict "$crashdir/srv" \
 echo "tools_pounce: serve-crash smoke OK" >&2
 rm -rf "$crashdir"
 
+# front-door smoke (ISSUE 16): two real daccord-serve peers share a peer-dir
+# (announce leases) behind a real daccord-router. The tenant's rendezvous
+# owner is computed up front and started with a deterministic SIGKILL at its
+# first progress append; the client's retry with the SAME idempotency key
+# must ride the router to the survivor and land exactly once, byte-identical
+# to the solo run — the exactly-once contract THROUGH the front door, gated
+# before any chip time. The router's own sidecar then passes the same strict
+# eventcheck / trace / sentinel / top chain as every other plane.
+routdir=$(mktemp -d)
+python - "$routdir" <<'EOF' || { echo "tools_pounce: router-smoke synth failed" >&2; exit 1; }
+import sys
+from daccord_tpu.sim.synth import SimConfig, make_dataset
+make_dataset(sys.argv[1], SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=500, min_overlap=200,
+                                    seed=5), name="sv")
+# the doomed peer is the tenant's rendezvous owner — computable before a
+# single process starts, because the stickiness is pure hash (stateless)
+from daccord_tpu.serve.router import Router
+owner = max(["p1", "p2"], key=lambda n: Router._score("smoke", n))
+open(f"{sys.argv[1]}/owner.txt", "w").write(owner)
+EOF
+python -m daccord_tpu.tools.cli daccord "$routdir/sv.db" "$routdir/sv.las" \
+    --backend native -b 64 -o "$routdir/solo.fasta" \
+  || { echo "tools_pounce: router-smoke solo reference FAILED" >&2; exit 1; }
+OWNER=$(cat "$routdir/owner.txt")
+if [ "$OWNER" = "p1" ]; then SURV=p2; else SURV=p1; fi
+env DACCORD_FAULT=serve_crash:3 \
+  python -m daccord_tpu.tools.cli serve --workdir "$routdir/$OWNER" \
+    --backend native -b 64 --port 0 --ready-file "$routdir/ready-owner.json" \
+    --checkpoint-reads 4 --peer-dir "$routdir/fleet" --lease-ttl-s 600 \
+    > "$routdir/serve-owner.log" 2>&1 &
+OWNER_PID=$!
+python -m daccord_tpu.tools.cli serve --workdir "$routdir/$SURV" \
+    --backend native -b 64 --port 0 --ready-file "$routdir/ready-surv.json" \
+    --checkpoint-reads 4 --peer-dir "$routdir/fleet" --lease-ttl-s 600 \
+    > "$routdir/serve-surv.log" 2>&1 &
+SURV_PID=$!
+python -m daccord_tpu.tools.cli router --workdir "$routdir/router" \
+    --peer-dir "$routdir/fleet" --port 0 --poll-s 0.3 --lease-ttl-s 600 \
+    --ready-file "$routdir/ready-router.json" \
+    > "$routdir/router.log" 2>&1 &
+ROUTER_PID=$!
+python - "$routdir" <<'EOF' || { echo "tools_pounce: router-smoke submit FAILED" >&2; kill "$OWNER_PID" "$SURV_PID" "$ROUTER_PID" 2>/dev/null; exit 1; }
+import json, os, sys, time, urllib.request
+d = sys.argv[1]
+for f in ("ready-owner.json", "ready-surv.json", "ready-router.json"):
+    for _ in range(600):
+        if os.path.exists(f"{d}/{f}"):
+            break
+        time.sleep(0.1)
+    else:
+        raise SystemExit(f"router smoke: {f} never appeared")
+port = json.load(open(f"{d}/ready-router.json"))["port"]
+base = f"http://127.0.0.1:{port}"
+for _ in range(300):   # discovery: both peers announced AND polled ready
+    with urllib.request.urlopen(base + "/v1/router", timeout=30) as resp:
+        rs = json.loads(resp.read())
+    if sum(1 for p in rs["peers"] if p["alive"] and p["ready"]) == 2:
+        break
+    time.sleep(0.1)
+else:
+    raise SystemExit(f"router smoke: fleet never turned ready: {rs['peers']}")
+r = urllib.request.Request(base + "/v1/jobs", method="POST",
+                           data=json.dumps({"db": f"{d}/sv.db",
+                                            "las": f"{d}/sv.las",
+                                            "tenant": "smoke",
+                                            "idempotency_key": "fd-smoke"}).encode(),
+                           headers={"Content-Type": "application/json"})
+with urllib.request.urlopen(r, timeout=60) as resp:
+    st = json.loads(resp.read())
+with urllib.request.urlopen(base + "/v1/router", timeout=30) as resp:
+    routed = json.loads(resp.read())["jobs"][st["job"]]
+owner = open(f"{d}/owner.txt").read().strip()
+assert routed == owner, f"stickiness broke: routed {routed}, owner {owner}"
+EOF
+wait "$OWNER_PID"; OWNER_RC=$?
+[ "$OWNER_RC" -eq 137 ] \
+  || { echo "tools_pounce: router-smoke owner exited $OWNER_RC (expected injected 137)" >&2; exit 1; }
+python - "$routdir" <<'EOF' || { echo "tools_pounce: router-smoke retry/parity FAILED" >&2; kill "$SURV_PID" "$ROUTER_PID" 2>/dev/null; exit 1; }
+import json, os, sys, time, urllib.error, urllib.request
+d = sys.argv[1]
+port = json.load(open(f"{d}/ready-router.json"))["port"]
+base = f"http://127.0.0.1:{port}"
+body = json.dumps({"db": f"{d}/sv.db", "las": f"{d}/sv.las",
+                   "tenant": "smoke", "idempotency_key": "fd-smoke"}).encode()
+def submit():
+    r = urllib.request.Request(base + "/v1/jobs", method="POST", data=body,
+                               headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=60) as resp:
+        return resp.status, json.loads(resp.read())
+job = None
+deadline = time.time() + 60
+while time.time() < deadline:   # early retries may see retryable 502/503
+    try:
+        stc, st = submit()
+        if stc in (200, 201):
+            job = st["job"]
+            break
+    except urllib.error.HTTPError as e:
+        assert e.code in (502, 503), e.code
+    except (urllib.error.URLError, OSError):
+        pass
+    time.sleep(0.3)
+assert job, "retry never landed on the survivor"
+with urllib.request.urlopen(base + f"/v1/jobs/{job}/result?wait=1",
+                            timeout=300) as resp:
+    got = resp.read()
+solo = open(f"{d}/solo.fasta", "rb").read()
+assert got == solo, "retried job FASTA diverged from the solo run"
+stc, dup = submit()             # exactly once: the key dedupes, no rerun
+assert stc == 200 and dup["job"] == job, (stc, dup, job)
+for f in ("ready-owner.json", "ready-surv.json"):
+    p = json.load(open(f"{d}/{f}"))["port"]
+    try:
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{p}/v1/shutdown", method="POST"),
+            timeout=60).read()
+    except (urllib.error.URLError, OSError):
+        pass                    # the dead owner: nothing to drain
+urllib.request.urlopen(urllib.request.Request(base + "/v1/shutdown",
+                                              method="POST"), timeout=60).read()
+print("router smoke: retry landed exactly once, byte-identical")
+EOF
+wait "$SURV_PID" \
+  || { echo "tools_pounce: surviving peer did not shut down cleanly" >&2; exit 1; }
+wait "$ROUTER_PID" \
+  || { echo "tools_pounce: router did not shut down cleanly" >&2; exit 1; }
+grep -q '"event": "router.peer_down"' "$routdir/router/router.events.jsonl" \
+  || { echo "tools_pounce: router never recorded the dead peer" >&2; exit 1; }
+python -m daccord_tpu.tools.cli eventcheck --strict \
+    "$routdir/router/router.events.jsonl" \
+    "$routdir/$SURV/serve.events.jsonl" "$routdir/$SURV"/g*.events.jsonl \
+    "$routdir/$SURV"/jobs/*/events.jsonl \
+  || { echo "tools_pounce: router-smoke events failed schema lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli trace --check --no-timeline \
+    "$routdir/router/router.events.jsonl" \
+    "$routdir/$SURV/serve.events.jsonl" "$routdir/$SURV"/g*.events.jsonl \
+    "$routdir/$SURV"/jobs/*/events.jsonl \
+  || { echo "tools_pounce: router-smoke sidecars failed daccord-trace lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli sentinel --strict "$routdir/router" \
+  || { echo "tools_pounce: router-smoke tripped the regression sentinel" >&2; exit 1; }
+python -m daccord_tpu.tools.cli top --once "$routdir/router" \
+  || { echo "tools_pounce: daccord-top failed over the router workdir" >&2; exit 1; }
+echo "tools_pounce: front-door smoke OK" >&2
+rm -rf "$routdir"
+
+# front-door bench stage (ISSUE 16 satellite): cold-peer TTFR with/without
+# the AOT cache + p99 through the router during a live scale-out
+env DACCORD_BENCH_ROUTER=1 python bench.py > "BENCH_ROUTER_${stamp}.log" 2>&1 \
+  && git add BENCH_ROUTER.json "BENCH_ROUTER_${stamp}.log" \
+  && git commit -q -m "pounce: front-door router bench (${stamp})" \
+  || echo "tools_pounce: router bench stage failed (non-fatal)" >&2
+
 # serve bench stage (ISSUE 10 satellite): replay the default job-arrival
 # trace against the server and commit the latency sidecar — the first
 # serving number (p50/p99 + windows/sec) lands beside the rung ladder
