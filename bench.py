@@ -102,6 +102,18 @@ BENCH_SERVE_TRACE = os.environ.get("DACCORD_BENCH_SERVE_TRACE")
 # BENCH_SERVE_SOAK.json. DACCORD_BENCH_SERVE_SOAK_JOBS overrides the job
 # count (default 20).
 BENCH_SERVE_SOAK = os.environ.get("DACCORD_BENCH_SERVE_SOAK") == "1"
+# disk-chaos soak (ISSUE 17): DACCORD_BENCH_DISK=1 runs the same 2-peer
+# serve fleet under an injected ENOSPC/EIO storage storm (io_enospc@journal
+# bursts on one peer, transient io_eio@lease on the other — the full-disk
+# matrix from runtime/faults.py) and asserts the graceful-degradation
+# contract: NO process dies, submissions during the latch get structured
+# 507 refusals, every completed FASTA is byte-identical to the solo
+# control with exactly-once commits, transient lease EIO never demotes a
+# healthy run, zero .tmp/spool litter remains, and the fleet recovers
+# fully once the storm is spent. Commits BENCH_DISK.json (chaos-flagged so
+# daccord-sentinel --strict exempts the deliberate pressure).
+# DACCORD_BENCH_DISK_JOBS overrides the job count (default 8).
+BENCH_DISK = os.environ.get("DACCORD_BENCH_DISK") == "1"
 # front door (ISSUE 16): DACCORD_BENCH_ROUTER=1 commits BENCH_ROUTER.json
 # with two arms: (a) cold-peer TTFR — time from fresh solve path to the
 # first fetched batch result — WITH the fleet-shared AOT executable cache
@@ -1606,6 +1618,368 @@ def run_serve_soak(root: str | None = None, n_jobs: int = 20,
     return line
 
 
+def run_disk_soak(root: str | None = None, n_jobs: int = 8,
+                  seed: int = 0xD15C, ev=None, backend: str | None = None,
+                  timeout_s: float = 900.0,
+                  commit_sidecar: bool = True) -> dict:
+    """Disk-chaos soak (ISSUE 17): the full-disk matrix against TWO live
+    ``daccord-serve`` peers. One peer's journal domain eats a consecutive
+    ``io_enospc@journal`` burst (every append in the window is refused —
+    the disk-pressure governor must latch, 507 new work, and release once
+    the volume proves writable); the other's lease domain eats scattered
+    transient ``io_eio@lease`` (heartbeats must ride the bounded grace,
+    never demote healthy runs). Unlike the crash soak, NOBODY dies — the
+    whole point is that a disk saying no produces structured refusals and
+    resumable state, not corpses.
+
+    Asserts the graceful-degradation contract (AssertionError = broken):
+
+    - no server process exits during the storm (rc 0 only at shutdown);
+    - >= 1 structured 507 refusal with ``reason: disk_pressure``;
+    - every admitted job completes DONE with a byte-identical FASTA and
+      exactly-once commit semantics (events, not the refused journal);
+    - zero lease demotions / takeovers (transient EIO stays transient);
+    - the pressure latch is observed entering AND the fleet fully
+      recovers: pressure clears, a post-storm submit admits and commits;
+    - zero ``.tmp`` litter, zero stray spool dirs, zero leaked quota.
+    """
+    import random as _random
+    import shutil
+    import socket
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from daccord_tpu.sim.synth import SimConfig, make_dataset
+
+    if backend is None:
+        backend = os.environ.get("DACCORD_BENCH_SERVE_BACKEND")
+    if not backend:
+        try:
+            from daccord_tpu.native import available as _nat
+
+            backend = "native" if _nat() else "cpu"
+        except Exception:
+            backend = "cpu"
+    rng = _random.Random(seed)
+    owns_root = root is None
+    root = root or tempfile.mkdtemp(prefix="daccord-disk-soak-")
+    data = make_dataset(root, SimConfig(genome_len=1500, coverage=10,
+                                        read_len_mean=500, min_overlap=200,
+                                        seed=5), name="sv")
+    import dataclasses as _dc
+
+    from daccord_tpu.runtime.pipeline import correct_to_fasta
+    from daccord_tpu.serve.jobs import JobSpec, build_job_config
+
+    spec = JobSpec.from_json({"db": data["db"], "las": data["las"]}, root)
+    ccfg = build_job_config(spec, backend, True, 64, "fused", root, "solo")
+    ccfg = _dc.replace(ccfg, native_solver=backend == "native",
+                       supervise=True, events_path=None, ledger_path=None,
+                       job_tag=None, quarantine_path=None)
+    solo = os.path.join(root, "solo.fasta")
+    correct_to_fasta(data["db"], data["las"], solo, ccfg)
+    with open(solo, "rb") as fh:
+        solo_bytes = fh.read()
+
+    peer = os.path.join(root, "peer")
+    pkg_root = os.path.dirname(os.path.abspath(
+        __import__("daccord_tpu").__file__))
+    pkg_root = os.path.dirname(pkg_root)
+
+    # the storm: a CONSECUTIVE journal-refusal window on srvA (appends
+    # 3..N all fail — the latch re-enters on every append until the burst
+    # is spent), scattered transient lease EIO on srvB (hits land on
+    # read/renew heartbeat ops; the grace must absorb them). Seed-jittered
+    # burst width so two soak seeds stress different exhaustion points.
+    burst_hi = 22 + rng.randint(0, 8)
+    storms = {
+        "srvA": ",".join(f"io_enospc:{i}@journal"
+                         for i in range(3, burst_hi)) + ",io_slow:2@journal",
+        "srvB": ",".join(f"io_eio:{i}@lease"
+                         for i in (4, 5, 9, 10, 15)),
+    }
+    servers = {name: {"workdir": os.path.join(root, name), "proc": None,
+                      "port": None}
+               for name in ("srvA", "srvB")}
+
+    def spawn(name: str) -> None:
+        s = servers[name]
+        ready = os.path.join(root, f"{name}.ready.json")
+        argv = [sys.executable, "-m", "daccord_tpu.tools.cli", "serve",
+                "--workdir", s["workdir"], "--backend", backend, "-b", "64",
+                "--workers", "2", "--port", "0", "--ready-file", ready,
+                "--peer-dir", peer, "--lease-ttl-s", "6",
+                "--heartbeat-s", "0.5", "--checkpoint-reads", "4",
+                "--flush-lag-ms", "20", "--metrics-snapshot-s", "5",
+                "--drain-deadline-s", "120"]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env["DACCORD_FAULT"] = storms[name]
+        log = open(os.path.join(root, f"{name}.log"), "wb")
+        s["proc"] = subprocess.Popen(argv, env=env, stdout=log, stderr=log)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if os.path.exists(ready):
+                try:
+                    s["port"] = json.load(open(ready))["port"]
+                    return
+                except (OSError, json.JSONDecodeError, ValueError):
+                    pass
+            assert s["proc"].poll() is None, \
+                f"disk soak: {name} died during startup " \
+                f"(rc {s['proc'].poll()})"
+            time.sleep(0.05)
+        raise RuntimeError(f"disk soak: {name} never wrote its ready file")
+
+    def assert_alive() -> None:
+        for name, s in servers.items():
+            rc = s["proc"].poll()
+            assert rc is None, \
+                f"disk soak: {name} DIED under the storage storm (rc {rc})" \
+                f" — a full disk must degrade, never kill"
+
+    def req(name: str, method: str, path: str, body=None, timeout=60):
+        s = servers[name]
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{s['port']}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(r, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except (json.JSONDecodeError, OSError, ValueError):
+                payload = {}
+            return e.code, payload
+
+    t0 = time.time()
+    for name in servers:
+        spawn(name)
+
+    refusals_507 = 0
+    refusals_other = 0
+    refusal_reasons: set[str] = set()
+    jobs = {}   # idem -> {"home": name, "job": id}
+
+    def submit(name: str, idem: str, patient: bool) -> bool:
+        """One admission attempt (``patient`` retries through refusals);
+        refusal codes are tallied, an admit lands in ``jobs``."""
+        nonlocal refusals_507, refusals_other
+        sub_deadline = time.time() + 180
+        while True:
+            assert_alive()
+            try:
+                code, st = req(name, "POST", "/v1/jobs",
+                               {"db": data["db"], "las": data["las"],
+                                "tenant": f"t{len(jobs) % 3}",
+                                "idempotency_key": idem})
+            except (urllib.error.URLError, ConnectionError, socket.timeout,
+                    OSError):
+                code, st = 0, {}
+            if code in (200, 201):
+                jobs[idem] = {"home": name, "job": st["job"]}
+                return True
+            if code == 507:
+                refusals_507 += 1
+                refusal_reasons.add(str(st.get("reason")))
+            elif code in (429, 503):
+                refusals_other += 1
+            if not patient or time.time() > sub_deadline:
+                return False
+            time.sleep(0.2)
+
+    # seeded arrival trace; each srvA admit is chased by one impatient
+    # probe — the admit's journal append fails inside the burst window and
+    # latches the governor, so a submit landing right behind it meets the
+    # 507 while the latch is hot
+    for i in range(n_jobs):
+        time.sleep(rng.uniform(0.03, 0.25))
+        name = "srvA" if i % 2 == 0 else "srvB"
+        assert submit(name, f"disk-{seed}-{i}", patient=True), \
+            f"disk soak: job {i} never admitted"
+        if name == "srvA":
+            submit("srvA", f"disk-{seed}-probe-{i}", patient=False)
+    # the burst outlives the arrival trace: hammer until the 507 is seen
+    # (every admitted probe burns more of the burst, so this terminates)
+    probes = 0
+    while refusals_507 == 0 and probes < 60:
+        probes += 1
+        submit("srvA", f"disk-{seed}-extra-{probes}", patient=False)
+        time.sleep(0.05)
+    assert refusals_507 >= 1, \
+        "disk soak: the ENOSPC burst never produced a 507 refusal"
+    assert "disk_pressure" in refusal_reasons, \
+        f"disk soak: 507s lacked the disk_pressure reason: {refusal_reasons}"
+
+    def poll_done() -> dict:
+        states = {}
+        for idem, entry in jobs.items():
+            try:
+                code, st = req(entry["home"], "GET",
+                               f"/v1/jobs/{entry['job']}", timeout=20)
+            except (urllib.error.URLError, ConnectionError, socket.timeout,
+                    OSError):
+                code, st = 0, {}
+            states[idem] = st.get("state") if code == 200 else None
+        return states
+
+    poll_deadline = time.time() + timeout_s
+    states = {}
+    while time.time() < poll_deadline:
+        assert_alive()
+        states = poll_done()
+        if all(s in ("done", "failed", "aborted") for s in states.values()):
+            break
+        time.sleep(0.5)
+    bad = {k: v for k, v in states.items()
+           if v not in ("done",)}
+    assert not bad, f"disk soak: jobs not DONE under the storm: {bad}"
+
+    # recovery: the latch must clear on its own (the probe writes to the
+    # REAL, healthy disk; no appends are failing once the burst is spent)
+    clear_deadline = time.time() + 60
+    pressure = True
+    while time.time() < clear_deadline:
+        try:
+            _, m = req("srvA", "GET", "/v1/metrics", timeout=20)
+            pressure = bool(m["admission"].get("disk_pressure"))
+        except (urllib.error.URLError, ConnectionError, socket.timeout,
+                OSError, KeyError):
+            pressure = True
+        if not pressure:
+            break
+        time.sleep(0.5)
+    assert not pressure, \
+        "disk soak: disk_pressure never cleared after the storm"
+    assert submit("srvA", f"disk-{seed}-recovery", patient=True), \
+        "disk soak: post-storm recovery submit never admitted"
+    rec_deadline = time.time() + 120
+    while time.time() < rec_deadline:
+        st = poll_done().get(f"disk-{seed}-recovery")
+        if st == "done":
+            break
+        assert st in (None, "queued", "running", "done"), \
+            f"disk soak: recovery job ended {st!r}"
+        time.sleep(0.5)
+
+    states = poll_done()
+    assert all(v == "done" for v in states.values()), \
+        f"disk soak: non-done terminal states: {states}"
+
+    # quota balances: refusals and completions alike must leave no charge
+    for name in servers:
+        _, m = req(name, "GET", "/v1/metrics", timeout=60)
+        for tname, tstat in m["admission"].get("tenants", {}).items():
+            assert tstat["queued"] == 0 and tstat["bytes"] == 0, \
+                f"disk soak: leaked quota on {name}/{tname}: {tstat}"
+
+    assert_alive()
+    for name in servers:
+        try:
+            req(name, "POST", "/v1/shutdown", timeout=60)
+        except (urllib.error.URLError, ConnectionError, socket.timeout,
+                OSError):
+            pass
+        rc = servers[name]["proc"].wait(timeout=180)
+        assert rc == 0, f"disk soak: {name} exited {rc} at shutdown"
+
+    # ---- the contract, from the durable record -------------------------
+    commits: dict[str, int] = {}
+    commits_real: dict[str, int] = {}
+    counts = {"io_fault_journal": 0, "io_fault_lease": 0,
+              "pressure_enter": 0, "pressure_clear": 0,
+              "takeovers": 0, "demotions": 0, "interrupted": 0}
+    for name in servers:
+        evp = os.path.join(servers[name]["workdir"], "serve.events.jsonl")
+        with open(evp) as fh:
+            for raw in fh:
+                try:
+                    rec = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                evk = rec.get("event")
+                if evk == "serve.commit":
+                    jid = str(rec.get("job", ""))
+                    key = jid if "." in jid else f"{name}.{jid}"
+                    commits[key] = commits.get(key, 0) + 1
+                    if int(rec.get("fragments", 0)) >= 0:
+                        commits_real[key] = commits_real.get(key, 0) + 1
+                elif evk == "io.fault":
+                    dom = rec.get("domain")
+                    if dom == "journal":
+                        counts["io_fault_journal"] += 1
+                    elif dom == "lease":
+                        counts["io_fault_lease"] += 1
+                elif evk == "disk.pressure":
+                    if rec.get("level") == "enter":
+                        counts["pressure_enter"] += 1
+                    elif rec.get("level") == "clear":
+                        counts["pressure_clear"] += 1
+                elif evk == "serve.takeover":
+                    counts["takeovers"] += 1
+                elif evk == "serve.journal":
+                    if rec.get("rec") == "demoted":
+                        counts["demotions"] += 1
+                    elif rec.get("rec") == "interrupted":
+                        counts["interrupted"] += 1
+    assert counts["io_fault_journal"] >= 1, \
+        "disk soak: no journal io.fault ever surfaced"
+    assert counts["pressure_enter"] >= 1 and counts["pressure_clear"] >= 1, \
+        f"disk soak: latch never cycled: {counts}"
+    assert counts["io_fault_lease"] >= 1, \
+        "disk soak: the lease EIO storm never landed"
+    assert counts["takeovers"] == 0 and counts["demotions"] == 0, \
+        f"disk soak: transient faults caused demotion/takeover: {counts}"
+    for idem, entry in jobs.items():
+        gkey = f"{entry['home']}.{entry['job']}"
+        jdir = os.path.join(servers[entry["home"]]["workdir"], "jobs",
+                            entry["job"])
+        with open(os.path.join(jdir, "out.fasta"), "rb") as fh:
+            got = fh.read()
+        assert got == solo_bytes, \
+            f"disk soak: job {gkey} FASTA diverged from the solo control"
+        assert commits_real.get(gkey, 0) <= 1, \
+            f"disk soak: job {gkey} committed by {commits_real[gkey]} runs"
+        assert commits.get(gkey, 0) >= 1, \
+            f"disk soak: done job {gkey} has no commit record"
+
+    # litter: a refused disk must strand nothing — no .tmp anywhere under
+    # the workdirs, no spool dir the driver didn't submit
+    known = {e["job"] for e in jobs.values()}
+    for name in servers:
+        w = servers[name]["workdir"]
+        tmp_litter = []
+        for dirpath, _dirs, files in os.walk(w):
+            tmp_litter += [os.path.join(dirpath, f) for f in files
+                           if ".tmp." in f]
+        assert not tmp_litter, f"disk soak: tmp litter on {name}: {tmp_litter}"
+        strays = set(os.listdir(os.path.join(w, "jobs"))) - known
+        assert not strays, f"disk soak: stray spool dirs on {name}: {strays}"
+
+    line = {
+        "metric": "disk_soak", "chaos": True, "backend": backend,
+        "seed": seed, "jobs": len(jobs), "done": len(jobs),
+        "refusals_507": refusals_507, "refusals_other": refusals_other,
+        "storm": storms,
+        **counts,
+        "wall_s": round(time.time() - t0, 3),
+        "parity": True, "leaks": 0, "recovered": True,
+        **_tunnel_staleness(),
+    }
+    if ev is not None:
+        ev.log("bench_done", wall_s=line["wall_s"])
+    if commit_sidecar:
+        _commit_sidecar("BENCH_DISK.json", line)
+    if owns_root:
+        shutil.rmtree(root, ignore_errors=True)
+    return line
+
+
 def main() -> None:
     import argparse
 
@@ -1631,6 +2005,14 @@ def main() -> None:
         ev.log("bench_start", batch=0, soak=True)
         n = int(os.environ.get("DACCORD_BENCH_SERVE_SOAK_JOBS", "20"))
         print(json.dumps(run_serve_soak(ev=ev, n_jobs=n)))
+        return
+    if BENCH_DISK:
+        # disk-chaos soak (ISSUE 17): 2 serve peers under an injected
+        # ENOSPC/EIO storage storm; the asserts ARE the stage — a broken
+        # degradation contract exits nonzero
+        ev.log("bench_start", batch=0, disk=True)
+        n = int(os.environ.get("DACCORD_BENCH_DISK_JOBS", "8"))
+        print(json.dumps(run_disk_soak(ev=ev, n_jobs=n)))
         return
     if BENCH_SERVE:
         # serving-plane stage: self-contained (synth corpus + real HTTP

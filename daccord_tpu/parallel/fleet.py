@@ -183,6 +183,14 @@ class FleetConfig:
                                       # by N (0/1 = single device)
     max_pile_overlaps: int | None = None  # monster-pile budget (None = the
                                           # pipeline default; 0 disables)
+    disk_floor_mb: float = 0.0        # free-bytes spawn floor (ISSUE 17):
+                                      # below this much free space on the
+                                      # outdir volume the orchestrator
+                                      # refuses to spawn NEW workers (each
+                                      # writes shard outputs + telemetry
+                                      # there) — running workers finish,
+                                      # leases stay claimable by peers on
+                                      # healthier volumes. 0 = off
     worker_telemetry: bool = True     # thread per-worker telemetry sidecars
                                       # (ISSUE 6): every daccord-shard worker
                                       # writes shardNNNN.events.jsonl (trace
@@ -556,6 +564,22 @@ class Fleet:
 
     def _claim_and_spawn(self, now: float) -> None:
         cfg = self.cfg
+        if cfg.disk_floor_mb:
+            from ..utils.obs import disk_free_mb
+
+            free = disk_free_mb(self.outdir)
+            if 0 <= free < cfg.disk_floor_mb:
+                # below the free-bytes floor: spawning another writer would
+                # only deepen the hole. Running workers finish; pending
+                # shards wait (their leases stay claimable by peers whose
+                # volumes have headroom). Logged at most once per second —
+                # the poll loop spins at poll_s.
+                if now - getattr(self, "_disk_floor_logged", 0.0) >= 1.0:
+                    self._disk_floor_logged = now
+                    self.log.log("disk.pressure", level="spawn_floor",
+                                 src="fleet", free_mb=round(free, 1),
+                                 detail=f"floor {cfg.disk_floor_mb:.0f} MiB")
+                return
         slots = cfg.workers - sum(1 for st in self.shards.values()
                                   if st.status == "running")
         for st in sorted(self.shards.values(), key=lambda s: s.shard):

@@ -123,6 +123,38 @@ lever the acceptance run uses: the same corpus with ``feeder_stall:50``
 must flip the committed verdict to ``host_feeder`` with ``stall`` named as
 the dominant sub-stage, while the FASTA stays byte-identical (a slow feeder
 changes wall-clock, never bytes).
+
+Storage kinds (the I/O twins, ISSUE 17) make the disk say no — every
+durable path (journal appends, lease claims/renewals, manifest commits,
+spool uploads, telemetry sidecars, AOT-cache publishes) consults the plan
+through ``utils/aio.py``'s fault hook, so the full-disk matrix runs
+chip-free like every prior one::
+
+    DACCORD_FAULT=io_enospc:3             # 3rd I/O primitive op: ENOSPC
+    DACCORD_FAULT=io_eio:2                # 2nd op: transient EIO (the aio
+                                          # bounded-retry wrapper absorbs it)
+    DACCORD_FAULT=io_fsync_fail:1         # 1st op: the fsync step fails
+    DACCORD_FAULT=io_short_write:2        # 2nd op: torn bytes hit the disk,
+                                          # then the write errors (ENOSPC)
+    DACCORD_FAULT=io_slow:50              # EVERY op delayed 50 ms (duration
+                                          # grammar, like feeder_stall)
+    DACCORD_FAULT=io_enospc:3@journal     # 3rd JOURNAL-domain op only
+
+The optional ``@domain`` suffix scopes a storage spec to one path class —
+``journal`` | ``lease`` | ``manifest`` | ``spool`` | ``sidecar`` | ``aot``
+— with a per-domain counter, so ``io_enospc:3@journal`` means "the 3rd
+journal write fails" regardless of how much lease/sidecar traffic
+interleaves. Without a domain, N indexes the process-wide I/O-op counter.
+Counter domains: every :meth:`FaultPlan.io_check` call (one per logical
+aio primitive invocation — retries of the same op re-count, because each
+retry genuinely re-runs the syscalls) advances both the global and the
+per-domain counter. ``io_slow`` reads N as milliseconds and is continuous
+(never fired-out), mirroring ``feeder_stall``; an ``@domain`` scopes the
+delay. ``io_eio`` is the only *transient* class: ``aio.retrying`` retries
+it with bounded backoff, while ``io_enospc`` / ``io_fsync_fail`` /
+``io_short_write`` are persistent-for-this-op and surface to the caller
+(a failed fsync in particular must never be silently retried — the page
+state after it is undefined).
 """
 
 from __future__ import annotations
@@ -176,7 +208,20 @@ _KINDS = ("fetch_hang", "dispatch_error", "device_lost", "compile_stall",
           "crash", "las_bitflip", "las_truncate", "db_garbage",
           "worker_crash", "worker_hang", "lease_stall",
           "device_oom", "host_rss", "monster_pile", "worker_oom",
-          "feeder_stall", "serve_crash", "serve_hang")
+          "feeder_stall", "serve_crash", "serve_hang",
+          "io_enospc", "io_eio", "io_fsync_fail", "io_short_write",
+          "io_slow")
+
+#: storage kinds (ISSUE 17): consumed by the utils/aio.py fault hook at
+#: every durable-I/O primitive, optionally scoped to one path class with
+#: ``@domain``. ``io_slow`` reads N as milliseconds (duration grammar).
+IO_KINDS = ("io_enospc", "io_eio", "io_fsync_fail", "io_short_write",
+            "io_slow")
+
+#: path classes a storage spec may scope to — the durable surfaces of the
+#: multi-process tier: the serve job journal, shared-FS leases, shard/job
+#: manifests, tenant spool uploads, telemetry sidecars, the AOT cache dir.
+IO_DOMAINS = ("journal", "lease", "manifest", "spool", "sidecar", "aot")
 
 #: fleet-orchestrator kinds: they sabotage worker spawns / lease renewal at
 #: the fleet layer (parallel/fleet.py) and are stripped from the worker
@@ -198,6 +243,7 @@ class FaultSpec:
     at: int = 1        # 1-based index in the kind's counter domain
     fired: bool = False
     device: int = -1   # mesh-member index a device_lost names (-1 = unknown)
+    domain: str = ""   # path class an io_* spec scopes to ("" = any domain)
 
 
 @dataclass
@@ -226,6 +272,11 @@ class FaultPlan:
     # serve counters (advance once per fsync'd journal append / job run)
     n_journal: int = 0
     n_jobrun: int = 0
+    # storage counters (advance once per aio primitive invocation): the
+    # process-wide op count plus one counter per path-class domain, so an
+    # ``@domain`` spec indexes only its own class's traffic
+    n_io: int = 0
+    n_io_domain: dict = field(default_factory=dict)
 
     @classmethod
     def parse(cls, text: str) -> "FaultPlan":
@@ -240,18 +291,31 @@ class FaultPlan:
                     f"DACCORD_FAULT: unknown kind {kind!r} (known: "
                     f"{', '.join(_KINDS)})")
             at, _, dev = at.partition("@")
-            if dev and kind != "device_lost":
-                raise ValueError(
-                    f"DACCORD_FAULT: @device only applies to device_lost "
-                    f"(got {part!r})")
+            d, dom = -1, ""
+            if dev:
+                if kind == "device_lost":
+                    try:
+                        d = int(dev)
+                    except ValueError:
+                        raise ValueError(
+                            f"DACCORD_FAULT: bad device in {part!r}")
+                elif kind in IO_KINDS:
+                    if dev not in IO_DOMAINS:
+                        raise ValueError(
+                            f"DACCORD_FAULT: unknown io domain {dev!r} "
+                            f"(known: {', '.join(IO_DOMAINS)})")
+                    dom = dev
+                else:
+                    raise ValueError(
+                        f"DACCORD_FAULT: @suffix only applies to device_lost "
+                        f"(@device) and io_* kinds (@domain) (got {part!r})")
             try:
                 n = int(at) if at else 1
-                d = int(dev) if dev else -1
             except ValueError:
                 raise ValueError(f"DACCORD_FAULT: bad count in {part!r}")
             if n < 1:
                 raise ValueError(f"DACCORD_FAULT: count must be >= 1 in {part!r}")
-            specs.append(FaultSpec(kind, n, device=d))
+            specs.append(FaultSpec(kind, n, device=d, domain=dom))
         return cls(specs=specs)
 
     @classmethod
@@ -390,6 +454,47 @@ class FaultPlan:
         the peer takeover of a hung process's lease."""
         self.n_jobrun += 1
         return self._take("serve_hang", self.n_jobrun) is not None
+
+    def io_check(self, domain: str = "") -> "FaultSpec | None":
+        """Advance the storage-op counters for one logical aio primitive
+        invocation in path class ``domain`` and return the fired ``io_*``
+        spec (never ``io_slow`` — that is a duration, see
+        :meth:`io_slow_ms`), or None. A domained spec matches only ops of
+        its own class and indexes that class's private counter; an
+        undomained spec indexes the process-wide op counter. One-shot like
+        the device kinds — the retry wrapper's next attempt runs clean,
+        which is exactly what makes ``io_eio`` a *transient* class."""
+        self.n_io += 1
+        cnt = self.n_io_domain.get(domain, 0) + 1
+        self.n_io_domain[domain] = cnt
+        for s in self.specs:
+            if s.kind not in IO_KINDS or s.kind == "io_slow" or s.fired:
+                continue
+            if s.domain:
+                if s.domain == domain and cnt >= s.at:
+                    s.fired = True
+                    return s
+            elif self.n_io >= s.at:
+                s.fired = True
+                return s
+        return None
+
+    def io_slow_ms(self, domain: str = "") -> float:
+        """Milliseconds of injected delay for ONE storage op in ``domain``
+        (``io_slow:MS[@domain]`` — N is a DURATION, like ``feeder_stall``),
+        0.0 when absent. Continuous, never fired-out: a degraded disk is
+        slow for the whole run, and sustained slowness — not a one-shot
+        blip — is what the saturation verdict and SLO burn must see."""
+        for s in self.specs:
+            if s.kind == "io_slow" and (not s.domain or s.domain == domain):
+                return float(s.at)
+        return 0.0
+
+    def has_io_faults(self) -> bool:
+        """True while any storage spec could still fire (or an ``io_slow``
+        delay applies) — the aio hook's fast-path gate."""
+        return any(s.kind in IO_KINDS and (s.kind == "io_slow" or not s.fired)
+                   for s in self.specs)
 
     def monster_check(self) -> bool:
         """Advance the inspected-pile counter (the monster guard runs once

@@ -23,8 +23,9 @@ from ..runtime.governor import host_rss_mb
 
 class AdmissionReject(Exception):
     """Admission refused: ``reason`` is machine-readable (quota_jobs,
-    quota_bytes, queue_full, pressure, draining); ``retryable`` hints the
-    HTTP layer between 429 (back off and retry) and 400-class refusals."""
+    quota_bytes, queue_full, pressure, disk_pressure, draining);
+    ``retryable`` hints the HTTP layer between 429/503/507 (back off and
+    retry) and 400-class refusals."""
 
     def __init__(self, reason: str, detail: str = "", retryable: bool = True):
         super().__init__(detail or reason)
@@ -40,6 +41,13 @@ class AdmissionConfig:
     rss_soft_mb: float = 0.0         # pause admission at this host RSS
     rss_hard_mb: float = 0.0         # reject + shed at this host RSS
                                      # (0 = watermark off)
+    # free-bytes watermarks (ISSUE 17), mirroring the RSS pair: admission
+    # pauses when the watched volume's free space sinks to soft, and the
+    # service's disk-pressure governor engages at hard. 0 = off.
+    disk_soft_mb: float = 0.0
+    disk_hard_mb: float = 0.0
+    watch_dir: str = ""              # the volume the watermarks read
+                                     # (the serve workdir; "" = off)
 
 
 @dataclass
@@ -64,10 +72,34 @@ class AdmissionController:
         self._queued = 0
         self._draining = False
         self.counters = {"admitted": 0, "rejected": 0, "shed": 0}
+        # disk-pressure latch (ISSUE 17): the service sets this to a detail
+        # string when the journal's own appends start failing (the watermark
+        # may not have seen it coming — ENOSPC can arrive first) and clears
+        # it once the volume recovers; any non-None value refuses admission
+        # with the 507-style ``disk_pressure`` reason
+        self.disk_pressure: str | None = None
 
     def drain(self) -> None:
         """Stop admitting (graceful shutdown); running jobs finish."""
         self._draining = True
+
+    def disk_level(self) -> tuple[str | None, float]:
+        """(level, free_mb) of the watched volume against the free-bytes
+        watermarks, mirroring :meth:`pressure_level`; (None, -1.0) when the
+        watermarks are off or the volume is unreadable."""
+        from ..utils.obs import disk_free_mb
+
+        cfg = self.cfg
+        if not cfg.watch_dir or not (cfg.disk_soft_mb or cfg.disk_hard_mb):
+            return None, -1.0
+        free = disk_free_mb(cfg.watch_dir)
+        if free < 0:
+            return None, free
+        if cfg.disk_hard_mb and free <= cfg.disk_hard_mb:
+            return "hard", free
+        if cfg.disk_soft_mb and free <= cfg.disk_soft_mb:
+            return "soft", free
+        return None, free
 
     def pressure_level(self) -> tuple[str | None, float]:
         """(level, rss_mb) against the ADMISSION watermarks. The injected
@@ -95,6 +127,13 @@ class AdmissionController:
             reason = None
             if self._draining:
                 reason = "draining"
+            elif self.disk_pressure is not None \
+                    or self.disk_level()[0] is not None:
+                # the volume is (or is about to be) full: both the journal-
+                # failure latch and the free-bytes watermarks refuse new
+                # work with the machine-readable 507-style reason — running
+                # jobs keep their already-charged quota and finish
+                reason = "disk_pressure"
             else:
                 level, rss = self.pressure_level()
                 if level is not None:
@@ -114,10 +153,14 @@ class AdmissionController:
                 self.counters["rejected"] += 1
                 self.log.log("serve.reject", tenant=tenant, reason=reason,
                              job=job, bytes=int(nbytes))
+                detail = f"tenant {tenant!r}: {reason}"
+                if reason == "disk_pressure" and self.disk_pressure:
+                    detail += f" ({self.disk_pressure})"
                 raise AdmissionReject(
-                    reason, f"tenant {tenant!r}: {reason}",
-                    retryable=reason in ("pressure", "queue_full",
-                                         "quota_jobs", "quota_bytes"))
+                    reason, detail,
+                    retryable=reason in ("pressure", "disk_pressure",
+                                         "queue_full", "quota_jobs",
+                                         "quota_bytes"))
             t.queued += 1
             t.bytes += int(nbytes)
             t.admitted += 1
@@ -139,6 +182,7 @@ class AdmissionController:
         with self._lock:
             return {**self.counters, "queued": self._queued,
                     "draining": self._draining,
+                    "disk_pressure": bool(self.disk_pressure),
                     "tenants": {k: {"queued": t.queued, "bytes": t.bytes,
                                     "admitted": t.admitted,
                                     "rejected": t.rejected}

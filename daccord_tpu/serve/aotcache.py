@@ -83,13 +83,24 @@ class AotCache:
     entry both succeed (last rename wins, both bodies identical-in-meaning).
     """
 
-    def __init__(self, cache_dir: str, log=None):
+    def __init__(self, cache_dir: str, log=None, cap_mb: float | None = None):
         self.dir = cache_dir
         self.log = log if log is not None else NullLogger()
         self._mem: dict[tuple[str, str], object] = {}
         self._lock = threading.Lock()
         self.counters = {"hits": 0, "mem_hits": 0, "misses": 0,
-                         "publishes": 0, "rejects": 0}
+                         "publishes": 0, "rejects": 0, "swept": 0}
+        # size cap on the SHARED dir (ISSUE 17): an always-on fleet keeps
+        # publishing new (shape, program) entries forever; without a sweep
+        # the cache itself becomes the thing that fills the volume. LRU by
+        # mtime (a hit re-reads but does not bump mtime — good enough: the
+        # hot entries are the recently published ones). 0 = uncapped.
+        if cap_mb is None:
+            try:
+                cap_mb = float(os.environ.get("DACCORD_AOT_CAP_MB", 512))
+            except ValueError:
+                cap_mb = 512.0
+        self.cap_mb = cap_mb
         os.makedirs(cache_dir, exist_ok=True)
 
     # ------------------------------------------------------------------
@@ -153,7 +164,9 @@ class AotCache:
 
     def publish(self, key: str, digest: str, compiled, wall_s: float) -> None:
         """Serialize ``compiled`` and install it durably; failures only log
-        (a peer that cannot publish still serves from memory)."""
+        (a peer that cannot publish — serialization refusal, or a full
+        shared volume, ENOSPC real or injected via the ``@aot`` fault
+        domain — still serves from memory: skip-and-continue)."""
         with self._lock:
             self._mem[(key, digest)] = compiled
         try:
@@ -167,13 +180,57 @@ class AotCache:
                                  "out_tree": out_tree})
             blob = _MAGIC + hashlib.sha256(body).digest() + body
             durable_write(self._path(key, digest),
-                          lambda fh: fh.write(blob))
+                          lambda fh: fh.write(blob), domain="aot")
         except Exception as e:
             self._reject(key, f"publish:{type(e).__name__}")
             return
         self.counters["publishes"] += 1
         self.log.log("aot.publish", key=key, bytes=len(blob),
                      wall_s=round(wall_s, 3))
+        self.sweep(keep=self._path(key, digest))
+
+    def sweep(self, keep: str | None = None) -> int:
+        """Size-capped LRU sweep of the shared dir: oldest-mtime ``.aot``
+        entries go until the total is back under ``cap_mb``. Wholly
+        OSError-tolerant — peers sweep concurrently, entries vanish under
+        us, and a full disk must never make the sweep (the relief valve)
+        the thing that raises. Returns the number of entries removed."""
+        if not self.cap_mb:
+            return 0
+        try:
+            names = [n for n in os.listdir(self.dir) if n.endswith(".aot")]
+        except OSError:
+            return 0
+        ents = []
+        for n in names:
+            p = os.path.join(self.dir, n)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            ents.append((st.st_mtime, st.st_size, p))
+        total = sum(sz for _, sz, _ in ents)
+        cap = self.cap_mb * (1 << 20)
+        if total <= cap:
+            return 0
+        removed = freed = 0
+        for _, sz, p in sorted(ents):
+            if total - freed <= cap:
+                break
+            if keep is not None and os.path.abspath(p) == \
+                    os.path.abspath(keep):
+                continue   # never evict the entry we just published
+            try:
+                os.remove(p)
+            except OSError:
+                continue
+            removed += 1
+            freed += sz
+        if removed:
+            self.counters["swept"] += removed
+            self.log.log("aot.sweep", removed=removed, freed=freed,
+                         total=total, cap_mb=self.cap_mb)
+        return removed
 
     def stats(self) -> dict:
         return dict(self.counters)

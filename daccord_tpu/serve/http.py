@@ -6,7 +6,9 @@ doctrine. The API surface:
     POST   /v1/jobs             submit a job (JSON: db/las paths or
                                 base64 ``files`` upload + config knobs);
                                 201 {job, state} | 400 bad spec/ingest |
-                                429 quota | 503 pressure/draining.
+                                429 quota | 503 pressure/draining |
+                                507 disk_pressure (the volume is — or is
+                                about to be — full; retryable).
                                 ``idempotency_key`` (ISSUE 15): a seen key
                                 answers 200 with the EXISTING job — the
                                 retry path for clients whose 201 was lost
@@ -109,7 +111,15 @@ class ServeHandler(BaseHTTPRequestHandler):
             try:
                 st = self.svc.submit(body)
             except AdmissionReject as e:
-                code = 503 if e.reason in ("pressure", "draining") else 429
+                # 507 Insufficient Storage for the disk-pressure governor
+                # (ISSUE 17): machine-readable, retryable — clients back
+                # off until the volume recovers
+                if e.reason == "disk_pressure":
+                    code = 507
+                elif e.reason in ("pressure", "draining"):
+                    code = 503
+                else:
+                    code = 429
                 return self._send(code, {"error": str(e), "reason": e.reason,
                                          "retryable": e.retryable})
             except (ValueError, TypeError) as e:

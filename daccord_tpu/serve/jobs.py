@@ -71,12 +71,19 @@ class JobSpec:
         files = body.pop("files", None)
         uploaded = False
         if files:
+            from ..utils import aio
+
             os.makedirs(jobdir, exist_ok=True)
             for name, b64 in files.items():
                 name = os.path.basename(str(name))
                 if not name:
                     raise ValueError("files: empty file name")
-                with open(os.path.join(jobdir, name), "wb") as fh:
+                # spool through the aio fault hook (``@spool`` domain): an
+                # ENOSPC here raises out of admission, which releases the
+                # tenant's quota charge and rmtree's the spool dir — a
+                # refused upload leaves no disk residue (see _submit_new)
+                with aio.open_output(os.path.join(jobdir, name), "wb",
+                                     domain="spool") as fh:
                     fh.write(base64.b64decode(b64))
             uploaded = True
             for key in ("db", "las"):
@@ -403,15 +410,26 @@ def run_job(job: Job, service) -> None:
                     # durable bytes
                     os.fsync(fh.fileno())
                     part_sz = fh.tell()
-                    durable_write(job.progress_path,
-                                  lambda mh, n=n_seen, b=part_sz: json.dump(
-                                      {"emitted": n, "part_bytes": b,
-                                       "part": os.path.basename(my_part)},
-                                      mh),
-                                  mode="wt")
-                    service.journal_mark("progress", job.id, emitted=n_seen,
-                                         bytes=part_sz,
-                                         part=os.path.basename(my_part))
+                    try:
+                        durable_write(
+                            job.progress_path,
+                            lambda mh, n=n_seen, b=part_sz: json.dump(
+                                {"emitted": n, "part_bytes": b,
+                                 "part": os.path.basename(my_part)}, mh),
+                            mode="wt", domain="manifest")
+                    except OSError as ce:
+                        # a refused CHECKPOINT must not fail a healthy run:
+                        # it only widens the resume window (the prior
+                        # checkpoint — or read zero — still bounds the
+                        # recompute). The run itself keeps going; the
+                        # commit path is where a full disk becomes fatal.
+                        service.log_event(
+                            "io.fault", domain="manifest", op="checkpoint",
+                            error=f"{type(ce).__name__}: {ce}"[:200])
+                    else:
+                        service.journal_mark("progress", job.id,
+                                             emitted=n_seen, bytes=part_sz,
+                                             part=os.path.basename(my_part))
             fh.flush()
             os.fsync(fh.fileno())
             if not service.still_owns(job.id):
@@ -436,7 +454,7 @@ def run_job(job: Job, service) -> None:
                           {**job.status(),
                            "fasta": job.fasta,
                            "fasta_bytes": os.path.getsize(job.fasta)}, mh),
-                      mode="wt")
+                      mode="wt", domain="manifest")
         import glob as _glob
 
         for leftover in (job.progress_path,

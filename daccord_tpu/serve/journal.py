@@ -47,6 +47,8 @@ import threading
 import time
 from dataclasses import dataclass
 
+from ..utils import aio
+
 #: journal record kinds that end a job's lifecycle
 TERMINAL_RECS = ("committed", "aborted", "failed")
 
@@ -84,29 +86,86 @@ class JobJournal:
         self._fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
                            0o644)
         self.appended = 0
+        # disk-says-no accounting (ISSUE 17): appends that failed to reach
+        # durability (ENOSPC/EIO, real or injected) and the last error text —
+        # the service's disk-pressure governor reads both
+        self.append_failures = 0
+        self.last_error: str | None = None
 
-    def append(self, rec: str, job: str, **fields) -> None:
+    def append(self, rec: str, job: str, **fields) -> bool:
         """Durably append one record: the write and fsync complete before
-        this returns — the WRITE-AHEAD contract every state transition in
-        the service leans on. The ``serve_crash`` fault fires here, AFTER
-        durability, so an injected death never loses a record it claims."""
+        this returns True — the WRITE-AHEAD contract every state transition
+        in the service leans on. The ``serve_crash`` fault fires here, AFTER
+        durability, so an injected death never loses a record it claims.
+
+        Returns False when the record did NOT become durable: the closed-fd
+        shutdown-drain window (the durable manifest is already the truth),
+        or a disk refusal (ENOSPC/EIO — real, or injected via the
+        ``@journal`` fault domain). A refusal never raises — the appenders
+        are HTTP threads, workers, and the ticker, none of which may die
+        for a full volume; the service reads False and enters its
+        ``disk_pressure`` state instead."""
         line = json.dumps({"rec": rec, "job": job, "ts": time.time(),
                            **fields}) + "\n"
         with self._lock:
             if self._fd is None:
-                # the shutdown drain window: a worker finishing just as the
-                # journal closes drops its record (the durable manifest is
-                # already the truth) instead of raising on a closed —
-                # or, worse, reused — fd. Same rule JsonlLogger.close uses.
-                return
-            os.write(self._fd, line.encode())
-            os.fsync(self._fd)
+                return False
+            try:
+                aio.io_gate("journal", op="append")
+                os.write(self._fd, line.encode())
+                os.fsync(self._fd)
+            except OSError as e:
+                # a partial write may have torn the tail; replay tolerates
+                # torn lines, so the journal stays replayable either way
+                self.append_failures += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+                return False
             self.appended += 1
         if self.faults is not None and self.faults.serve_crash_check():
             # test-only hard death (see runtime/faults.py serve_crash): the
             # record above is durable; nothing after it is — exactly a
             # SIGKILL landing between syscalls
             os._exit(137)
+        return True
+
+    def size_bytes(self) -> int:
+        """Current on-disk journal size (0 when unreadable) — the online
+        compaction watermark's input."""
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def compact_online(self) -> dict | None:
+        """Compact the LIVE journal in place — the restart-only compaction
+        (replay → :func:`compact`) without the restart, triggered by the
+        service at a size/free-space watermark so an ENOSPC'd volume can be
+        relieved by the journal's own garbage (terminal chains without
+        idempotency keys) instead of waiting for an operator bounce.
+
+        Replays from disk under the append lock (disk state IS the truth —
+        records that failed to append were never durable), durably rewrites,
+        then swaps the append fd to the new file. Returns a summary dict
+        (``before``/``after`` bytes, ``kept`` jobs, ``torn`` lines) or None
+        when the rewrite itself was refused — the old fd keeps appending,
+        nothing is lost, and the caller may retry at the next watermark."""
+        with self._lock:
+            if self._fd is None:
+                return None
+            before = self.size_bytes()
+            entries, torn = replay(self.path)
+            try:
+                compact(self.path, entries)
+                fd = os.open(self.path,
+                             os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            except OSError:
+                return None  # disk still refusing; keep the old fd
+            os.close(self._fd)
+            self._fd = fd
+            return {"before": before, "after": self.size_bytes(),
+                    "kept": sum(1 for e in entries.values()
+                                if not e.terminal or e.idem),
+                    "torn": torn}
 
     def close(self) -> None:
         with self._lock:
@@ -178,7 +237,6 @@ def compact(path: str, entries: dict[str, JournalEntry]) -> None:
     an ``admitted``+terminal pair kept ONLY while they carry an idempotency
     key (the dedupe memory). Without compaction an always-on server's
     journal — and every restart's replay — grows with lifetime job count."""
-    from ..utils.aio import durable_write
 
     def _write(fh) -> None:
         now = time.time()
@@ -198,4 +256,4 @@ def compact(path: str, entries: dict[str, JournalEntry]) -> None:
                     tail["part"] = e.part_name
                 fh.write((json.dumps(tail) + "\n").encode())
 
-    durable_write(path, _write, mode="wb")
+    aio.durable_write(path, _write, mode="wb", domain="journal")

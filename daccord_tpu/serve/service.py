@@ -143,6 +143,25 @@ class ServeConfig:
                                      # and shutdown reports unclean (the
                                      # serve CLI exits nonzero). 0 = legacy
                                      # unbounded-ish drain (timeout_s)
+    # disk-pressure governor (ISSUE 17). The free-bytes admission
+    # watermarks live on AdmissionConfig (disk_soft_mb / disk_hard_mb);
+    # watch_dir defaults to the serve workdir at construction.
+    journal_compact_mb: float = 64.0 # ONLINE journal compaction triggers
+                                     # when journal.jsonl reaches this size
+                                     # (or the hard free-space watermark
+                                     # fires): the restart-only compaction
+                                     # without the restart, so a filling
+                                     # volume is relieved by the journal's
+                                     # own garbage. 0 = size trigger off
+    lease_grace_beats: int = 3       # consecutive failed lease renewals
+                                     # (EIO-class, real or injected)
+                                     # tolerated before a holder self-
+                                     # demotes: one shared-FS hiccup must
+                                     # not abort healthy in-flight work,
+                                     # but a holder that cannot prove
+                                     # liveness for this many heartbeats
+                                     # stands down before the TTL lets a
+                                     # peer steal the lease mid-commit
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     events_path: str | None = None   # default: <workdir>/serve.events.jsonl
 
@@ -163,6 +182,10 @@ class ConsensusService:
         self.cfg = cfg
         os.makedirs(cfg.workdir, exist_ok=True)
         os.makedirs(os.path.join(cfg.workdir, "jobs"), exist_ok=True)
+        if not cfg.admission.watch_dir:
+            # the free-bytes watermarks read the serve volume by default
+            # (they stay off until disk_soft_mb/disk_hard_mb are set)
+            cfg.admission.watch_dir = cfg.workdir
         ev = cfg.events_path or os.path.join(cfg.workdir,
                                              "serve.events.jsonl")
         self.events = _LockedLogger(ev, buffer_lines=16, flush_s=1.0)
@@ -187,6 +210,8 @@ class ConsensusService:
             f"{self.service_id}@{socket.gethostname()}:{os.getpid()}"
         self._lease_lock = threading.Lock()
         self._owned_leases: dict[str, str] = {}   # job id -> lease path
+        self._lease_grace: dict[str, int] = {}    # job id -> consecutive
+                                                  # failed renew beats
         self._idem: dict[str, str | None] = {}    # idem key -> job id
         # front door (ISSUE 16): the announce lease (peer discovery for the
         # router — <peer_dir>/peers/<service_id>.lease carrying our URL),
@@ -239,6 +264,11 @@ class ConsensusService:
         # bad did it GET", not just "how bad is it now"
         self._peak_rss_mb = 0.0
         self._peak_queue_depth = 0
+        # disk-pressure governor state (ISSUE 17): what latched the 507
+        # state (journal refusal vs free-bytes watermark) and the online-
+        # compaction rate limiter
+        self._disk_latch_src: str | None = None
+        self._last_compact = 0.0
         # saturation profiler (ISSUE 14): the serve-plane verdict denominator
         # is DEMAND wall (ticker-sampled time with >= 1 job queued/running),
         # not uptime — an always-on server that simply has no traffic is
@@ -336,11 +366,26 @@ class ConsensusService:
         """Durably append one lifecycle record (no-op with the journal off)
         and mirror it into the events stream (``serve.journal``) + the
         ``journal_records`` counter, so recovery is observable without
-        reading the journal file itself."""
+        reading the journal file itself.
+
+        A disk refusal (ENOSPC/EIO, real or injected) never raises — the
+        appenders are HTTP threads, workers, and the ticker. It is counted,
+        surfaced as an ``io.fault`` event, and latches the admission
+        ``disk_pressure`` state (507-style refusals) until the volume
+        proves writable again (``_disk_tick``'s probe)."""
         j = self.journal   # racing shutdown's None-swap: read once
         if j is None:
             return
-        j.append(rec, job_id, **fields)
+        before = j.append_failures
+        if not j.append(rec, job_id, **fields):
+            if j.append_failures > before:
+                # a disk refusal, not the closed-fd shutdown-drain window
+                self.log_event("io.fault", domain="journal", op="append",
+                               error=str(j.last_error or "?")[:200])
+                self.metrics.counter("journal_append_failures").inc()
+                self._enter_disk_pressure(
+                    "journal", j.last_error or "append refused")
+            return
         self.metrics.counter("journal_records").inc()
         self.log_event("serve.journal", rec=rec, job=job_id)
 
@@ -393,7 +438,17 @@ class ConsensusService:
             return True
         from ..utils import lease
 
-        info = lease.read(self._lease_file(job_id))
+        path = self._lease_file(job_id)
+        info, lstat = lease.read_result(path)
+        for i in range(3):
+            # an EIO-class read hiccup here is NOT ownership loss — failing
+            # the gate on it would strand a finished solve (stand down with
+            # no taker to finish the job). Bounded re-read, like the
+            # heartbeat's renewal grace; absent/torn/foreign stay decisive.
+            if lstat != "error":
+                break
+            time.sleep(0.01 * (2 ** i))
+            info, lstat = lease.read_result(path)
         return info is not None and info.get("host") == self.peer
 
     def release_job_lease(self, job_id: str) -> None:
@@ -403,6 +458,7 @@ class ConsensusService:
 
         with self._lease_lock:
             path = self._owned_leases.pop(job_id, None)
+        self._lease_grace.pop(job_id, None)
         if path is not None:
             lease.release(path, host=self.peer)
 
@@ -530,7 +586,7 @@ class ConsensusService:
                                   {"job": j, "state": "done", "fasta": f,
                                    "fasta_bytes": os.path.getsize(f),
                                    "recovered": True}, mh),
-                              mode="wt")
+                              mode="wt", domain="manifest")
                 self.journal_mark("committed", e.job, by="replay")
                 self.log_event("serve.commit", job=e.job, fragments=-1,
                                bytes=os.path.getsize(fasta))
@@ -722,11 +778,16 @@ class ConsensusService:
             # the operator must give peers distinct workdir basenames.
             from .admission import AdmissionReject
 
+            import shutil
+
             with self._jobs_lock:
                 self.jobs.pop(job_id, None)
                 if idem is not None and self._idem.get(idem) == job_id:
                     del self._idem[idem]
             self.admission.release(tenant, spec.nbytes)
+            # the refused job's spool is OURS (the holder has its own
+            # workdir) — keeping it would strand tenant bytes forever
+            shutil.rmtree(jobdir, ignore_errors=True)
             self.journal_mark("failed", job_id, error="lease conflict")
             raise AdmissionReject(
                 "lease_conflict",
@@ -804,6 +865,7 @@ class ConsensusService:
         The on-call triage fields (ISSUE 13): uptime, queue depth, and
         WHICH group is mid-solve when latency spikes."""
         from ..runtime.governor import host_rss_mb
+        from ..utils.obs import disk_free_mb
 
         with self._jobs_lock:
             states: dict[str, int] = {}
@@ -832,7 +894,11 @@ class ConsensusService:
                 # ownership state daccord-top renders
                 "peer": self.peer,
                 "leases": held,
-                "rss_mb": round(host_rss_mb(), 1)}
+                "rss_mb": round(host_rss_mb(), 1),
+                # disk-pressure governor (ISSUE 17): the on-call "is the
+                # volume the problem" pair — daccord-top's DISK column
+                "disk_free_mb": round(disk_free_mb(self.cfg.workdir), 1),
+                "disk_pressure": bool(self.admission.disk_pressure)}
 
     def stats(self) -> dict:
         """Full stats (the /v1/metrics body). NOTE: group stats take each
@@ -927,15 +993,25 @@ class ConsensusService:
         self._refresh_gauges()
         self.metrics.snapshot(self.events, final=True)
         from ..utils.aio import durable_write
+        from ..utils.obs import _note_dropped
 
-        durable_write(os.path.join(self.cfg.workdir, "serve.metrics.json"),
-                      lambda fh: json.dump(self.stats(), fh), mode="wt")
-        # the scrapeable twin (ISSUE 13): the same registry as a prom text
-        # exposition, durably beside the JSON rollup — post-mortem tooling
-        # and the pounce scrape checker read one format
-        prom = self.stats_prom()
-        durable_write(os.path.join(self.cfg.workdir, "serve.metrics.prom"),
-                      lambda fh: fh.write(prom), mode="wt")
+        try:
+            durable_write(
+                os.path.join(self.cfg.workdir, "serve.metrics.json"),
+                lambda fh: json.dump(self.stats(), fh), mode="wt",
+                domain="sidecar")
+            # the scrapeable twin (ISSUE 13): the same registry as a prom
+            # text exposition, durably beside the JSON rollup — post-mortem
+            # tooling and the pounce scrape checker read one format
+            prom = self.stats_prom()
+            durable_write(
+                os.path.join(self.cfg.workdir, "serve.metrics.prom"),
+                lambda fh: fh.write(prom), mode="wt", domain="sidecar")
+        except OSError:
+            # telemetry never raises into shutdown: a full volume costs the
+            # rollup sidecars, not the drain verdict (counted like any
+            # other dropped telemetry)
+            _note_dropped(1)
         with self._jobs_lock:
             n_done = sum(j.state == DONE for j in self.jobs.values())
         self.log_event("serve.done", jobs=len(self.jobs), done=n_done,
@@ -1051,6 +1127,7 @@ class ConsensusService:
                 if now - last_pressure >= 1.0:
                     last_pressure = now
                     self._pressure_tick()
+                    self._disk_tick(now)
                     self._prune_jobs(now)
                 if self.cfg.peer_dir \
                         and now - last_beat >= self.cfg.heartbeat_s:
@@ -1110,6 +1187,26 @@ class ConsensusService:
         self._announce_path = path
         self.log_event("serve.announce", url=url, peer=self.peer)
 
+    def _demote_job(self, job, jid: str, to: str) -> None:
+        """Stand down from a job whose lease we can no longer prove we hold
+        (a taker owns it, or the renew grace ran out): our run aborts at its
+        next check and the job becomes a watch (a committed peer manifest
+        flips it DONE). A still-QUEUED job flips to RUNNING-watch under the
+        lock so the worker's dequeue skips it (state != QUEUED) instead of
+        misreading the demotion abort_event as a client abort — and its
+        quota charge releases NOW (the taker charged its own)."""
+        with self._lease_lock:
+            self._owned_leases.pop(jid, None)
+        with self._jobs_lock:
+            was_queued = job.state == QUEUED
+            if was_queued:
+                job.state = RUNNING
+            job.watch = True
+        job.abort_event.set()
+        if was_queued:
+            self.admission.release(job.tenant, job.spec.nbytes)
+        self.journal_mark("demoted", jid, to=to)
+
     def _lease_tick(self) -> None:
         """The peer-takeover heartbeat (ISSUE 15), at ``heartbeat_s``
         cadence so a serve fleet never storms the shared FS:
@@ -1154,29 +1251,30 @@ class ConsensusService:
             if job is None or job.state in (DONE, FAILED, ABORTED):
                 self.release_job_lease(jid)
                 continue
-            info = lease.read(path)
+            info, lstat = lease.read_result(path)
             if info is not None and info.get("host") != self.peer:
-                # ownership lost: never renew the taker's lease; our run
-                # stands down and the job becomes a watch (the taker's
-                # manifest will flip it DONE). A still-QUEUED job flips to
-                # RUNNING-watch under the lock so the worker's dequeue
-                # skips it (state != QUEUED) instead of misreading the
-                # demotion abort_event as a client abort — and its quota
-                # charge releases NOW (the taker charged its own).
-                with self._lease_lock:
-                    self._owned_leases.pop(jid, None)
-                with self._jobs_lock:
-                    was_queued = job.state == QUEUED
-                    if was_queued:
-                        job.state = RUNNING
-                    job.watch = True
-                job.abort_event.set()
-                if was_queued:
-                    self.admission.release(job.tenant, job.spec.nbytes)
-                self.journal_mark("demoted", jid,
-                                  to=str(info.get("host", "?")))
+                # ownership lost: never renew the taker's lease
+                self._lease_grace.pop(jid, None)
+                self._demote_job(job, jid, str(info.get("host", "?")))
                 continue
-            lease.renew(path)
+            # ``lstat`` != ok (absent / torn / EIO-class read error) leaves
+            # ownership unproven this beat; still attempt the bump — utime
+            # can succeed where the read hiccupped — and count a failed
+            # beat against the bounded grace. One shared-FS hiccup must not
+            # abort healthy in-flight work, but a holder that cannot prove
+            # liveness for lease_grace_beats heartbeats stands down BEFORE
+            # the TTL lets a peer steal the lease out from under a commit.
+            if lease.renew(path):
+                self._lease_grace.pop(jid, None)
+                continue
+            n = self._lease_grace.get(jid, 0) + 1
+            self._lease_grace[jid] = n
+            grace = max(1, int(self.cfg.lease_grace_beats))
+            self.log_event("io.fault", domain="lease", op="renew",
+                           error=f"beat {n}/{grace} ({lstat})")
+            if n >= grace:
+                self._lease_grace.pop(jid, None)
+                self._demote_job(job, jid, "(renew grace exhausted)")
         # 2. watch jobs: peer finished, or peer died
         with self._jobs_lock:
             watches = [j for j in self.jobs.values()
@@ -1345,6 +1443,92 @@ class ConsensusService:
             for g in self.warm.groups():
                 g.set_shed(want)
 
+    def _enter_disk_pressure(self, src: str, detail: str) -> None:
+        """Latch the admission ``disk_pressure`` state (idempotent):
+        submissions answer machine-readable 507-style refusals until the
+        volume proves writable again. Every in-flight job is journal-marked
+        INTERRUPTED — a resumable record, NOT an abort: the jobs keep
+        running (compute needs no disk until commit), but if the full
+        volume kills the process first, replay resumes them from their
+        checkpoints instead of losing them. The marks themselves may be
+        refused by the same full disk — tolerated (append returns False);
+        a never-marked orphan replays identically."""
+        if self.admission.disk_pressure is not None:
+            return
+        self.admission.disk_pressure = f"{src}: {detail}"[:200]
+        self._disk_latch_src = src
+        _, free = self.admission.disk_level()
+        self.log_event("disk.pressure", level="enter", src=src,
+                       free_mb=round(free, 1), detail=str(detail)[:200])
+        self.metrics.counter("disk_pressure_events").inc()
+        with self._jobs_lock:
+            inflight = [j.id for j in self.jobs.values()
+                        if j.state in (QUEUED, RUNNING) and not j.watch]
+        for jid in inflight:
+            self.journal_mark("interrupted", jid)
+
+    def _clear_disk_pressure(self, free: float) -> None:
+        detail = self.admission.disk_pressure
+        self.admission.disk_pressure = None
+        self._disk_latch_src = None
+        self.log_event("disk.pressure", level="clear", src="probe",
+                       free_mb=round(free, 1), detail=str(detail or "")[:200])
+
+    def _disk_probe_ok(self) -> bool:
+        """One raw write+fsync on the serve volume — deliberately NOT
+        through the aio fault hook (the probe asks the REAL disk, and must
+        not consume injected-fault counters): the latch clears only when
+        bytes demonstrably reach durability again."""
+        p = os.path.join(self.cfg.workdir, ".disk.probe")
+        try:
+            fd = os.open(p, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            try:
+                os.write(fd, b"ok\n")
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            os.remove(p)
+            return True
+        except OSError:
+            return False
+
+    def _disk_tick(self, now: float) -> None:
+        """The disk-pressure governor (ISSUE 17), mirroring the RSS ladder
+        at the same 1 Hz cadence: free-bytes watermarks latch the admission
+        507 state at hard, a successful probe (plus a clear watermark)
+        releases it, and the journal compacts ONLINE at a size or hard
+        free-space watermark — a filling volume is relieved by the
+        journal's own garbage before an operator has to bounce the
+        server."""
+        level, free = self.admission.disk_level()
+        if free >= 0:
+            self.metrics.gauge("disk_free_mb").set(round(free, 1))
+        self.metrics.gauge("disk_pressure").set(
+            1.0 if self.admission.disk_pressure else 0.0)
+        if level == "hard" and self.admission.disk_pressure is None:
+            self._enter_disk_pressure(
+                "watermark",
+                f"free {free:.0f} MiB <= hard "
+                f"{self.admission.cfg.disk_hard_mb:.0f} MiB")
+        elif self.admission.disk_pressure is not None and level is None \
+                and self._disk_probe_ok():
+            self._clear_disk_pressure(free)
+        j = self.journal
+        if j is None:
+            return
+        size_mb = j.size_bytes() / float(1 << 20)
+        want = bool(self.cfg.journal_compact_mb
+                    and size_mb >= self.cfg.journal_compact_mb) \
+            or level == "hard"
+        if want and now - self._last_compact >= 5.0:
+            # rate-limited: a journal that compacts to >= the watermark
+            # (nothing terminal to collapse) must not rewrite every tick
+            self._last_compact = now
+            res = j.compact_online()
+            if res is not None:
+                self.log_event("journal.compact", **res)
+                self.metrics.counter("journal_compactions").inc()
+
     def _refresh_gauges(self) -> None:
         from ..runtime.governor import host_rss_mb
 
@@ -1365,6 +1549,13 @@ class ConsensusService:
         g("queue_depth").set(float(qd))
         g("queue_depth_peak").set(float(self._peak_queue_depth))
         g("shed_level").set(float(self._shed))
+        from ..utils.obs import disk_free_mb
+
+        free = disk_free_mb(self.cfg.workdir)
+        if free >= 0:
+            g("disk_free_mb").set(round(free, 1))
+        g("disk_pressure").set(
+            1.0 if self.admission.disk_pressure else 0.0)
         with self._lease_lock:
             g("leases_held").set(float(len(self._owned_leases)))
         mixed = rows = 0

@@ -181,6 +181,22 @@ EVENT_FIELDS: dict[str, dict] = {
     "aot.miss": {"key": str},
     "aot.publish": {"key": str, "bytes": int, "wall_s": _NUM},
     "aot.reject": {"key": str, "reason": str},
+    # storage fault matrix (ISSUE 17). io.fault = one observed disk refusal
+    # (domain = journal | lease | manifest | spool | sidecar | aot, real or
+    # injected; error = errno text or grace-beat accounting). disk.pressure
+    # = the governor's state transitions (level = enter | clear |
+    # spawn_floor; src = journal | watermark | probe | fleet; free_mb = -1
+    # when the volume was unreadable). journal.compact = one ONLINE journal
+    # compaction (before/after bytes, kept = live + idempotency-keyed jobs,
+    # torn = tolerated unparseable lines). aot.sweep = the shared AOT dir's
+    # size-capped LRU eviction (freed/total in bytes).
+    "io.fault": {"domain": str, "op": str, "error": str},
+    "disk.pressure": {"level": str, "src": str, "free_mb": _NUM,
+                      "detail": str},
+    "journal.compact": {"before": int, "after": int, "kept": int,
+                        "torn": int},
+    "aot.sweep": {"removed": int, "freed": int, "total": int,
+                  "cap_mb": _NUM},
     # stateless tenant router (serve/router.py): route = one admission
     # decision (spilled = stickiness overridden), spill = why + where,
     # peer_up/peer_down = discovery transitions (announce lease + healthz),

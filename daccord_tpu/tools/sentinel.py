@@ -85,6 +85,20 @@ def check_bench_series(entries: list[tuple[str, dict]],
         hist_rungs: dict[int, list[float]] = {}
         hist_scaling: list[float] = []
         for name, d in items:
+            # storage red flags (ISSUE 17): a committed sidecar recording
+            # disk pressure or dropped telemetry means the bench ran on a
+            # sick volume — its numbers are not comparable. A CHAOS sidecar
+            # (BENCH_DISK.json sets "chaos": true) injected the pressure on
+            # purpose; its own assertions cover it, the sentinel skips it.
+            if not d.get("chaos"):
+                for fld in ("disk_pressure_events", "telemetry_dropped"):
+                    n = d.get(fld)
+                    if isinstance(n, (int, float)) \
+                            and not isinstance(n, bool) and n > 0:
+                        issues.append(
+                            f"{name}: {fld} = {n:g} — the bench ran under "
+                            "disk pressure / dropped telemetry (volume was "
+                            "sick; numbers not comparable)")
             if d.get("fallback"):
                 reason = d.get("fallback_reason") or d.get("device") or "?"
                 issues.append(f"{name}: fallback: true ({reason}) — not a "
@@ -210,6 +224,14 @@ def check_rollup(path: str, baseline: dict | None = None,
     d = _unwrap_rollup(d)
     if not isinstance(d, dict) or "counters" not in d or "gauges" not in d:
         return [f"{path}: not a metrics rollup (counters/gauges missing)"]
+    # dropped telemetry (ISSUE 17): the counter only appears when nonzero
+    # (obs.MetricsRegistry), so its presence at all means events were lost
+    # to a sick volume — whatever this rollup claims is an undercount
+    td = (d.get("counters") or {}).get("telemetry_dropped_total")
+    if isinstance(td, (int, float)) and not isinstance(td, bool) and td > 0:
+        issues.append(f"{path}: telemetry_dropped_total = {td:g} — events "
+                      "were dropped (full/sick volume); every other number "
+                      "here is an undercount")
     if baseline is not None:
         bl = _unwrap_rollup(baseline)
         bg = (bl.get("gauges") or {}) if isinstance(bl, dict) else {}
@@ -326,6 +348,15 @@ def scan_events(path: str) -> list[str]:
             issues.append(f"{path}:{ln}: corrupt AOT cache entry for "
                           f"{rec.get('key')!r} (torn publish or shared-FS "
                           "damage; cold fallback engaged)")
+        elif ev == "disk.pressure" and rec.get("level") in ("enter",
+                                                            "spawn_floor"):
+            # ISSUE 17: a committed run that went into disk pressure is a
+            # red flag even when it recovered — the volume needs an
+            # operator before the next run hits the hard watermark
+            issues.append(
+                f"{path}:{ln}: DISK PRESSURE ({rec.get('src', '?')}: "
+                f"{str(rec.get('detail', ''))[:80]}; free "
+                f"{rec.get('free_mb', '?')} MiB)")
     for sl, b0, mn in spawns_open:
         if b0 != float("inf") and mn >= b0:
             issues.append(f"{path}:{sl}: scale-out spawned at burn {b0:g} "

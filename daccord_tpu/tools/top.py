@@ -218,14 +218,22 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
                         "governor.classify",
                         # crash-durable serve tier (ISSUE 15): recovery
                         # milestones belong on the operator screen
-                        "serve.replay", "serve.takeover"):
+                        "serve.replay", "serve.takeover",
+                        # storage fault matrix (ISSUE 17): disk refusals
+                        # and pressure transitions are operator events
+                        "io.fault", "disk.pressure", "journal.compact"):
                 snap["faults"].append(
                     {"src": src, "event": ev,
                      **{k: v for k, v in rec.items()
                         if k in ("kind", "reason", "key", "nd_from", "nd_to",
                                  "culprit", "shard", "op", "job",
                                  "prev_host", "stale_s", "orphans",
-                                 "finished")}})
+                                 "finished", "domain", "error", "level",
+                                 "free_mb", "before", "after")}})
+                if ev == "disk.pressure":
+                    snap["disk"] = {"level": rec.get("level"),
+                                    "src": rec.get("src"),
+                                    "free_mb": rec.get("free_mb")}
         snap["sources"].append(row)
     for path in sidecars:
         d = _load_json(path)
@@ -335,6 +343,10 @@ def render(snap: dict) -> str:
                 line += f"  queue {serve['queue_depth']}"
             if "shed_level" in serve:
                 line += f"  shed {serve['shed_level']}"
+            if serve.get("disk_free_mb") is not None:
+                line += f"  disk {_fmt(serve['disk_free_mb'])}MB"
+                if serve.get("disk_pressure"):
+                    line += " PRESSURE"
             if serve.get("verdict"):
                 line += f"  verdict {serve['verdict']}"
             if serve.get("peer"):

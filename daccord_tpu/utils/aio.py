@@ -15,13 +15,30 @@ Binary formats (DB/LAS) open their inputs through :func:`open_input` /
 multi-file stores (the DB's .idx/.bps/track sidecars) and the persistent
 LAS index sidecar stay file-backed by design — they are the durable
 resume/data plane of the shard model, not stream consumers.
+
+Storage fault hook (ISSUE 17): every durable primitive here —
+:func:`durable_write`, :func:`durable_replace`, :func:`exclusive_create`,
+:func:`open_output`, :func:`fsync_dir` — consults the process
+``DACCORD_FAULT`` plan's ``io_*`` kinds (``runtime/faults.py``) before
+touching the disk, keyed by an optional path-class ``domain``
+(``journal`` | ``lease`` | ``manifest`` | ``spool`` | ``sidecar`` |
+``aot``). Injected failures are real :class:`OSError` instances with real
+errnos (ENOSPC / EIO) so callers' handling of the injected matrix IS their
+handling of the real thing; :func:`retrying` is the bounded-backoff
+wrapper for the transient class (EIO), and code with its own fds (the
+journal's O_APPEND fd, lease renewal's utime) consults :func:`io_gate`
+directly. Tests install a plan with :func:`install_faults`; subprocess
+tiers pick the plan up lazily from the env, so a serve peer under an
+``io_enospc@journal`` storm needs no extra wiring.
 """
 
 from __future__ import annotations
 
+import errno
 import io
 import os
 import threading
+import time
 
 _MEM: dict[str, bytes] = {}
 _LOCK = threading.Lock()
@@ -41,6 +58,128 @@ def local_path(url: str) -> str:
 
 
 _path = local_path
+
+
+# ---------------------------------------------------------------------------
+# Injected-storage-fault hook (ISSUE 17). The plan is either installed
+# explicitly (tests, in-process services) or resolved lazily from
+# DACCORD_FAULT — cached per env-string so counters persist across ops
+# within one setting but a test changing the var gets a fresh plan.
+# ---------------------------------------------------------------------------
+
+_FAULTS = None                     # explicitly installed plan (wins)
+_ENV_FAULTS: tuple = (None, None)  # (env text, parsed plan) lazy cache
+
+
+class InjectedIOFault(OSError):
+    """An ``io_*``-injected failure; ``fault_kind`` names the spec so the
+    retry policy can distinguish an injected fsync failure (never retried)
+    from an injected transient EIO (retried) despite both wearing real
+    errnos."""
+
+    def __init__(self, err: int, msg: str, fault_kind: str):
+        super().__init__(err, msg)
+        self.fault_kind = fault_kind
+
+
+def install_faults(plan) -> None:
+    """Install (or with None, clear) the FaultPlan whose ``io_*`` kinds the
+    primitives consult — counters and one-shot state live on the plan, so
+    installing the same object a service already consumes keeps the two
+    views coherent."""
+    global _FAULTS, _ENV_FAULTS
+    _FAULTS = plan
+    _ENV_FAULTS = (None, None)
+
+
+def _io_plan():
+    if _FAULTS is not None:
+        return _FAULTS if _FAULTS.has_io_faults() else None
+    text = os.environ.get("DACCORD_FAULT")
+    global _ENV_FAULTS
+    if _ENV_FAULTS[0] != text:
+        plan = None
+        if text:
+            try:
+                from ..runtime.faults import FaultPlan
+                p = FaultPlan.parse(text)
+                plan = p if p.has_io_faults() else None
+            except ValueError:
+                plan = None  # the CLI entry point already rejected it loudly
+        _ENV_FAULTS = (text, plan)
+    plan = _ENV_FAULTS[1]
+    return plan if plan is not None and plan.has_io_faults() else None
+
+
+#: re-entrancy guard: a primitive composed from other primitives (e.g.
+#: durable_write publishing through durable_replace) is ONE logical storage
+#: op — the inner call must not advance fault counters a second time
+_NESTED = threading.local()
+
+
+def _io_prelude(domain: str):
+    """One logical storage op: apply any ``io_slow`` delay and return the
+    fired error spec (or None)."""
+    if getattr(_NESTED, "depth", 0):
+        return None
+    plan = _io_plan()
+    if plan is None:
+        return None
+    ms = plan.io_slow_ms(domain)
+    if ms > 0:
+        time.sleep(ms / 1000.0)
+    return plan.io_check(domain)
+
+
+def _io_raise(spec, op: str, domain: str):
+    err = errno.ENOSPC if spec.kind in ("io_enospc", "io_short_write") \
+        else errno.EIO
+    raise InjectedIOFault(
+        err, f"injected {spec.kind}"
+             + (f"@{domain}" if domain else "")
+             + f" at {op} #{spec.at}", spec.kind)
+
+
+def io_gate(domain: str, op: str = "write") -> None:
+    """Consult the storage-fault hook for one logical op performed OUTSIDE
+    the aio primitives (the journal's own ``O_APPEND`` fd, lease renewal's
+    ``os.utime``): applies any ``io_slow`` delay and raises the injected
+    OSError when a spec fires. No-op without a plan."""
+    spec = _io_prelude(domain)
+    if spec is not None:
+        _io_raise(spec, op, domain)
+
+
+#: errnos the bounded-retry wrapper treats as transient on REAL errors
+_TRANSIENT_ERRNOS = (errno.EIO, errno.EAGAIN, errno.EINTR)
+
+
+def _retryable(e: OSError) -> bool:
+    kind = getattr(e, "fault_kind", None)
+    if kind is not None:
+        # injected faults declare their class: only io_eio is transient —
+        # ENOSPC won't clear in milliseconds, a torn write already damaged
+        # the artifact, and a failed fsync leaves page state undefined
+        return kind == "io_eio"
+    return e.errno in _TRANSIENT_ERRNOS
+
+
+def retrying(fn, attempts: int = 3, base_s: float = 0.01):
+    """Run ``fn()`` with bounded retries + exponential backoff on transient
+    OSErrors (EIO / EAGAIN / EINTR). Persistent classes — ENOSPC, injected
+    fsync/short-write faults — propagate immediately: retrying them burns
+    the caller's latency budget against a disk that will keep saying no.
+    The caller's ``fn`` must be safe to re-run from scratch (every aio
+    primitive is: each attempt rewrites its tmp/claim file whole)."""
+    i = 0
+    while True:
+        try:
+            return fn()
+        except OSError as e:
+            if not _retryable(e) or i >= attempts - 1:
+                raise
+            time.sleep(base_s * (2 ** i))
+            i += 1
 
 
 def _is_text(mode: str) -> bool:
@@ -77,13 +216,24 @@ def open_input(url: str, mode: str = "rb"):
     return open(local_path(url), mode)
 
 
-def open_output(url: str, mode: str = "wb"):
+def open_output(url: str, mode: str = "wb", domain: str = ""):
     """Writable stream for a URL (text unless mode contains 'b'). mem:
-    content becomes visible at close."""
+    content becomes visible at close. A fired storage fault raises at open
+    (``io_short_write`` additionally leaves the zero-byte file behind — the
+    torn-artifact litter the caller's cleanup discipline must handle)."""
     if is_mem(url):
         buf = _MemWriter(url)
         return io.TextIOWrapper(buf) if _is_text(mode) else buf
-    return open(local_path(url), mode)
+
+    def attempt():
+        spec = _io_prelude(domain)
+        if spec is not None:
+            if spec.kind == "io_short_write":
+                open(local_path(url), mode).close()
+            _io_raise(spec, "open_output", domain)
+        return open(local_path(url), mode)
+
+    return retrying(attempt)
 
 
 def exists(url: str) -> bool:
@@ -102,10 +252,7 @@ def getsize(url: str) -> int:
     return os.path.getsize(local_path(url))
 
 
-def fsync_dir(path: str) -> None:
-    """Best-effort fsync of the directory holding ``path`` — makes a rename
-    itself durable, not just the renamed bytes. Filesystems that cannot
-    fsync a directory fd are silently tolerated."""
+def _fsync_dir_raw(path: str) -> None:
     d = os.path.dirname(os.path.abspath(local_path(path))) or "."
     try:
         fd = os.open(d, os.O_RDONLY)
@@ -119,66 +266,129 @@ def fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def durable_replace(tmp: str, dst: str) -> None:
+def fsync_dir(path: str, domain: str = "") -> None:
+    """Best-effort fsync of the directory holding ``path`` — makes a rename
+    itself durable, not just the renamed bytes. Filesystems that cannot
+    fsync a directory fd are silently tolerated, and an injected storage
+    fault is absorbed the same way (the real failure mode it simulates)."""
+    spec = _io_prelude(domain)
+    if spec is not None:
+        return  # a failed dir fsync is tolerated — same as the real branch
+    _fsync_dir_raw(path)
+
+
+def durable_replace(tmp: str, dst: str, domain: str = "") -> None:
     """``os.replace`` + directory fsync: the crash-durable commit primitive.
 
     The caller must have fsynced ``tmp``'s CONTENT already; this makes the
     rename that publishes it survive power loss too. The ordering contract
     of the ingest layer (ISSUE 2): data bytes fsync first, then the pointer
     that references them commits through here — a checkpoint manifest must
-    never point past the durable bytes."""
-    os.replace(local_path(tmp), local_path(dst))
-    fsync_dir(dst)
+    never point past the durable bytes. One logical storage op: an injected
+    fault fires before the rename, so a refused publish never half-lands."""
+    def attempt():
+        spec = _io_prelude(domain)
+        if spec is not None:
+            _io_raise(spec, "durable_replace", domain)
+        os.replace(local_path(tmp), local_path(dst))
+        _fsync_dir_raw(dst)
+
+    retrying(attempt)
 
 
-def durable_write(dst: str, write_fn, mode: str = "wb"):
+def durable_write(dst: str, write_fn, mode: str = "wb", domain: str = ""):
     """The one crash-durable file-commit sequence: write to a pid-suffixed
     tmp via ``write_fn(fh)``, fsync its content, publish with
     :func:`durable_replace` (rename + dir fsync). The tmp is removed on any
     failure so aborted commits never strand ``.tmp`` litter. Returns
-    ``write_fn``'s return value."""
+    ``write_fn``'s return value.
+
+    One logical storage op per attempt: a fired fault lands after
+    ``write_fn`` has populated the tmp (``io_short_write`` first truncates
+    it to half, putting genuinely torn bytes on disk; ``io_fsync_fail``
+    replaces the content fsync), so the cleanup-on-failure path — not just
+    the happy path — is what the matrix exercises. Transient EIO is
+    absorbed by :func:`retrying` (each attempt rewrites the tmp whole)."""
     real = local_path(dst)
     tmp = f"{real}.tmp.{os.getpid()}"
-    try:
-        with open(tmp, mode) as fh:
-            out = write_fn(fh)
-            fh.flush()
-            os.fsync(fh.fileno())
-    except BaseException:
+
+    def attempt():
+        spec = _io_prelude(domain)
         try:
-            os.remove(tmp)
-        except OSError:
-            pass
-        raise
-    durable_replace(tmp, real)
-    return out
+            with open(tmp, mode) as fh:
+                out = write_fn(fh)
+                fh.flush()
+                if spec is not None:
+                    if spec.kind == "io_short_write":
+                        fh.truncate(max(0, fh.tell() // 2))
+                    _io_raise(spec, "durable_write", domain)
+                os.fsync(fh.fileno())
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        # publish through the module-level durable_replace so crash-injection
+        # harnesses can interpose on the rename; the nesting guard keeps the
+        # whole sequence ONE logical storage op for the fault counters
+        _NESTED.depth = getattr(_NESTED, "depth", 0) + 1
+        try:
+            durable_replace(tmp, real)
+        finally:
+            _NESTED.depth -= 1
+        return out
+
+    return retrying(attempt)
 
 
-def exclusive_create(url: str, data: bytes) -> bool:
+def exclusive_create(url: str, data: bytes, domain: str = "") -> bool:
     """Atomically create ``url`` with ``data`` iff it does not exist —
     the ``O_CREAT|O_EXCL`` claim primitive of the shared-FS lease protocol
     (``parallel/fleet.py``): of N hosts racing to claim a shard, exactly one
     sees True. Content and the containing directory are fsynced so a claim
     survives power loss (a lost claim file would let two hosts run the same
-    shard after a crash+restart). False when the file already exists."""
+    shard after a crash+restart). False when the file already exists.
+
+    A write/fsync failure AFTER the O_EXCL open unlinks the claim before
+    re-raising: a stranded zero-byte/torn claim file would otherwise block
+    every future claimant of that slot until the stale-TTL takeover — and
+    the unlink is also what makes a transient-EIO retry attempt's O_EXCL
+    succeed instead of colliding with our own wreckage."""
     if is_mem(url):
         with _LOCK:
             if url in _MEM:
                 return False
             _MEM[url] = data
         return True
-    try:
-        fd = os.open(local_path(url), os.O_WRONLY | os.O_CREAT | os.O_EXCL,
-                     0o644)
-    except FileExistsError:
-        return False
-    try:
-        os.write(fd, data)
-        os.fsync(fd)
-    finally:
-        os.close(fd)
-    fsync_dir(url)
-    return True
+
+    def attempt():
+        spec = _io_prelude(domain)
+        try:
+            fd = os.open(local_path(url),
+                         os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+        except FileExistsError:
+            return False
+        try:
+            try:
+                if spec is not None:
+                    if spec.kind == "io_short_write":
+                        os.write(fd, data[: len(data) // 2])
+                    _io_raise(spec, "exclusive_create", domain)
+                os.write(fd, data)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except BaseException:
+            try:
+                os.remove(local_path(url))
+            except OSError:
+                pass
+            raise
+        _fsync_dir_raw(url)
+        return True
+
+    return retrying(attempt)
 
 
 def remove(url: str) -> None:

@@ -59,13 +59,25 @@ def claim(path: str, host: str, ttl_s: float,
     payload = json.dumps({"host": host, "pid": os.getpid(),
                           "claimed_t": time.time(),
                           **(extra or {})}).encode()
-    if aio.exclusive_create(path, payload):
+
+    def _create() -> bool:
+        # a disk that says no (ENOSPC/EIO — real or injected via the
+        # ``@lease`` fault domain) is indistinguishable from losing the
+        # race, and exactly as retryable: never let an OSError escape a
+        # claim attempt into a heartbeat/submit thread. A torn claim file
+        # cannot be ours — exclusive_create unlinks its wreckage on failure.
+        try:
+            return aio.exclusive_create(path, payload, domain="lease")
+        except OSError:
+            return False
+
+    if _create():
         return True, None
     try:
         stale_s = time.time() - os.path.getmtime(path)
     except OSError:
         # holder released between our create and stat: claim the vacancy
-        return aio.exclusive_create(path, payload), None
+        return _create(), None
     if stale_s <= ttl_s:
         return False, None
     prev = read(path) or {}
@@ -74,34 +86,65 @@ def claim(path: str, host: str, ttl_s: float,
         os.replace(path, grave)
     except FileNotFoundError:
         return False, None  # another taker won the replace race
+    except OSError:
+        return False, None  # disk refused the takeover rename: stand down
     try:
         os.remove(grave)
     except OSError:
         pass
-    if not aio.exclusive_create(path, payload):
+    if not _create():
         return False, None
     return True, {"prev_host": str(prev.get("host", "?")),
                   "stale_s": round(stale_s, 3)}
 
 
+def read_result(path: str) -> tuple[dict | None, str]:
+    """The lease's payload plus WHY it is missing when it is:
+    ``(info, "ok")`` | ``(None, "absent" | "torn" | "error")``.
+
+    ``absent`` = no file (released / taken over); ``torn`` = the file
+    exists but its payload doesn't parse (zero-byte or partial write from
+    a claimer killed mid-create — stale-TTL takeover-eligible, never a
+    crash); ``error`` = the read itself failed (EIO-class — the holder's
+    bounded heartbeat grace applies, see the serve tier's ``_lease_tick``).
+    The distinction exists because demoting on a transient read error would
+    abort healthy in-flight work every time a shared FS hiccups."""
+    try:
+        aio.io_gate("lease", op="read")
+        with open(path) as fh:
+            info = json.load(fh)
+    except FileNotFoundError:
+        return None, "absent"
+    except OSError:
+        return None, "error"
+    except json.JSONDecodeError:
+        return None, "torn"
+    if not isinstance(info, dict):
+        return None, "torn"
+    return info, "ok"
+
+
 def read(path: str) -> dict | None:
     """The lease's payload, or None when absent/torn (a torn lease from a
     killed claimer is still takeover-able once stale)."""
-    try:
-        with open(path) as fh:
-            return json.load(fh)
-    except (OSError, json.JSONDecodeError):
-        return None
+    return read_result(path)[0]
 
 
-def renew(path: str) -> None:
+def renew(path: str) -> bool:
     """Heartbeat: bump the lease mtime (the staleness clock other processes
     read). Callers must :func:`read`-check ownership first (see module doc);
-    a vanished lease is tolerated — the owner's reaper notices soon enough."""
+    a vanished lease is tolerated — the owner's reaper notices soon enough.
+    Returns False when the bump failed (vanished OR an EIO-class refusal,
+    real or injected): the caller's bounded grace counts these before
+    self-demoting — one hiccup must not abort healthy work, but a holder
+    that cannot prove liveness for several heartbeats must stand down
+    before the TTL lets a peer steal the lease out from under it."""
     try:
+        aio.io_gate("lease", op="renew")
         os.utime(path, None)
+        return True
     except OSError:
-        pass
+        return False
 
 
 def release(path: str, host: str | None = None) -> None:

@@ -56,7 +56,57 @@ DURABLE_EVENTS = frozenset({
     "router.spill", "router.proxy_error", "router.peer_up",
     "router.peer_down", "scale.spawn", "scale.drain", "scale.reap",
     "aot.publish", "aot.reject",
+    # storage fault matrix (ISSUE 17): injected/observed I/O failures and
+    # disk-pressure transitions are the post-mortem spine of the disk soak
+    "io.fault", "disk.pressure", "journal.compact",
 })
+
+
+# ---------------------------------------------------------------------------
+# Telemetry drop accounting (ISSUE 17). The rule: telemetry writers NEVER
+# raise into the data path. A full or failing volume under an events /
+# ledger / metrics sidecar drops the buffered lines and counts them here —
+# process-wide, because any number of loggers may share the fate of one
+# volume — and the count surfaces in every metrics snapshot/rollup so
+# ``daccord-sentinel --strict`` can flag a run that flew blind.
+# ---------------------------------------------------------------------------
+
+_TEL_DROPPED = 0
+
+
+def _note_dropped(n: int) -> None:
+    global _TEL_DROPPED
+    _TEL_DROPPED += int(n)
+
+
+def telemetry_dropped_total() -> int:
+    """Lines dropped by telemetry writers process-wide (0 = none)."""
+    return _TEL_DROPPED
+
+
+def reset_telemetry_dropped() -> None:
+    """Test hook: zero the process-wide drop counter."""
+    global _TEL_DROPPED
+    _TEL_DROPPED = 0
+
+
+def disk_free_mb(path: str) -> float:
+    """Free MiB on the filesystem holding ``path`` (walking up to the
+    nearest existing ancestor — a watched dir may not exist yet); -1.0 when
+    even statvfs fails. The free-bytes gauge feeding the disk-pressure
+    watermark machinery (admission pause, shed ladder, fleet spawn floor),
+    mirroring the RSS governor's ``host_rss_mb``."""
+    p = os.path.abspath(path or ".")
+    while p and not os.path.exists(p):
+        parent = os.path.dirname(p)
+        if parent == p:
+            break
+        p = parent
+    try:
+        st = os.statvfs(p)
+    except (OSError, AttributeError):
+        return -1.0
+    return st.f_bavail * st.f_frsize / float(1 << 20)
 
 
 class JsonlLogger:
@@ -97,18 +147,33 @@ class JsonlLogger:
     def flush(self) -> None:
         if self._fh is None or not self._buf:
             return
-        # one write call for the whole buffer: complete lines only, so
-        # concurrent appenders (launch.py's checkpoint logger shares the
-        # worker's events file) interleave at line granularity
-        self._fh.write("".join(self._buf))
+        try:
+            from . import aio
+
+            aio.io_gate("sidecar", op="events")
+            # one write call for the whole buffer: complete lines only, so
+            # concurrent appenders (launch.py's checkpoint logger shares the
+            # worker's events file) interleave at line granularity
+            self._fh.write("".join(self._buf))
+            self._fh.flush()
+        except (OSError, ValueError):
+            # telemetry NEVER raises into the data path (ISSUE 17): a full
+            # or failing volume under a sidecar drops the buffered lines and
+            # counts them — the serve ticker and fleet heartbeat threads
+            # writing through here must not die for an events file.
+            # ValueError is the racing-close case ("I/O operation on closed
+            # file"), tolerated since the serve drain window existed.
+            _note_dropped(len(self._buf))
         self._buf.clear()
-        self._fh.flush()
         self._last_flush = time.time()
 
     def close(self) -> None:
         self.flush()
         if self._fh is not None and self._fh is not sys.stderr:
-            self._fh.close()
+            try:
+                self._fh.close()
+            except OSError:
+                _note_dropped(0)  # OS-buffer tail lost; nothing countable
         # a closed logger silently drops later records instead of raising
         # "I/O operation on closed file": long-lived writers (the serve
         # plane's shutdown drain window) may race a final log against close
@@ -467,16 +532,25 @@ class MetricsRegistry:
     def histogram(self, name: str) -> _Histogram:
         return self._hists.setdefault(name, _Histogram())
 
+    def _counter_view(self) -> dict:
+        out = {k: c.n for k, c in sorted(self._counters.items())}
+        # the process-wide telemetry drop count rides every snapshot/rollup
+        # — but only once nonzero, so committed baselines predating ISSUE 17
+        # don't see a phantom new counter on clean runs
+        if _TEL_DROPPED and "telemetry_dropped_total" not in out:
+            out["telemetry_dropped_total"] = _TEL_DROPPED
+        return out
+
     def snapshot(self, log: JsonlLogger, **extra) -> None:
         log.log("metrics",
-                counters={k: c.n for k, c in sorted(self._counters.items())},
+                counters=self._counter_view(),
                 gauges={k: round(g.v, 6)
                         for k, g in sorted(self._gauges.items())},
                 hists={k: h.summary() for k, h in sorted(self._hists.items())},
                 **extra)
 
     def rollup(self) -> dict:
-        return {"counters": {k: c.n for k, c in sorted(self._counters.items())},
+        return {"counters": self._counter_view(),
                 "gauges": {k: round(g.v, 6)
                            for k, g in sorted(self._gauges.items())},
                 "hists": {k: h.summary()

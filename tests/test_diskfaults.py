@@ -1,0 +1,485 @@
+"""Storage fault matrix units (ISSUE 17).
+
+The injectable I/O fault kinds (``runtime/faults.py`` ``io_*``), the aio
+fault hook + bounded-retry discipline (``utils/aio.py``), and the graceful
+degradation each subsystem owes a disk that says no: telemetry drops and
+counts (never raises), the journal refusal latches the admission
+``disk_pressure`` 507 state and the probe releases it, torn/zero-byte
+lease payloads stay takeover-eligible, a refused spool upload releases the
+tenant's quota charge with no disk residue, the AOT cache sweeps to its
+size cap, and the sentinel/eventcheck tool belt understands the new event
+kinds. The end-to-end storm lives in ``bench.run_disk_soak`` (slow rung
+here, pounce smoke + ``DACCORD_BENCH_DISK=1`` elsewhere).
+"""
+
+import errno
+import json
+import os
+import time
+
+import pytest
+
+from daccord_tpu.runtime.faults import FaultPlan
+from daccord_tpu.utils import aio, lease
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test leaves the process-wide aio fault hook and telemetry drop
+    counter as it found them (both are process-global by design)."""
+    from daccord_tpu.utils import obs
+
+    yield
+    aio.install_faults(None)
+    obs.reset_telemetry_dropped()
+
+
+# ---------------------------------------------------------------------------
+# grammar + counters
+# ---------------------------------------------------------------------------
+
+def test_io_fault_grammar_parse():
+    p = FaultPlan.parse("io_enospc:3@journal,io_eio:2,io_slow:50@lease")
+    kinds = {(s.kind, s.at, s.domain) for s in p.specs}
+    assert ("io_enospc", 3, "journal") in kinds
+    assert ("io_eio", 2, "") in kinds
+    assert ("io_slow", 50, "lease") in kinds
+    assert p.has_io_faults()
+    with pytest.raises(ValueError):
+        FaultPlan.parse("io_enospc:1@attic")      # unknown domain
+    with pytest.raises(ValueError):
+        FaultPlan.parse("serve_crash:1@journal")  # @domain is io_*-only
+    with pytest.raises(ValueError):
+        FaultPlan.parse("io_bogus:1")
+
+
+def test_io_check_domain_scoped_counter():
+    """An ``@journal`` spec indexes ONLY journal-domain traffic: lease ops
+    interleaving never advance it toward firing."""
+    p = FaultPlan.parse("io_enospc:2@journal")
+    assert p.io_check("lease") is None
+    assert p.io_check("lease") is None
+    assert p.io_check("journal") is None          # journal op #1
+    s = p.io_check("journal")                     # journal op #2: fires
+    assert s is not None and s.kind == "io_enospc"
+    assert p.io_check("journal") is None          # one-shot
+    assert not p.has_io_faults()
+
+
+def test_io_check_global_counter_and_slow():
+    p = FaultPlan.parse("io_eio:3,io_slow:25")
+    assert p.io_check("journal") is None
+    assert p.io_check("lease") is None
+    s = p.io_check("manifest")                    # process-wide op #3
+    assert s is not None and s.kind == "io_eio"
+    assert p.io_slow_ms("spool") == 25.0          # undomained: every class
+    assert p.has_io_faults()                      # io_slow never fires out
+
+
+# ---------------------------------------------------------------------------
+# aio primitive matrix
+# ---------------------------------------------------------------------------
+
+def test_durable_write_enospc_no_litter(tmp_path):
+    dst = str(tmp_path / "m.json")
+    aio.install_faults(FaultPlan.parse("io_enospc:1@manifest"))
+    with pytest.raises(OSError) as ei:
+        aio.durable_write(dst, lambda fh: fh.write(b"x" * 64),
+                          domain="manifest")
+    assert ei.value.errno == errno.ENOSPC
+    assert not os.path.exists(dst)
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+    # one-shot: the next commit lands durably
+    aio.durable_write(dst, lambda fh: fh.write(b"ok"), domain="manifest")
+    assert open(dst, "rb").read() == b"ok"
+
+
+def test_durable_write_short_write_cleans_torn_tmp(tmp_path):
+    dst = str(tmp_path / "m.json")
+    aio.install_faults(FaultPlan.parse("io_short_write:1@manifest"))
+    with pytest.raises(OSError) as ei:
+        aio.durable_write(dst, lambda fh: fh.write(b"y" * 128),
+                          domain="manifest")
+    assert ei.value.errno == errno.ENOSPC
+    # the genuinely-torn tmp was removed; nothing published
+    assert not os.listdir(tmp_path)
+
+
+def test_durable_write_transient_eio_absorbed(tmp_path):
+    """``io_eio`` is the transient class: the bounded-retry wrapper's next
+    attempt runs clean, so the caller never sees the hiccup."""
+    dst = str(tmp_path / "m.json")
+    aio.install_faults(FaultPlan.parse("io_eio:1@manifest"))
+    aio.durable_write(dst, lambda fh: fh.write(b"ok"), domain="manifest")
+    assert open(dst, "rb").read() == b"ok"
+
+
+def test_durable_write_fsync_fail_not_retried(tmp_path):
+    dst = str(tmp_path / "m.json")
+    aio.install_faults(FaultPlan.parse("io_fsync_fail:1@manifest"))
+    with pytest.raises(OSError) as ei:
+        aio.durable_write(dst, lambda fh: fh.write(b"z"), domain="manifest")
+    assert ei.value.errno == errno.EIO
+    assert getattr(ei.value, "fault_kind", None) == "io_fsync_fail"
+    assert not os.path.exists(dst)
+
+
+def test_exclusive_create_unlinks_wreckage(tmp_path):
+    """A write/fsync failure AFTER the O_EXCL open must unlink the claim:
+    stranded zero-byte wreckage would block every future claimant until the
+    stale-TTL takeover."""
+    p = str(tmp_path / "j.lease")
+    aio.install_faults(FaultPlan.parse("io_enospc:1@lease"))
+    with pytest.raises(OSError):
+        aio.exclusive_create(p, b'{"host": "me"}', domain="lease")
+    assert not os.path.exists(p)                  # no wreckage
+    assert aio.exclusive_create(p, b'{"host": "me"}', domain="lease")
+    # transient EIO: retrying re-claims — the unlink is what lets the retry
+    # attempt's O_EXCL succeed instead of colliding with our own corpse
+    p2 = str(tmp_path / "k.lease")
+    aio.install_faults(FaultPlan.parse("io_eio:1@lease"))
+    assert aio.exclusive_create(p2, b"{}", domain="lease")
+
+
+def test_io_slow_delays_ops(tmp_path):
+    aio.install_faults(FaultPlan.parse("io_slow:40@sidecar"))
+    t0 = time.monotonic()
+    with aio.open_output(str(tmp_path / "s.jsonl"), "wb",
+                         domain="sidecar") as fh:
+        fh.write(b"line\n")
+    assert time.monotonic() - t0 >= 0.035
+    # other domains are untouched by the scoped delay
+    t0 = time.monotonic()
+    aio.durable_write(str(tmp_path / "m"), lambda fh: fh.write(b"x"),
+                      domain="manifest")
+    assert time.monotonic() - t0 < 0.035
+
+
+def test_retrying_bounded_on_real_transient():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(errno.EIO, "hiccup")
+        return "ok"
+
+    assert aio.retrying(flaky, base_s=0.001) == "ok"
+    assert calls["n"] == 3
+    with pytest.raises(OSError):
+        aio.retrying(lambda: (_ for _ in ()).throw(
+            OSError(errno.ENOSPC, "full")), base_s=0.001)
+
+
+# ---------------------------------------------------------------------------
+# telemetry never raises (satellite: JsonlLogger drop-and-count)
+# ---------------------------------------------------------------------------
+
+def test_jsonl_logger_drops_and_counts(tmp_path):
+    from daccord_tpu.utils import obs
+
+    obs.reset_telemetry_dropped()
+    log = obs.JsonlLogger(str(tmp_path / "ev.jsonl"))
+    aio.install_faults(FaultPlan.parse("io_enospc:1@sidecar"))
+    log.log("io.fault", domain="journal", op="append", error="x")  # durable
+    assert obs.telemetry_dropped_total() == 1     # dropped, never raised
+    log.log("disk.pressure", level="enter", src="journal", free_mb=1.0,
+            detail="d")
+    log.close()
+    recs = [json.loads(x) for x in open(tmp_path / "ev.jsonl")]
+    assert [r["event"] for r in recs] == ["disk.pressure"]
+    assert obs.telemetry_dropped_total() == 1
+
+
+def test_metrics_snapshot_surfaces_drops_only_when_nonzero(tmp_path):
+    from daccord_tpu.utils import obs
+
+    obs.reset_telemetry_dropped()
+    reg = obs.MetricsRegistry()
+    reg.counter("jobs").inc()
+    assert "telemetry_dropped_total" not in reg.rollup()["counters"]
+    obs._note_dropped(3)
+    assert reg.rollup()["counters"]["telemetry_dropped_total"] == 3
+
+
+# ---------------------------------------------------------------------------
+# lease protocol under a refusing disk (satellite: torn payloads)
+# ---------------------------------------------------------------------------
+
+def test_lease_read_result_statuses(tmp_path):
+    p = str(tmp_path / "j.lease")
+    assert lease.read_result(p) == (None, "absent")
+    open(p, "w").close()                          # zero-byte claim corpse
+    assert lease.read_result(p) == (None, "torn")
+    with open(p, "w") as fh:
+        fh.write('{"host": "to')                  # partial write
+    assert lease.read_result(p) == (None, "torn")
+    with open(p, "w") as fh:
+        json.dump({"host": "me"}, fh)
+    info, st = lease.read_result(p)
+    assert st == "ok" and info["host"] == "me"
+    aio.install_faults(FaultPlan.parse("io_eio:1@lease"))
+    assert lease.read_result(p) == (None, "error")
+    assert lease.read_result(p)[1] == "ok"        # one-shot hiccup
+
+
+def test_zero_byte_lease_stale_takeover(tmp_path):
+    """A zero-byte payload (claimer killed mid-create) must be
+    takeover-eligible once stale — it can never renew itself."""
+    p = str(tmp_path / "j.lease")
+    open(p, "w").close()
+    lease.backdate(p, 120.0)
+    ok, tk = lease.claim(p, "taker", ttl_s=60.0)
+    assert ok and tk["prev_host"] == "?"
+    assert lease.read(p)["host"] == "taker"
+
+
+def test_lease_claim_disk_refusal_loses_gracefully(tmp_path):
+    """A disk that says no at claim time is indistinguishable from losing
+    the race — never an exception into the submit/heartbeat thread, never
+    wreckage blocking the next claimant."""
+    p = str(tmp_path / "j.lease")
+    aio.install_faults(FaultPlan.parse(
+        "io_enospc:1@lease,io_enospc:2@lease"))
+    ok, tk = lease.claim(p, "me", ttl_s=60.0)
+    assert not ok and tk is None
+    assert not os.path.exists(p)
+    ok, _ = lease.claim(p, "me", ttl_s=60.0)      # storm spent: wins
+    assert ok
+
+
+def test_lease_renew_eio_returns_false_then_recovers(tmp_path):
+    p = str(tmp_path / "j.lease")
+    lease.claim(p, "me", 60.0)
+    aio.install_faults(FaultPlan.parse("io_eio:2@lease"))
+    assert lease.renew(p)                         # lease op #1: clean
+    assert not lease.renew(p)                     # op #2: injected EIO
+    assert lease.renew(p)                         # transient: next beat ok
+
+
+# ---------------------------------------------------------------------------
+# journal refusal -> disk-pressure latch -> 507 -> probe release
+# ---------------------------------------------------------------------------
+
+def _svc(workdir, **kw):
+    from daccord_tpu.serve import ConsensusService, ServeConfig
+
+    kw.setdefault("backend", "cpu")
+    kw.setdefault("backend_explicit", True)
+    kw.setdefault("workers", 1)
+    return ConsensusService(ServeConfig(workdir=str(workdir), **kw))
+
+
+def test_journal_refusal_latches_507_and_probe_clears(tmp_path):
+    from daccord_tpu.serve.admission import AdmissionReject
+
+    svc = _svc(tmp_path / "srv")
+    try:
+        aio.install_faults(FaultPlan.parse("io_enospc:1@journal"))
+        svc.journal_mark("admitted", "j99999", tenant="t", nbytes=1)
+        assert svc.admission.disk_pressure        # latched
+        assert svc.journal.append_failures == 1
+        with pytest.raises(AdmissionReject) as ei:
+            svc.submit({"tenant": "t"})
+        assert ei.value.reason == "disk_pressure" and ei.value.retryable
+        # the raw probe proves the volume writable again: latch releases
+        svc._disk_tick(time.time())
+        assert svc.admission.disk_pressure is None
+        svc.admission.admit("t", 1, job="jX")
+        svc.admission.release("t", 1)
+        evp = os.path.join(str(tmp_path / "srv"), "serve.events.jsonl")
+        evs = [json.loads(x) for x in open(evp)]
+        kinds = [(e["event"], e.get("level")) for e in evs]
+        assert ("io.fault", None) in kinds
+        assert ("disk.pressure", "enter") in kinds
+        assert ("disk.pressure", "clear") in kinds
+    finally:
+        aio.install_faults(None)
+        svc.shutdown()
+
+
+def test_spool_enospc_releases_quota_and_dir(tmp_path):
+    """A refused upload (ENOSPC mid-spool) raises out of admission, which
+    releases the tenant's charge and leaves no spool dir behind."""
+    import base64
+
+    svc = _svc(tmp_path / "srv")
+    try:
+        aio.install_faults(FaultPlan.parse("io_enospc:1@spool"))
+        body = {"tenant": "t",
+                "files": {"x.db": base64.b64encode(b"junk").decode()}}
+        with pytest.raises(OSError):
+            svc.submit(body)
+        st = svc.admission.stats()["tenants"].get("t", {})
+        assert st.get("queued", 0) == 0 and st.get("bytes", 0) == 0
+        assert os.listdir(os.path.join(str(tmp_path / "srv"), "jobs")) == []
+    finally:
+        aio.install_faults(None)
+        svc.shutdown()
+
+
+def test_journal_compact_online(tmp_path):
+    from daccord_tpu.serve.journal import JobJournal, replay
+
+    j = JobJournal(str(tmp_path / "journal.jsonl"))
+    for i in range(40):
+        jid = f"j{i:05d}"
+        assert j.append("admitted", jid, tenant="t", nbytes=1)
+        assert j.append("committed", jid)         # terminal, no idem: GC-able
+    assert j.append("admitted", "jlive", tenant="t", nbytes=1)
+    before = j.size_bytes()
+    res = j.compact_online()
+    assert res is not None
+    assert res["before"] == before and res["after"] < before
+    assert res["kept"] == 1 and res["torn"] == 0
+    # the swapped fd keeps appending durably
+    assert j.append("running", "jlive")
+    j.close()
+    ents, torn = replay(str(tmp_path / "journal.jsonl"))
+    assert torn == 0 and set(ents) == {"jlive"}
+    assert ents["jlive"].state == "running"
+
+
+def test_journal_append_refusal_counts_not_raises(tmp_path):
+    from daccord_tpu.serve.journal import JobJournal
+
+    j = JobJournal(str(tmp_path / "journal.jsonl"))
+    aio.install_faults(FaultPlan.parse("io_enospc:1@journal"))
+    assert not j.append("admitted", "j1")
+    assert j.append_failures == 1 and "ENOSPC" in (j.last_error or "") \
+        or j.last_error
+    assert j.append("admitted", "j1")             # storm spent
+    j.close()
+
+
+def test_admission_hard_watermark_rejects(tmp_path):
+    from daccord_tpu.serve.admission import (AdmissionConfig,
+                                             AdmissionController,
+                                             AdmissionReject)
+
+    adm = AdmissionController(AdmissionConfig(
+        watch_dir=str(tmp_path), disk_hard_mb=10.0 ** 9))
+    level, free = adm.disk_level()
+    assert level == "hard" and free >= 0
+    with pytest.raises(AdmissionReject) as ei:
+        adm.admit("t", 1)
+    assert ei.value.reason == "disk_pressure"
+    # thresholds off: the governor is inert
+    adm2 = AdmissionController(AdmissionConfig(watch_dir=str(tmp_path)))
+    assert adm2.disk_level() == (None, -1.0)
+    adm2.admit("t", 1)
+    adm2.release("t", 1)
+
+
+def test_disk_free_mb_walks_to_existing_ancestor(tmp_path):
+    from daccord_tpu.utils.obs import disk_free_mb
+
+    free = disk_free_mb(str(tmp_path))
+    assert free > 0
+    # a not-yet-created watch dir reads its nearest existing ancestor
+    assert disk_free_mb(str(tmp_path / "no" / "such" / "dir")) > 0
+
+
+# ---------------------------------------------------------------------------
+# AOT cache: skip-and-continue publish + size-capped LRU sweep
+# ---------------------------------------------------------------------------
+
+def test_aot_sweep_caps_by_lru(tmp_path):
+    from daccord_tpu.serve.aotcache import AotCache
+
+    d = str(tmp_path / "aot")
+    os.makedirs(d)
+    now = time.time()
+    for i in range(4):
+        p = os.path.join(d, f"k{i}.aot")
+        with open(p, "wb") as fh:
+            fh.write(b"\0" * (512 * 1024))        # 0.5 MiB each
+        os.utime(p, (now - 100 + i, now - 100 + i))
+    cache = AotCache(d, cap_mb=1.0)               # cap: 2 of 4 survive
+    removed = cache.sweep(keep=os.path.join(d, "k0.aot"))
+    left = sorted(os.listdir(d))
+    assert removed == 2
+    # k0 is pinned (the file just published); then LRU: oldest unpinned die
+    assert "k0.aot" in left and "k3.aot" in left
+    assert cache.counters["swept"] == 2
+    assert cache.sweep() == 0                     # already under cap
+
+
+# ---------------------------------------------------------------------------
+# tool belt: eventcheck schemas + sentinel flags
+# ---------------------------------------------------------------------------
+
+def _write_events(path, recs):
+    with open(path, "w") as fh:
+        for i, r in enumerate(recs):
+            fh.write(json.dumps({"t": float(i), "ts": float(i), **r}) + "\n")
+    return str(path)
+
+
+def test_eventcheck_knows_disk_kinds(tmp_path):
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    good = _write_events(tmp_path / "ok.jsonl", [
+        {"event": "io.fault", "domain": "journal", "op": "append",
+         "error": "ENOSPC"},
+        {"event": "disk.pressure", "level": "enter", "src": "journal",
+         "free_mb": 12.5, "detail": "x"},
+        {"event": "journal.compact", "before": 100, "after": 10,
+         "kept": 1, "torn": 0},
+        {"event": "aot.sweep", "removed": 2, "freed": 1024, "total": 4096,
+         "cap_mb": 1.0},
+    ])
+    assert validate_events(good, strict=True) == []
+    bad = _write_events(tmp_path / "bad.jsonl", [
+        {"event": "disk.pressure", "level": 3, "src": "journal",
+         "free_mb": "lots", "detail": "x"},
+    ])
+    assert validate_events(bad, strict=True)
+
+
+def test_sentinel_flags_disk_pressure_events(tmp_path):
+    from daccord_tpu.tools.sentinel import scan_events
+
+    p = _write_events(tmp_path / "ev.jsonl", [
+        {"event": "disk.pressure", "level": "enter", "src": "watermark",
+         "free_mb": 3.0, "detail": "free 3 MiB <= hard 5 MiB"},
+    ])
+    issues = scan_events(p)
+    assert any("DISK PRESSURE" in s for s in issues)
+    clear_only = _write_events(tmp_path / "ev2.jsonl", [
+        {"event": "disk.pressure", "level": "clear", "src": "probe",
+         "free_mb": 900.0, "detail": ""},
+    ])
+    assert not any("DISK PRESSURE" in s for s in scan_events(clear_only))
+
+
+def test_sentinel_bench_chaos_exemption():
+    from daccord_tpu.tools.sentinel import check_bench_series
+
+    sick = [("BENCH_SERVE.json", {"metric": "m", "value": 1.0,
+                                  "disk_pressure_events": 2})]
+    assert any("disk pressure" in s for s in check_bench_series(sick))
+    chaos = [("BENCH_DISK.json", {"metric": "disk_soak", "chaos": True,
+                                  "disk_pressure_events": 4})]
+    assert check_bench_series(chaos) == []
+
+
+# ---------------------------------------------------------------------------
+# the full storm (slow rung; the pounce smoke and DACCORD_BENCH_DISK=1
+# run the same contract end-to-end)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_disk_soak_contract(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    line = bench.run_disk_soak(root=str(tmp_path), n_jobs=4,
+                               commit_sidecar=False)
+    assert line["chaos"] and line["recovered"] and line["parity"]
+    assert line["refusals_507"] >= 1 and line["done"] == line["jobs"]

@@ -658,6 +658,50 @@ python -m daccord_tpu.tools.cli top --once "$routdir/router" \
 echo "tools_pounce: front-door smoke OK" >&2
 rm -rf "$routdir"
 
+# disk-chaos smoke (ISSUE 17): the full storage fault matrix against two
+# live serve peers — an io_enospc@journal burst on one, transient
+# io_eio@lease on the other. The soak's own asserts ARE the contract (no
+# process death, structured 507 refusals, byte parity, exactly-once
+# commits, zero litter, full recovery); the tool belt then gates the
+# artifacts: strict eventcheck + trace --check over the chaos sidecars,
+# the sentinel MUST flag the deliberately-pressured workdirs (proving the
+# disk red-flag wiring), and the committed chaos-flagged BENCH_DISK.json
+# MUST pass the same sentinel (proving the chaos exemption).
+diskdir=$(mktemp -d)
+python - "$diskdir" <<'EOF' || { echo "tools_pounce: disk-chaos soak FAILED (degradation contract broke)" >&2; exit 1; }
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+import bench
+line = bench.run_disk_soak(root=sys.argv[1], n_jobs=6)
+print("disk-chaos smoke:", json.dumps({k: line[k] for k in (
+    "jobs", "done", "refusals_507", "pressure_enter", "pressure_clear",
+    "takeovers", "demotions")}))
+EOF
+python -m daccord_tpu.tools.cli eventcheck --strict \
+    "$diskdir"/srv?/serve.events.jsonl "$diskdir"/srv?/g*.events.jsonl \
+    "$diskdir"/srv?/jobs/*/events.jsonl \
+  || { echo "tools_pounce: disk-chaos events failed schema lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli trace --check --no-timeline \
+    "$diskdir"/srv?/serve.events.jsonl "$diskdir"/srv?/g*.events.jsonl \
+    "$diskdir"/srv?/jobs/*/events.jsonl \
+  || { echo "tools_pounce: disk-chaos sidecars failed daccord-trace lint" >&2; exit 1; }
+if python -m daccord_tpu.tools.cli sentinel --strict "$diskdir/srvA" \
+    > "$diskdir/sentinel.out" 2>&1; then
+  echo "tools_pounce: sentinel MISSED the injected disk pressure" >&2; exit 1
+fi
+grep -q "DISK PRESSURE" "$diskdir/sentinel.out" \
+  || { echo "tools_pounce: sentinel flagged srvA for the wrong reason:" >&2; \
+       cat "$diskdir/sentinel.out" >&2; exit 1; }
+python -m daccord_tpu.tools.cli sentinel --strict BENCH_DISK.json \
+  || { echo "tools_pounce: chaos-flagged BENCH_DISK.json tripped the sentinel (exemption broken)" >&2; exit 1; }
+python -m daccord_tpu.tools.cli top --once "$diskdir/srvA" \
+  || { echo "tools_pounce: daccord-top failed over the chaos workdir" >&2; exit 1; }
+git add BENCH_DISK.json \
+  && git commit -q -m "pounce: disk-chaos soak (${stamp})" \
+  || echo "tools_pounce: BENCH_DISK.json unchanged (no commit)" >&2
+echo "tools_pounce: disk-chaos smoke OK" >&2
+rm -rf "$diskdir"
+
 # front-door bench stage (ISSUE 16 satellite): cold-peer TTFR with/without
 # the AOT cache + p99 through the router during a live scale-out
 env DACCORD_BENCH_ROUTER=1 python bench.py > "BENCH_ROUTER_${stamp}.log" 2>&1 \
