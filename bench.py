@@ -114,6 +114,23 @@ BENCH_SERVE_SOAK = os.environ.get("DACCORD_BENCH_SERVE_SOAK") == "1"
 # daccord-sentinel --strict exempts the deliberate pressure).
 # DACCORD_BENCH_DISK_JOBS overrides the job count (default 8).
 BENCH_DISK = os.environ.get("DACCORD_BENCH_DISK") == "1"
+# network-chaos soak (ISSUE 18): DACCORD_BENCH_NET=1 runs a live
+# daccord-router (in-process, so the injected net_* matrix from
+# runtime/faults.py fires inside its serve/netio choke point) fronting TWO
+# healthy daccord-serve subprocesses, and storms the NETWORK between them:
+# a net_reset burst on the submit domain (absorbed by bounded idempotent
+# retries), net_torn + net_hang + net_slow on the stream domain (torn
+# proxied streams are detected via the byte-count trailer and retried,
+# never committed short), then a full healthz partition of one peer
+# (SIGSTOP: host answers TCP, process says nothing) whose announce lease
+# stays fresh — the router must mark it PARTITIONED and route around it,
+# the autoscaler must not drain/reap it, and job takeover must not fire.
+# Asserts exactly-once commits fleet-wide, byte parity vs the solo
+# control, breaker open AND re-close observed, and post-storm recovery.
+# Commits BENCH_NET.json (chaos-flagged so daccord-sentinel --strict
+# exempts the deliberate storm). DACCORD_BENCH_NET_JOBS overrides the job
+# count (default 6).
+BENCH_NET = os.environ.get("DACCORD_BENCH_NET") == "1"
 # front door (ISSUE 16): DACCORD_BENCH_ROUTER=1 commits BENCH_ROUTER.json
 # with two arms: (a) cold-peer TTFR — time from fresh solve path to the
 # first fetched batch result — WITH the fleet-shared AOT executable cache
@@ -1980,6 +1997,468 @@ def run_disk_soak(root: str | None = None, n_jobs: int = 8,
     return line
 
 
+def run_net_soak(root: str | None = None, n_jobs: int = 6,
+                 seed: int = 0x4E70, ev=None, backend: str | None = None,
+                 timeout_s: float = 900.0,
+                 commit_sidecar: bool = True) -> dict:
+    """Network-chaos soak (ISSUE 18): a live ``daccord-router`` fronting
+    TWO healthy ``daccord-serve`` subprocesses while the NETWORK between
+    them misbehaves. The router runs in-process so the injected ``net_*``
+    matrix (``runtime/faults.py``) fires inside its ``serve/netio`` choke
+    point — the servers themselves are never faulted; the wire is.
+
+    Three storms, in sequence:
+
+    1. a ``net_reset`` burst on the submit domain — bounded idempotent
+       retries (client keys) must absorb it with exactly-once admission;
+    2. ``net_torn`` + ``net_hang`` + ``net_slow`` on the stream domain —
+       a torn proxied stream is detected via the byte-count trailer and
+       surfaces as a tear the client retries, never a short FASTA;
+    3. a full healthz partition of srvB (SIGSTOP: the host answers TCP,
+       the process says nothing) while its announce lease stays fresh —
+       the router must mark it PARTITIONED (not dead), tenants spill to
+       srvA, the autoscaler (which owns both peers here) must not drain
+       or reap it, and job takeover must not fire.
+
+    Asserts the network-resilience contract (AssertionError = broken):
+
+    - every admitted job commits exactly once fleet-wide, with streamed
+      bytes identical to the solo control;
+    - the circuit breaker is observed OPEN and RE-CLOSED;
+    - the partition window begins AND ends, with zero ``scale.drain`` /
+      ``scale.reap`` inside any window and zero takeovers ever;
+    - a post-storm submit + clean stream fetch completes (recovery);
+    - both peers exit 0 at shutdown (the network was the only enemy).
+    """
+    import http.client as _http_client
+    import random as _random
+    import shutil
+    import signal
+    import socket
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from daccord_tpu.runtime.faults import FaultPlan
+    from daccord_tpu.serve import AutoscaleConfig, Autoscaler, RouterConfig
+    from daccord_tpu.serve import netio
+    from daccord_tpu.serve.router import Router, start_router
+    from daccord_tpu.sim.synth import SimConfig, make_dataset
+
+    if backend is None:
+        backend = os.environ.get("DACCORD_BENCH_SERVE_BACKEND")
+    if not backend:
+        try:
+            from daccord_tpu.native import available as _nat
+
+            backend = "native" if _nat() else "cpu"
+        except Exception:
+            backend = "cpu"
+    rng = _random.Random(seed)
+    owns_root = root is None
+    root = root or tempfile.mkdtemp(prefix="daccord-net-soak-")
+    data = make_dataset(root, SimConfig(genome_len=1500, coverage=10,
+                                        read_len_mean=500, min_overlap=200,
+                                        seed=5), name="sv")
+    import dataclasses as _dc
+
+    from daccord_tpu.runtime.pipeline import correct_to_fasta
+    from daccord_tpu.serve.jobs import JobSpec, build_job_config
+
+    spec = JobSpec.from_json({"db": data["db"], "las": data["las"]}, root)
+    ccfg = build_job_config(spec, backend, True, 64, "fused", root, "solo")
+    ccfg = _dc.replace(ccfg, native_solver=backend == "native",
+                       supervise=True, events_path=None, ledger_path=None,
+                       job_tag=None, quarantine_path=None)
+    solo = os.path.join(root, "solo.fasta")
+    correct_to_fasta(data["db"], data["las"], solo, ccfg)
+    with open(solo, "rb") as fh:
+        solo_bytes = fh.read()
+
+    peer = os.path.join(root, "peer")
+    pkg_root = os.path.dirname(os.path.abspath(
+        __import__("daccord_tpu").__file__))
+    pkg_root = os.path.dirname(pkg_root)
+    servers = {name: {"workdir": os.path.join(root, name), "proc": None,
+                      "port": None}
+               for name in ("srvA", "srvB")}
+
+    def spawn(name: str) -> None:
+        s = servers[name]
+        ready = os.path.join(root, f"{name}.ready.json")
+        argv = [sys.executable, "-m", "daccord_tpu.tools.cli", "serve",
+                "--workdir", s["workdir"], "--backend", backend, "-b", "64",
+                "--workers", "2", "--port", "0", "--ready-file", ready,
+                "--peer-dir", peer, "--lease-ttl-s", "6",
+                "--heartbeat-s", "0.5", "--flush-lag-ms", "20",
+                "--metrics-snapshot-s", "5", "--drain-deadline-s", "120"]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        # the servers are HEALTHY — only the wire (the in-process router's
+        # netio layer) is stormed
+        env.pop("DACCORD_FAULT", None)
+        log = open(os.path.join(root, f"{name}.log"), "wb")
+        s["proc"] = subprocess.Popen(argv, env=env, stdout=log, stderr=log)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if os.path.exists(ready):
+                try:
+                    s["port"] = json.load(open(ready))["port"]
+                    return
+                except (OSError, json.JSONDecodeError, ValueError):
+                    pass
+            assert s["proc"].poll() is None, \
+                f"net soak: {name} died during startup " \
+                f"(rc {s['proc'].poll()})"
+            time.sleep(0.05)
+        raise RuntimeError(f"net soak: {name} never wrote its ready file")
+
+    t0 = time.time()
+    for name in servers:
+        spawn(name)
+
+    # the front door: in-process, storm-injected. Tight healthz deadline +
+    # short breaker cooldown keep the chaos phases brisk; the huge router
+    # lease TTL keeps a SIGSTOPped peer's announce FRESH for the whole
+    # partition window (the peer cannot renew while frozen).
+    router = Router(RouterConfig(
+        workdir=os.path.join(root, "router"), peer_dir=peer, poll_s=0.3,
+        lease_ttl_s=600.0, healthz_timeout_s=1.0, probe_timeout_s=5.0,
+        breaker_fails=3, breaker_open_s=2.0, net_retries=2))
+    router.autoscaler = Autoscaler(AutoscaleConfig(
+        peer_dir=peer, root=os.path.join(root, "autopeers"),
+        max_peers=2, min_peers=2, idle_ttl_s=1.0, cooldown_s=3600.0,
+        backend=backend, spawn_env={"JAX_PLATFORMS": "cpu"}), router.log)
+    for name, s in servers.items():
+        # the autoscaler OWNS both peers: its idle-drain sweep runs every
+        # tick, so the partition-safety guard is exercised for real
+        # (min_peers=2 blocks any legitimate drain)
+        router.autoscaler.adopt(name, s["proc"], s["workdir"])
+    rhttpd, rport, _rt = start_router(router)
+    base = f"http://127.0.0.1:{rport}"
+
+    def req(method: str, path: str, body=None, timeout=60,
+            port: int | None = None):
+        url = (f"http://127.0.0.1:{port}{path}" if port is not None
+               else base + path)
+        r = urllib.request.Request(
+            url, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(r, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except (json.JSONDecodeError, OSError, ValueError):
+                payload = {}
+            return e.code, payload
+
+    def assert_alive() -> None:
+        for name, s in servers.items():
+            rc = s["proc"].poll()
+            assert rc is None, \
+                f"net soak: {name} DIED (rc {rc}) — the network was the " \
+                f"only thing being stormed"
+
+    def peer_row(name: str) -> dict:
+        try:
+            _, st = req("GET", "/v1/router", timeout=20)
+        except (urllib.error.URLError, ConnectionError, socket.timeout,
+                OSError):
+            return {}
+        return {p["name"]: p for p in st.get("peers", [])}.get(name, {})
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            _, st = req("GET", "/v1/router", timeout=20)
+        except (urllib.error.URLError, ConnectionError, socket.timeout,
+                OSError):
+            st = {}
+        if st.get("ready") and \
+                sum(1 for p in st.get("peers", []) if p.get("alive")) == 2:
+            break
+        time.sleep(0.1)
+    else:
+        raise RuntimeError("net soak: router never saw both peers alive")
+
+    jobs = {}            # idem -> job id (router-assigned home)
+    submit_retries = 0
+    stream_retries = 0
+
+    def submit(idem: str, deadline_s: float = 180.0) -> bool:
+        """Patient admission through the router: retryable 502s (transport
+        failure after the netio budget) and 503s are the client's to
+        retry; the idempotency key carries exactly-once across them."""
+        nonlocal submit_retries
+        sub_deadline = time.time() + deadline_s
+        while True:
+            assert_alive()
+            try:
+                code, st = req("POST", "/v1/jobs",
+                               {"db": data["db"], "las": data["las"],
+                                "tenant": f"t{len(jobs) % 3}",
+                                "idempotency_key": idem})
+            except (urllib.error.URLError, ConnectionError, socket.timeout,
+                    OSError):
+                code, st = 0, {}
+            if code in (200, 201):
+                jobs[idem] = st["job"]
+                return True
+            submit_retries += 1
+            if time.time() > sub_deadline:
+                return False
+            time.sleep(0.2)
+
+    def wait_done(idems, deadline_s: float = None) -> None:
+        poll_deadline = time.time() + (deadline_s or timeout_s)
+        states = {}
+        while time.time() < poll_deadline:
+            assert_alive()
+            states = {}
+            for idem in idems:
+                try:
+                    code, st = req("GET", f"/v1/jobs/{jobs[idem]}",
+                                   timeout=20)
+                except (urllib.error.URLError, ConnectionError,
+                        socket.timeout, OSError):
+                    code, st = 0, {}
+                states[idem] = st.get("state") if code == 200 else None
+            if all(s in ("done", "failed", "aborted")
+                   for s in states.values()):
+                break
+            time.sleep(0.3)
+        bad = {k: v for k, v in states.items() if v != "done"}
+        assert not bad, f"net soak: jobs not DONE: {bad}"
+
+    def fetch_stream(idem: str, deadline_s: float = 120.0) -> bytes:
+        """Streamed FASTA through the router's verified proxy. A torn
+        stream surfaces to THIS client as a chunked-framing failure (the
+        router never sends the terminal chunk past a tear) — detected and
+        retried, never returned short."""
+        nonlocal stream_retries
+        f_deadline = time.time() + deadline_s
+        while True:
+            try:
+                r = urllib.request.Request(
+                    base + f"/v1/jobs/{jobs[idem]}/stream")
+                with urllib.request.urlopen(r, timeout=60) as resp:
+                    return resp.read()
+            except (urllib.error.URLError, _http_client.HTTPException,
+                    ConnectionError, socket.timeout, OSError):
+                stream_retries += 1
+                assert time.time() < f_deadline, \
+                    f"net soak: stream fetch for {idem} never recovered"
+                time.sleep(0.2)
+
+    try:
+        # ---- storm 1: reset burst on the submit domain -----------------
+        storms = {
+            "submit": ",".join(f"net_reset:{i}@submit" for i in
+                               range(1, 6)),
+            "stream": "net_torn:500@stream,net_hang:2@stream,"
+                      "net_slow:120@stream",
+        }
+        netio.install_faults(FaultPlan.parse(storms["submit"]))
+        for i in range(n_jobs):
+            time.sleep(rng.uniform(0.02, 0.15))
+            assert submit(f"net-{seed}-{i}"), \
+                f"net soak: job {i} never admitted through the reset storm"
+        wait_done([f"net-{seed}-{i}" for i in range(n_jobs)])
+
+        # ---- storm 2: torn + hung + slow streams -----------------------
+        netio.install_faults(FaultPlan.parse(storms["stream"]))
+        for i in range(n_jobs):
+            got = fetch_stream(f"net-{seed}-{i}")
+            assert got == solo_bytes, \
+                f"net soak: streamed FASTA for job {i} diverged from the " \
+                f"solo control ({len(got)} vs {len(solo_bytes)} bytes)"
+        assert stream_retries >= 1, \
+            "net soak: the stream storm never forced a client retry"
+        netio.install_faults(None)
+
+        # ---- storm 3: asymmetric partition of srvB ---------------------
+        os.kill(servers["srvB"]["proc"].pid, signal.SIGSTOP)
+        t_part = time.time()
+        part_deadline = time.time() + 30
+        row = {}
+        while time.time() < part_deadline:
+            row = peer_row("srvB")
+            if row.get("partitioned"):
+                break
+            time.sleep(0.2)
+        assert row.get("partitioned"), \
+            f"net soak: frozen srvB never marked PARTITIONED: {row}"
+        assert row.get("lease_age_s", -1) >= 0, \
+            "net soak: partitioned srvB lost its announce lease age"
+        breaker_during = row.get("breaker")
+        # the fleet must keep serving THROUGH the partition
+        assert submit(f"net-{seed}-window"), \
+            "net soak: submit during the partition window never admitted"
+        wait_done([f"net-{seed}-window"], deadline_s=300)
+        # hold the window until the breaker has provably opened (poll
+        # cadence x breaker_fails bounds this to a few seconds)
+        brk_deadline = time.time() + 30
+        while time.time() < brk_deadline:
+            breaker_during = peer_row("srvB").get("breaker")
+            if breaker_during in ("open", "half-open"):
+                break
+            time.sleep(0.2)
+        assert breaker_during in ("open", "half-open"), \
+            f"net soak: srvB breaker never opened under the partition " \
+            f"({breaker_during})"
+        os.kill(servers["srvB"]["proc"].pid, signal.SIGCONT)
+        heal_deadline = time.time() + 60
+        while time.time() < heal_deadline:
+            row = peer_row("srvB")
+            if row.get("alive") and not row.get("partitioned") and \
+                    row.get("breaker") == "closed":
+                break
+            time.sleep(0.2)
+        assert row.get("alive") and not row.get("partitioned"), \
+            f"net soak: srvB never healed after SIGCONT: {row}"
+        assert row.get("breaker") == "closed", \
+            f"net soak: srvB breaker never re-closed: {row}"
+        window_s = time.time() - t_part
+
+        # reap safety: the autoscaler owned an idle, partitioned,
+        # TTL-expired peer the whole window and must have touched nothing
+        ac = dict(router.autoscaler.counters)
+        assert ac["drains"] == 0 and ac["reaps"] == 0, \
+            f"net soak: autoscaler drained/reaped during the storm: {ac}"
+
+        # ---- recovery: clean submit + clean verified stream ------------
+        assert submit(f"net-{seed}-recovery"), \
+            "net soak: post-storm recovery submit never admitted"
+        wait_done([f"net-{seed}-recovery"], deadline_s=300)
+        assert fetch_stream(f"net-{seed}-recovery") == solo_bytes, \
+            "net soak: post-storm streamed FASTA diverged"
+    finally:
+        netio.install_faults(None)
+        try:
+            os.kill(servers["srvB"]["proc"].pid, signal.SIGCONT)
+        except (OSError, ProcessLookupError):
+            pass
+
+    # teardown: hand the peers back (autoscaler.shutdown must not SIGTERM
+    # what we stop gracefully), stop the poll loop BEFORE the peers die
+    # (so their exit never reads as one more partition), then drain them
+    try:
+        _, rst = req("GET", "/v1/router", timeout=20)
+    except (urllib.error.URLError, ConnectionError, socket.timeout, OSError):
+        rst = {}
+    jmap = rst.get("jobs", {})        # job id -> home peer (ids are
+    for name in servers:              # per-peer, so commits key on both)
+        router.autoscaler.disown(name)
+    router.shutdown()
+    rhttpd.shutdown()
+    assert_alive()
+    for name, s in servers.items():
+        try:
+            req("POST", "/v1/shutdown", body={}, port=s["port"])
+        except (urllib.error.URLError, ConnectionError, socket.timeout,
+                OSError):
+            pass
+        rc = s["proc"].wait(timeout=180)
+        assert rc == 0, f"net soak: {name} exited {rc} at shutdown"
+
+    # ---- the contract, from the durable record -------------------------
+    counts = {"net_fault_reset": 0, "net_fault_torn": 0, "net_fault_hang": 0,
+              "breaker_open": 0, "breaker_closed": 0,
+              "partition_begin": 0, "partition_end": 0,
+              "drain_or_reap_in_partition": 0}
+    open_windows: set = set()
+    with open(os.path.join(root, "router", "router.events.jsonl")) as fh:
+        for raw in fh:
+            try:
+                rec = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            evk = rec.get("event")
+            if evk == "net.fault":
+                key = "net_fault_" + str(rec.get("kind", ""))[4:]
+                if key in counts:
+                    counts[key] += 1
+            elif evk == "router.breaker":
+                if rec.get("state") == "open":
+                    counts["breaker_open"] += 1
+                elif rec.get("state") == "closed":
+                    counts["breaker_closed"] += 1
+            elif evk == "router.partition":
+                if rec.get("state") == "begin":
+                    counts["partition_begin"] += 1
+                    open_windows.add(rec.get("peer"))
+                else:
+                    counts["partition_end"] += 1
+                    open_windows.discard(rec.get("peer"))
+            elif evk in ("scale.drain", "scale.reap") and open_windows:
+                counts["drain_or_reap_in_partition"] += 1
+    assert counts["net_fault_reset"] >= 5, \
+        f"net soak: the reset storm never fully landed: {counts}"
+    assert counts["net_fault_torn"] >= 1 and counts["net_fault_hang"] >= 1, \
+        f"net soak: the stream storm never fully landed: {counts}"
+    assert counts["breaker_open"] >= 1 and counts["breaker_closed"] >= 1, \
+        f"net soak: breaker open AND re-close not both observed: {counts}"
+    assert counts["partition_begin"] >= 1 and \
+        counts["partition_end"] >= 1 and not open_windows, \
+        f"net soak: partition window never cycled: {counts} {open_windows}"
+    assert counts["drain_or_reap_in_partition"] == 0, \
+        f"net soak: the autoscaler killed cut-off hardware: {counts}"
+
+    commits: dict[str, int] = {}
+    takeovers = 0
+    for name, s in servers.items():
+        evp = os.path.join(s["workdir"], "serve.events.jsonl")
+        with open(evp) as fh:
+            for raw in fh:
+                try:
+                    rec = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                evk = rec.get("event")
+                if evk == "serve.commit":
+                    jid = str(rec.get("job", ""))
+                    key = jid if "." in jid else f"{name}.{jid}"
+                    commits[key] = commits.get(key, 0) + 1
+                elif evk == "serve.takeover":
+                    takeovers += 1
+    assert takeovers == 0, \
+        f"net soak: a partition caused {takeovers} false takeover(s)"
+    for idem, jid in jobs.items():
+        home = jmap.get(jid)
+        assert home in servers, \
+            f"net soak: job {idem} ({jid}) has no router home: {jmap}"
+        key = f"{home}.{jid}"
+        assert commits.get(key, 0) == 1, \
+            f"net soak: job {idem} ({key}) committed " \
+            f"{commits.get(key, 0)} times — exactly-once broke"
+
+    line = {
+        "metric": "net_soak", "chaos": True, "backend": backend,
+        "seed": seed, "jobs": len(jobs), "done": len(jobs),
+        "storm": storms,
+        "submit_retries": submit_retries, "stream_retries": stream_retries,
+        **counts,
+        "breaker_during_partition": breaker_during,
+        "partition_window_s": round(window_s, 3),
+        "takeovers": takeovers, "drains": 0, "reaps": 0,
+        "wall_s": round(time.time() - t0, 3),
+        "parity": True, "recovered": True,
+        **_tunnel_staleness(),
+    }
+    if ev is not None:
+        ev.log("bench_done", wall_s=line["wall_s"])
+    if commit_sidecar:
+        _commit_sidecar("BENCH_NET.json", line)
+    if owns_root:
+        shutil.rmtree(root, ignore_errors=True)
+    return line
+
+
 def main() -> None:
     import argparse
 
@@ -2013,6 +2492,15 @@ def main() -> None:
         ev.log("bench_start", batch=0, disk=True)
         n = int(os.environ.get("DACCORD_BENCH_DISK_JOBS", "8"))
         print(json.dumps(run_disk_soak(ev=ev, n_jobs=n)))
+        return
+    if BENCH_NET:
+        # network-chaos soak (ISSUE 18): live router + 2 healthy serve
+        # peers under an injected socket-fault storm and a SIGSTOP
+        # partition; the asserts ARE the stage — a broken resilience
+        # contract exits nonzero
+        ev.log("bench_start", batch=0, net=True)
+        n = int(os.environ.get("DACCORD_BENCH_NET_JOBS", "6"))
+        print(json.dumps(run_net_soak(ev=ev, n_jobs=n)))
         return
     if BENCH_SERVE:
         # serving-plane stage: self-contained (synth corpus + real HTTP

@@ -155,6 +155,38 @@ it with bounded backoff, while ``io_enospc`` / ``io_fsync_fail`` /
 ``io_short_write`` are persistent-for-this-op and surface to the caller
 (a failed fsync in particular must never be silently retried — the page
 state after it is undefined).
+
+Network kinds (the socket twins, ISSUE 18) make the router → peer HTTP
+fabric say no — every router/autoscaler/client call goes through the
+``serve/netio.py`` choke point, which consults the plan before (and, for
+``net_torn``, while) each request, so grey network failures run chip-free
+and socket-free like every prior matrix::
+
+    DACCORD_FAULT=net_refused:3           # 3rd HTTP op: connection refused
+    DACCORD_FAULT=net_reset:2             # 2nd op: connection reset mid-flight
+    DACCORD_FAULT=net_hang:1              # 1st op: the socket wedges until
+                                          # the per-domain deadline expires
+    DACCORD_FAULT=net_torn:512            # next response body truncated
+                                          # after 512 bytes (N is BYTES, not
+                                          # an op index — it tears the FIRST
+                                          # matching op's stream)
+    DACCORD_FAULT=net_slow:80             # EVERY op delayed 80 ms (duration
+                                          # grammar, like io_slow)
+    DACCORD_FAULT=net_reset:3@submit      # 3rd SUBMIT-domain op only
+
+The optional ``@domain`` suffix scopes a net spec to one RPC class —
+``healthz`` | ``submit`` | ``result`` | ``stream`` | ``abort`` — with a
+per-domain counter, exactly the ``io_*@domain`` design one layer up.
+Counter domains: every :meth:`FaultPlan.net_check` call (one per HTTP
+*attempt* — retries re-count, each retry genuinely re-opens a socket)
+advances both the global and the per-domain counter. ``net_slow`` reads N
+as milliseconds and is continuous; ``net_torn`` reads N as a BYTE offset
+and fires one-shot on the first matching op. ``net_reset`` and
+``net_refused`` are the *transient* class: ``netio.request`` retries them
+with bounded backoff+jitter (idempotent domains only — a submit without an
+idempotency key is never retried); ``net_hang`` surfaces as a deadline
+timeout and ``net_torn`` as a short-read integrity error, both feeding the
+per-peer circuit breaker rather than the retry loop.
 """
 
 from __future__ import annotations
@@ -210,7 +242,8 @@ _KINDS = ("fetch_hang", "dispatch_error", "device_lost", "compile_stall",
           "device_oom", "host_rss", "monster_pile", "worker_oom",
           "feeder_stall", "serve_crash", "serve_hang",
           "io_enospc", "io_eio", "io_fsync_fail", "io_short_write",
-          "io_slow")
+          "io_slow",
+          "net_refused", "net_reset", "net_hang", "net_torn", "net_slow")
 
 #: storage kinds (ISSUE 17): consumed by the utils/aio.py fault hook at
 #: every durable-I/O primitive, optionally scoped to one path class with
@@ -222,6 +255,17 @@ IO_KINDS = ("io_enospc", "io_eio", "io_fsync_fail", "io_short_write",
 #: multi-process tier: the serve job journal, shared-FS leases, shard/job
 #: manifests, tenant spool uploads, telemetry sidecars, the AOT cache dir.
 IO_DOMAINS = ("journal", "lease", "manifest", "spool", "sidecar", "aot")
+
+#: network kinds (ISSUE 18): consumed by the serve/netio.py choke point at
+#: every router/autoscaler/client HTTP attempt, optionally scoped to one
+#: RPC class with ``@domain``. ``net_slow`` reads N as milliseconds and
+#: ``net_torn`` reads N as a body byte offset (see the module doc).
+NET_KINDS = ("net_refused", "net_reset", "net_hang", "net_torn", "net_slow")
+
+#: RPC classes a net spec may scope to — the router → peer call surfaces:
+#: healthz polls, job submits, result fetches, streamed result proxies,
+#: abort/shutdown-drain calls.
+NET_DOMAINS = ("healthz", "submit", "result", "stream", "abort")
 
 #: fleet-orchestrator kinds: they sabotage worker spawns / lease renewal at
 #: the fleet layer (parallel/fleet.py) and are stripped from the worker
@@ -277,6 +321,10 @@ class FaultPlan:
     # ``@domain`` spec indexes only its own class's traffic
     n_io: int = 0
     n_io_domain: dict = field(default_factory=dict)
+    # network counters (advance once per HTTP attempt through serve/netio):
+    # process-wide plus one counter per RPC-class domain, mirroring storage
+    n_net: int = 0
+    n_net_domain: dict = field(default_factory=dict)
 
     @classmethod
     def parse(cls, text: str) -> "FaultPlan":
@@ -305,10 +353,17 @@ class FaultPlan:
                             f"DACCORD_FAULT: unknown io domain {dev!r} "
                             f"(known: {', '.join(IO_DOMAINS)})")
                     dom = dev
+                elif kind in NET_KINDS:
+                    if dev not in NET_DOMAINS:
+                        raise ValueError(
+                            f"DACCORD_FAULT: unknown net domain {dev!r} "
+                            f"(known: {', '.join(NET_DOMAINS)})")
+                    dom = dev
                 else:
                     raise ValueError(
                         f"DACCORD_FAULT: @suffix only applies to device_lost "
-                        f"(@device) and io_* kinds (@domain) (got {part!r})")
+                        f"(@device), io_* and net_* kinds (@domain) "
+                        f"(got {part!r})")
             try:
                 n = int(at) if at else 1
             except ValueError:
@@ -494,6 +549,49 @@ class FaultPlan:
         """True while any storage spec could still fire (or an ``io_slow``
         delay applies) — the aio hook's fast-path gate."""
         return any(s.kind in IO_KINDS and (s.kind == "io_slow" or not s.fired)
+                   for s in self.specs)
+
+    def net_check(self, domain: str = "") -> "FaultSpec | None":
+        """Advance the network-op counters for one HTTP *attempt* in RPC
+        class ``domain`` and return the fired ``net_*`` spec (never
+        ``net_slow`` — that is a duration, see :meth:`net_slow_ms`), or
+        None. A domained spec matches only attempts of its own class and
+        indexes that class's private counter; an undomained spec indexes
+        the process-wide attempt counter. ``net_torn`` is special: its N is
+        a BYTE offset, not an index, so it fires on the FIRST matching
+        attempt and the caller reads ``spec.at`` as the truncation point.
+        One-shot like the storage kinds — a retry's next attempt runs
+        clean, which is what makes reset/refused the *transient* class."""
+        self.n_net += 1
+        cnt = self.n_net_domain.get(domain, 0) + 1
+        self.n_net_domain[domain] = cnt
+        for s in self.specs:
+            if s.kind not in NET_KINDS or s.kind == "net_slow" or s.fired:
+                continue
+            if s.domain and s.domain != domain:
+                continue
+            if s.kind == "net_torn" or (cnt if s.domain
+                                        else self.n_net) >= s.at:
+                s.fired = True
+                return s
+        return None
+
+    def net_slow_ms(self, domain: str = "") -> float:
+        """Milliseconds of injected delay for ONE HTTP attempt in ``domain``
+        (``net_slow:MS[@domain]`` — N is a DURATION, like ``io_slow``), 0.0
+        when absent. Continuous, never fired-out: a grey-slow peer is slow
+        for the whole run, and sustained slowness — not a one-shot blip —
+        is what the hedged-read latency budget must see."""
+        for s in self.specs:
+            if s.kind == "net_slow" and (not s.domain or s.domain == domain):
+                return float(s.at)
+        return 0.0
+
+    def has_net_faults(self) -> bool:
+        """True while any network spec could still fire (or a ``net_slow``
+        delay applies) — the netio hook's fast-path gate."""
+        return any(s.kind in NET_KINDS
+                   and (s.kind == "net_slow" or not s.fired)
                    for s in self.specs)
 
     def monster_check(self) -> bool:

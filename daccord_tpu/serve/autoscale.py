@@ -24,6 +24,16 @@ Policy, deliberately simple and bounded:
   ``POST /v1/shutdown`` (``scale.drain``). The drain path releases its job
   leases; if it dies unclean instead, the PR 15 takeover path re-homes its
   jobs — reaping is safe either way. Process exit emits ``scale.reap``.
+- **partition safety** (ISSUE 18): a peer whose healthz is unreachable but
+  whose announce lease is fresh (``Peer.partitioned``) is alive-but-cut-off
+  — it is NEVER drained (its idle clock resets: the autoscaler cannot see
+  its queue, so it must not claim the peer is idle), it still occupies
+  spawn capacity (the partition healing must not land the fleet over
+  ``max_peers``), and a drain call that times out journal-marks nothing —
+  the peer's own journal owns its recovery, the autoscaler only ever asks
+  politely. The drain call itself goes through the ``serve/netio`` choke
+  point with the bounded ``abort`` deadline, so a wedged peer socket can
+  no longer stall the scale loop.
 """
 
 from __future__ import annotations
@@ -33,8 +43,9 @@ import os
 import subprocess
 import sys
 import time
-import urllib.request
 from dataclasses import dataclass, field
+
+from . import netio
 
 
 @dataclass
@@ -48,6 +59,7 @@ class AutoscaleConfig:
     cooldown_s: float = 30.0          # min gap between spawns
     idle_ttl_s: float = 120.0         # idle spawned peer older than this
                                       # drains (0 = never scale in)
+    drain_timeout_s: float = 10.0     # bound on the graceful-shutdown call
     backend: str = "native"
     batch: int = 64
     workers: int = 2
@@ -107,15 +119,37 @@ class Autoscaler:
         self.log.log("scale.spawn", peer=name, pid=proc.pid,
                      workdir=workdir, n_spawned=len(self._spawned))
 
+    def adopt(self, name: str, proc, workdir: str) -> None:
+        """Take ownership of an externally spawned peer (bench / chaos
+        harness escape hatch): it joins the idle-drain and reap sweeps
+        exactly as if this autoscaler had spawned it."""
+        self._spawned[name] = {"proc": proc, "workdir": workdir,
+                               "spawn_ts": time.time()}
+
+    def disown(self, name: str) -> None:
+        """Release an adopted peer without draining or reaping it —
+        :meth:`shutdown` must not terminate a process the harness intends
+        to stop gracefully itself."""
+        self._spawned.pop(name, None)
+        self._idle_since.pop(name, None)
+
+    def _net_event(self, event: str, **fields) -> None:
+        # ``event``, not ``kind``: net.fault carries a field named kind
+        try:
+            self.log.log(event, **fields)
+        except Exception:  # noqa: BLE001 — telemetry never breaks scaling
+            pass
+
     def _drain(self, name: str, url: str) -> None:
         try:
-            req = urllib.request.Request(url + "/v1/shutdown", method="POST",
-                                         data=b"{}")
-            with urllib.request.urlopen(req, timeout=10.0):
-                pass
+            netio.request(url + "/v1/shutdown", "abort", method="POST",
+                          body=b"{}", timeout=self.cfg.drain_timeout_s,
+                          log_event=self._net_event, peer=name)
         except Exception:
-            # unreachable: the process is likely already dead; the reap
-            # sweep below collects it and takeover re-homes any jobs
+            # unreachable or timed out: journal-mark NOTHING — the peer's
+            # own journal owns its recovery (graceful exit releases its
+            # leases; an unclean death goes stale and takeover re-homes
+            # the jobs). The reap sweep collects the process if it exits.
             pass
         self.counters["drains"] += 1
         self.log.log("scale.drain", peer=name, reason="idle_ttl")
@@ -140,6 +174,10 @@ class Autoscaler:
         self._reap()
         ready = [p for p in peers if p.ready]
         live = [p for p in peers if p.alive]
+        # a partitioned peer (healthz dead, announce lease fresh) is alive
+        # hardware we merely cannot see: it occupies capacity
+        present = [p for p in peers
+                   if p.alive or getattr(p, "partitioned", False)]
 
         # burn signal + band audit trail
         burn = max((p.burn for p in ready), default=0.0)
@@ -155,7 +193,7 @@ class Autoscaler:
                 self._burn_since = now
             sustained = now - self._burn_since >= self.cfg.sustain_s
             cooled = now - self._last_spawn_ts >= self.cfg.cooldown_s
-            capacity = len(live) + self._n_pending() < self.cfg.max_peers
+            capacity = len(present) + self._n_pending() < self.cfg.max_peers
             if sustained and cooled and capacity:
                 self._spawn()
         else:
@@ -168,6 +206,10 @@ class Autoscaler:
         for name in list(self._spawned):
             p = by_name.get(name)
             if p is None or not p.alive:
+                if p is not None and getattr(p, "partitioned", False):
+                    # we cannot see a partitioned peer's queue, so we
+                    # cannot call it idle — reset its clock, never drain
+                    self._idle_since.pop(name, None)
                 continue
             idle = p.jobs_active == 0 and p.queue_depth == 0
             if not idle:

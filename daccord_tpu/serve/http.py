@@ -47,6 +47,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .admission import AdmissionReject
 from .jobs import ABORTED, DONE, FAILED
+from .netio import BODY_BYTES_HEADER, STREAM_BYTES_TRAILER
 
 
 def _json_bytes(obj) -> bytes:
@@ -72,6 +73,10 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
+        # end-to-end integrity (ISSUE 18): unlike Content-Length, this
+        # survives proxies that re-frame the body — netio verifies it and
+        # turns a torn response into a retryable error, not a short commit
+        self.send_header(BODY_BYTES_HEADER, str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
 
@@ -203,6 +208,7 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "text/x-fasta")
         self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Trailer", STREAM_BYTES_TRAILER)
         self.end_headers()
 
         def chunk(data: bytes) -> None:
@@ -224,7 +230,11 @@ class ServeHandler(BaseHTTPRequestHandler):
                 if job.state in (DONE, FAILED, ABORTED):
                     break
                 time.sleep(0.05)
-            self.wfile.write(b"0\r\n\r\n")
+            # terminal chunk + byte-count trailer: a consumer (the router's
+            # verified proxy, netio.stream) that got fewer bytes knows the
+            # stream tore — a short FASTA must never look complete
+            self.wfile.write(b"0\r\n" + STREAM_BYTES_TRAILER.encode()
+                             + b": %d\r\n\r\n" % pos)
         except (BrokenPipeError, ConnectionResetError):
             self.svc.abort(job_id, reason="disconnect")
             self.close_connection = True

@@ -32,10 +32,24 @@ fleet boundary):
   peer or, after a takeover, on its successor. The router holds no job
   state a crash could lose; its job→peer map is a cache rebuilt by
   fan-out on miss.
+- **network discipline** (ISSUE 18): every peer call goes through the
+  ``serve/netio.py`` choke point — per-domain deadlines (a wedged socket
+  can no longer stall the poll loop), bounded transient retries, a
+  per-peer circuit breaker (``router.breaker`` events; an open breaker
+  spills the owner's tenants like a shed does), hedged healthz/result
+  reads against grey-slow peers, and byte-count verification that turns a
+  torn proxied stream into a retryable error instead of a short commit.
+- **partition asymmetry**: an HTTP-unreachable peer whose announce lease
+  is still fresh is *partitioned*, not dead (``router.partition``): its
+  tenants spill, but its jobs keep their leases (no takeover fires — the
+  job-lease clock is the peer's own, still beating) and the autoscaler
+  must neither reap nor drain it. Only a stale lease — the shared-FS
+  ground truth — declares a peer gone.
 
 The router's own telemetry (``router.events.jsonl``: ``router.*`` routing
-milestones + ``scale.*`` from the optional autoscaler) rides the same
-eventcheck/trace/sentinel chain as every other sidecar in the repo.
+milestones + ``scale.*`` from the optional autoscaler + ``net.*`` from
+the choke point) rides the same eventcheck/trace/sentinel chain as every
+other sidecar in the repo.
 """
 
 from __future__ import annotations
@@ -45,18 +59,24 @@ import json
 import os
 import threading
 import time
-import urllib.error
-import urllib.request
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..utils import lease
+from . import netio
 from .service import _LockedLogger
 
 # hop-by-hop headers a proxy must not forward (RFC 9110 §7.6.1)
 _HOP_HEADERS = {"connection", "keep-alive", "proxy-authenticate",
                 "proxy-authorization", "te", "trailer",
                 "transfer-encoding", "upgrade", "host", "content-length"}
+
+
+class _ClientGone(Exception):
+    """The DOWNSTREAM client disconnected mid-proxy — a failure of the
+    tenant's connection, not of the peer being proxied to. Kept distinct
+    so the error paths never blame (mark_dead / breaker-strike) a healthy
+    peer for it."""
 
 
 @dataclass
@@ -68,6 +88,12 @@ class RouterConfig:
     spill_burn: float = 1.0          # owner burn >= this (red band) → spill
     proxy_timeout_s: float = 600.0   # per proxied request (result?wait=1
                                      # legitimately blocks for minutes)
+    healthz_timeout_s: float = 5.0   # per poll — the poll loop's cadence
+                                     # rides on this being bounded
+    probe_timeout_s: float = 5.0     # per fan-out job probe
+    breaker_fails: int = 3           # consecutive failures → breaker opens
+    breaker_open_s: float = 5.0      # open cooldown before half-open probe
+    net_retries: int = 2             # transient-class retry budget
     events_path: str | None = None   # default <workdir>/router.events.jsonl
 
 
@@ -77,6 +103,9 @@ class Peer:
     url: str
     alive: bool = False              # lease fresh + healthz answering
     ready: bool = False              # healthz.ready (warm, replay done)
+    partitioned: bool = False        # healthz unreachable, lease FRESH —
+                                     # alive-but-unroutable, never reaped
+    lease_age: float = -1.0          # announce lease age at last scan
     shed_level: int = 0
     queue_depth: int = 0
     burn: float = 0.0
@@ -110,6 +139,10 @@ class Router:
         self.counters = {"routes": 0, "spills": 0, "proxied": 0,
                          "proxy_errors": 0, "fanouts": 0}
         self.autoscaler = None                # attached by start_router
+        self.net = netio.NetClient(log_event=self._net_event,
+                                   retries=cfg.net_retries,
+                                   breaker_fails=cfg.breaker_fails,
+                                   breaker_open_s=cfg.breaker_open_s)
         self._stop = threading.Event()
         self.started_ts = time.time()
         self.log.log("router.start", workdir=cfg.workdir,
@@ -122,13 +155,26 @@ class Router:
     # discovery: announce leases + healthz polls
     # ------------------------------------------------------------------
 
-    def _scan_announces(self) -> dict[str, str]:
-        """name -> url from fresh announce leases (stale = peer presumed
-        dead; its job leases are going stale on the same clock and the
-        takeover path owns recovery — the router only stops routing there)."""
+    def _net_event(self, event: str, **fields) -> None:
+        """netio's event sink: the choke point's net.fault / net.hedge /
+        router.breaker milestones land in the router's own sidecar. The
+        positional is named ``event`` on purpose — ``net.fault`` carries a
+        FIELD named ``kind``, which would collide with a ``kind`` param."""
+        try:
+            self.log.log(event, **fields)
+        except Exception:  # noqa: BLE001 — telemetry never breaks routing
+            pass
+
+    def _scan_announces(self) -> dict[str, tuple]:
+        """name -> (url, lease_age_s) from fresh announce leases (stale =
+        peer presumed dead; its job leases are going stale on the same
+        clock and the takeover path owns recovery — the router only stops
+        routing there). The age rides along so an HTTP-unreachable peer
+        can be reconciled against the shared-FS ground truth: fresh lease
+        + dead healthz = partitioned, not dead."""
         import glob as _glob
 
-        out: dict[str, str] = {}
+        out: dict[str, tuple] = {}
         for path in _glob.glob(os.path.join(self.cfg.peer_dir, "peers",
                                             "*.lease")):
             age = lease.stale_s(path)
@@ -137,18 +183,23 @@ class Router:
             info = lease.read(path)
             if info and info.get("url"):
                 name = os.path.basename(path).rsplit(".lease", 1)[0]
-                out[name] = str(info["url"])
+                out[name] = (str(info["url"]), float(age))
         return out
 
     def _poll_one(self, peer: Peer) -> None:
-        """One lock-free healthz poll; the X-Daccord-Router header arms the
-        peer's evict-vs-route grace window."""
+        """One lock-free healthz poll through the choke point — bounded by
+        the healthz deadline (a hung peer socket costs one deadline, never
+        a stalled poll loop), breaker-gated, hedged once the peer has a
+        latency history. The X-Daccord-Router header arms the peer's
+        evict-vs-route grace window."""
         try:
-            req = urllib.request.Request(
-                peer.url + "/v1/healthz",
-                headers={"X-Daccord-Router": "1"})
-            with urllib.request.urlopen(req, timeout=5.0) as resp:
-                h = json.loads(resp.read())
+            status, body, _h = self.net.request(
+                peer.name, peer.url + "/v1/healthz", "healthz",
+                headers={"X-Daccord-Router": "1"},
+                timeout=self.cfg.healthz_timeout_s)
+            if status != 200:
+                raise OSError(f"healthz status {status}")
+            h = json.loads(body)
         except Exception:
             peer.alive = False
             peer.ready = False
@@ -170,17 +221,19 @@ class Router:
         announced = self._scan_announces()
         with self._lock:
             known = dict(self.peers)
-        for name, url in announced.items():
+        for name, (url, age) in announced.items():
             p = known.get(name)
             if p is None:
                 p = Peer(name=name, url=url)
                 with self._lock:
                     self.peers[name] = p
             p.url = url
+            p.lease_age = age
         for name, p in list(known.items()):
             if name not in announced:
-                # stale/released announce: the peer is gone
-                if p.alive:
+                # stale/released announce: the peer is gone — the shared-FS
+                # ground truth, strictly stronger than an HTTP verdict
+                if p.alive or p.partitioned:
                     self.log.log("router.peer_down", peer=name,
                                  reason="lease_stale")
                 with self._lock:
@@ -196,6 +249,20 @@ class Router:
             elif was and not p.alive:
                 self.log.log("router.peer_down", peer=p.name,
                              reason="healthz")
+            # partition reconciliation: healthz says dead, the announce
+            # lease says the peer's heart is beating. Believe the lease —
+            # the peer is cut off from US, not from the world: its tenants
+            # spill (it is unroutable) but its jobs keep their fresh
+            # leases (takeover must not fire) and the autoscaler must not
+            # reap or drain it (tick() checks this flag).
+            part = not p.alive and p.name in announced
+            if part and not p.partitioned:
+                self.log.log("router.partition", peer=p.name, state="begin",
+                             lease_age_s=round(p.lease_age, 3))
+            elif p.partitioned and not part:
+                self.log.log("router.partition", peer=p.name, state="end",
+                             lease_age_s=round(p.lease_age, 3))
+            p.partitioned = part
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.cfg.poll_s):
@@ -253,6 +320,10 @@ class Router:
             reason = "shed"
         elif self.cfg.spill_burn and owner.burn >= self.cfg.spill_burn:
             reason = "burn"
+        elif self.net.breaker_state(owner.name) == "open":
+            # the owner's sockets are in a failure storm: spill past it
+            # while the breaker cools (half-open probes re-admit it)
+            reason = "breaker"
         if reason is not None:
             others = [p for p in peers if p.ready and p.name != owner.name]
             if others:
@@ -301,40 +372,52 @@ class Router:
             if not p.alive:
                 continue
             try:
-                req = urllib.request.Request(p.url + f"/v1/jobs/{job_id}")
-                with urllib.request.urlopen(req, timeout=5.0):
-                    self.note_job(job_id, p.name)
-                    return p
-            except urllib.error.HTTPError:
-                continue
+                status, _b, _h = self.net.request(
+                    p.name, p.url + f"/v1/jobs/{job_id}", "result",
+                    timeout=self.cfg.probe_timeout_s)
             except Exception:
                 continue
+            if status == 200:
+                self.note_job(job_id, p.name)
+                return p
         return None
 
+    @staticmethod
+    def _domain_for(method: str, path: str) -> str:
+        """RPC class of a proxied request — the netio deadline/fault key."""
+        p = path.split("?")[0]
+        if method == "DELETE" or p.endswith("/shutdown"):
+            return "abort"
+        if method == "POST":
+            return "submit"
+        if p.endswith("/stream"):
+            return "stream"
+        return "result"
+
     def proxy(self, peer: Peer, method: str, path: str,
-              body: bytes | None = None,
-              headers: dict | None = None) -> tuple[int, bytes, str]:
-        """Forward one request; returns (status, body, content_type).
-        Raises URLError/OSError on transport failure (the caller maps that
-        to 502 + retryable, and the client's idempotency key makes the
-        retry exactly-once)."""
-        req = urllib.request.Request(
-            peer.url + path, method=method, data=body,
+              body: bytes | None = None, headers: dict | None = None,
+              idempotent: bool | None = None) -> tuple[int, bytes, str]:
+        """Forward one request through the choke point; returns (status,
+        body, content_type). An HTTP-level refusal (429/503/404...) is a
+        valid answer and forwards verbatim; transport failure raises (the
+        caller maps that to 502 + retryable, and the client's idempotency
+        key makes the retry exactly-once). ``idempotent`` gates the
+        transient-retry budget: a submit is only retry-safe when the
+        client sent an idempotency key — everything else (GET status,
+        result, DELETE abort) is safe by construction."""
+        domain = self._domain_for(method, path)
+        if idempotent is None:
+            idempotent = domain != "submit"
+        status, data, rhead = self.net.request(
+            peer.name, peer.url + path, domain, method=method, body=body,
             headers={k: v for k, v in (headers or {}).items()
-                     if k.lower() not in _HOP_HEADERS})
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=self.cfg.proxy_timeout_s) as resp:
-                self.counters["proxied"] += 1
-                return (resp.status, resp.read(),
-                        resp.headers.get("Content-Type",
-                                         "application/json"))
-        except urllib.error.HTTPError as e:
-            # an HTTP-level refusal (429/503/404...) is a valid answer,
-            # not a transport failure — forward it verbatim
-            self.counters["proxied"] += 1
-            return (e.code, e.read(),
-                    e.headers.get("Content-Type", "application/json"))
+                     if k.lower() not in _HOP_HEADERS},
+            timeout=min(self.cfg.proxy_timeout_s,
+                        netio.deadline_for(domain)),
+            idempotent=idempotent)
+        self.counters["proxied"] += 1
+        return (status, data,
+                rhead.get("Content-Type", "application/json"))
 
     def stats(self) -> dict:
         peers = self.snapshot_peers()
@@ -345,7 +428,10 @@ class Router:
                "peers": [{"name": p.name, "url": p.url, "alive": p.alive,
                           "ready": p.ready, "shed": p.shed_level,
                           "queue_depth": p.queue_depth, "burn": p.burn,
-                          "jobs_active": p.jobs_active}
+                          "jobs_active": p.jobs_active,
+                          "partitioned": p.partitioned,
+                          "lease_age_s": round(p.lease_age, 3),
+                          "breaker": self.net.breaker_state(p.name)}
                          for p in sorted(peers, key=lambda p: p.name)],
                "jobs": jmap, **self.counters}
         if self.autoscaler is not None:
@@ -385,6 +471,9 @@ class RouterHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        # end-to-end integrity: recomputed here (not forwarded) because
+        # the router re-frames the body it proxies
+        self.send_header(netio.BODY_BYTES_HEADER, str(len(body)))
         self.end_headers()
         try:
             self.wfile.write(body)
@@ -395,6 +484,19 @@ class RouterHandler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0) or 0)
         return self.rfile.read(n) if n > 0 else b""
 
+    def _peer_fail(self, peer, e: BaseException):
+        """Transport failure talking to the PEER: a retryable 502, plus
+        the peer-table verdict. An open breaker is NOT evidence of death
+        (the breaker is the evidence-gatherer; healthz decides), so only
+        genuine transport failures de-route the peer."""
+        self.rt.counters["proxy_errors"] += 1
+        self.rt.log.log("router.proxy_error", peer=peer.name,
+                        error=f"{type(e).__name__}: {e}"[:200])
+        if not isinstance(e, netio.BreakerOpen):
+            self.rt.mark_dead(peer)
+        return self._send(502, {"error": f"peer {peer.name} unreachable",
+                                "peer": peer.name, "retryable": True})
+
     def _forward(self, peer, method: str, body: bytes | None = None):
         """Proxy + map transport failure to a retryable 502 (the client's
         idempotency key carries exactly-once across the retry)."""
@@ -402,12 +504,7 @@ class RouterHandler(BaseHTTPRequestHandler):
             code, data, ctype = self.rt.proxy(peer, method, self.path, body,
                                               dict(self.headers))
         except Exception as e:
-            self.rt.counters["proxy_errors"] += 1
-            self.rt.log.log("router.proxy_error", peer=peer.name,
-                            error=f"{type(e).__name__}: {e}"[:200])
-            self.rt.mark_dead(peer)
-            return self._send(502, {"error": f"peer {peer.name} unreachable",
-                                    "peer": peer.name, "retryable": True})
+            return self._peer_fail(peer, e)
         return self._send(code, body=data, ctype=ctype)
 
     def _job_route(self):
@@ -432,17 +529,13 @@ class RouterHandler(BaseHTTPRequestHandler):
                 return self._send(503, {"error": "no ready peers",
                                         "retryable": True})
             try:
-                code, data, ctype = self.rt.proxy(peer, "POST", self.path,
-                                                  raw, dict(self.headers))
+                # a keyed submit is retry-safe (the fleet dedupes on the
+                # journal-backed key); a bare one must surface its reset
+                code, data, ctype = self.rt.proxy(
+                    peer, "POST", self.path, raw, dict(self.headers),
+                    idempotent=bool(body.get("idempotency_key")))
             except Exception as e:
-                self.rt.counters["proxy_errors"] += 1
-                self.rt.log.log("router.proxy_error", peer=peer.name,
-                                error=f"{type(e).__name__}: {e}"[:200])
-                self.rt.mark_dead(peer)
-                return self._send(502, {"error":
-                                        f"peer {peer.name} unreachable",
-                                        "peer": peer.name,
-                                        "retryable": True})
+                return self._peer_fail(peer, e)
             if code in (200, 201):
                 try:
                     jid = json.loads(data).get("job")
@@ -487,35 +580,59 @@ class RouterHandler(BaseHTTPRequestHandler):
         return self._forward(peer, "DELETE")
 
     def _proxy_stream(self, peer) -> None:
-        """Chunked passthrough of a live FASTA stream. A peer death
-        mid-stream surfaces to the client as a torn stream (exactly what a
-        direct connection would do); the job itself survives via the peer's
-        journal, and the client re-fetches the result."""
+        """Chunked passthrough of a live FASTA stream, byte-verified. The
+        peer's ``X-Daccord-Stream-Bytes`` trailer is checked by the netio
+        reader: a torn upstream (peer died mid-copy, injected ``net_torn``)
+        means the terminal chunk is NEVER sent to the client — the client
+        sees a torn stream and re-fetches, instead of committing a short
+        result. A CLIENT disconnect mid-proxy is classified separately
+        (``router.client_gone``): the peer is healthy and keeps its
+        routability — a tenant's flaky connection must not de-ready a
+        peer for everyone else."""
         try:
-            req = urllib.request.Request(peer.url + self.path)
-            resp = urllib.request.urlopen(req,
-                                          timeout=self.rt.cfg.proxy_timeout_s)
+            status, rhead, chunks = netio.stream(
+                peer.url + self.path, "stream",
+                timeout=self.rt.cfg.proxy_timeout_s,
+                log_event=self.rt._net_event, peer=peer.name)
         except Exception as e:
+            self.rt.net.record_fail(peer.name)
+            return self._peer_fail(peer, e)
+        self.send_response(status)
+        self.send_header("Content-Type",
+                         rhead.get("Content-Type", "text/x-fasta"))
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Trailer", netio.STREAM_BYTES_TRAILER)
+        self.end_headers()
+        sent = 0
+        client_gone = False
+        try:
+            for data in chunks:
+                try:
+                    self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                except (BrokenPipeError, ConnectionResetError) as e:
+                    client_gone = True
+                    raise _ClientGone() from e
+                sent += len(data)
+            # clean end: terminal chunk + end-to-end byte-count trailer,
+            # so the CLIENT can verify the full proxied path too
+            self.wfile.write(b"0\r\n" + netio.STREAM_BYTES_TRAILER.encode()
+                             + b": %d\r\n\r\n" % sent)
+            self.rt.net.record_ok(peer.name)
+        except _ClientGone:
+            # the CLIENT hung up mid-proxy: log it as such and leave the
+            # peer's verdict alone — no mark_dead, no breaker strike
+            self.rt.log.log("router.client_gone", peer=peer.name,
+                            path=self.path.split("?")[0], bytes=sent)
+            self.close_connection = True
+        except Exception as e:  # noqa: BLE001 — peer-side tear
             self.rt.counters["proxy_errors"] += 1
+            self.rt.net.record_fail(peer.name)
             self.rt.log.log("router.proxy_error", peer=peer.name,
                             error=f"{type(e).__name__}: {e}"[:200])
-            self.rt.mark_dead(peer)
-            return self._send(502, {"error": f"peer {peer.name} unreachable",
-                                    "retryable": True})
-        self.send_response(resp.status)
-        self.send_header("Content-Type",
-                         resp.headers.get("Content-Type", "text/x-fasta"))
-        self.send_header("Transfer-Encoding", "chunked")
-        self.end_headers()
-        try:
-            with resp:
-                while True:
-                    data = resp.read(1 << 16)
-                    if not data:
-                        break
-                    self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
-            self.wfile.write(b"0\r\n\r\n")
-        except (BrokenPipeError, ConnectionResetError, OSError):
+            if not client_gone:
+                self.rt.mark_dead(peer, reason="torn_stream")
+            # no terminal chunk was written: the client sees a torn
+            # stream, never a silently short result
             self.close_connection = True
 
 

@@ -1242,6 +1242,17 @@ def router_main(argv=None) -> int:
                         "tenant to the least-loaded ready peer (0 = never "
                         "spill on burn)")
     p.add_argument("--proxy-timeout-s", type=float, default=600.0)
+    p.add_argument("--healthz-timeout-s", type=float, default=5.0,
+                   help="per-poll deadline — bounds the poll loop against "
+                        "a hung peer socket")
+    p.add_argument("--breaker-fails", type=int, default=3,
+                   help="consecutive transport failures that open a "
+                        "peer's circuit breaker")
+    p.add_argument("--breaker-open-s", type=float, default=5.0,
+                   help="breaker cooldown before a half-open probe")
+    p.add_argument("--net-retries", type=int, default=2,
+                   help="transient-class (reset/refused) retry budget per "
+                        "proxied call; non-idempotent submits never retry")
     p.add_argument("--events", default=None, metavar="PATH",
                    help="router events jsonl (router.* + scale.*; default "
                         "WORKDIR/router.events.jsonl)")
@@ -1282,6 +1293,10 @@ def router_main(argv=None) -> int:
                         poll_s=args.poll_s, lease_ttl_s=args.lease_ttl_s,
                         spill_burn=args.spill_burn,
                         proxy_timeout_s=args.proxy_timeout_s,
+                        healthz_timeout_s=args.healthz_timeout_s,
+                        breaker_fails=args.breaker_fails,
+                        breaker_open_s=args.breaker_open_s,
+                        net_retries=args.net_retries,
                         events_path=args.events)
     router = Router(rcfg)
     if args.autoscale_max > 0:

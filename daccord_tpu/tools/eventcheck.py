@@ -209,6 +209,22 @@ EVENT_FIELDS: dict[str, dict] = {
     "router.peer_up": {"peer": str, "url": str, "ready": bool},
     "router.peer_down": {"peer": str, "reason": str},
     "router.done": {"wall_s": _NUM, "routes": int, "spills": int},
+    # network fault matrix (ISSUE 18). net.fault = one injected socket
+    # fault observed at the serve/netio.py choke point (kind = net_* per
+    # the DACCORD_FAULT grammar, domain = healthz|submit|result|stream|
+    # abort). net.hedge = a hedged read fired because the peer exceeded
+    # its p99-derived latency budget. router.breaker = a per-peer circuit
+    # breaker transition (state = open | half-open | closed).
+    # router.partition = asymmetry reconciliation: healthz unreachable but
+    # the announce lease is fresh (state = begin | end) — the peer spills
+    # but is never reaped or takeover-claimed. router.client_gone = the
+    # DOWNSTREAM client disconnected mid-proxied-stream (classified apart
+    # from peer failures so a healthy peer is not blamed).
+    "net.fault": {"kind": str, "domain": str, "peer": str},
+    "net.hedge": {"peer": str, "domain": str, "budget_s": _NUM},
+    "router.breaker": {"peer": str, "state": str},
+    "router.partition": {"peer": str, "state": str, "lease_age_s": _NUM},
+    "router.client_gone": {"peer": str, "path": str, "bytes": int},
     # SLO-burn autoscaler (serve/autoscale.py): burn = fleet band change
     # audit trail, spawn/drain/reap = the bounded scale-out/in lifecycle.
     "scale.burn": {"burn": _NUM, "band": int, "n_ready": int, "n_live": int},

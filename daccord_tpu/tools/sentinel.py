@@ -283,6 +283,14 @@ def scan_events(path: str) -> list[str]:
     last_burn: float | None = None
     spawns_open: list[tuple[int, float, float]] = []  # (ln, burn@spawn, min since)
     aot_published: set[str] = set()
+    # network fault matrix (ISSUE 18): a reap/drain landing inside a
+    # peer's partition window killed live hardware (the lease was fresh —
+    # the peer was cut off, not dead), and a breaker that opened but never
+    # re-closed means a peer was written off for the rest of the run
+    # (cooldown never probed back, or the peer genuinely never recovered —
+    # either way, look).
+    partitioned_now: set[str] = set()
+    breaker_open_at: dict[str, int] = {}
     for ln, line in enumerate(lines, start=1):
         line = line.strip()
         if not line:
@@ -327,6 +335,34 @@ def scan_events(path: str) -> list[str]:
         elif ev == "scale.spawn":
             spawns_open.append((ln, last_burn if last_burn is not None
                                 else float("inf"), float("inf")))
+        elif ev == "router.partition":
+            peer = str(rec.get("peer"))
+            if rec.get("state") == "begin":
+                partitioned_now.add(peer)
+                # like disk pressure: a partition window is a red flag
+                # even when it later heals — the network needs an operator
+                # before the next one lands somewhere less survivable
+                issues.append(
+                    f"{path}:{ln}: ASYMMETRIC PARTITION of peer {peer!r} "
+                    f"(healthz unreachable, announce lease fresh at "
+                    f"{rec.get('lease_age_s', '?')}s) — routed around, "
+                    "not reaped")
+            else:
+                partitioned_now.discard(peer)
+        elif ev in ("scale.reap", "scale.drain"):
+            peer = str(rec.get("peer"))
+            if peer in partitioned_now:
+                issues.append(
+                    f"{path}:{ln}: {ev} of peer {peer!r} DURING its "
+                    "partition window — the announce lease was fresh, the "
+                    "peer was alive; the autoscaler killed cut-off "
+                    "hardware")
+        elif ev == "router.breaker":
+            peer = str(rec.get("peer"))
+            if rec.get("state") == "open":
+                breaker_open_at.setdefault(peer, ln)
+            elif rec.get("state") == "closed":
+                breaker_open_at.pop(peer, None)
         if ev in ("serve.slo", "scale.burn"):
             burn = rec.get("burn")
             if isinstance(burn, (int, float)) and not isinstance(burn, bool):
@@ -372,6 +408,15 @@ def scan_events(path: str) -> list[str]:
             issues.append(f"{path}: job {jid} taken over {n} times (peers "
                           "trading the lease without finishing — crash "
                           "loop, or lease TTL below real job latency)")
+    for peer, ln in sorted(breaker_open_at.items()):
+        issues.append(f"{path}:{ln}: circuit breaker for peer {peer!r} "
+                      "opened and never re-closed — the peer was written "
+                      "off for the rest of the run (no half-open probe "
+                      "succeeded)")
+    for peer in sorted(partitioned_now):
+        issues.append(f"{path}: peer {peer!r} still partitioned at stream "
+                      "end (healthz never came back while the lease stayed "
+                      "fresh — asymmetric partition unresolved)")
     return issues
 
 

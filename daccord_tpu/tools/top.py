@@ -206,6 +206,14 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
                     r["spills"] += 1
                 elif ev == "router.proxy_error":
                     r["proxy_errors"] += 1
+                elif ev == "router.breaker":
+                    # network fault matrix (ISSUE 18): per-peer breaker
+                    # state rides the peer table
+                    p_ = r["peers"].setdefault(rec.get("peer"), {})
+                    p_["breaker"] = rec.get("state")
+                elif ev == "router.partition":
+                    p_ = r["peers"].setdefault(rec.get("peer"), {})
+                    p_["partitioned"] = rec.get("state") == "begin"
                 elif ev in ("scale.spawn", "scale.drain", "scale.reap"):
                     r["scale"].append(
                         {"event": ev, "peer": rec.get("peer"),
@@ -221,7 +229,10 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
                         "serve.replay", "serve.takeover",
                         # storage fault matrix (ISSUE 17): disk refusals
                         # and pressure transitions are operator events
-                        "io.fault", "disk.pressure", "journal.compact"):
+                        "io.fault", "disk.pressure", "journal.compact",
+                        # network fault matrix (ISSUE 18): socket refusals
+                        # and partition transitions likewise
+                        "net.fault", "router.partition"):
                 snap["faults"].append(
                     {"src": src, "event": ev,
                      **{k: v for k, v in rec.items()
@@ -229,7 +240,8 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
                                  "culprit", "shard", "op", "job",
                                  "prev_host", "stale_s", "orphans",
                                  "finished", "domain", "error", "level",
-                                 "free_mb", "before", "after")}})
+                                 "free_mb", "before", "after",
+                                 "peer", "state")}})
                 if ev == "disk.pressure":
                     snap["disk"] = {"level": rec.get("level"),
                                     "src": rec.get("src"),
@@ -374,14 +386,20 @@ def render(snap: dict) -> str:
                    f"spills {router['spills']} "
                    f"proxy-errs {router['proxy_errors']}")
         if router["peers"]:
-            out.append(f"    {'PEER':<26}{'UP':<5}{'READY':<7}URL")
+            out.append(f"    {'PEER':<26}{'UP':<5}{'READY':<7}"
+                       f"{'NET':<13}URL")
             for name in sorted(router["peers"]):
                 d = router["peers"][name]
                 ready = d.get("ready")
+                # network column (ISSUE 18): partition verdict beats the
+                # breaker state — a partitioned peer is the operator event
+                net = "PARTITIONED" if d.get("partitioned") else \
+                    (d.get("breaker") or "-")
                 out.append(
                     f"    {str(name):<26}"
                     f"{('yes' if d.get('up') else 'NO'):<5}"
                     f"{('yes' if ready else ('-' if ready is None else 'NO')):<7}"
+                    f"{net:<13}"
                     f"{d.get('url') or d.get('reason') or '-'}")
         if router["owners"]:
             owners = " ".join(f"{t}->{p_}" for t, p_ in

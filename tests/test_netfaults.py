@@ -1,0 +1,756 @@
+"""Network fault matrix units (ISSUE 18).
+
+The injectable socket fault kinds (``runtime/faults.py`` ``net_*``), the
+``serve/netio`` choke point every router/autoscaler/client HTTP call rides
+(per-domain deadlines, transient-only bounded retries, body/trailer
+integrity, per-peer circuit breaker, hedged reads), and the failure
+asymmetries the fleet owes a flaky wire: a hung healthz costs one bounded
+deadline (the poll loop keeps ticking), a fresh-leased unreachable peer is
+PARTITIONED — routed around, never drained/reaped — and a client that
+hangs up mid-proxied-stream is classified ``router.client_gone``, never
+blamed on the peer. The end-to-end storm lives in ``bench.run_net_soak``
+(slow rung here, pounce smoke + ``DACCORD_BENCH_NET=1`` elsewhere).
+"""
+
+import errno
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from daccord_tpu.runtime.faults import FaultPlan
+from daccord_tpu.serve import netio
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test leaves the process-wide netio fault hook as it found it
+    (the plan and its counters are process-global by design)."""
+    yield
+    netio.install_faults(None)
+
+
+class _CapLog:
+    """Capture logger matching the obs logger surface."""
+
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **kw):
+        self.events.append((event, kw))
+
+    def __getitem__(self, name):
+        return [kw for ev, kw in self.events if ev == name]
+
+    def close(self):
+        pass
+
+
+def _lint(paths):
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    for p in paths:
+        errs = validate_events(p, strict=True)
+        assert not errs, (p, errs[:5])
+
+
+# ---------------------------------------------------------------------------
+# grammar + counters
+# ---------------------------------------------------------------------------
+
+def test_net_fault_grammar_parse():
+    p = FaultPlan.parse("net_refused:1@healthz,net_reset:2,net_hang:1@stream"
+                        ",net_torn:500@result,net_slow:150@stream")
+    kinds = {(s.kind, s.at, s.domain) for s in p.specs}
+    assert ("net_refused", 1, "healthz") in kinds
+    assert ("net_reset", 2, "") in kinds
+    assert ("net_hang", 1, "stream") in kinds
+    assert ("net_torn", 500, "result") in kinds
+    assert ("net_slow", 150, "stream") in kinds
+    assert p.has_net_faults()
+    with pytest.raises(ValueError):
+        FaultPlan.parse("net_reset:1@attic")       # unknown net domain
+    with pytest.raises(ValueError):
+        FaultPlan.parse("serve_crash:1@submit")    # @domain net_*/io_* only
+    with pytest.raises(ValueError):
+        FaultPlan.parse("net_bogus:1")
+
+
+def test_net_check_domain_scoped_counter():
+    """An ``@submit`` spec indexes ONLY submit-class attempts: healthz
+    polls interleaving never advance it toward firing."""
+    p = FaultPlan.parse("net_reset:2@submit")
+    assert p.net_check("healthz") is None
+    assert p.net_check("healthz") is None
+    assert p.net_check("submit") is None           # submit attempt #1
+    s = p.net_check("submit")                      # #2: fires
+    assert s is not None and s.kind == "net_reset"
+    assert p.net_check("submit") is None           # one-shot
+    assert not p.has_net_faults()
+
+
+def test_net_torn_first_op_and_slow_continuous():
+    """``net_torn:N`` carries a BYTE offset, so it fires on the first
+    matching attempt; ``net_slow:MS`` is a duration — continuous, never
+    fired out (the grey-slow peer stays slow all run)."""
+    p = FaultPlan.parse("net_torn:500@stream,net_slow:25@stream")
+    assert p.net_slow_ms("stream") == 25.0
+    assert p.net_slow_ms("submit") == 0.0
+    assert p.net_check("submit") is None
+    s = p.net_check("stream")
+    assert s is not None and s.kind == "net_torn" and s.at == 500
+    assert p.net_check("stream") is None
+    assert p.has_net_faults()                      # net_slow still applies
+    # undomained slow applies to every RPC class
+    assert FaultPlan.parse("net_slow:10").net_slow_ms("healthz") == 10.0
+
+
+def test_env_fault_plan_reaches_netio(monkeypatch):
+    """DACCORD_FAULT resolves lazily inside netio (the aio pattern): a
+    router under a storm needs no extra wiring."""
+    monkeypatch.setenv("DACCORD_FAULT", "net_refused:1@healthz")
+    netio.install_faults(None)                     # drop any explicit plan
+    with pytest.raises(netio.InjectedNetFault) as ei:
+        netio.request("http://127.0.0.1:1/healthz", "healthz", timeout=0.2)
+    assert ei.value.errno == errno.ECONNREFUSED
+    assert ei.value.fault_kind == "net_refused"
+
+
+# ---------------------------------------------------------------------------
+# netio request discipline (real loopback server)
+# ---------------------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):  # noqa: A002
+        pass
+
+    def _serve(self):
+        srv = self.server
+        srv.hits += 1
+        beh = srv.script.pop(0) if srv.script else {}
+        if beh.get("delay"):
+            time.sleep(beh["delay"])
+        body = beh.get("body", b'{"ok": true}')
+        self.send_response(beh.get("status", 200))
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        declared = beh.get("declared", len(body))
+        if declared is not None:
+            self.send_header(netio.BODY_BYTES_HEADER, str(declared))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        self._serve()
+
+    def do_POST(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        if n:
+            self.rfile.read(n)
+        self._serve()
+
+
+@pytest.fixture
+def httpd():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    srv.daemon_threads = True
+    srv.hits = 0
+    srv.script = []
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    srv.url = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_request_absorbs_transient_reset(httpd):
+    """Injected reset fires BEFORE the request leaves the socket (the
+    peer never saw it), wears the real errno, logs ``net.fault``, and the
+    bounded retry absorbs it."""
+    netio.install_faults(FaultPlan.parse("net_reset:1@submit"))
+    events = []
+    status, body, _ = netio.request(
+        httpd.url + "/v1/jobs", "submit", method="POST", body=b"{}",
+        retries=2, log_event=lambda e, **kw: events.append((e, kw)),
+        peer="pX")
+    assert status == 200 and json.loads(body)["ok"]
+    assert httpd.hits == 1                        # fault fired pre-send
+    assert events == [("net.fault", {"kind": "net_reset",
+                                     "domain": "submit", "peer": "pX"})]
+
+
+def test_request_non_idempotent_never_retried(httpd):
+    """A submit without an idempotency key must surface its reset: only
+    the journal-backed key makes the retry exactly-once."""
+    netio.install_faults(FaultPlan.parse("net_reset:1@submit"))
+    with pytest.raises(netio.InjectedNetFault) as ei:
+        netio.request(httpd.url + "/v1/jobs", "submit", method="POST",
+                      body=b"{}", retries=3, idempotent=False)
+    assert ei.value.errno == errno.ECONNRESET
+    assert httpd.hits == 0
+
+
+def test_injected_hang_bounded_by_deadline(httpd):
+    """``net_hang`` surfaces as the DEADLINE timeout, after a bounded
+    wall-clock spend — the caller's per-domain deadline is the contract."""
+    netio.install_faults(FaultPlan.parse("net_hang:1@healthz"))
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        netio.request(httpd.url + "/healthz", "healthz", timeout=0.25)
+    assert time.monotonic() - t0 < 2.0
+    assert httpd.hits == 0
+
+
+def test_torn_body_detected_and_retried_when_idempotent(httpd):
+    """A body shorter than the peer's declared byte count is a TornBody —
+    retried when idempotent, surfaced when not."""
+    httpd.script = [{"declared": 999}, {}]
+    status, body, _ = netio.request(httpd.url + "/x", "result", retries=1)
+    assert status == 200 and httpd.hits == 2
+    httpd.script = [{"declared": 999}]
+    with pytest.raises(netio.TornBody):
+        netio.request(httpd.url + "/x", "submit", retries=1,
+                      idempotent=False)
+
+
+def test_injected_torn_truncates_and_retry_heals(httpd):
+    netio.install_faults(FaultPlan.parse("net_torn:4@result"))
+    status, body, _ = netio.request(httpd.url + "/x", "result", retries=1)
+    assert status == 200 and body == b'{"ok": true}' and httpd.hits == 2
+
+
+def test_http_error_status_is_an_answer_not_a_failure(httpd):
+    """429/503/404 are VALID answers from a live peer: returned verbatim,
+    never retried, never fed to the breaker as transport failures."""
+    httpd.script = [{"status": 503, "body": b'{"retryable": true}'}]
+    status, body, _ = netio.request(httpd.url + "/x", "result", retries=2)
+    assert status == 503 and json.loads(body)["retryable"]
+    assert httpd.hits == 1
+
+
+# ---------------------------------------------------------------------------
+# streamed reads: trailer verification
+# ---------------------------------------------------------------------------
+
+class _StreamHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):  # noqa: A002
+        pass
+
+    def do_GET(self):  # noqa: N802
+        srv = self.server
+        self.send_response(200)
+        self.send_header("Content-Type", "text/x-fasta")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Trailer", netio.STREAM_BYTES_TRAILER)
+        self.end_headers()
+        sent = 0
+        for c in srv.chunks:
+            self.wfile.write(b"%x\r\n" % len(c) + c + b"\r\n")
+            sent += len(c)
+        declared = srv.declared if srv.declared is not None else sent
+        self.wfile.write(b"0\r\n" + netio.STREAM_BYTES_TRAILER.encode()
+                         + b": %d\r\n\r\n" % declared)
+
+
+@pytest.fixture
+def stream_srv():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _StreamHandler)
+    srv.daemon_threads = True
+    srv.chunks = [b"aaaa", b"bbbb"]
+    srv.declared = None
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    srv.url = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_stream_trailer_verified(stream_srv):
+    status, rhead, gen = netio.stream(stream_srv.url + "/s", "stream")
+    assert status == 200
+    assert b"".join(gen) == b"aaaabbbb"
+
+
+def test_stream_trailer_mismatch_raises(stream_srv):
+    stream_srv.declared = 999
+    _, _, gen = netio.stream(stream_srv.url + "/s", "stream")
+    with pytest.raises(netio.TornBody) as ei:
+        b"".join(gen)
+    assert ei.value.expected == 999 and ei.value.got == 8
+
+
+def test_stream_injected_torn_partial_then_raises(stream_srv):
+    """An injected mid-copy tear: bytes stop at the offset and the
+    terminator/trailer never arrives — a consumer can never mistake the
+    partial for a complete result."""
+    netio.install_faults(FaultPlan.parse("net_torn:6@stream"))
+    _, _, gen = netio.stream(stream_srv.url + "/s", "stream")
+    got = b""
+    with pytest.raises(netio.TornBody):
+        for c in gen:
+            got += c
+    assert got == b"aaaabb"
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker + NetClient discipline
+# ---------------------------------------------------------------------------
+
+def test_circuit_breaker_lifecycle():
+    t = [0.0]
+    br = netio.CircuitBreaker(fails=2, open_s=5.0, clock=lambda: t[0])
+    assert br.state() == "closed" and br.allow()
+    assert br.fail() is None                       # 1 of 2
+    assert br.fail() == "open"                     # threshold: transition
+    assert br.state() == "open" and not br.allow()
+    t[0] = 5.1
+    assert br.state() == "half-open"
+    assert br.allow()                              # ONE probe admitted
+    assert not br.allow()                          # concurrent: fail fast
+    assert br.fail() is None                       # failed probe re-arms
+    assert br.state() == "open" and not br.allow()
+    t[0] = 10.3
+    assert br.state() == "half-open" and br.allow()
+    assert br.ok() == "closed"
+    assert br.state() == "closed" and br.allow()
+    assert br.ok() is None                         # steady state: no event
+
+
+def test_netclient_breaker_opens_then_recloses(httpd):
+    events = []
+    nc = netio.NetClient(
+        log_event=lambda e, **kw: events.append((e, kw)),
+        retries=0, breaker_fails=1, breaker_open_s=0.2)
+    netio.install_faults(FaultPlan.parse("net_refused:1@submit"))
+    with pytest.raises(netio.InjectedNetFault):
+        nc.request("px", httpd.url + "/v1/jobs", "submit", method="POST",
+                   body=b"{}", idempotent=False)
+    assert nc.breaker_state("px") == "open"
+    hits0 = httpd.hits
+    with pytest.raises(netio.BreakerOpen):         # open: no socket spend
+        nc.request("px", httpd.url + "/v1/jobs", "submit", method="POST",
+                   body=b"{}")
+    assert httpd.hits == hits0
+    time.sleep(0.25)                               # half-open: probe admitted
+    status, _, _ = nc.request("px", httpd.url + "/x", "result")
+    assert status == 200
+    assert nc.breaker_state("px") == "closed"
+    states = [kw["state"] for e, kw in events if e == "router.breaker"]
+    assert states == ["open", "closed"]
+    assert nc.counters["breaker_opens"] == 1
+
+
+def test_hedged_read_races_grey_slow_peer(httpd):
+    """Past the p99-derived budget a second identical request races the
+    wedged primary; the earliest answer wins and ``net.hedge`` records
+    the countermeasure firing."""
+    events = []
+    nc = netio.NetClient(
+        log_event=lambda e, **kw: events.append((e, kw)),
+        hedge_floor_s=0.05, hedge_min_samples=4)
+    for _ in range(4):
+        nc._note_latency("px", "result", 0.01)
+    httpd.script = [{"delay": 0.6}, {}]            # primary wedged, hedge ok
+    t0 = time.monotonic()
+    status, body, _ = nc.request("px", httpd.url + "/x", "result")
+    assert status == 200
+    assert time.monotonic() - t0 < 0.5             # did not wait the primary
+    assert nc.counters["hedges"] == 1 and nc.counters["hedge_wins"] == 1
+    assert any(e == "net.hedge" and kw["domain"] == "result"
+               for e, kw in events)
+
+
+# ---------------------------------------------------------------------------
+# router: bounded healthz polls + partition reconciliation (satellite b)
+# ---------------------------------------------------------------------------
+
+def _mk_router(tmp_path, **kw):
+    from daccord_tpu.serve.router import Router, RouterConfig
+
+    kw.setdefault("poll_s", 3600.0)
+    kw.setdefault("peer_dir", str(tmp_path / "fleet"))
+    kw.setdefault("workdir", str(tmp_path / "router"))
+    os.makedirs(kw["peer_dir"], exist_ok=True)
+    return Router(RouterConfig(**kw))
+
+
+def _events(rt):
+    rt.log.flush()
+    path = os.path.join(rt.cfg.workdir, "router.events.jsonl")
+    with open(path) as fh:
+        return [json.loads(l) for l in fh if l.strip()]
+
+
+class _Healthz(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        body = json.dumps({"ok": True, "ready": True}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # noqa: A002
+        pass
+
+
+def test_healthz_hang_poll_bounded_and_partition_cycle(tmp_path):
+    """The ISSUE 18 poll-wedge regression: a ``net_hang@healthz`` costs
+    ONE bounded deadline — the sweep returns promptly, the unreachable
+    peer with a FRESH announce lease is reconciled to PARTITIONED (not
+    dead, not removed), and heals to alive on the next clean poll. A
+    stale lease, by contrast, removes the peer entirely."""
+    from daccord_tpu.utils import lease
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Healthz)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+
+    rt = _mk_router(tmp_path, healthz_timeout_s=0.3, lease_ttl_s=60.0)
+    os.makedirs(os.path.join(rt.cfg.peer_dir, "peers"), exist_ok=True)
+    lp = os.path.join(rt.cfg.peer_dir, "peers", "peer-x.lease")
+    lease.claim(lp, "peer-x@test", 60.0, extra={"url": url,
+                                                "service": "peer-x"})
+    try:
+        rt.refresh()
+        assert rt.peers["peer-x"].alive
+
+        netio.install_faults(FaultPlan.parse("net_hang:1@healthz"))
+        t0 = time.monotonic()
+        rt.refresh()
+        assert time.monotonic() - t0 < 2.5         # deadline, not a wedge
+        p = rt.peers["peer-x"]
+        assert not p.alive and p.partitioned       # lease fresh: cut off,
+        assert p.lease_age >= 0.0                  # not dead
+
+        netio.install_faults(None)
+        rt.refresh()                               # clean poll: healed
+        assert p.alive and not p.partitioned
+
+        evs = _events(rt)
+        parts = [e for e in evs if e["event"] == "router.partition"]
+        assert [e["state"] for e in parts] == ["begin", "end"]
+        assert any(e["event"] == "net.fault" and e["kind"] == "net_hang"
+                   and e["domain"] == "healthz" for e in evs)
+
+        lease.backdate(lp, 120.0)                  # stale announce: gone
+        rt.refresh()
+        assert "peer-x" not in rt.peers
+        downs = [e for e in _events(rt)
+                 if e["event"] == "router.peer_down"]
+        assert any(e["reason"] == "lease_stale" for e in downs)
+    finally:
+        rt.shutdown()
+        srv.shutdown()
+        srv.server_close()
+    _lint([os.path.join(str(tmp_path / "router"), "router.events.jsonl")])
+
+
+# ---------------------------------------------------------------------------
+# router: client disconnect mid-proxied-stream (satellite a regression)
+# ---------------------------------------------------------------------------
+
+class _SlowStream(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):  # noqa: A002
+        pass
+
+    def do_GET(self):  # noqa: N802
+        self.send_response(200)
+        self.send_header("Content-Type", "text/x-fasta")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        sent = 0
+        try:
+            for _ in range(40):
+                c = b"x" * 1024
+                self.wfile.write(b"%x\r\n" % len(c) + c + b"\r\n")
+                self.wfile.flush()
+                sent += len(c)
+                time.sleep(0.1)
+            self.wfile.write(b"0\r\n" + netio.STREAM_BYTES_TRAILER.encode()
+                             + b": %d\r\n\r\n" % sent)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+def test_client_disconnect_midstream_not_blamed_on_peer(tmp_path):
+    """The misclassification bugfix: a DOWNSTREAM client hanging up while
+    the router proxies a healthy peer's stream is ``router.client_gone``
+    — no ``mark_dead``, no breaker strike, no ``router.peer_down``. One
+    tenant's flaky connection must not de-route a peer for everyone."""
+    from daccord_tpu.serve.router import Peer, start_router
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _SlowStream)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    peer_url = f"http://127.0.0.1:{srv.server_address[1]}"
+
+    rt = _mk_router(tmp_path)
+    rt.peers["px"] = Peer(name="px", url=peer_url, alive=True, ready=True)
+    rt._job_map["jx"] = "px"
+    rhttpd, rport, _t = start_router(rt)
+    try:
+        s = socket.create_connection(("127.0.0.1", rport), timeout=10)
+        s.sendall(b"GET /v1/jobs/jx/stream HTTP/1.1\r\n"
+                  b"Host: localhost\r\n\r\n")
+        s.recv(2048)                               # headers + first chunks
+        # RST on close so the router's next write fails immediately
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+        s.close()
+
+        deadline = time.time() + 10
+        gone = []
+        while time.time() < deadline:
+            gone = [e for e in _events(rt)
+                    if e["event"] == "router.client_gone"]
+            if gone:
+                break
+            time.sleep(0.1)
+        assert gone and gone[0]["peer"] == "px"
+        assert gone[0]["path"] == "/v1/jobs/jx/stream"
+        assert gone[0]["bytes"] >= 0
+
+        # the peer keeps its routability and its clean breaker
+        assert rt.peers["px"].alive
+        assert rt.net.breaker_state("px") == "closed"
+        evs = _events(rt)
+        assert not [e for e in evs if e["event"] == "router.peer_down"]
+        assert not [e for e in evs if e["event"] == "router.proxy_error"]
+    finally:
+        rt.shutdown()
+        rhttpd.shutdown()
+        srv.shutdown()
+        srv.server_close()
+    _lint([os.path.join(str(tmp_path / "router"), "router.events.jsonl")])
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: partition reap-safety matrix (satellite c)
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def time(self):
+        return self.t
+
+
+class _FakeProc:
+    def __init__(self):
+        self.pid = 54321
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+
+def _mk_peer(name, **kw):
+    from daccord_tpu.serve.router import Peer
+
+    kw.setdefault("alive", True)
+    kw.setdefault("ready", True)
+    return Peer(name=name, url=kw.pop("url", f"http://127.0.0.1:1/{name}"),
+                **kw)
+
+
+def _mk_scaler(tmp_path, log, **kw):
+    from daccord_tpu.serve import AutoscaleConfig, Autoscaler
+
+    kw.setdefault("peer_dir", str(tmp_path / "fleet"))
+    kw.setdefault("root", str(tmp_path / "autopeers"))
+    kw.setdefault("backend", "native")
+    return Autoscaler(AutoscaleConfig(**kw), log)
+
+
+def test_autoscaler_never_drains_partitioned_peer(tmp_path, monkeypatch):
+    """A fresh-leased unreachable peer is invisible, not idle: its idle
+    clock resets every partitioned sweep, so no TTL ever elapses against
+    the window — and after healing, the TTL starts FRESH."""
+    import daccord_tpu.serve.autoscale as asc
+
+    clock = _Clock(1000.0)
+    monkeypatch.setattr(asc, "time", clock)
+    log = _CapLog()
+    sc = _mk_scaler(tmp_path, log, max_peers=4, min_peers=1,
+                    idle_ttl_s=4.0, cooldown_s=3600.0)
+    sc.adopt("pp", _FakeProc(), str(tmp_path / "pp"))
+    anchor = _mk_peer("p0")                        # keeps live > min_peers
+    part = _mk_peer("pp", alive=False, partitioned=True)
+
+    sc.tick([anchor, part])
+    clock.t = 1020.0                               # 20s >> idle_ttl
+    sc.tick([anchor, part])
+    assert sc.counters["drains"] == 0 and not log["scale.drain"]
+
+    healed = _mk_peer("pp")                        # healthz back, idle
+    sc.tick([anchor, healed])                      # clock starts NOW
+    clock.t = 1023.9
+    sc.tick([anchor, healed])
+    assert sc.counters["drains"] == 0              # fresh TTL not elapsed
+    clock.t = 1024.1
+    sc.tick([anchor, healed])                      # ... now it is
+    assert sc.counters["drains"] == 1
+    assert log["scale.drain"][0]["peer"] == "pp"
+
+
+def test_partitioned_peer_occupies_spawn_capacity(tmp_path, monkeypatch):
+    """Partitioned hardware is alive hardware we merely cannot see: it
+    still counts against ``max_peers`` — healing must not land the fleet
+    over the cap."""
+    import daccord_tpu.serve.autoscale as asc
+
+    clock = _Clock(1000.0)
+    monkeypatch.setattr(asc, "time", clock)
+    procs = []
+
+    class _FakeSub:
+        STDOUT = None
+
+        @staticmethod
+        def Popen(cmd, env=None, stdout=None, stderr=None):
+            if stdout is not None:
+                stdout.close()
+            procs.append(cmd)
+            return _FakeProc()
+
+    monkeypatch.setattr(asc, "subprocess", _FakeSub)
+    log = _CapLog()
+    sc = _mk_scaler(tmp_path, log, max_peers=2, min_peers=1,
+                    spawn_burn=1.0, sustain_s=1.0, cooldown_s=0.0,
+                    idle_ttl_s=0.0)
+    hot = _mk_peer("p0", burn=3.0)
+    part = _mk_peer("pp", alive=False, partitioned=True)
+
+    sc.tick([hot, part])
+    clock.t = 1002.0                               # sustained + cooled ...
+    sc.tick([hot, part])
+    assert sc.counters["spawns"] == 0              # ... but present == cap
+    clock.t = 1003.0
+    sc.tick([hot])                                 # partition resolved dead
+    assert sc.counters["spawns"] == 1 and len(procs) == 1
+
+
+def test_drain_call_bounded_and_marks_nothing(tmp_path):
+    """A drain whose socket wedges costs one ``abort`` deadline and
+    journal-marks NOTHING — the peer's own journal owns its recovery;
+    the autoscaler only ever asks politely."""
+    log = _CapLog()
+    sc = _mk_scaler(tmp_path, log, drain_timeout_s=0.3)
+    netio.install_faults(FaultPlan.parse("net_hang:1@abort"))
+    t0 = time.monotonic()
+    sc._drain("pp", "http://127.0.0.1:1")
+    assert time.monotonic() - t0 < 2.0             # bounded, not wedged
+    assert sc.counters["drains"] == 1
+    assert [e for e, kw in log.events] == ["net.fault", "scale.drain"]
+    # unreachable-peer drain (refused) is equally silent
+    sc._drain("pq", "http://127.0.0.1:1")
+    assert sc.counters["drains"] == 2
+
+
+# ---------------------------------------------------------------------------
+# tool belt: eventcheck schemas + sentinel flags
+# ---------------------------------------------------------------------------
+
+def _write_events(path, recs):
+    with open(path, "w") as fh:
+        for i, r in enumerate(recs):
+            fh.write(json.dumps({"t": float(i), "ts": float(i), **r}) + "\n")
+    return str(path)
+
+
+def test_eventcheck_knows_net_kinds(tmp_path):
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    good = _write_events(tmp_path / "ok.jsonl", [
+        {"event": "net.fault", "kind": "net_reset", "domain": "submit",
+         "peer": "pA"},
+        {"event": "net.hedge", "peer": "pA", "domain": "result",
+         "budget_s": 0.25},
+        {"event": "router.breaker", "peer": "pA", "state": "open"},
+        {"event": "router.partition", "peer": "pB", "state": "begin",
+         "lease_age_s": 0.4},
+        {"event": "router.client_gone", "peer": "pA",
+         "path": "/v1/jobs/j1/stream", "bytes": 512},
+    ])
+    assert validate_events(good, strict=True) == []
+    bad = _write_events(tmp_path / "bad.jsonl", [
+        {"event": "router.partition", "peer": "pB", "state": 3,
+         "lease_age_s": "fresh"},
+    ])
+    assert validate_events(bad, strict=True)
+
+
+def test_sentinel_flags_partition_and_breaker(tmp_path):
+    from daccord_tpu.tools.sentinel import scan_events
+
+    healed = _write_events(tmp_path / "healed.jsonl", [
+        {"event": "router.partition", "peer": "pB", "state": "begin",
+         "lease_age_s": 0.5},
+        {"event": "router.partition", "peer": "pB", "state": "end",
+         "lease_age_s": 0.7},
+        {"event": "router.breaker", "peer": "pA", "state": "open"},
+        {"event": "router.breaker", "peer": "pA", "state": "closed"},
+    ])
+    issues = scan_events(healed)
+    # a partition window is a red flag even when it heals (the disk-
+    # pressure precedent): the network needs an operator
+    assert any("ASYMMETRIC PARTITION" in s for s in issues)
+    assert not any("never re-closed" in s for s in issues)
+    assert not any("still partitioned" in s for s in issues)
+    assert not any("DURING its partition window" in s for s in issues)
+
+    sick = _write_events(tmp_path / "sick.jsonl", [
+        {"event": "router.partition", "peer": "pB", "state": "begin",
+         "lease_age_s": 0.5},
+        {"event": "scale.reap", "peer": "pB", "rc": -9, "life_s": 12.0},
+        {"event": "router.breaker", "peer": "pA", "state": "open"},
+    ])
+    issues = scan_events(sick)
+    assert any("DURING its partition window" in s for s in issues)
+    assert any("never re-closed" in s for s in issues)
+    assert any("still partitioned" in s for s in issues)
+
+
+def test_sentinel_bench_chaos_exemption_net():
+    from daccord_tpu.tools.sentinel import check_bench_series
+
+    chaos = [("BENCH_NET.json", {"metric": "net_soak", "chaos": True,
+                                 "partition_begin": 1, "breaker_open": 2})]
+    assert check_bench_series(chaos) == []
+
+
+# ---------------------------------------------------------------------------
+# the full storm (slow rung; the pounce smoke and DACCORD_BENCH_NET=1 run
+# the same contract end-to-end)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_net_soak_contract(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    line = bench.run_net_soak(root=str(tmp_path), n_jobs=2,
+                              commit_sidecar=False)
+    assert line["chaos"] and line["recovered"] and line["parity"]
+    assert line["breaker_open"] >= 1 and line["partition_begin"] >= 1
+    assert line["drain_or_reap_in_partition"] == 0
+    assert line["done"] == line["jobs"]

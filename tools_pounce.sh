@@ -702,6 +702,54 @@ git add BENCH_DISK.json \
 echo "tools_pounce: disk-chaos smoke OK" >&2
 rm -rf "$diskdir"
 
+# net-chaos smoke (ISSUE 18): the network fault matrix against two live
+# serve peers fronted by the resilient router — a reset storm on the
+# submit domain, a torn/hung/grey-slow stream domain, and an asymmetric
+# healthz partition (SIGSTOP) against a lease-fresh peer. The soak's own
+# asserts ARE the contract (exactly-once commits under the reset storm,
+# byte parity through torn/hung streams, zero drains/reaps/takeovers
+# inside the partition window, breaker open AND re-close, full recovery);
+# the tool belt then gates the artifacts: strict eventcheck + trace
+# --check over the chaos sidecars, the sentinel MUST flag the partition
+# window in the router workdir (proving the net red-flag wiring), and the
+# committed chaos-flagged BENCH_NET.json MUST pass the same sentinel
+# (proving the chaos exemption).
+netdir=$(mktemp -d)
+python - "$netdir" <<'EOF' || { echo "tools_pounce: net-chaos soak FAILED (resilience contract broke)" >&2; exit 1; }
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+import bench
+line = bench.run_net_soak(root=sys.argv[1], n_jobs=6)
+print("net-chaos smoke:", json.dumps({k: line[k] for k in (
+    "jobs", "done", "net_fault_reset", "net_fault_torn", "net_fault_hang",
+    "breaker_open", "breaker_closed", "partition_begin", "partition_end",
+    "drain_or_reap_in_partition", "takeovers")}))
+EOF
+python -m daccord_tpu.tools.cli eventcheck --strict \
+    "$netdir"/router/router.events.jsonl \
+    "$netdir"/srv?/serve.events.jsonl "$netdir"/srv?/jobs/*/events.jsonl \
+  || { echo "tools_pounce: net-chaos events failed schema lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli trace --check --no-timeline \
+    "$netdir"/router/router.events.jsonl \
+    "$netdir"/srv?/serve.events.jsonl "$netdir"/srv?/jobs/*/events.jsonl \
+  || { echo "tools_pounce: net-chaos sidecars failed daccord-trace lint" >&2; exit 1; }
+if python -m daccord_tpu.tools.cli sentinel --strict "$netdir/router" \
+    > "$netdir/sentinel.out" 2>&1; then
+  echo "tools_pounce: sentinel MISSED the injected partition window" >&2; exit 1
+fi
+grep -q "ASYMMETRIC PARTITION" "$netdir/sentinel.out" \
+  || { echo "tools_pounce: sentinel flagged the router for the wrong reason:" >&2; \
+       cat "$netdir/sentinel.out" >&2; exit 1; }
+python -m daccord_tpu.tools.cli sentinel --strict BENCH_NET.json \
+  || { echo "tools_pounce: chaos-flagged BENCH_NET.json tripped the sentinel (exemption broken)" >&2; exit 1; }
+python -m daccord_tpu.tools.cli top --once "$netdir/router" \
+  || { echo "tools_pounce: daccord-top failed over the chaos router workdir" >&2; exit 1; }
+git add BENCH_NET.json \
+  && git commit -q -m "pounce: net-chaos soak (${stamp})" \
+  || echo "tools_pounce: BENCH_NET.json unchanged (no commit)" >&2
+echo "tools_pounce: net-chaos smoke OK" >&2
+rm -rf "$netdir"
+
 # front-door bench stage (ISSUE 16 satellite): cold-peer TTFR with/without
 # the AOT cache + p99 through the router during a live scale-out
 env DACCORD_BENCH_ROUTER=1 python bench.py > "BENCH_ROUTER_${stamp}.log" 2>&1 \
