@@ -907,13 +907,21 @@ def run_mesh_bench(data: dict, ev, fallback_reason=None) -> dict:
     if BENCH_MESH_MAX_BATCHES is not None:
         nb = min(nb, BENCH_MESH_MAX_BATCHES)
     widths = [1, nd] if nd > 1 else [1]
+    # staged double-buffered dispatch (ISSUE 19): batch i+1's pad/shard/H2D
+    # staging runs on ONE background thread under batch i's solve, exactly
+    # the pipeline's _Stager discipline; DACCORD_MESH_PIPELINE=0 reverts to
+    # the monolithic dispatch (the parity/Amdahl control arm)
+    pipelined = os.environ.get("DACCORD_MESH_PIPELINE", "1") != "0"
     rungs = []
     for mesh_w in widths:
         solver = make_sharded_solver(ladder, make_mesh(mesh_w), batch=BATCH)
+        staged_ok = pipelined and hasattr(solver, "stage")
         # warmup / compile outside the timed region (the expected-wall echo
         # for cold mesh shapes rides the same bench_compile event)
         _announce_compile(ev, BATCH)
         solver(_make_batch(data, 0, BATCH, shape))
+        dw0 = (solver.dispatch_walls()
+               if hasattr(solver, "dispatch_walls") else None)
         t0 = time.perf_counter()
         t_disp = 0.0
         t_fetch = 0.0
@@ -940,15 +948,39 @@ def run_mesh_bench(data: dict, ev, fallback_reason=None) -> dict:
                 windows += len(out["solved"])
                 solved += int(out["solved"].sum())
 
-        for i in range(nb):
-            td = time.perf_counter()
-            if sat["t0"] is None:
-                sat["t0"] = td
-            inflight.append(solver.dispatch(_make_batch(data, i, BATCH,
-                                                        shape)))
-            t_disp += time.perf_counter() - td
-            if len(inflight) >= 8:
-                drain(4)
+        if staged_ok and nb > 0:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=1,
+                                    thread_name_prefix="bench-stager") as ex:
+                fut = ex.submit(solver.stage, _make_batch(data, 0, BATCH,
+                                                          shape))
+                for i in range(nb):
+                    staged = fut.result()
+                    if i + 1 < nb:
+                        fut = ex.submit(solver.stage,
+                                        _make_batch(data, i + 1, BATCH,
+                                                    shape))
+                    # t_disp = host wall BLOCKED on the dispatch path (the
+                    # acceptance number): with staging overlapped it is the
+                    # cheap async jit launch, not the pad+transfer
+                    td = time.perf_counter()
+                    if sat["t0"] is None:
+                        sat["t0"] = td
+                    inflight.append(solver.dispatch(staged))
+                    t_disp += time.perf_counter() - td
+                    if len(inflight) >= 8:
+                        drain(4)
+        else:
+            for i in range(nb):
+                td = time.perf_counter()
+                if sat["t0"] is None:
+                    sat["t0"] = td
+                inflight.append(solver.dispatch(_make_batch(data, i, BATCH,
+                                                            shape)))
+                t_disp += time.perf_counter() - td
+                if len(inflight) >= 8:
+                    drain(4)
         drain(0)
         wall = time.perf_counter() - t0
         wps = windows / wall if wall > 0 else 0.0
@@ -969,6 +1001,7 @@ def run_mesh_bench(data: dict, ev, fallback_reason=None) -> dict:
             # vs blocked on the grouped fetch — the rest is overlap slack
             "dispatch_s": round(t_disp, 3), "fetch_s": round(t_fetch, 3),
             "windows_per_sec": round(wps, 1),
+            "pipelined": bool(staged_ok),
             # per-device view: each device ran rows/mesh of every batch
             "per_device_rows": BATCH // mesh_w,
             "windows_per_sec_per_device": round(wps / mesh_w, 1),
@@ -977,6 +1010,22 @@ def run_mesh_bench(data: dict, ev, fallback_reason=None) -> dict:
                 solver.pad_rows / max(solver.pad_rows + solver.live_rows, 1),
                 6),
         })
+        if dw0 is not None:
+            # dispatch sub-walls (ISSUE 19): this rung's pack/stage/launch
+            # deltas — host work only, wherever the staging thread spent it
+            dw1 = solver.dispatch_walls()
+            rungs[-1].update(
+                pack_s=round(dw1["pack_s"] - dw0["pack_s"], 3),
+                stage_s=round(dw1["stage_s"] - dw0["stage_s"], 3),
+                launch_s=round(dw1["launch_s"] - dw0["launch_s"], 3))
+        if hasattr(solver, "health_map"):
+            # per-member starvation + overlap gauges (ISSUE 19): the
+            # sentinel's dispatch-share/idle-rise checks read these rows
+            hm = solver.health_map()
+            rungs[-1]["members"] = {
+                str(i): {"device_idle_frac": row.get("idle_frac"),
+                         "overlap_frac": row.get("overlap_frac")}
+                for i, row in sorted(hm.get("devices", {}).items())}
         ev.log("bench_rung", batch=BATCH,
                bases_per_sec=0.0, fallback=bool(fallback_reason),
                pad_waste=rungs[-1]["pad_to_mesh_waste"])

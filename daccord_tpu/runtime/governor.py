@@ -355,6 +355,11 @@ class CapacityGovernor:
         failover) and lets :class:`DeviceLostError` propagate (the chip
         died mid-walk — a different failure class)."""
         self._ensure_loaded()
+        # Capacity bisect operates on the HOST batch: a staged mesh batch
+        # (parallel/mesh.py StagedBatch) unwraps to its retained host-side
+        # windows — the staged device buffers are width-committed and get
+        # discarded here, then re-staged per rung by the dispatch path.
+        batch = getattr(batch, "replay_batch", batch)
         B = int(batch.size)
         q = max(1, int(self._quantum_fn())) if self._quantum_fn else 1
 

@@ -354,6 +354,12 @@ class PipelineStats:
     # metrics rollup, the prom exposition, and the bench sidecars.
     feeder_s: float = 0.0
     dispatch_s: float = 0.0
+    dispatch_walls: dict | None = None
+                                 # staged mesh dispatch (ISSUE 19): the
+                                 # pack/stage/launch sub-wall decomposition
+                                 # (+ restaged count) from
+                                 # ShardedLadderSolver.dispatch_walls();
+                                 # None off the mesh path
     stage_profile: dict = field(default_factory=dict)
     verdict: str = "balanced"
     bottleneck: dict = field(default_factory=dict)
@@ -843,6 +849,74 @@ def _iter_pile_blocks_threaded(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             for item in it:
                 inflight.append(submit(item))
                 break
+
+
+class _Stager:
+    """Async double-buffered dispatch staging (ISSUE 19).
+
+    One daemon thread runs the *stage* half of the split mesh dispatch
+    (``parallel/mesh.py`` — host pad/pack + per-device shard slicing + H2D
+    transfer) so batch N+1's host work proceeds entirely under batch N's
+    device solve; the pipeline thread only ``launch``es finished stages (a
+    cheap async jit call). Depth is bounded at 2 — one batch staging on the
+    thread plus at most one waiting in the queue — and :meth:`submit`
+    BLOCKS when the buffer is full, so the feeder cannot run ahead of the
+    governor's RSS watermarks (backpressure still binds; at most two extra
+    host batches are retained, same order as the in-flight window).
+
+    A ticket retains the HOST batch alongside the staged device buffers:
+    a staging error falls back to the direct dispatch path (the supervisor
+    ladder takes it from there), and replay-class faults downstream never
+    depend on staged state — the supervisor unwraps ``replay_batch``.
+    """
+
+    class _Ticket:
+        __slots__ = ("batch", "meta", "staged", "error", "done")
+
+        def __init__(self, batch, meta):
+            import threading
+
+            self.batch = batch
+            self.meta = meta
+            self.staged = None
+            self.error: BaseException | None = None
+            self.done = threading.Event()
+
+    def __init__(self, stage_fn, prof=None):
+        import queue
+        import threading
+
+        self._stage_fn = stage_fn
+        self._prof = prof
+        self._q: queue.Queue = queue.Queue(maxsize=1)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="daccord-stager")
+        self._thread.start()
+
+    def submit(self, batch, meta) -> "_Stager._Ticket":
+        t = self._Ticket(batch, meta)
+        self._q.put(t)   # blocks at depth 2: the double-buffer backpressure
+        return t
+
+    def _loop(self) -> None:
+        # this thread NEVER logs: the events sidecar requires monotonic
+        # timestamps within one file, and a second writer interleaving its
+        # own clock reads breaks that lint. The staged walls ride the
+        # ticket; the pipeline thread emits dispatch.stage when it consumes
+        # it (StageProfile.add is lock-guarded aggregation, not an event).
+        while True:
+            t = self._q.get()
+            if t is None:
+                return
+            try:
+                t.staged = self._stage_fn(t.batch, prof=self._prof)
+            except BaseException as e:  # noqa: BLE001 - relayed to launcher
+                t.error = e
+            finally:
+                t.done.set()
+
+    def stop(self) -> None:
+        self._q.put(None)
 
 
 def _native_wide_rescue(wide_nladder, b, out: dict, nt: int) -> None:
@@ -1618,6 +1692,78 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             dev["busy_s"] += dt
         return handle
 
+    # Async double-buffered dispatch pipeline (ISSUE 19): with a staged-
+    # dispatch mesh solver, batch N+1's pad/shard/H2D transfer runs on the
+    # _Stager daemon thread while batch N solves; the pipeline thread only
+    # launches finished stages. DACCORD_MESH_PIPELINE=0 opts out (the
+    # unpipelined path is the byte-parity control). The supervisor unwraps
+    # a StagedBatch to its retained host batch for every replay path, so
+    # the fault matrix is unchanged by pipelining.
+    stager = None
+    if (mesh_solver is not None and hasattr(mesh_solver, "stage")
+            and os.environ.get("DACCORD_MESH_PIPELINE", "1") != "0"):
+        stager = _Stager(mesh_solver.stage, prof=tel.stage)
+        ev_log.log("dispatch.pipeline", depth=2, solver=mesh_solver.describe())
+    staged_pending: deque = deque()
+
+    def _launch_staged(block: bool = False):
+        # launch staged tickets FIFO. A head still staging only blocks the
+        # launcher when the device would otherwise idle (empty in-flight
+        # window) or the caller needs the buffer drained (block=True) —
+        # otherwise the stage keeps overlapping the in-flight solve.
+        while staged_pending:
+            t = staged_pending[0]
+            if not t.done.is_set() and not block and inflight:
+                break
+            t.done.wait()
+            staged_pending.popleft()
+            rid, widx, take, rows_ctx, bi, stream, b_sp = t.meta
+            if t.error is None and t.staged is not None:
+                # emitted HERE (not on the staging thread) so the events
+                # sidecar keeps one monotonic writer; the walls were
+                # measured on the staging thread and ride the StagedBatch
+                ev_log.log("dispatch.stage", rows=int(take),
+                           pack_s=round(t.staged.pack_s, 4),
+                           stage_s=round(t.staged.stage_s, 4))
+            l_sp = tracer.open("dispatch.launch", parent=b_sp, attach=False,
+                               rows=int(take))
+            t_l = time.time()
+            _prof_on_dispatch()
+            if t.error is not None or t.staged is None:
+                # staging failed host-side: dispatch the retained host batch
+                # directly — the supervisor ladder takes it from here
+                handle = timed_dispatch(t.batch)
+            else:
+                handle = timed_dispatch(t.staged)
+            tracer.close(l_sp)
+            ev_log.log("dispatch.launch", rows=int(take),
+                       launch_s=round(time.time() - t_l, 4))
+            metrics.counter("dispatches").inc()
+            inflight.append((handle, rid, widx, take, time.time(),
+                             rows_ctx, bi, stream, b_sp))
+            if len(inflight) >= cfg.max_inflight:
+                drain(cfg.max_inflight // 2)
+
+    def submit_batch(batch, rid, widx, take, rows_ctx, bi, stream, b_sp):
+        """The ONE dispatch seam both streams use: direct (unpipelined) or
+        staged through the double buffer. Keeps the dispatch span/stage
+        accounting rules in one place."""
+        if stager is None:
+            d_sp = tracer.open("dispatch", parent=b_sp, stream=stream)
+            _prof_on_dispatch()
+            handle = timed_dispatch(batch)
+            tracer.close(d_sp)
+            metrics.counter("dispatches").inc()
+            inflight.append((handle, rid, widx, take, time.time(),
+                             rows_ctx, bi, stream, b_sp))
+            if len(inflight) >= cfg.max_inflight:
+                drain(cfg.max_inflight // 2)
+            return
+        _launch_staged()
+        staged_pending.append(stager.submit(
+            batch, (rid, widx, take, rows_ctx, bi, stream, b_sp)))
+        _launch_staged()
+
     # split-ladder rescue pools, one per bucket shape (Stream B inputs):
     # tier-0 failures and top-M-overflow windows accumulate here until a
     # full dense batch (or the flush deadline / final drain) dispatches them
@@ -2008,11 +2154,6 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 tracer.close(fl_sp)
                 b_sp = tracer.open("batch", attach=False, stream="rescue",
                                    rows=take, bucket=bi)
-                d_sp = tracer.open("dispatch", parent=b_sp, stream="rescue")
-                _prof_on_dispatch()
-                handle = timed_dispatch(batch)
-                tracer.close(d_sp)
-                metrics.counter("dispatches").inc()
                 metrics.histogram("flush_rows").observe(take)
                 stats.n_dispatch_rescue += 1
                 stats.n_rescue_windows += take
@@ -2021,10 +2162,8 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                     {"rows": take, "slots": int(batch.size), "reason": reason})
                 ev_log.log("ladder.flush", rows=take, slots=int(batch.size),
                            reason=reason, bucket=bi)
-                inflight.append((handle, rid, widx, take, time.time(),
-                                 rows_ctx, bi, "rescue", b_sp))
-                if len(inflight) >= cfg.max_inflight:
-                    drain(cfg.max_inflight // 2)
+                submit_batch(batch, rid, widx, take, rows_ctx, bi, "rescue",
+                             b_sp)
 
     def run_batches(final: bool, drain_inflight: bool | None = None,
                     pressure: bool = False):
@@ -2061,33 +2200,27 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 batch, rows_ctx = _finish_batch(batch, bi, pages_popped)
                 b_sp = tracer.open("batch", attach=False, stream=batch.stream,
                                    rows=take, bucket=bi)
-                d_sp = tracer.open("dispatch", parent=b_sp,
-                                   stream=batch.stream)
-                _prof_on_dispatch()
-                handle = timed_dispatch(batch)
-                tracer.close(d_sp)
-                metrics.counter("dispatches").inc()
                 if split_ladder:
                     stats.n_dispatch_tier0 += 1
                 # hp rescue reconstructs segments, and the split ladder pools
-                # rescue rows, from the dispatched rows_ctx arrays — keep
-                # them alive until the fetch (the supervisor's replay handles
-                # retain the whole batch anyway)
-                inflight.append((handle, rid, widx, take, time.time(),
-                                 rows_ctx, bi, batch.stream, b_sp))
-                # let the in-flight window FILL, then drain half of it in one
+                # rescue rows, from the dispatched rows_ctx arrays — kept
+                # alive until the fetch (the supervisor's replay handles
+                # retain the whole batch anyway). submit_batch lets the
+                # in-flight window FILL, then drains half of it in one
                 # grouped fetch — steady state pays one tunnel RTT per
                 # max_inflight/2 batches instead of one per batch
-                if len(inflight) >= cfg.max_inflight:
-                    drain(cfg.max_inflight // 2)
+                submit_batch(batch, rid, widx, take, rows_ctx, bi,
+                             batch.stream, b_sp)
         flush_rescues(final, pressure)
         if drain_inflight:
+            _launch_staged(block=True)
             drain(0)
             # draining Stream A pools fresh rescue rows; alternate flush and
             # drain until both are empty (Stream B results never pool, so
             # this terminates after at most one extra round)
-            while inflight or (split_ladder and any(r_nrows)):
+            while inflight or staged_pending or (split_ladder and any(r_nrows)):
                 flush_rescues(True, pressure)
+                _launch_staged(block=True)
                 drain(0)
 
     stats.paged = paged_on
@@ -2228,6 +2361,10 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                        hbm_peak_bytes=row["hbm_peak_bytes"],
                        # per-member starvation gauge (ISSUE 14)
                        idle_frac=row.get("idle_frac"),
+                       # per-member stage/solve overlap gauge (ISSUE 19):
+                       # fraction of staging wall that ran under an
+                       # in-flight solve — the pipeline acceptance gauge
+                       overlap_frac=row.get("overlap_frac"),
                        **({"rung_rows": int(rung_rows)}
                           if rung_rows is not None else {}))
         return hm
@@ -2466,6 +2603,8 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         tracer.close(pile_sp)
 
     run_batches(final=True)
+    if stager is not None:
+        stager.stop()
     while emit_idx < len(order):
         r = order[emit_idx]
         frags = ready.pop(r, [])
@@ -2500,6 +2639,17 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
     sat_g, sat_summ, sat_verdict = _saturation()
     stats.feeder_s = round(feeder_wall[0], 4)
     stats.dispatch_s = round(dev["dispatch_s"], 4)
+    # Staged mesh dispatch (ISSUE 19): the dispatch wall decomposes into
+    # pack/stage/launch sub-walls, and — satellite 2 — means HOST work only
+    # on every backend: the solver's own perf_counter brackets around the
+    # pad/slice/transfer/jit-call stages can never swallow a synchronous
+    # solve the way a wall around a blocking dispatch call did
+    # (MULTICHIP_r06's 40.2 s mesh-8 "dispatch" was partly compute).
+    dispatch_walls = None
+    if mesh_solver is not None and hasattr(mesh_solver, "dispatch_walls"):
+        dispatch_walls = mesh_solver.dispatch_walls()
+        stats.dispatch_s = round(dispatch_walls["dispatch_s"], 4)
+        stats.dispatch_walls = dispatch_walls
     stats.stage_profile = sat_summ
     stats.verdict = sat_verdict["verdict"]
     stats.bottleneck = sat_verdict
@@ -2536,6 +2686,14 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
         feeder_s=stats.feeder_s, dispatch_s=stats.dispatch_s,
         verdict=stats.verdict, bottleneck=sat_verdict,
         mesh=int(ledger_mesh),
+        # staged-dispatch sub-walls (ISSUE 19): host-only pack/stage/launch
+        # decomposition of the dispatch wall, plus the stale-staged-buffer
+        # re-stage count (shrink/failover landed while a batch was staged)
+        **({"pack_s": round(dispatch_walls["pack_s"], 4),
+            "stage_s": round(dispatch_walls["stage_s"], 4),
+            "launch_s": round(dispatch_walls["launch_s"], 4),
+            "restaged": int(dispatch_walls["restaged"])}
+           if dispatch_walls is not None else {}),
         tiers=stats.tier_histogram, native=stats.native_host,
         # two-stream ladder decision counters (ISSUE 4): fused-vs-split
         # rescue tail cost is measurable from these with no chip

@@ -734,7 +734,16 @@ class DeviceSupervisor:
     # ---- solver interface ----------------------------------------------
 
     def dispatch(self, batch) -> _SupHandle:
-        key = self._shape_key(batch)
+        # Staged-dispatch unwrap (ISSUE 19): a mesh ``StagedBatch`` carries
+        # pre-transferred per-device shards PLUS the host-side batch they
+        # came from. Everything the supervisor might ever replay — retries,
+        # governor bisect, partial-mesh shrink, failover, crash/resume —
+        # operates on the retained HOST batch; only the first dispatch
+        # attempt consumes the staged device buffers (and the mesh solver
+        # re-stages a stale one itself when the mesh changed under it).
+        staged = batch if hasattr(batch, "replay_batch") else None
+        rb = staged.replay_batch if staged is not None else batch
+        key = self._shape_key(rb)
         if self.state == DEGRADED:
             self._maybe_failback()
         if self.state in (LOST, DEGRADED):
@@ -743,8 +752,8 @@ class DeviceSupervisor:
             self.counters["dispatch"] += 1
             if self.faults is not None:
                 self.faults.op("dispatch", degraded=True)
-            return _SupHandle(None, batch, key, degraded=True)
-        w = self._width_of(batch)
+            return _SupHandle(None, rb, key, degraded=True)
+        w = self._width_of(rb)
         if w is not None:
             planned = self.governor.planned_width(key, w)
             if planned is not None:
@@ -753,31 +762,36 @@ class DeviceSupervisor:
                 # module exists to kill); opt-in probation restores it. Not
                 # counted here: no op runs at this width — the governor's
                 # own guarded ops count themselves
-                return self._gov_dispatch(batch, key, reason=None)
+                return self._gov_dispatch(rb, key, reason=None)
         self.counters["dispatch"] += 1
         while True:
             fresh = self._is_fresh(key)
             t_d = time.time()
             try:
+                arg = staged if staged is not None else rb
                 inner = self._guarded("dispatch", self._dispatch_fn,
-                                      lambda attempt: (batch,), key, fresh,
-                                      width=w)
+                                      lambda attempt: (arg if attempt == 1
+                                                       else rb,),
+                                      key, fresh, width=w)
                 break
             except CapacityError as e:
-                return self._gov_dispatch(batch, key, reason=str(e))
+                return self._gov_dispatch(rb, key, reason=str(e))
             except DeviceLostError as e:
                 # partial-mesh degradation rung: a shrunken mesh is a new
                 # primary at a new :m<N> key (cold-classified), so the
-                # re-dispatch below gets real compile deadlines
+                # re-dispatch below gets real compile deadlines. The staged
+                # device buffers are discarded with it — the retained host
+                # batch re-stages at the new width (byte-identical).
                 if self._mesh_degrade(str(e)):
-                    key = self._shape_key(batch)
+                    staged = None
+                    key = self._shape_key(rb)
                     continue
                 self._engage_fallback(str(e))
-                return _SupHandle(None, batch, key, degraded=True)
+                return _SupHandle(None, rb, key, degraded=True)
         self._seen_shapes.add(key)
         if fresh:
             self._record_compile(key, time.time() - t_d)
-        return _SupHandle(inner, batch, key)
+        return _SupHandle(inner, rb, key)
 
     def _record_compile(self, key: str, wall_s: float) -> None:
         """Fold a fresh shape's measured dispatch wall into the fingerprint

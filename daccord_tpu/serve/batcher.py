@@ -44,6 +44,7 @@ windowing with device work exactly as the solo pipeline does.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -666,6 +667,16 @@ class SolveGroup:
         t_d = time.time()
         if not self._sync_engine and self.sat["t0"] is None:
             self.sat["t0"] = t_d
+        if (self.mesh_solver is not None
+                and hasattr(self.mesh_solver, "stage")
+                and os.environ.get("DACCORD_MESH_PIPELINE", "1") != "0"):
+            # merged cross-job batches ride the staged dispatch path
+            # (ISSUE 19): pre-built per-device shard buffers consumed by the
+            # launch; with earlier flushes still in flight the staging books
+            # as overlapped, and every supervisor replay path (failover,
+            # shrink, capacity bisect) operates on the retained HOST batch
+            # the StagedBatch carries
+            merged = self.mesh_solver.stage(merged)
         dh = self.sup.dispatch(merged)
         dt = time.time() - t_d
         self.sat["dispatch_s"] += dt

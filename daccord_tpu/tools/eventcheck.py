@@ -89,6 +89,15 @@ EVENT_FIELDS: dict[str, dict] = {
     # full | lag | final | pressure — the last is a host-watermark
     # force-flush, ISSUE 5)
     "ladder.flush": {"rows": int, "slots": int, "reason": str},
+    # staged dispatch pipeline (ISSUE 19): dispatch.pipeline announces the
+    # double buffer once per run; dispatch.stage is one row per staged batch
+    # (host pad/pack + per-device shard-transfer sub-walls, measured on the
+    # staging thread but EMITTED by the pipeline thread so the sidecar keeps
+    # one monotonic writer); dispatch.launch is the jit-call row, whose
+    # trace span pairs under the ordinary span_open/span_close rule.
+    "dispatch.pipeline": {"depth": int, "solver": str},
+    "dispatch.stage": {"rows": int, "pack_s": _NUM, "stage_s": _NUM},
+    "dispatch.launch": {"rows": int, "launch_s": _NUM},
     # capacity governor (runtime/governor.py, ISSUE 5): memory faults walk a
     # byte-identical degradation ladder instead of the transient retry ladder
     "governor.classify": {"key": str, "width": int, "reason": str},

@@ -61,9 +61,24 @@ FEEDER_SUBSTAGES = ("decode", "rank", "realign", "kmer", "tensorize",
 TOL_FRAC = 0.05
 TOL_ABS = 0.05
 
+#: staged-dispatch sub-walls (ISSUE 19): host-only decomposition of the
+#: dispatch wall — pad/pack assembly, per-device shard transfer, jit call.
+#: Like `pack` they are NOT feeder sub-stages (staging runs on its own
+#: thread, outside the feeder wall); they reconcile against dispatch_s.
+DISPATCH_SUBWALLS = ("pack_s", "stage_s", "launch_s")
+
 
 def _tol(anchor: float) -> float:
     return max(TOL_FRAC * max(anchor, 0.0), TOL_ABS)
+
+
+def _dispatch_walls(payload: dict) -> dict | None:
+    """The pack/stage/launch sub-wall dict carried by a shard_done record or
+    a MULTICHIP bench rung payload, or None when the run predates (or never
+    ran) the staged dispatch path."""
+    dw = {k: float(payload[k]) for k in DISPATCH_SUBWALLS
+          if isinstance(payload.get(k), (int, float))}
+    return dw or None
 
 
 def profile_from_events(records: list[dict], src: str = "") -> dict | None:
@@ -86,6 +101,7 @@ def profile_from_events(records: list[dict], src: str = "") -> dict | None:
                     "host_s": done.get("host_s"),
                     "feeder_s": done.get("feeder_s"),
                     "dispatch_s": done.get("dispatch_s"),
+                    "dispatch_walls": _dispatch_walls(done),
                     "threads": int(done.get("stage_threads") or 1),
                     "stages": {k: float(v)
                                for k, v in done["stages"].items()},
@@ -145,10 +161,24 @@ def profile_from_rollup(path: str) -> dict | None:
 def profile_from_bench(payload: dict, name: str) -> dict | None:
     """Normalized profile from a bench/feeder sidecar payload (already
     unwrapped from the ``{"parsed": {...}}`` r-series format)."""
+    rungs = payload.get("rungs")
+    if isinstance(rungs, list) and rungs and isinstance(rungs[-1], dict):
+        # MULTICHIP sidecar: profile the final (mesh-N) rung — the subject
+        # of the scaling claim; the mesh-1 rung is its control. Older
+        # sidecars carry verdict/saturation only per rung (or not at all);
+        # newer ones also commit them top-level, which the rung inherits.
+        rung = dict(rungs[-1])
+        if rung.get("verdict") is None and payload.get("verdict") is not None:
+            rung["verdict"] = payload["verdict"]
+        sub = profile_from_bench(rung, f"{name}:mesh{rung.get('mesh')}")
+        if sub is not None:
+            return sub
     stages = payload.get("stages")
     sat = payload.get("saturation") or {}
     if not isinstance(stages, dict) and not sat \
-            and "verdict" not in payload:
+            and "verdict" not in payload \
+            and not ("mesh" in payload
+                     and isinstance(payload.get("dispatch_s"), (int, float))):
         return None
     if isinstance(stages, dict) and stages and \
             isinstance(next(iter(stages.values())), dict):
@@ -157,6 +187,7 @@ def profile_from_bench(payload: dict, name: str) -> dict | None:
             "wall_s": payload.get("wall_s"), "device_s": None,
             "host_s": None, "feeder_s": payload.get("feeder_s"),
             "dispatch_s": payload.get("dispatch_s"),
+            "dispatch_walls": _dispatch_walls(payload),
             "threads": int(payload.get("stage_threads")
                            or payload.get("threads") or 1),
             "stages": stages if isinstance(stages, dict) else {},
@@ -260,6 +291,11 @@ def render_profile(d: dict) -> str:
                        float(g.get("device_idle_frac") or 0.0),
                        float(g.get("host_blocked_frac") or 0.0),
                        float(g.get("overlap_frac") or 0.0)))
+    dw = d.get("dispatch_walls")
+    if dw:
+        out.append("  dispatch: " + "  ".join(
+            f"{k.replace('_s', '')} {dw[k]:.3f}s"
+            for k in DISPATCH_SUBWALLS if k in dw))
     v = d.get("verdict")
     if v:
         dom = d.get("stage")
@@ -312,6 +348,18 @@ def check_profile(d: dict) -> list[str]:
                 f"{src}: stage sum {per_thread:.3f}s (per thread) exceeds "
                 f"host_s {float(host):.3f}s (tolerance "
                 f"{_tol(float(host)):.3f}s)")
+    dw = d.get("dispatch_walls")
+    disp = d.get("dispatch_s")
+    if dw and isinstance(disp, (int, float)):
+        # staged dispatch (ISSUE 19): the committed sub-walls must rebuild
+        # the host-only dispatch wall — a sub-wall that silently swallowed
+        # a synchronous solve (the MULTICHIP_r06 double-count) cannot
+        sub_sum = sum(dw.values())
+        if abs(sub_sum - float(disp)) > _tol(float(disp)):
+            errs.append(
+                f"{src}: dispatch sub-wall sum {sub_sum:.3f}s "
+                f"(pack+stage+launch) does not reconcile with dispatch_s "
+                f"{float(disp):.3f}s (tolerance {_tol(float(disp)):.3f}s)")
     wall, dev = d.get("wall_s"), d.get("device_s")
     if all(isinstance(x, (int, float)) for x in (wall, host, dev)):
         if abs((float(host) + float(dev)) - float(wall)) > _tol(float(wall)):
@@ -336,6 +384,20 @@ def diff_profiles(a: dict, b: dict) -> list[str]:
         pct = f"{100 * (wb - wa) / wa:+.0f}%" if wa > 1e-9 else "new"
         lines.append(f"  {name:<10} {wa:9.3f}s -> {wb:9.3f}s  ({pct}, "
                      f"share {d_share:+.1%})")
+    # staged-dispatch decomposition (ISSUE 19): the blocked-dispatch wall
+    # plus its host-only sub-walls — how the async pipeline PR proves the
+    # host pack/shard/transfer left the critical path ("new" on the B side
+    # when the baseline predates the split)
+    da, db = a.get("dispatch_s"), b.get("dispatch_s")
+    if isinstance(da, (int, float)) and isinstance(db, (int, float)):
+        pct = f"{100 * (db - da) / da:+.0f}%" if da > 1e-9 else "new"
+        lines.append(f"  {'dispatch_s':<10} {da:9.3f}s -> {db:9.3f}s  ({pct})")
+    dwa, dwb = a.get("dispatch_walls") or {}, b.get("dispatch_walls") or {}
+    for k in DISPATCH_SUBWALLS:
+        if k in dwa or k in dwb:
+            wa, wb = float(dwa.get(k, 0.0)), float(dwb.get(k, 0.0))
+            pct = f"{100 * (wb - wa) / wa:+.0f}%" if wa > 1e-9 else "new"
+            lines.append(f"  {k:<10} {wa:9.3f}s -> {wb:9.3f}s  ({pct})")
     ga, gb = a.get("gauges") or {}, b.get("gauges") or {}
     for k in ("device_idle_frac", "host_blocked_frac", "overlap_frac"):
         va, vb = ga.get(k), gb.get(k)

@@ -83,6 +83,7 @@ def check_bench_series(entries: list[tuple[str, dict]],
     for key, items in series.items():
         hist_vals: list[float] = []
         hist_rungs: dict[int, list[float]] = {}
+        hist_dshare: dict[int, list[float]] = {}
         hist_scaling: list[float] = []
         for name, d in items:
             # storage red flags (ISSUE 17): a committed sidecar recording
@@ -128,6 +129,26 @@ def check_bench_series(entries: list[tuple[str, dict]],
                             f"{100 * (1 - wps / ref):.0f}% below the series "
                             f"median {ref:g}")
                 prev.append(float(wps))
+                # dispatch-share regression (ISSUE 19): the host-only
+                # dispatch wall's share of the rung RISING beyond the noise
+                # band means the staged pipeline is re-serializing against
+                # the solve — the same inverse rule as idle-rise
+                disp, wall = rung.get("dispatch_s"), rung.get("wall_s")
+                if (isinstance(disp, (int, float)) and not isinstance(disp, bool)
+                        and isinstance(wall, (int, float)) and wall
+                        and not isinstance(wall, bool)):
+                    share = float(disp) / float(wall)
+                    dprev = hist_dshare.setdefault(m, [])
+                    if dprev:
+                        ref = _median(dprev)
+                        if share > ref + noise:
+                            issues.append(
+                                f"{name}: mesh-{m} rung: dispatch share "
+                                f"{share:.0%} of wall is {share - ref:.2f} "
+                                f"above the series median {ref:.0%} (band "
+                                f"{noise:.0%}) — host dispatch is newly "
+                                "serializing against the solve")
+                    dprev.append(share)
             sc = d.get("scaling_vs_single")
             if isinstance(sc, (int, float)) and not isinstance(sc, bool):
                 if hist_scaling:

@@ -295,12 +295,13 @@ def render(snap: dict) -> str:
     out.append(f"daccord-top  {t}  ({len(snap['sources'])} source(s))")
     if snap["sources"]:
         out.append("")
-        # IDLE%/BLK%/VERDICT = the saturation column (ISSUE 14): device
-        # idle fraction, host-blocked-on-device fraction, and the committed
-        # (or live) bottleneck verdict per source
+        # IDLE%/BLK%/OVR%/VERDICT = the saturation column (ISSUE 14): device
+        # idle fraction, host-blocked-on-device fraction, host/device
+        # overlap fraction (ISSUE 19 — a starving staged pipeline shows a
+        # falling OVR% live), and the committed (or live) bottleneck verdict
         out.append(f"  {'SOURCE':<18}{'STATE':<10}{'WIN/S':>8}{'BASES/S':>10}"
                    f"{'RSS MB':>8}{'INFL':>6}{'POOL':>6}{'IDLE%':>7}"
-                   f"{'BLK%':>6}  {'VERDICT':<12}OUTCOME")
+                   f"{'BLK%':>6}{'OVR%':>6}  {'VERDICT':<12}OUTCOME")
         for row in snap["sources"]:
             g = (row["metrics"] or {}).get("gauges", {})
             done = row["done"]
@@ -317,6 +318,7 @@ def render(snap: dict) -> str:
                 f"{_fmt(row['inflight'], 0):>6}{_fmt(row['pool'], 0):>6}"
                 f"{_pct(g.get('device_idle_frac')):>7}"
                 f"{_pct(g.get('host_blocked_frac')):>6}"
+                f"{_pct(g.get('overlap_frac')):>6}"
                 f"  {(row.get('verdict') or '-'):<12}{outcome}")
     mesh = snap.get("mesh") or {}
     devs = mesh.get("devices") or {}
@@ -330,7 +332,8 @@ def render(snap: dict) -> str:
             hdr += f"  rung {rung} rows/device"
         out.append(hdr)
         out.append(f"  {'DEV':>5} {'PLAT':<6}{'STATE':<9}{'DISP':>7}"
-                   f"{'WALL S':>9}{'ROWS':>9}{'HBM PEAK':>10}{'IDLE%':>7}")
+                   f"{'WALL S':>9}{'ROWS':>9}{'HBM PEAK':>10}{'IDLE%':>7}"
+                   f"{'OVR%':>6}")
         for k in sorted(devs, key=lambda x: int(x)):
             d = devs[k]
             out.append(
@@ -340,7 +343,8 @@ def render(snap: dict) -> str:
                 f"{_fmt(d.get('dispatch_wall_s'), 2):>9}"
                 f"{_fmt(d.get('rows'), 0):>9}"
                 f"{_fmt(d.get('hbm_peak_bytes'), 0):>10}"
-                f"{_pct(d.get('idle_frac')):>7}")
+                f"{_pct(d.get('idle_frac')):>7}"
+                f"{_pct(d.get('overlap_frac')):>6}")
     serve = snap.get("serve")
     slo = snap.get("slo")
     if serve is not None or slo is not None:

@@ -414,3 +414,181 @@ def test_serve_mesh_group_mixed_batch_parity(corpus, tmp_path):
                                       ref["cons"][i])
         np.testing.assert_array_equal(np.asarray(out_b["cons"][i]),
                                       ref["cons"][half + i])
+
+
+# ---------------------------------------------------------------------------
+# dispatch pipeline (ISSUE 19): staged double-buffered dispatch
+# ---------------------------------------------------------------------------
+
+
+def _unit_batch(n):
+    from daccord_tpu.kernels.tensorize import BatchShape, WindowBatch
+
+    return WindowBatch(seqs=np.zeros((n, 4, 8), np.int8),
+                       lens=np.ones((n, 4), np.int32),
+                       nsegs=np.ones(n, np.int32), shape=BatchShape(4, 8, 40),
+                       read_ids=np.arange(n, dtype=np.int64),
+                       wstarts=np.zeros(n, np.int64))
+
+
+def test_stage_launch_split_units(monkeypatch):
+    """stage/launch decompose the dispatch: StagedBatch proxies the host
+    batch, the sub-walls accrue, and a staged batch whose mesh changed since
+    staging is discarded + re-staged at launch (the `restaged` counter)."""
+    from daccord_tpu.parallel import mesh as meshmod
+
+    s = meshmod.ShardedLadderSolver(_stub_ladder(), meshmod.make_mesh(8),
+                                    batch=64)
+    b = _unit_batch(60)                 # not a mesh multiple: pads to 64
+    st = s.stage(b)
+    assert isinstance(st, meshmod.StagedBatch)
+    assert st.size == 60 and st.target == 64 and st.stream == "full"
+    assert st.replay_batch is b         # the replayable truth is the HOST batch
+    assert s.stage(st) is st            # idempotent on an already-staged batch
+    dw = s.dispatch_walls()
+    assert set(dw) == {"pack_s", "stage_s", "launch_s", "dispatch_s",
+                       "restaged"}
+    assert dw["stage_s"] > 0 and dw["restaged"] == 0
+    assert dw["dispatch_s"] == dw["pack_s"] + dw["stage_s"] + dw["launch_s"]
+    # shrink AFTER staging: the staged device buffers are stale — launch
+    # must discard them and re-stage the host batch on the current mesh
+    monkeypatch.setattr(meshmod, "_ladder_sharded_packed",
+                        lambda *a, **k: "arr")
+    assert s.shrink() and s.nd == 4
+    _, B0 = s.launch(st)
+    assert B0 == 60
+    assert s.dispatch_walls()["restaged"] == 1
+    # staging while a solve is outstanding counts as overlapped: health_map
+    # reports the overlap_frac gauge in (0, 1]
+    s.stage(_unit_batch(64))
+    hm = s.health_map()
+    ovr = [row["overlap_frac"] for row in hm["devices"].values()]
+    assert all(o is not None and 0.0 < o <= 1.0 for o in ovr)
+
+
+def test_supervisor_retains_host_batch_for_staged():
+    """The supervisor unwraps a StagedBatch at dispatch: shape keys, the
+    replay handle, and every fault path operate on the retained host batch
+    (the staged device buffers are first-attempt-only)."""
+    from daccord_tpu.parallel.mesh import ShardedLadderSolver, make_mesh
+    from daccord_tpu.runtime.supervisor import DeviceSupervisor
+
+    solver = ShardedLadderSolver(_stub_ladder(), make_mesh(8), batch=64)
+    seen = []
+    sup = DeviceSupervisor(lambda b: seen.append(type(b).__name__) or b,
+                           lambda h: h, inline=True,
+                           fingerprint_prefix="cpu:", mesh=solver)
+    b = _unit_batch(64)
+    st = solver.stage(b)
+    h = sup.dispatch(st)
+    assert seen == ["StagedBatch"]      # first attempt consumed the staged form
+    assert h.batch is b                 # ...but the replay handle keeps the host batch
+    assert h.key == "cpu:B64xD4xL8:m8"  # keyed off the host batch, not the pad
+
+
+def test_mesh_pipeline_telemetry_and_optout_parity(corpus, tmp_path,
+                                                   monkeypatch):
+    """Tentpole: the default --mesh run double-buffers dispatch (stage under
+    the in-flight solve) and emits the staged-dispatch telemetry; the
+    DACCORD_MESH_PIPELINE=0 control arm takes the fused path — both
+    byte-identical to the single-device run."""
+    ev = str(tmp_path / "pipe.events.jsonl")
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+
+    cfg = PipelineConfig(**corpus["base"], mesh=8, events_path=ev)
+    got = [(rid, [f.tobytes() for f in frags])
+           for rid, frags, st in correct_shard(corpus["db"], corpus["las"],
+                                               cfg, profile=corpus["profile"])]
+    assert got == corpus["single"]
+    evs = [json.loads(x) for x in open(ev)]
+    kinds = [e["event"] for e in evs]
+    pipe = [e for e in evs if e["event"] == "dispatch.pipeline"]
+    assert pipe and pipe[0]["depth"] == 2
+    stg = [e for e in evs if e["event"] == "dispatch.stage"]
+    lch = [e for e in evs if e["event"] == "dispatch.launch"]
+    assert stg and lch and len(stg) == len(lch)
+    assert all(e["stage_s"] >= 0 and e["rows"] > 0 for e in stg)
+    # the terminal record decomposes the dispatch wall into host-only
+    # sub-walls that reconcile (daccord-prof --check enforces the same rule)
+    done = [e for e in evs if e["event"] == "shard_done"][-1]
+    sub = done["pack_s"] + done["stage_s"] + done["launch_s"]
+    assert abs(sub - done["dispatch_s"]) <= max(0.05, 0.05 * done["dispatch_s"])
+    assert done["restaged"] == 0        # no shrink in this arm
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    assert validate_events(ev, strict=True) == []
+    # opt-out control arm: fused dispatch, no pipeline telemetry, same bytes
+    monkeypatch.setenv("DACCORD_MESH_PIPELINE", "0")
+    ev0 = str(tmp_path / "nopipe.events.jsonl")
+    cfg0 = PipelineConfig(**corpus["base"], mesh=8, events_path=ev0)
+    got0 = [(rid, [f.tobytes() for f in frags])
+            for rid, frags, st in correct_shard(corpus["db"], corpus["las"],
+                                                cfg0,
+                                                profile=corpus["profile"])]
+    assert got0 == corpus["single"]
+    kinds0 = [json.loads(x)["event"] for x in open(ev0)]
+    assert "dispatch.pipeline" not in kinds0
+    assert "dispatch.stage" not in kinds0
+
+
+def test_pipelined_staged_replay_device_lost_attributed(corpus, tmp_path,
+                                                        monkeypatch,
+                                                        throwaway_compcache):
+    """Staged-batch replay: device_lost:2@3 lands on a dispatch while the
+    stager holds batch N+1. The staged device buffers are discarded, the
+    mesh shrinks around member 3, and the retained HOST batch replays at
+    :m4 — byte-identical, with the pipeline still on after the shrink."""
+    monkeypatch.setenv("DACCORD_FAULT", "device_lost:2@3")
+    ev = str(tmp_path / "staged_lost.events.jsonl")
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+
+    cfg = PipelineConfig(**corpus["base"], mesh=8, events_path=ev)
+    got = [(rid, [f.tobytes() for f in frags])
+           for rid, frags, st in correct_shard(corpus["db"], corpus["las"],
+                                               cfg, profile=corpus["profile"])]
+    assert got == corpus["single"]
+    evs = [json.loads(x) for x in open(ev)]
+    kinds = [e["event"] for e in evs]
+    assert "dispatch.pipeline" in kinds
+    shr = [e for e in evs if e["event"] == "mesh.shrink"]
+    assert shr and shr[0]["nd_from"] == 8 and shr[0]["nd_to"] == 4
+    assert "sup_failover" not in kinds
+    # staged telemetry continued PAST the shrink (the pipeline survived it)
+    last_shrink = max(i for i, e in enumerate(evs)
+                      if e["event"] == "mesh.shrink")
+    assert any(e["event"] == "dispatch.stage"
+               for e in evs[last_shrink:])
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    assert validate_events(ev, strict=True) == []
+
+
+@pytest.mark.slow
+def test_mesh_crash_resume_with_staged_batch(corpus, tmp_path, monkeypatch,
+                                             throwaway_compcache):
+    """A hard crash landing while the staging buffer is non-empty (crash:4
+    — early enough that the stager is running ahead of the drain) must not
+    lose bytes: the resume run replays from the checkpoint and the final
+    FASTA matches the uninterrupted single-device shard."""
+    from daccord_tpu.parallel import launch
+    from daccord_tpu.runtime import PipelineConfig
+
+    paths = corpus["paths"]
+    ref_dir = str(tmp_path / "ref")
+    cfg = PipelineConfig(**corpus["base"])
+    launch.run_shard(paths["db"], paths["las"], ref_dir, 0, 1, cfg,
+                     checkpoint_every=2)
+    ref_fasta = open(launch.shard_paths(ref_dir, 0)["fasta"]).read()
+
+    mesh_dir = str(tmp_path / "mesh")
+    mcfg = PipelineConfig(**corpus["base"], mesh=8)
+    monkeypatch.setenv("DACCORD_FAULT", "crash:4")
+    from daccord_tpu.runtime.faults import InjectedCrash
+
+    with pytest.raises(InjectedCrash):
+        launch.run_shard(paths["db"], paths["las"], mesh_dir, 0, 1, mcfg,
+                         checkpoint_every=2)
+    monkeypatch.delenv("DACCORD_FAULT")
+    launch.run_shard(paths["db"], paths["las"], mesh_dir, 0, 1, mcfg,
+                     checkpoint_every=2)
+    assert open(launch.shard_paths(mesh_dir, 0)["fasta"]).read() == ref_fasta

@@ -316,7 +316,35 @@ grep -q '"event": "mesh.device"' "$meshdir/mesh.events.jsonl" \
   || { echo "tools_pounce: mesh run emitted no per-device telemetry" >&2; exit 1; }
 python -m daccord_tpu.tools.cli sentinel --strict "$meshdir/mesh.events.jsonl" \
   || { echo "tools_pounce: mesh sidecar tripped the regression sentinel" >&2; exit 1; }
-echo "tools_pounce: mesh smoke OK" >&2
+# dispatch pipeline (ISSUE 19): the mesh run above is double-buffered by
+# default — require its staged-dispatch telemetry (dispatch.stage/launch
+# span pairs, the pack/stage/launch sub-walls prof --check reconciles above)
+# and byte parity against the DACCORD_MESH_PIPELINE=0 fused control arm.
+# A divergence means staging batch N+1 under batch N's solve changed bytes
+# — the one thing the pipeline must never do.
+grep -q '"event": "dispatch.pipeline"' "$meshdir/mesh.events.jsonl" \
+  || { echo "tools_pounce: mesh run never engaged the dispatch pipeline" >&2; exit 1; }
+grep -q '"event": "dispatch.stage"' "$meshdir/mesh.events.jsonl" \
+  || { echo "tools_pounce: pipelined mesh run staged no batches" >&2; exit 1; }
+grep -q '"event": "dispatch.launch"' "$meshdir/mesh.events.jsonl" \
+  || { echo "tools_pounce: pipelined mesh run launched no staged batches" >&2; exit 1; }
+grep -q '"pack_s"' "$meshdir/mesh.events.jsonl" \
+  || { echo "tools_pounce: mesh shard_done carries no dispatch sub-walls" >&2; exit 1; }
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    DACCORD_MESH_PIPELINE=0 \
+    python -m daccord_tpu.tools.cli daccord "$meshdir/mx.db" "$meshdir/mx.las" \
+    --backend cpu -b 64 --mesh 8 --paged on -o "$meshdir/nopipe.fasta" \
+    --events "$meshdir/nopipe.events.jsonl" \
+  || { echo "tools_pounce: unpipelined mesh control run FAILED" >&2; exit 1; }
+cmp -s "$meshdir/mesh.fasta" "$meshdir/nopipe.fasta" \
+  || { echo "tools_pounce: pipelined FASTA diverged from unpipelined control" >&2; exit 1; }
+grep -q '"event": "dispatch.pipeline"' "$meshdir/nopipe.events.jsonl" \
+  && { echo "tools_pounce: DACCORD_MESH_PIPELINE=0 did not disable the pipeline" >&2; exit 1; }
+python -m daccord_tpu.tools.cli eventcheck --strict "$meshdir/nopipe.events.jsonl" \
+  || { echo "tools_pounce: unpipelined mesh events failed schema lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli prof --check "$meshdir/nopipe.events.jsonl" \
+  || { echo "tools_pounce: unpipelined sidecar failed daccord-prof reconciliation" >&2; exit 1; }
+echo "tools_pounce: mesh + dispatch-pipeline smoke OK" >&2
 rm -rf "$meshdir"
 
 # serving-plane smoke (ISSUE 10): start a real daccord-serve HTTP server on
