@@ -131,6 +131,20 @@ BENCH_DISK = os.environ.get("DACCORD_BENCH_DISK") == "1"
 # exempts the deliberate storm). DACCORD_BENCH_NET_JOBS overrides the job
 # count (default 6).
 BENCH_NET = os.environ.get("DACCORD_BENCH_NET") == "1"
+# silent-data-corruption soak (ISSUE 20): DACCORD_BENCH_SDC=1 runs a
+# mesh-8 correction three times over one seeded dataset — audit OFF
+# (golden bytes + unaudited wall), an injected `sdc:*@3` storm (one mesh
+# member silently flips consensus bases in every batch it touches), and a
+# clean control at the DEFAULT 1/64 audit rate — and asserts the defense
+# contract: the storm is detected by the sampled shadow audit, attributed
+# to member 3 from the event stream alone, quarantined through the
+# partial-mesh shrink rung with the verdict persisted in the trust
+# registry, the final output is byte-identical to the golden run, and the
+# control's steady-state audit cost is <=2% of wall. Chip-free: re-execs
+# itself under the off-pod recipe (forced 8-device host platform), the
+# same pattern as the mesh arm. Commits BENCH_SDC.json (chaos-flagged).
+# DACCORD_BENCH_SDC_BATCH / _SEED override the window batch and the seed.
+BENCH_SDC = os.environ.get("DACCORD_BENCH_SDC") == "1"
 # front door (ISSUE 16): DACCORD_BENCH_ROUTER=1 commits BENCH_ROUTER.json
 # with two arms: (a) cold-peer TTFR — time from fresh solve path to the
 # first fetched batch result — WITH the fleet-shared AOT executable cache
@@ -2508,6 +2522,170 @@ def run_net_soak(root: str | None = None, n_jobs: int = 6,
     return line
 
 
+def run_sdc_soak(ev=None, root: str | None = None,
+                 commit_sidecar: bool = True) -> dict:
+    """Silent-data-corruption soak (ISSUE 20): one seeded dataset, four
+    mesh-8 runs. (1) audit off — golden bytes and the unaudited wall;
+    (2) an `sdc:*@K` storm — one member silently corrupts every batch,
+    and the asserts ARE the stage: detection, culprit attribution from
+    the durable event stream alone, trust quarantine through the
+    partial-mesh shrink rung, registry persistence, and byte-parity of
+    the final output against the golden run; (3)+(4) twin warm-cache
+    controls, audit OFF then audit at the default 1/64 rate, whose
+    marginal process-CPU must stay <=2%. CPU-marginal is the honest
+    overhead on a single-core host: the audit's in-run wall (reported as
+    ``audit_share_wall_pct``) double-counts device compute it merely
+    overlaps with, while the twin-run CPU delta is exactly the extra
+    compute auditing consumed. A contract break exits nonzero before
+    any sidecar commits."""
+    import shutil
+    import tempfile
+
+    from daccord_tpu.formats import LasFile, read_db
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+    from daccord_tpu.runtime.pipeline import estimate_profile_for_shard
+    from daccord_tpu.sim import SimConfig, make_dataset
+    from daccord_tpu.tools.eventcheck import validate_events
+    from daccord_tpu.utils.obs import TRUST_QUARANTINED, trust_registry
+
+    t0 = time.time()
+    seed = int(os.environ.get("DACCORD_BENCH_SDC_SEED", "20"))
+    batch = int(os.environ.get("DACCORD_BENCH_SDC_BATCH", "512"))
+    mesh_n, culprit = 8, 3
+    owns_root = root is None
+    root = root or tempfile.mkdtemp(prefix="daccord-sdc-")
+    # isolate the repo registries: the storm QUARANTINES a virtual member,
+    # and that verdict must not leak into the real trust/compile registries
+    prev_cc = os.environ.get("DACCORD_COMPCACHE")
+    os.environ["DACCORD_COMPCACHE"] = os.path.join(root, "compcache")
+    try:
+        data = make_dataset(root, SimConfig(
+            genome_len=4000, coverage=12, read_len_mean=700,
+            min_overlap=300, seed=seed), name="sdc")
+        db = read_db(data["db"])
+        las = LasFile(data["las"])
+        base = dict(batch_size=batch, depth_buckets=(16,))
+        profile = estimate_profile_for_shard(db, las, PipelineConfig(**base))
+
+        def run(tag: str, **kw):
+            evp = os.path.join(root, f"{tag}.events.jsonl")
+            cfg = PipelineConfig(**base, mesh=mesh_n, events_path=evp, **kw)
+            w0, c0 = time.time(), time.process_time()
+            got = [(rid, [f.tobytes() for f in frags])
+                   for rid, frags, _ in correct_shard(db, las, cfg,
+                                                      profile=profile)]
+            return got, time.time() - w0, time.process_time() - c0, evp
+
+        def events_of(evp: str):
+            recs = []
+            with open(evp) as fh:
+                for raw in fh:
+                    try:
+                        recs.append(json.loads(raw))
+                    except json.JSONDecodeError:
+                        continue
+            done = [r for r in recs if r.get("event") == "sup_done"]
+            return recs, (done[-1] if done else {})
+
+        # ---- golden: audit off = the pre-PR byte path -------------------
+        golden, clean_wall, _, _ = run("clean", audit_rate=0.0)
+        assert golden, "sdc soak: empty corrected output"
+
+        # ---- storm: member `culprit` lies in every batch ----------------
+        os.environ["DACCORD_FAULT"] = f"sdc:*@{culprit}"
+        try:
+            storm, storm_wall, _, storm_ev = run("storm")
+        finally:
+            os.environ.pop("DACCORD_FAULT", None)
+        recs, sdone = events_of(storm_ev)
+        sdc = [r for r in recs if r.get("event") == "sup_sdc"]
+        attrib = [r for r in recs if r.get("event") == "audit.attrib"]
+        trust = [r for r in recs if r.get("event") == "trust.state"]
+        shrinks = [r for r in recs if r.get("event") == "mesh.shrink"]
+        assert sdc, "sdc soak: the storm was never detected (no sup_sdc)"
+        blamed = {int(r.get("culprit", -2)) for r in sdc + attrib}
+        assert blamed == {culprit}, \
+            f"sdc soak: events blame member(s) {blamed}, injected {culprit}"
+        quar = [r for r in trust if r.get("state_to") == TRUST_QUARANTINED
+                and int(r.get("device", -1)) == culprit]
+        assert quar, \
+            f"sdc soak: member {culprit} never reached QUARANTINED: {trust}"
+        assert shrinks, \
+            "sdc soak: quarantine never engaged the partial-mesh shrink rung"
+        assert storm == golden, \
+            "sdc soak: storm output diverged from the golden bytes — " \
+            "a detected-too-late corruption reached the FASTA"
+        reg = trust_registry()
+        persisted = [k for k, v in reg.items()
+                     if k.endswith(f"m{culprit}")
+                     and v.get("state") == TRUST_QUARANTINED]
+        assert persisted, \
+            f"sdc soak: quarantine verdict not persisted in the registry: {reg}"
+        lint = validate_events(storm_ev, strict=True)
+        assert not lint, \
+            f"sdc soak: eventcheck --strict rejects the storm stream: {lint[:5]}"
+
+        # ---- twin controls: same warm caches (post-storm), audit off
+        # then audit at the DEFAULT rate — the quarantined-registry mesh
+        # both times, so the ONLY difference is the shadow audit ---------
+        control0, ctl0_wall, ctl0_cpu, _ = run("control0", audit_rate=0.0)
+        assert control0 == golden, \
+            "sdc soak: the quarantine-shrunk mesh changed output bytes"
+        control, ctl_wall, ctl_cpu, ctl_ev = run("control")
+        _, cdone = events_of(ctl_ev)
+        assert control == golden, \
+            "sdc soak: audited control diverged from the golden bytes — " \
+            "the audit rate changed output bytes"
+        assert int(cdone.get("sdc_detected", 0)) == 0, \
+            f"sdc soak: clean control false-positived: {cdone}"
+        audits = int(cdone.get("audits", 0))
+        assert audits > 0, "sdc soak: control never audited a batch"
+        audit_s = float(cdone.get("audit_s", 0.0))
+        overhead = max(0.0, ctl_cpu - ctl0_cpu) / max(ctl0_cpu, 1e-9)
+        assert overhead <= 0.02, \
+            f"sdc soak: default-rate audit cost {overhead:.1%} marginal " \
+            f"CPU over the audit-off twin (>2%; audit_s {audit_s:.1f}s, " \
+            f"cpu {ctl_cpu:.1f}s vs {ctl0_cpu:.1f}s)"
+
+        line = {
+            "metric": "sdc_soak", "chaos": True, "seed": seed,
+            "batch": batch, "mesh": mesh_n, "fault": f"sdc:*@{culprit}",
+            "windows": sum(len(f) for _, f in golden),
+            "reads": len(golden),
+            "detected": int(sdone.get("sdc_detected", 0)),
+            "storm_audits": int(sdone.get("audits", 0)),
+            "culprit": culprit, "culprit_from_events": sorted(blamed),
+            "quarantined": True, "trust_persisted": True,
+            "mesh_shrinks": len(shrinks),
+            "parity": True, "false_positives": 0,
+            "control_audits": audits,
+            "audit_s": round(audit_s, 3),
+            "audit_overhead_pct": round(100.0 * overhead, 3),
+            "audit_share_wall_pct": round(100.0 * audit_s
+                                          / max(ctl_wall, 1e-9), 3),
+            "clean_wall_s": round(clean_wall, 3),
+            "storm_wall_s": round(storm_wall, 3),
+            "control0_wall_s": round(ctl0_wall, 3),
+            "control_wall_s": round(ctl_wall, 3),
+            "control0_cpu_s": round(ctl0_cpu, 3),
+            "control_cpu_s": round(ctl_cpu, 3),
+            "wall_s": round(time.time() - t0, 3),
+            **_tunnel_staleness(),
+        }
+    finally:
+        if prev_cc is None:
+            os.environ.pop("DACCORD_COMPCACHE", None)
+        else:
+            os.environ["DACCORD_COMPCACHE"] = prev_cc
+    if ev is not None:
+        ev.log("bench_done", wall_s=line["wall_s"])
+    if commit_sidecar:
+        _commit_sidecar("BENCH_SDC.json", line)
+    if owns_root:
+        shutil.rmtree(root, ignore_errors=True)
+    return line
+
+
 def main() -> None:
     import argparse
 
@@ -2550,6 +2728,24 @@ def main() -> None:
         ev.log("bench_start", batch=0, net=True)
         n = int(os.environ.get("DACCORD_BENCH_NET_JOBS", "6"))
         print(json.dumps(run_net_soak(ev=ev, n_jobs=n)))
+        return
+    if BENCH_SDC:
+        # silent-data-corruption soak (ISSUE 20): mesh-8 golden/storm/
+        # control triple; the asserts ARE the stage — a broken defense
+        # contract exits nonzero. Chip-free by the mesh arm's off-pod
+        # recipe: re-exec under a forced 8-device host platform
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            import subprocess
+            import sys as _sys
+
+            env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=(
+                flags + " --xla_force_host_platform_device_count=8").strip())
+            r = subprocess.run([_sys.executable, os.path.abspath(__file__)],
+                               env=env)
+            raise SystemExit(r.returncode)
+        ev.log("bench_start", batch=0, sdc=True)
+        print(json.dumps(run_sdc_soak(ev=ev)))
         return
     if BENCH_SERVE:
         # serving-plane stage: self-contained (synth corpus + real HTTP

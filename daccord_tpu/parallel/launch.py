@@ -182,6 +182,12 @@ def run_shard(db_path: str, las_path: str, outdir: str, shard: int, nshards: int
         # the merge gate catch a truncated FASTA, not just a missing one
         "fasta_bytes": os.path.getsize(paths["fasta"]),
     }
+    # content digest (ISSUE 20): the merge gate re-verifies it before
+    # concatenating, closing the silent-corruption window the byte-count
+    # checks can't see (a lying chip writes the right NUMBER of bytes)
+    from ..utils.obs import sha256_file
+
+    manifest["fasta_sha256"] = sha256_file(paths["fasta"])
     _write_manifest_durable(paths["manifest"], manifest)
     if metrics_rollup:
         _write_manifest_durable(paths["metrics"], {
@@ -434,6 +440,7 @@ def merge_shards(outdir: str, nshards: int, out_fasta: str,
     manifests: dict[int, dict] = {}
     missing: list[int] = []
     degraded: list[int] = []
+    corrupt: list[int] = []
     issues: list[str] = []
     for s in range(nshards):
         m, why = load_shard_manifest(outdir, s)
@@ -456,7 +463,22 @@ def merge_shards(outdir: str, nshards: int, out_fasta: str,
         # piles, whose output genuinely differs from the healthy run
         if m.get("degraded") or m.get("quarantined"):
             degraded.append(s)
+        # content verification (ISSUE 20): the committed digest must match
+        # the bytes on disk — byte COUNTS pass under silent corruption (a
+        # lying chip writes the right number of wrong bytes), the digest
+        # cannot. Manifests from before the digest era verify by counts only.
+        sha = m.get("fasta_sha256")
+        if sha is not None:
+            from ..utils.obs import sha256_file
+
+            if sha256_file(shard_paths(outdir, s)["fasta"]) != sha:
+                corrupt.append(s)
         manifests[s] = m
+    if corrupt and not allow_degraded:
+        issues.append(f"shard(s) {corrupt}: FASTA content digest mismatches "
+                      "the committed manifest (silent corruption) — rerun "
+                      "them, or pass --allow-degraded to merge the bytes on "
+                      "disk anyway")
     if missing and not allow_degraded:
         issues.append(f"missing shard output(s) {missing} — rerun them or "
                       "pass --allow-degraded to merge without them")

@@ -384,6 +384,14 @@ class ShardedLadderSolver:
                 return i
         return -1
 
+    def member_ids(self) -> list[int]:
+        """Original member index of every ACTIVE device, in slice order:
+        position j of this list owns row slice ``[j*per, (j+1)*per)`` of a
+        staged batch. The shadow audit's injection/attribution row map
+        (ISSUE 20) — it is how ``sdc:N@K`` finds member K's rows and how a
+        divergent probe row names its chip."""
+        return [self._dev_index(d) for d in self.mesh.devices.flat]
+
     def shrink(self, culprit: int = -1) -> bool:
         """Partial-mesh degradation rung: halve the device set. With an
         attributed ``culprit`` (original member index — fault injection

@@ -187,6 +187,30 @@ with bounded backoff+jitter (idempotent domains only — a submit without an
 idempotency key is never retried); ``net_hang`` surfaces as a deadline
 timeout and ``net_torn`` as a short-read integrity error, both feeding the
 per-peer circuit breaker rather than the retry loop.
+
+The silent-data-corruption kind (ISSUE 20) is the one fault nothing in the
+loud matrices can see: the device op SUCCEEDS, but the bytes are wrong ——
+no exception, no timeout, no event at injection time (detection is the
+shadow audit's job, runtime/supervisor.py)::
+
+    DACCORD_FAULT=sdc:3                   # 3rd fetched device result:
+                                          # consensus rows silently perturbed
+    DACCORD_FAULT=sdc:1@2                 # 1st result: only mesh member 2's
+                                          # row slice lies
+    DACCORD_FAULT=sdc:*@3                 # EVERY result: member 3 lies
+                                          # continuously (the chaos-storm
+                                          # grammar; '*' = never fired-out)
+
+Counter domain: ``sdc`` counts successfully fetched primary results
+(:meth:`FaultPlan.sdc_check`, consumed by the supervisor AFTER unpack,
+BEFORE the shadow audit sees the dict). The ``@K`` suffix reuses the
+``device_lost`` ``@device`` grammar: member K's contiguous row slice of the
+fetched batch is the only part perturbed — and K joins the plan's
+persistent liar set, so the supervisor's per-member attribution probe
+(which re-solves the divergent window on every member) deterministically
+re-corrupts K's copy. That persistence is the point: a real lying chip
+lies to the probe too, and without it culprit attribution of a one-shot
+lie would be impossible.
 """
 
 from __future__ import annotations
@@ -243,7 +267,8 @@ _KINDS = ("fetch_hang", "dispatch_error", "device_lost", "compile_stall",
           "feeder_stall", "serve_crash", "serve_hang",
           "io_enospc", "io_eio", "io_fsync_fail", "io_short_write",
           "io_slow",
-          "net_refused", "net_reset", "net_hang", "net_torn", "net_slow")
+          "net_refused", "net_reset", "net_hang", "net_torn", "net_slow",
+          "sdc")
 
 #: storage kinds (ISSUE 17): consumed by the utils/aio.py fault hook at
 #: every durable-I/O primitive, optionally scoped to one path class with
@@ -325,6 +350,13 @@ class FaultPlan:
     # process-wide plus one counter per RPC-class domain, mirroring storage
     n_net: int = 0
     n_net_domain: dict = field(default_factory=dict)
+    # silent-corruption counter (advances once per successfully fetched
+    # primary result) and the persistent liar set: mesh members a fired
+    # ``sdc@K`` spec named. A liar keeps lying to attribution probes — the
+    # deterministic stand-in for a chip whose bad lane corrupts everything
+    # it computes, which is what makes per-member culprit attribution sound
+    n_result: int = 0
+    liar_devices: set = field(default_factory=set)
 
     @classmethod
     def parse(cls, text: str) -> "FaultPlan":
@@ -341,7 +373,7 @@ class FaultPlan:
             at, _, dev = at.partition("@")
             d, dom = -1, ""
             if dev:
-                if kind == "device_lost":
+                if kind in ("device_lost", "sdc"):
                     try:
                         d = int(dev)
                     except ValueError:
@@ -362,14 +394,20 @@ class FaultPlan:
                 else:
                     raise ValueError(
                         f"DACCORD_FAULT: @suffix only applies to device_lost "
-                        f"(@device), io_* and net_* kinds (@domain) "
+                        f"and sdc (@device), io_* and net_* kinds (@domain) "
                         f"(got {part!r})")
-            try:
-                n = int(at) if at else 1
-            except ValueError:
-                raise ValueError(f"DACCORD_FAULT: bad count in {part!r}")
-            if n < 1:
-                raise ValueError(f"DACCORD_FAULT: count must be >= 1 in {part!r}")
+            if kind == "sdc" and at == "*":
+                # continuous storm: '*' = EVERY fetched result is perturbed
+                # (never fired-out, like the duration kinds); at=0 encodes it
+                n = 0
+            else:
+                try:
+                    n = int(at) if at else 1
+                except ValueError:
+                    raise ValueError(f"DACCORD_FAULT: bad count in {part!r}")
+                if n < 1:
+                    raise ValueError(
+                        f"DACCORD_FAULT: count must be >= 1 in {part!r}")
             specs.append(FaultSpec(kind, n, device=d, domain=dom))
         return cls(specs=specs)
 
@@ -593,6 +631,45 @@ class FaultPlan:
         return any(s.kind in NET_KINDS
                    and (s.kind == "net_slow" or not s.fired)
                    for s in self.specs)
+
+    def sdc_check(self) -> "FaultSpec | None":
+        """Advance the fetched-result counter and return the ``sdc`` spec
+        whose silent corruption applies to THIS result, or None. A ``sdc:N``
+        spec is one-shot at result N; ``sdc:*`` (at=0) is continuous —
+        every result perturbs, the chaos-storm grammar. A device-pinned
+        spec adds its member to :attr:`liar_devices` so attribution probes
+        (:meth:`sdc_liars`) re-corrupt that member's answers forever —
+        silent by contract: no event, no exception, the supervisor's shadow
+        audit is the only thing that can see it."""
+        self.n_result += 1
+        for s in self.specs:
+            if s.kind != "sdc":
+                continue
+            if s.at == 0 or (not s.fired and self.n_result >= s.at):
+                if s.at != 0:
+                    s.fired = True
+                if s.device >= 0:
+                    self.liar_devices.add(s.device)
+                return s
+        return None
+
+    def sdc_liars(self) -> set:
+        """Original mesh-member indexes every fired (or continuous)
+        device-pinned ``sdc`` spec named — the members whose attribution-
+        probe answers must re-corrupt. Includes continuous specs' members
+        even before their first main-stream hit."""
+        liars = set(self.liar_devices)
+        for s in self.specs:
+            if s.kind == "sdc" and s.at == 0 and s.device >= 0:
+                liars.add(s.device)
+        return liars
+
+    def has_sdc_faults(self) -> bool:
+        """True while any ``sdc`` spec could still perturb a result (or a
+        liar member exists) — the supervisor's fast-path gate."""
+        return bool(self.liar_devices) or any(
+            s.kind == "sdc" and (s.at == 0 or not s.fired)
+            for s in self.specs)
 
     def monster_check(self) -> bool:
         """Advance the inspected-pile counter (the monster guard runs once

@@ -247,6 +247,16 @@ class PipelineConfig:
     failback: bool = False       # background re-probe may route dispatches
                                  # back to a revived chip (opt-in: failback
                                  # re-compiles every bucket shape)
+    audit_rate: float | None = None  # sampled shadow verification (--audit-
+                                 # rate): fraction of windows per fetched
+                                 # batch re-solved on the trusted host ladder
+                                 # and compared byte-for-byte (supervisor
+                                 # ._audit, ISSUE 20). None = env
+                                 # DACCORD_AUDIT_RATE (default 1/64); 0
+                                 # disables. Changing the rate NEVER changes
+                                 # output bytes — a detected divergence
+                                 # re-solves the whole batch on the byte-
+                                 # exact reference — only detection latency
     ingest_policy: str = "strict"    # validated LAS/DB decode policy
                                  # (formats/ingest.py): 'strict' aborts the
                                  # shard with a structured IngestError naming
@@ -1490,6 +1500,18 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                 _cpu_fb.__name__ = "cpu-ladder"
                 return _cpu_fb
 
+            def _audit_factory():
+                # audit reference: same bytes as the failover engine, but
+                # where failover would hand back the host tiered ladder,
+                # audit k-row samples on the fused single-dispatch program
+                # instead — one XLA call per audit, not one per rescue tier
+                eng = fallback_factory()
+                if getattr(eng, "__name__", "") == "cpu-ladder":
+                    from ..kernels.tiers import audit_reference
+
+                    return audit_reference(_lad)
+                return eng
+
         sup = DeviceSupervisor(
             dispatch_fn, fetch_fn, fetch_many_fn,
             fallback_factory=fallback_factory, log=ev_log,
@@ -1499,7 +1521,16 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
             faults=plan, rtt_s=rtt_s, describe=desc,
             fingerprint_prefix=fp_prefix, inline=inline,
             clamp_solve=clamp_solve, governor_cfg=gov_cfg, tracer=tracer,
-            mesh=mesh_solver)
+            mesh=mesh_solver,
+            # sampled shadow verification (ISSUE 20): the reference shares
+            # bytes with the failover rung. Only the pipeline-built
+            # primaries audit here — an injected serve JobSolver is audited
+            # by the batcher's OWN supervisor, and a native primary's
+            # reference would be itself (tautology)
+            audit_ref_factory=(_audit_factory
+                               if ((solver is None or mesh_solver is not None)
+                                   and not native_dispatch) else None),
+            audit_rate=cfg.audit_rate)
         dispatch_fn, fetch_fn = sup.dispatch, sup.fetch
         if fetch_many_fn is not None:
             fetch_many_fn = sup.fetch_many
@@ -2629,6 +2660,7 @@ def _correct_shard_impl(db: DazzDB, las: LasFile, cfg: PipelineConfig,
                                  if stats.governor_ratchet
                                  else cfg.batch_size)
         ev_log.log("sup_done", state=sup.state, degraded=sup.failed_over,
+                   audit_s=round(sup.audit_s, 4),
                    **sup.counters,
                    **{f"gov_{k}": v for k, v in gov.counters.items()})
     # saturation profiler final stamp (ISSUE 14): gauges + stage table +

@@ -57,6 +57,7 @@ retried batch — output bytes are unaffected.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -266,7 +267,8 @@ class DeviceSupervisor:
                  rtt_s: float | None = None, describe: str = "",
                  fingerprint_prefix: str = "", inline: bool = False,
                  clamp_solve=None, governor_cfg: GovernorConfig | None = None,
-                 tracer=None, mesh=None):
+                 tracer=None, mesh=None, audit_ref_factory=None,
+                 audit_rate: float | None = None):
         import random
 
         from ..utils.obs import NullLogger, Tracer
@@ -300,7 +302,8 @@ class DeviceSupervisor:
         self.fail_reason: str | None = None
         self.counters = {"dispatch": 0, "fetch": 0, "retries": 0,
                          "timeouts": 0, "probes": 0, "degraded_solves": 0,
-                         "heartbeats": 0, "mesh_shrinks": 0}
+                         "heartbeats": 0, "mesh_shrinks": 0,
+                         "audits": 0, "sdc_detected": 0}
         # host-blocking wall spent inside governor ladder solves (they run
         # synchronously at dispatch time, so the pipeline's fetch timer
         # never sees them) — folded into stats.device_s at shard end
@@ -338,11 +341,40 @@ class DeviceSupervisor:
                                      rtt_s * self.cfg.rtt_mult)
         else:
             self.op_deadline_s = self.cfg.op_deadline_s
+        # ---- silent-data-corruption defense (ISSUE 20) ----------------
+        # Sampled shadow verification: a deterministic seeded sample of
+        # windows per fetched batch is re-solved on the trusted reference
+        # engine (the host ladder that is already the byte-exact oracle)
+        # and compared byte-for-byte. audit_ref_factory is the lazy builder
+        # for that engine; no factory => audit disabled. Rate 0 disables.
+        self._audit_ref_factory = audit_ref_factory
+        if audit_rate is None:
+            audit_rate = _env_float("DACCORD_AUDIT_RATE", 1.0 / 64.0)
+        self._audit_rate = max(0.0, float(audit_rate)) \
+            if audit_ref_factory is not None else 0.0
+        self._audit_ref = None
+        self._n_audit = 0          # audited-batch ordinal, seeds the sampler
+        self.audit_s = 0.0         # host wall spent in shadow solves
+                                   # (steady-state: first-shape XLA compile
+                                   # books under the audit.warm span instead)
+        self._audit_warmed: set[tuple] = set()
+        # Device trust is a per-member ratchet TRUSTED -> SUSPECT ->
+        # QUARANTINED, persisted in a registry beside the compile/capacity
+        # registries so a lying chip stays quarantined across runs (and is
+        # re-verified under DACCORD_TRUST_PROBATION like the governor).
+        self._trust: dict[int, dict] = {}
+        self._trust_strikes_max = max(1, int(os.environ.get(
+            "DACCORD_TRUST_STRIKES", "2") or "2"))
+        self._trust_probation = os.environ.get(
+            "DACCORD_TRUST_PROBATION", "") == "1"
         self.log.log("sup_init", primary=describe or "solver",
                      op_deadline_s=round(self.op_deadline_s, 1),
                      compile_deadline_s=self.cfg.compile_deadline_s,
                      rtt_s=rtt_s, faults=bool(self.faults),
-                     failback=self.cfg.failback, inline=inline)
+                     failback=self.cfg.failback, inline=inline,
+                     audit_rate=self._audit_rate)
+        if self._mesh is not None:
+            self._trust_load()
 
     # ---- state machine -------------------------------------------------
 
@@ -594,7 +626,7 @@ class DeviceSupervisor:
 
     # ---- partial-mesh degradation rung ----------------------------------
 
-    def _mesh_degrade(self, reason: str) -> bool:
+    def _mesh_degrade(self, reason: str, culprit: int = -1) -> bool:
         """On declared device loss with a mesh primary: shrink the mesh
         N -> N/2 and keep the run on the (smaller) primary — the retained
         batch re-pads and re-dispatches, byte-identical by per-window
@@ -616,14 +648,14 @@ class DeviceSupervisor:
             self.log.log("mesh.degrade", nd=int(m.nd), reason=reason[:200])
             return False
         nd_from = m.nd
-        culprit = -1
-        if self.faults is not None and self.faults.dead_device >= 0:
-            culprit = self.faults.dead_device
-        elif self.faults is None and not getattr(m, "host_local", True):
-            with self.tracer.span("probe"):
-                dead = m.probe_devices()
-            if len(dead) == 1:
-                culprit = dead[0]
+        if culprit < 0:
+            if self.faults is not None and self.faults.dead_device >= 0:
+                culprit = self.faults.dead_device
+            elif self.faults is None and not getattr(m, "host_local", True):
+                with self.tracer.span("probe"):
+                    dead = m.probe_devices()
+                if len(dead) == 1:
+                    culprit = dead[0]
         prev_state = {i: row.get("state")
                       for i, row in getattr(m, "device_stats", {}).items()}
         m.shrink(culprit=culprit)
@@ -825,9 +857,10 @@ class DeviceSupervisor:
         if h.degraded or self.state in (LOST, DEGRADED):
             return self._degraded_solve(h.batch, "fetch")
         try:
-            return self._guarded("fetch", self._fetch_fn,
-                                 lambda attempt: self._refetch_args(h, attempt),
-                                 h.key, fresh=False, width=self._width_of(h.batch))
+            out = self._guarded("fetch", self._fetch_fn,
+                                lambda attempt: self._refetch_args(h, attempt),
+                                h.key, fresh=False, width=self._width_of(h.batch))
+            return self._postfetch(h, out)
         except CapacityError as e:
             # the OOM surfaced at materialization (async dispatch): the
             # retained batch re-solves down the ladder, never verbatim
@@ -866,8 +899,9 @@ class DeviceSupervisor:
             return (inners,)
 
         try:
-            return self._guarded("fetch", self._fetch_many_fn, make_args,
+            outs = self._guarded("fetch", self._fetch_many_fn, make_args,
                                  handles[0].key, fresh=False, width=width)
+            return [self._postfetch(h, o) for h, o in zip(handles, outs)]
         except CapacityError:
             # per-handle fallback: each batch classifies (and degrades)
             # against its OWN width — a group is not a capacity unit. The
@@ -883,3 +917,340 @@ class DeviceSupervisor:
                 return [self.fetch(self.dispatch(h.batch)) for h in handles]
             self._engage_fallback(str(e))
             return [self._degraded_solve(h.batch, "fetch") for h in handles]
+
+    # ---- silent-data-corruption defense plane (ISSUE 20) -----------------
+
+    def _postfetch(self, h, out):
+        """Runs on every SUCCESSFUL primary fetch: (1) inject any pending
+        ``sdc`` fault — silent corruption of the packed consensus rows, no
+        exception raised, exactly what a lying chip looks like; (2) sampled
+        shadow verification against the trusted reference engine. Degraded
+        solves and governor-solved results never pass through here: the
+        reference IS (or shares bytes with) the degraded engine, so
+        auditing those would be a tautology."""
+        if not isinstance(out, dict) or "cons" not in out:
+            return out
+        if self.faults is not None and self.faults.has_sdc_faults():
+            spec = self.faults.sdc_check()
+            if spec is not None:
+                self._sdc_corrupt(out, spec.device)
+        if self._audit_rate > 0.0 and self._audit_ref_factory is not None:
+            out = self._audit(h, out)
+        return out
+
+    def _sdc_corrupt(self, out: dict, device: int) -> None:
+        """Silently corrupt the result rows owned by mesh member ``device``
+        (every row when unpinned or no mesh). Corruption bumps live
+        consensus bases in place — valid alphabet, valid lengths, no flag
+        touched — so nothing downstream can notice without comparing bytes
+        against the reference."""
+        import numpy as np
+
+        B = int(np.asarray(out["cons"]).shape[0])
+        rows = range(B)
+        if device >= 0 and self._mesh is not None and self._mesh.nd > 1:
+            members = self._mesh.member_ids()
+            if device not in members:
+                return      # pinned member already shrunk out of the mesh
+            per = -(-B // len(members))
+            j = members.index(device)
+            lo, hi = j * per, min((j + 1) * per, B)
+            if lo >= hi:
+                return      # trimmed tail: this member got only pad rows
+            rows = range(lo, hi)
+        self._corrupt_rows(out, rows)
+
+    @staticmethod
+    def _corrupt_rows(out: dict, rows) -> None:
+        import numpy as np
+
+        cons = np.asarray(out["cons"])
+        if not cons.flags.writeable:
+            cons = cons.copy()
+            out["cons"] = cons
+        cl = np.asarray(out["cons_len"])
+        solved = np.asarray(out["solved"])
+        for i in rows:
+            if not bool(solved[i]):
+                continue
+            n = int(cl[i])
+            if n <= 0:
+                continue
+            seg = cons[i, :n]
+            live = seg < 4
+            seg[live] = (seg[live] + 1) % 4
+
+    # ---- sampled shadow verification -------------------------------------
+
+    def _audit_engine(self):
+        """Lazy build of the trusted reference engine (the same factory the
+        failover rung uses — byte-exact host ladder). A build failure
+        disables auditing for the run rather than killing it: the audit is
+        a defense plane, not a dependency."""
+        if self._audit_ref is None and self._audit_ref_factory is not None:
+            try:
+                with self.tracer.span("audit.build"):
+                    self._audit_ref = self._audit_ref_factory()
+            except Exception as e:
+                self.log.log("audit.disabled", error=str(e)[:200])
+                self._audit_rate = 0.0
+                self._audit_ref_factory = None
+                return None
+        return self._audit_ref
+
+    def _audit_sample(self, B: int) -> list[int]:
+        """Deterministic seeded row sample for one audited batch, budgeted
+        at ``k = max(1, round(B*rate))`` rows. On a mesh the sample is
+        member-aware — a lying member must not hide in the unsampled rows:
+        when the budget covers the mesh (``k >= nd``) every member slice
+        contributes a row EVERY batch (deterministic per-batch detection,
+        what BENCH_SDC asserts at B=512/nd=8/rate=1/64); under that, member
+        slices rotate round-robin across audited batches, so every member
+        is still audited once per ``nd`` batches at the configured cost.
+        Seeded by (cfg.seed, audit ordinal) so a re-run samples identically
+        — the chaos soak depends on that determinism."""
+        import random
+
+        rng = random.Random((self.cfg.seed << 16) ^ self._n_audit)
+        k = min(max(1, round(B * self._audit_rate)), B)
+        rows: set[int] = set()
+        if self._mesh is not None and self._mesh.nd > 1:
+            nd = self._mesh.nd
+            per = -(-B // nd)
+            slices = range(nd) if k >= nd else [self._n_audit % nd]
+            for j in slices:
+                lo, hi = j * per, min((j + 1) * per, B)
+                if lo < hi:
+                    rows.add(rng.randrange(lo, hi))
+        while len(rows) < k:
+            rows.add(rng.randrange(B))
+        return sorted(rows)
+
+    @staticmethod
+    def _take_rows(batch, rows):
+        """Row-subset copy of a batch (dense first — the reference ladder
+        iterates dense rows, same contract as ``_degraded_solve``)."""
+        import dataclasses
+
+        import numpy as np
+
+        if hasattr(batch, "to_dense"):
+            batch = batch.to_dense()
+        idx = np.asarray(rows, dtype=np.int64)
+        return dataclasses.replace(
+            batch, seqs=batch.seqs[idx], lens=batch.lens[idx],
+            nsegs=batch.nsegs[idx], read_ids=batch.read_ids[idx],
+            wstarts=batch.wstarts[idx])
+
+    @staticmethod
+    def _rows_equal(dev: dict, ref: dict, i: int, j: int, tier0: bool):
+        """Byte comparison of device row ``i`` against reference row ``j``.
+        Returns None to SKIP a row the comparison cannot judge: on a
+        tier0-stream batch the reference (a full ladder) legitimately
+        solves rows the tier0 program pools for rescue, so only rows the
+        device claims final (solved & !m_ovf) are comparable. err/tier are
+        deliberately excluded — they never reach the FASTA."""
+        import numpy as np
+
+        if tier0 and (not bool(dev["solved"][i]) or bool(dev["m_ovf"][i])):
+            return None
+        if bool(dev["solved"][i]) != bool(ref["solved"][j]):
+            return False
+        if not bool(dev["solved"][i]):
+            return True
+        nd_, nr_ = int(dev["cons_len"][i]), int(ref["cons_len"][j])
+        if nd_ != nr_:
+            return False
+        return bool(np.array_equal(np.asarray(dev["cons"])[i, :nd_],
+                                   np.asarray(ref["cons"])[j, :nr_]))
+
+    def _audit(self, h, out: dict):
+        """Shadow-verify a seeded sample of ``out`` rows byte-for-byte
+        against the reference engine. On divergence: emit ``sup_sdc``,
+        attribute the culprit member (mesh), strike its trust ratchet, and
+        re-solve the WHOLE batch on the reference — so a detected
+        corruption never reaches the caller and output bytes are identical
+        to a clean run (a tier0 batch re-solves to full-ladder rows, which
+        composes byte-identically by the pipeline's pool rule — the same
+        argument the failover replay rests on)."""
+        import numpy as np
+
+        eng = self._audit_engine()
+        if eng is None:
+            return out
+        batch = h.batch
+        B = int(np.asarray(out["cons"]).shape[0])
+        if B <= 0:
+            return out
+        rows = self._audit_sample(B)
+        self._n_audit += 1
+        self.counters["audits"] += 1
+        sample = self._take_rows(batch, rows)
+        shape = tuple(np.asarray(sample.seqs).shape)
+        if shape not in self._audit_warmed:
+            # first audit at this shape pays the reference ladder's XLA
+            # compile — a one-time cost like the engine build, booked under
+            # its own span and NOT under audit_s: the audit RATE controls
+            # the per-audit steady-state cost, which is what the ≤2%
+            # overhead contract (BENCH_SDC) is about
+            self._audit_warmed.add(shape)
+            with self.tracer.span("audit.warm", rows=len(rows)):
+                eng(sample)
+        t0 = time.time()
+        tier0 = getattr(batch, "stream", "full") == "tier0"
+        with self.tracer.span("audit", rows=len(rows)):
+            ref = eng(sample)
+        divergent = [i for j, i in enumerate(rows)
+                     if self._rows_equal(out, ref, i, j, tier0) is False]
+        if not divergent:
+            self.audit_s += time.time() - t0
+            return out
+        self.counters["sdc_detected"] += 1
+        culprit = self._sdc_attribute(batch, divergent[0])
+        self.log.log("sup_sdc", key=h.key, rows=int(B), sampled=len(rows),
+                     divergent=len(divergent), row=int(divergent[0]),
+                     culprit=int(culprit))
+        dense = batch.to_dense() if hasattr(batch, "to_dense") else batch
+        with self.tracer.span("audit.resolve", rows=int(B)):
+            out = eng(dense)
+        self.audit_s += time.time() - t0
+        self._trust_strike(culprit, "shadow audit divergence")
+        return out
+
+    def _sdc_attribute(self, batch, row: int) -> int:
+        """Per-member re-dispatch of ONE divergent window: the row is
+        replicated mesh-width times so each member solves its own copy
+        (slice width 1), and whichever member's copy diverges from the
+        reference is the culprit. Rides the raw mesh (not the supervised
+        path — a recursive audit would be circular); the fault plan's
+        persistent liar set re-applies the injected corruption here, which
+        is what makes attribution verifiable chip-free on CPU."""
+        m = self._mesh
+        if m is None or m.nd <= 1:
+            return -1
+        eng = self._audit_engine()
+        if eng is None:
+            return -1
+        import dataclasses
+
+        import numpy as np
+
+        dense = batch.to_dense() if hasattr(batch, "to_dense") else batch
+        nd = int(m.nd)
+        rep = lambda a: np.repeat(a[row:row + 1], nd, axis=0)
+        probe = dataclasses.replace(
+            dense, seqs=rep(dense.seqs), lens=rep(dense.lens),
+            nsegs=rep(dense.nsegs), read_ids=rep(dense.read_ids),
+            wstarts=rep(dense.wstarts), stream="full")
+        members = m.member_ids()
+        try:
+            pout = m.fetch(m.dispatch(probe))
+        except Exception as e:
+            self.log.log("audit.attrib", row=int(row), culprit=-1,
+                         nd=nd, error=str(e)[:200])
+            return -1
+        if self.faults is not None:
+            liars = self.faults.sdc_liars()
+            for j, orig in enumerate(members):
+                if orig in liars:
+                    self._corrupt_rows(pout, [j])
+        ref1 = eng(self._take_rows(dense, [row]))
+        culprits = [members[j] for j in range(len(members))
+                    if self._rows_equal(pout, ref1, j, 0, False) is False]
+        culprit = int(culprits[0]) if culprits else -1
+        self.log.log("audit.attrib", row=int(row), culprit=culprit, nd=nd)
+        return culprit
+
+    # ---- device trust ratchet --------------------------------------------
+
+    def _trust_key(self, orig: int) -> str:
+        return f"{self._fp_prefix}m{int(orig)}"
+
+    def _trust_strike(self, orig: int, reason: str) -> None:
+        """Ratchet TRUSTED -> SUSPECT -> QUARANTINED (never loosens within
+        a run). Quarantine drives the EXISTING degradation rungs — the
+        partial-mesh shrink for an attributed member, whole-program
+        failover otherwise — and persists to the trust registry so the
+        next run starts with the chip already out (or on probation under
+        ``DACCORD_TRUST_PROBATION=1``)."""
+        from ..utils.obs import (TRUST_QUARANTINED, TRUST_SUSPECT,
+                                 TRUST_TRUSTED, record_trust)
+
+        orig = int(orig)
+        ent = self._trust.setdefault(orig, {"state": TRUST_TRUSTED,
+                                            "strikes": 0})
+        ent["strikes"] += 1
+        frm = ent["state"]
+        to = TRUST_QUARANTINED \
+            if ent["strikes"] >= self._trust_strikes_max else TRUST_SUSPECT
+        if frm == TRUST_QUARANTINED:
+            to = TRUST_QUARANTINED
+        ent["state"] = to
+        self.log.log("trust.state", device=orig, state_from=frm,
+                     state_to=to, strikes=int(ent["strikes"]))
+        record_trust(self._trust_key(orig), to, ent["strikes"])
+        if to != TRUST_QUARANTINED or frm == TRUST_QUARANTINED:
+            return
+        # SUSPECT first: the state machine has no HEALTHY->RETRYING edge,
+        # and a trust quarantine IS a suspicion resolved against the device
+        if self._mesh is not None and self._mesh.nd > 1 and \
+                orig in self._mesh.member_ids():
+            if self.state in (HEALTHY, COMPILING):
+                self._transition(SUSPECT, reason=reason)
+            self._mesh_degrade(f"trust quarantined: {reason}", culprit=orig)
+        elif self._fallback_factory is not None:
+            if self.state in (HEALTHY, COMPILING, RETRYING):
+                self._transition(SUSPECT, reason=reason)
+            try:
+                self._engage_fallback(f"trust quarantined: {reason}")
+            except DeviceLostError:
+                pass        # no fallback buildable: keep running, keep auditing
+
+    def _trust_load(self) -> None:
+        """Load persisted trust state for the active mesh members (called
+        once, right after ``sup_init``). A registry-quarantined member is
+        shrunk out before it solves a single window — unless
+        ``DACCORD_TRUST_PROBATION=1`` demotes it to SUSPECT for a
+        re-verify, mirroring the governor's probation lever."""
+        from ..utils.obs import (TRUST_QUARANTINED, TRUST_SUSPECT,
+                                 record_trust, trust_registry)
+
+        reg = trust_registry()
+        if not reg:
+            return
+        m = self._mesh
+        for orig in list(m.member_ids()):
+            ent = reg.get(self._trust_key(orig))
+            if not ent:
+                continue
+            state = ent.get("state")
+            strikes = int(ent.get("strikes", 0))
+            self._trust[int(orig)] = {"state": state, "strikes": strikes}
+            self.log.log("trust.load", device=int(orig), state=state,
+                         strikes=strikes)
+            if state != TRUST_QUARANTINED:
+                continue
+            if self._trust_probation:
+                demoted = max(0, strikes - 1)
+                self._trust[int(orig)] = {"state": TRUST_SUSPECT,
+                                          "strikes": demoted}
+                self.log.log("trust.state", device=int(orig),
+                             state_from=state, state_to=TRUST_SUSPECT,
+                             strikes=demoted)
+                record_trust(self._trust_key(orig), TRUST_SUSPECT, demoted)
+                continue
+            while m.nd > 1 and orig in m.member_ids():
+                nd_from = m.nd
+                prev_state = {i: row.get("state") for i, row in
+                              getattr(m, "device_stats", {}).items()}
+                m.shrink(culprit=int(orig))
+                self.counters["mesh_shrinks"] += 1
+                self.log.log("mesh.shrink", nd_from=int(nd_from),
+                             nd_to=int(m.nd), culprit=int(orig),
+                             reason="trust quarantined (registry)")
+                for i, row in getattr(m, "device_stats", {}).items():
+                    if row.get("state") != prev_state.get(i):
+                        self.log.log("mesh.device", device=int(i),
+                                     state=row["state"],
+                                     platform=row.get("platform", "?"),
+                                     dispatches=int(row.get("dispatches", 0)))

@@ -152,6 +152,11 @@ class GroupConfig:
                                  # stream dispatches load/publish serialized
                                  # executables here so a fresh peer's first
                                  # solve skips the cold compile; None = off
+    audit_rate: float | None = None  # sampled shadow verification rate for
+                                 # the group's supervisor (ISSUE 20); None =
+                                 # env DACCORD_AUDIT_RATE (1/64), 0 disables.
+                                 # Native groups never audit: the reference
+                                 # engine IS the primary there
     governor: GovernorConfig = field(default_factory=GovernorConfig.from_env)
 
 
@@ -327,13 +332,31 @@ class SolveGroup:
                     return _cpu_fb
                 return _build_native_fallback(profile, cfg)
 
+            def audit_factory():
+                # audit reference: byte-identical to the failover engine,
+                # but k-row samples ride the fused single-dispatch ladder
+                # (one XLA call per audit, not one per rescue tier)
+                eng = fallback_factory()
+                if getattr(eng, "__name__", "") == "cpu-ladder":
+                    from ..kernels.tiers import audit_reference
+
+                    return audit_reference(ladder)
+                return eng
+
         self.sup = DeviceSupervisor(
             dispatch, fetch, fetch_many, fallback_factory=fallback_factory,
             log=self.log, cfg=SupervisorConfig.from_env(),
             faults=FaultPlan.from_env(), rtt_s=rtt_s, describe=desc,
             fingerprint_prefix=prefix, inline=inline, clamp_solve=clamp,
             governor_cfg=g.governor, tracer=self.tracer,
-            mesh=self.mesh_solver)
+            mesh=self.mesh_solver,
+            # sampled shadow verification (ISSUE 20): the group's own
+            # supervisor audits merged cross-job batches — the per-job
+            # pipeline never sees the device, so this is the only seam.
+            # Native groups skip it: the reference IS the primary
+            audit_ref_factory=(None if g.backend == "native"
+                               else audit_factory),
+            audit_rate=g.audit_rate)
 
     # ------------------------------------------------------------------
     # job-side API

@@ -444,8 +444,16 @@ def run_job(job: Job, service) -> None:
             # and replay finishes the rename/manifest WITHOUT re-running —
             # the mid-commit crash window (fsync'd FASTA, un-renamed part)
             # recovers to the identical committed output
+            # the committing record carries the content digest of the
+            # fsync'd bytes (ISSUE 20): a replay/takeover finalize verifies
+            # it before the publishing rename, so a part file silently
+            # corrupted between crash and recovery re-solves instead of
+            # publishing wrong bytes
+            from ..utils.obs import sha256_file
+
             service.journal_mark("committing", job.id, bytes=fh.tell(),
-                                 part=os.path.basename(my_part))
+                                 part=os.path.basename(my_part),
+                                 sha=sha256_file(my_part, limit=fh.tell()))
         os.replace(my_part, job.fasta)
         job.done_ts = time.time()
         job.state = DONE
@@ -453,7 +461,8 @@ def run_job(job: Job, service) -> None:
                       lambda mh: json.dump(
                           {**job.status(),
                            "fasta": job.fasta,
-                           "fasta_bytes": os.path.getsize(job.fasta)}, mh),
+                           "fasta_bytes": os.path.getsize(job.fasta),
+                           "fasta_sha256": sha256_file(job.fasta)}, mh),
                       mode="wt", domain="manifest")
         import glob as _glob
 

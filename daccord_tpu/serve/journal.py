@@ -68,6 +68,10 @@ class JournalEntry:
     part_bytes: int = 0               # committing: fsync'd part-file bytes
     part_name: str | None = None      # the attempt-private part file those
                                       # bytes live in (basename)
+    part_sha: str | None = None       # committing: sha256 of those fsync'd
+                                      # bytes — replay/takeover finalize
+                                      # refuses a part whose content belies
+                                      # the journaled digest (ISSUE 20)
 
     @property
     def terminal(self) -> bool:
@@ -211,6 +215,8 @@ def replay(path: str) -> tuple[dict[str, JournalEntry], int]:
             e.part_bytes = int(b)
         if isinstance(rec.get("part"), str):
             e.part_name = os.path.basename(rec["part"])
+        if isinstance(rec.get("sha"), str):
+            e.part_sha = rec["sha"]
         if kind == "admitted":
             e.tenant = str(rec.get("tenant", e.tenant))
             e.nbytes = int(rec.get("nbytes", e.nbytes) or 0)
@@ -254,6 +260,8 @@ def compact(path: str, entries: dict[str, JournalEntry]) -> None:
                     tail["bytes"] = e.part_bytes
                 if e.part_name:
                     tail["part"] = e.part_name
+                if e.part_sha:
+                    tail["sha"] = e.part_sha
                 fh.write((json.dumps(tail) + "\n").encode())
 
     aio.durable_write(path, _write, mode="wb", domain="journal")

@@ -136,6 +136,9 @@ class ServeConfig:
                                      # warm. Conventionally
                                      # <peer_dir>/aotcache (the serve CLI
                                      # defaults it there). None = off
+    audit_rate: float | None = None  # sampled shadow verification for the
+                                     # solve groups (ISSUE 20); None = env
+                                     # DACCORD_AUDIT_RATE (1/64), 0 = off
     drain_deadline_s: float = 0.0    # bounded graceful shutdown: >0 means a
                                      # drain that outlives this many seconds
                                      # journal-marks in-flight jobs
@@ -324,7 +327,8 @@ class ConsensusService:
                            mesh=scfg.group_mesh(),
                            use_pallas=scfg.use_pallas,
                            shed_levels=self._shed,
-                           aot_dir=scfg.aot_dir)
+                           aot_dir=scfg.aot_dir,
+                           audit_rate=scfg.audit_rate)
         g = SolveGroup(key, profile, cfg, gcfg, log=glog, name=name)
         self.log_event("serve.group", group=name, key=key[:16],
                        backend=scfg.backend, batch=int(scfg.batch))
@@ -572,9 +576,24 @@ class ConsensusService:
                 # attempts write private part files; the committing record
                 # names the one whose bytes are fsync'd
                 part = os.path.join(jobdir, e.part_name)
-            if (e.state == "committing" and os.path.exists(part)
-                    and os.path.getsize(part) >= e.part_bytes
-                    and e.part_bytes > 0):
+            part_ok = (e.state == "committing" and os.path.exists(part)
+                       and os.path.getsize(part) >= e.part_bytes
+                       and e.part_bytes > 0)
+            if part_ok and e.part_sha:
+                # content verification (ISSUE 20): the journaled committing
+                # digest must match the fsync'd prefix on disk — a part file
+                # silently corrupted between crash and recovery falls
+                # through to orphan re-admission (re-solve), never to a
+                # publishing rename of wrong bytes
+                from ..utils.obs import sha256_file
+
+                if sha256_file(part, limit=e.part_bytes) != e.part_sha:
+                    self.log_event(
+                        "io.fault", domain="manifest", op="finalize",
+                        error=f"job {e.job}: part digest mismatches the "
+                              "journaled committing record"[:200])
+                    part_ok = False
+            if part_ok:
                 # the crash landed between the FASTA fsync and the
                 # publishing rename: every byte is durable — finish the
                 # commit in place, byte-identical, zero recompute

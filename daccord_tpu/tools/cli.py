@@ -154,6 +154,12 @@ def daccord_main(argv=None) -> int:
     p.add_argument("--failback", action="store_true",
                    help="let a background re-probe route dispatches back to "
                         "a revived chip (re-compiles every bucket shape)")
+    p.add_argument("--audit-rate", type=float, default=None, metavar="F",
+                   help="sampled shadow verification: fraction of windows "
+                        "per fetched batch re-solved on the trusted host "
+                        "ladder and compared byte-for-byte (default: env "
+                        "DACCORD_AUDIT_RATE or 1/64; 0 disables). Changes "
+                        "detection latency only, never output bytes")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler device trace into DIR")
     p.add_argument("--no-native", action="store_true", help="disable C++ host path")
@@ -363,6 +369,7 @@ def daccord_main(argv=None) -> int:
                          supervise=not args.no_supervise,
                          failover_backend=args.failover_backend,
                          failback=args.failback,
+                         audit_rate=args.audit_rate,
                          use_native=not args.no_native,
                          feeder_threads=args.threads, use_pallas=args.pallas,
                          end_trim=not args.no_end_trim,
@@ -1103,6 +1110,12 @@ def serve_main(argv=None) -> int:
                         "(resumable on restart) and exits NONZERO — a "
                         "wedged group thread can no longer hang shutdown "
                         "forever (0 = unbounded)")
+    p.add_argument("--audit-rate", type=float, default=None, metavar="F",
+                   help="sampled shadow verification for solve groups: "
+                        "fraction of windows per merged batch re-solved on "
+                        "the trusted host ladder and byte-compared (default: "
+                        "env DACCORD_AUDIT_RATE or 1/64; 0 disables; native "
+                        "groups never audit). Never changes output bytes")
     # front door (ISSUE 16)
     p.add_argument("--aot-cache", default=None, metavar="DIR",
                    help="fleet-shared AOT executable cache: jitted solve "
@@ -1169,6 +1182,7 @@ def serve_main(argv=None) -> int:
         peer_dir=args.peer_dir, peer_name=args.peer_name,
         lease_ttl_s=args.lease_ttl_s, heartbeat_s=args.heartbeat_s,
         drain_deadline_s=args.drain_deadline_s, aot_dir=aot_dir,
+        audit_rate=args.audit_rate,
         admission=AdmissionConfig(
             max_queued_jobs=args.max_queued,
             tenant_max_queued=args.tenant_max_queued,
@@ -1363,7 +1377,10 @@ def merge_main(argv=None) -> int:
     except MergeGateError as ex:
         raise SystemExit("daccord-merge: refusing to merge:\n  "
                          + "\n  ".join(ex.issues))
-    print(f"merged {n} fragments", file=sys.stderr)
+    from ..utils.obs import sha256_file
+
+    print(f"merged {n} fragments sha256={sha256_file(args.out_fasta)}",
+          file=sys.stderr)
     return 0
 
 
@@ -1468,7 +1485,25 @@ def fleet_main(argv=None) -> int:
         except MergeGateError as ex:
             raise SystemExit("daccord-fleet: merge gate refused:\n  "
                              + "\n  ".join(ex.issues))
-        print(f"merged {n} fragments -> {args.merge}", file=sys.stderr)
+        # merged-output digest into fleet.json (ISSUE 20): the integrity
+        # chain's last durable link — daccord-audit re-verifies it offline
+        from ..parallel.launch import _write_manifest_durable
+        from ..utils.obs import sha256_file
+
+        merged_sha = sha256_file(args.merge)
+        fj = os.path.join(args.outdir, "fleet.json")
+        try:
+            with open(fj) as fh:
+                fm = json.load(fh)
+        except (OSError, ValueError):
+            fm = None
+        if fm is not None:
+            fm["merged_fasta"] = args.merge
+            fm["merged_fragments"] = n
+            fm["merged_sha256"] = merged_sha
+            _write_manifest_durable(fj, fm)
+        print(f"merged {n} fragments -> {args.merge} sha256={merged_sha}",
+              file=sys.stderr)
     return 0 if (not manifest["poison"] or args.allow_degraded) else 1
 
 

@@ -84,6 +84,21 @@ EVENT_FIELDS: dict[str, dict] = {
     "mesh.restore": {"nd_from": int, "nd_to": int},
     "mesh.degrade": {"nd": int, "reason": str},
     "mesh.device": {"device": int, "state": str},
+    # silent-data-corruption defense plane (ISSUE 20): sup_sdc = a sampled
+    # shadow audit caught a row whose device bytes diverge from the trusted
+    # reference (culprit = attributed mesh member, -1 unknown/non-mesh);
+    # audit.attrib = the per-member single-window re-dispatch that
+    # attributed it; audit.disabled = the reference engine failed to build
+    # (auditing off for the run, never fatal); trust.state / trust.load =
+    # the per-device trust ratchet (TRUSTED -> SUSPECT -> QUARANTINED,
+    # persisted in the trust registry beside the compile/capacity ones)
+    "sup_sdc": {"key": str, "rows": int, "sampled": int, "divergent": int,
+                "row": int, "culprit": int},
+    "audit.attrib": {"row": int, "culprit": int, "nd": int},
+    "audit.disabled": {"error": str},
+    "trust.state": {"device": int, "state_from": str, "state_to": str,
+                    "strikes": int},
+    "trust.load": {"device": int, "state": str, "strikes": int},
     # two-stream tier ladder (ISSUE 4): one row per Stream B rescue dispatch
     # (rows = live rescue windows, slots = padded batch width, reason =
     # full | lag | final | pressure — the last is a host-watermark
@@ -255,6 +270,16 @@ EVENT_FIELDS: dict[str, dict] = {
 _STATES = ("HEALTHY", "COMPILING", "SUSPECT", "RETRYING", "LOST",
            "DEGRADED", "FAILBACK")
 
+# device trust ratchet (ISSUE 20): tightens within a run (self-loops are
+# repeat strikes under a >2 threshold); QUARANTINED -> SUSPECT is the one
+# loosening edge — the registry-load probation demotion
+_TRUST_STATES = ("TRUSTED", "SUSPECT", "QUARANTINED")
+_TRUST_TRANSITIONS = {
+    "TRUSTED": {"SUSPECT", "QUARANTINED"},
+    "SUSPECT": {"SUSPECT", "QUARANTINED"},
+    "QUARANTINED": {"QUARANTINED", "SUSPECT"},
+}
+
 
 def validate_events(path: str, strict: bool = False) -> list[str]:
     """Errors found in the events file (empty list = valid)."""
@@ -359,6 +384,12 @@ def validate_events(path: str, strict: bool = False) -> list[str]:
                 errs.append(f"line {ln}: transition from {f} but supervisor "
                             f"was {state}")
             state = to
+        if rec.get("event") == "trust.state":
+            f, to = rec.get("state_from"), rec.get("state_to")
+            if f not in _TRUST_STATES or to not in _TRUST_STATES:
+                errs.append(f"line {ln}: unknown trust state {f!r} -> {to!r}")
+            elif to not in _TRUST_TRANSITIONS.get(f, set()):
+                errs.append(f"line {ln}: illegal trust transition {f} -> {to}")
     return errs
 
 

@@ -12,6 +12,9 @@ between rounds (ISSUE 13). The sentinel closes that gap with three checks:
   as a regression. MULTICHIP sidecars compare per-mesh-rung
   ``windows_per_sec`` and ``scaling_vs_single`` the same way. Wrapper
   files (``{"parsed": {...}}``, the committed r-series format) unwrap.
+  Each sidecar's ``last_real_tpu_age_h`` provenance stamp is checked
+  against ``--tpu-stale-h`` (default 168 h): a trajectory that has not
+  seen a live chip in over a week flags instead of aging out silently.
 
 - **Metrics rollups** (``*.metrics.json``): structural sanity (a rollup
   must carry counters/gauges), and with ``--baseline`` the throughput
@@ -47,6 +50,13 @@ import sys
 #: passes.
 DEFAULT_NOISE = 0.15
 
+#: hours since the last real TPU life sign beyond which a committed
+#: sidecar's own staleness stamp flags (ISSUE 20 satellite: the
+#: ``last_real_tpu_age_h`` stamp has existed since PR 13 but nothing ever
+#: read it — a week of chip-free "trajectory" landed self-reported yet
+#: invisible). One week by default.
+DEFAULT_TPU_STALE_H = 168.0
+
 
 def load_bench(path: str) -> dict | None:
     """A bench sidecar's payload dict. The committed r-series wraps the
@@ -70,7 +80,9 @@ def _median(vals: list[float]) -> float:
 
 
 def check_bench_series(entries: list[tuple[str, dict]],
-                       noise: float = DEFAULT_NOISE) -> list[str]:
+                       noise: float = DEFAULT_NOISE,
+                       tpu_stale_h: float = DEFAULT_TPU_STALE_H
+                       ) -> list[str]:
     """Drift/fallback findings over bench sidecars. ``entries`` is
     ``[(name, payload)]`` in trajectory order (the caller sorts by
     filename); series group by (metric, batch) so a B=64 rung never
@@ -86,6 +98,19 @@ def check_bench_series(entries: list[tuple[str, dict]],
         hist_dshare: dict[int, list[float]] = {}
         hist_scaling: list[float] = []
         for name, d in items:
+            # trajectory staleness (ISSUE 20): the sidecar's own dated
+            # provenance stamp says how long ago a real chip last answered;
+            # past the threshold every device number in it is archaeology,
+            # not telemetry — flag it instead of letting the series age out
+            # silently
+            age = d.get("last_real_tpu_age_h")
+            if (isinstance(age, (int, float)) and not isinstance(age, bool)
+                    and tpu_stale_h > 0 and age > tpu_stale_h):
+                issues.append(
+                    f"{name}: last real TPU life sign {age:g} h before this "
+                    f"sidecar committed (> {tpu_stale_h:g} h) — the tunnel "
+                    "has been dead for over the staleness budget; this is "
+                    "a chip-free trajectory self-reporting as such")
             # storage red flags (ISSUE 17): a committed sidecar recording
             # disk pressure or dropped telemetry means the bench ran on a
             # sick volume — its numbers are not comparable. A CHAOS sidecar
@@ -506,6 +531,11 @@ def sentinel_main(argv=None) -> int:
     p.add_argument("--noise", type=float, default=DEFAULT_NOISE,
                    help="regression noise band as a fraction "
                         f"(default {DEFAULT_NOISE}: drops beyond it flag)")
+    p.add_argument("--tpu-stale-h", type=float, default=DEFAULT_TPU_STALE_H,
+                   metavar="H",
+                   help="flag sidecars whose last_real_tpu_age_h stamp "
+                        f"exceeds H hours (default {DEFAULT_TPU_STALE_H:g}; "
+                        "0 disables)")
     p.add_argument("--baseline", default=None, metavar="PATH",
                    help="baseline *.metrics.json rollup the current "
                         "rollups compare against")
@@ -527,7 +557,8 @@ def sentinel_main(argv=None) -> int:
             return 2
 
     findings: list[str] = []
-    findings.extend(check_bench_series(bench, noise=args.noise))
+    findings.extend(check_bench_series(bench, noise=args.noise,
+                                       tpu_stale_h=args.tpu_stale_h))
     for path in rollups:
         findings.extend(check_rollup(path, baseline, noise=args.noise))
     for path in events:

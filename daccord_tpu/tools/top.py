@@ -10,12 +10,16 @@ daccord-serve workdir) and renders a refreshing one-screen snapshot:
   state, in-flight depth, rescue-pool density, RSS, and the last
   ``shard_done`` outcome;
 - **MESH** — the per-device flight recorder (ISSUE 13): state (ok / lost /
-  dropped), dispatch count + wall, rows, HBM peak, and the capacity rung
-  per device index, from the latest ``mesh.device`` rows;
+  dropped), trust verdict + strike count (ISSUE 20 ratchet, from the latest
+  ``trust.state``/``trust.load``), dispatch count + wall, rows, HBM peak,
+  and the capacity rung per device index, from the latest ``mesh.device``
+  rows;
 - **SERVE** — job states, queue depth, shed level, SLO burn
   (rolling p99 vs target), and latency quantiles from the latest snapshot;
 - **GOVERNOR** — active capacity ratchets (shape key → width);
-- **FAULTS** — recent supervisor faults / failovers / mesh shrinks.
+- **FAULTS** — recent supervisor faults / failovers / mesh shrinks, plus
+  the SDC defense plane's milestones (``sup_sdc`` audit divergence,
+  ``audit.attrib`` culprit attribution, ``trust.state`` verdicts).
 
 ``--once`` renders a single snapshot and exits (tests, CI, cron health
 checks); the default loop refreshes every ``--interval`` seconds. ``--json``
@@ -153,13 +157,40 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
                 row["metrics"] = rec
                 mesh = rec.get("mesh")
                 if isinstance(mesh, dict):
+                    # carry event-sourced trust verdicts (ISSUE 20) across:
+                    # the metrics snapshot knows utilization, not trust
+                    old = (snap.get("mesh") or {}).get("devices") or {}
                     snap["mesh"] = mesh
+                    devs = mesh.setdefault("devices", {})
+                    for k, v in old.items():
+                        if "trust" in v:
+                            devs.setdefault(k, {}).update(
+                                {kk: v[kk] for kk in ("trust", "strikes")
+                                 if kk in v})
             elif ev == "mesh.device":
                 d = rec.get("device")
                 if isinstance(d, int):
                     devs = snap["mesh"].setdefault("devices", {})
+                    trust = devs.get(str(d), {}).get("trust")
                     devs[str(d)] = {k: v for k, v in rec.items()
                                     if k not in ("t", "ts", "event", "device")}
+                    if trust is not None:
+                        devs[str(d)]["trust"] = trust
+            elif ev in ("trust.state", "trust.load"):
+                # device trust ratchet (ISSUE 20): latest verdict per member
+                d = rec.get("device")
+                if isinstance(d, int):
+                    devs = snap["mesh"].setdefault("devices", {})
+                    drow = devs.setdefault(str(d), {})
+                    drow["trust"] = rec.get("state_to") or rec.get("state")
+                    drow["strikes"] = rec.get("strikes")
+                if ev == "trust.state":
+                    # a verdict transition is also a fault-panel milestone
+                    snap["faults"].append(
+                        {"src": src, "event": ev,
+                         **{k: v for k, v in rec.items()
+                            if k in ("device", "state_from", "state_to",
+                                     "strikes")}})
             elif ev == "sup_state":
                 row["state"] = rec.get("state_to")
             elif ev == "sup_init":
@@ -232,7 +263,11 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
                         "io.fault", "disk.pressure", "journal.compact",
                         # network fault matrix (ISSUE 18): socket refusals
                         # and partition transitions likewise
-                        "net.fault", "router.partition"):
+                        "net.fault", "router.partition",
+                        # SDC defense plane (ISSUE 20): audit divergence
+                        # and culprit attribution (trust.state milestones
+                        # are appended by the ratchet branch above)
+                        "sup_sdc", "audit.attrib", "audit.disabled"):
                 snap["faults"].append(
                     {"src": src, "event": ev,
                      **{k: v for k, v in rec.items()
@@ -241,7 +276,9 @@ def collect(paths: list[str], tail_kb: int = 256) -> dict:
                                  "prev_host", "stale_s", "orphans",
                                  "finished", "domain", "error", "level",
                                  "free_mb", "before", "after",
-                                 "peer", "state")}})
+                                 "peer", "state", "device", "divergent",
+                                 "sampled", "state_from", "state_to",
+                                 "strikes")}})
                 if ev == "disk.pressure":
                     snap["disk"] = {"level": rec.get("level"),
                                     "src": rec.get("src"),
@@ -331,14 +368,19 @@ def render(snap: dict) -> str:
         if rung is not None:
             hdr += f"  rung {rung} rows/device"
         out.append(hdr)
-        out.append(f"  {'DEV':>5} {'PLAT':<6}{'STATE':<9}{'DISP':>7}"
+        out.append(f"  {'DEV':>5} {'PLAT':<6}{'STATE':<9}{'TRUST':<13}"
+                   f"{'DISP':>7}"
                    f"{'WALL S':>9}{'ROWS':>9}{'HBM PEAK':>10}{'IDLE%':>7}"
                    f"{'OVR%':>6}")
         for k in sorted(devs, key=lambda x: int(x)):
             d = devs[k]
+            trust = d.get("trust") or "-"
+            if trust != "-" and d.get("strikes") is not None:
+                trust = f"{trust}:{d['strikes']}"
             out.append(
                 f"  {k:>5} {str(d.get('platform', '?')):<6}"
                 f"{str(d.get('state', '?')):<9}"
+                f"{trust:<13}"
                 f"{_fmt(d.get('dispatches'), 0):>7}"
                 f"{_fmt(d.get('dispatch_wall_s'), 2):>9}"
                 f"{_fmt(d.get('rows'), 0):>9}"

@@ -59,6 +59,11 @@ DURABLE_EVENTS = frozenset({
     # storage fault matrix (ISSUE 17): injected/observed I/O failures and
     # disk-pressure transitions are the post-mortem spine of the disk soak
     "io.fault", "disk.pressure", "journal.compact",
+    # silent-data-corruption defense plane (ISSUE 20): a detected shadow-
+    # verification divergence, the per-member attribution probe, and every
+    # device-trust ratchet transition are exactly what the post-mortem (and
+    # the BENCH_SDC attribution assert) read from events alone
+    "sup_sdc", "audit.attrib", "trust.state", "trust.load",
 })
 
 
@@ -1003,6 +1008,166 @@ def record_fingerprint(key: str, wall_s: float | None = None,
                 return
             entry = {**entry, **added}
         reg[key] = entry
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "wt") as fh:
+            json.dump(reg, fh)
+        os.replace(tmp, p)
+    except (OSError, json.JSONDecodeError):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Content digests (ISSUE 20). ONE implementation feeds every durable
+# boundary of the integrity chain: shard-manifest stamping, the merge gate's
+# content verify, the serve journal's committing record, and daccord-audit.
+# ---------------------------------------------------------------------------
+
+
+def sha256_file(path: str, limit: int | None = None,
+                chunk: int = 1 << 20) -> str:
+    """Streaming sha256 hex digest of a file's content (first ``limit``
+    bytes when given — the journal records the fsync'd prefix length, so a
+    finalize verifies exactly the bytes it is about to publish). Speaks aio
+    URLs like every durable reader (mem: fixtures hash too)."""
+    import hashlib
+
+    from . import aio
+
+    h = hashlib.sha256()
+    remaining = limit
+    with aio.open_input(path, "rb") as fh:
+        while remaining is None or remaining > 0:
+            n = chunk if remaining is None else min(chunk, remaining)
+            b = fh.read(n)
+            if not b:
+                break
+            h.update(b)
+            if remaining is not None:
+                remaining -= len(b)
+    return h.hexdigest()
+
+
+def result_digest(out: dict, rows=None) -> str:
+    """Canonical sha256 of a (packed-wire) solver result dict — the
+    per-window bytes the FASTA is assembled from: ``solved`` flag,
+    ``cons_len``, and the live consensus bytes per row. Deliberately
+    EXCLUDES err/tier/m_ovf: those steer routing, never output bytes, so
+    two engines at byte parity digest equal even where float err differs in
+    the last ulp. ``rows`` restricts to a row subset (the shadow audit
+    digests its sample)."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+    cons = np.asarray(out["cons"])
+    cons_len = np.asarray(out["cons_len"])
+    solved = np.asarray(out["solved"])
+    idx = range(len(cons)) if rows is None else rows
+    for i in idx:
+        ok = bool(solved[i])
+        h.update(b"\x01" if ok else b"\x00")
+        if ok:
+            cl = int(cons_len[i])
+            h.update(cl.to_bytes(4, "little"))
+            h.update(np.ascontiguousarray(cons[i, :cl]).tobytes())
+    return h.hexdigest()
+
+
+def row_digests(batch) -> list:
+    """Per-window sha256 digests of a :class:`WindowBatch`'s live content
+    (identity + ragged segment bytes; pad cells excluded). The anchor of the
+    window→batch→shard composition property: ``pack_paged``/``unpack_paged``/
+    ``to_dense`` round-trips and ``slice_batch`` row slices must preserve
+    these exactly — re-batching can never change a window's bytes."""
+    import hashlib
+
+    import numpy as np
+
+    if getattr(batch, "pool", None) is not None:
+        batch = batch.to_dense()
+    out = []
+    for i in range(batch.size):
+        h = hashlib.sha256()
+        h.update(int(batch.read_ids[i]).to_bytes(8, "little", signed=True))
+        h.update(int(batch.wstarts[i]).to_bytes(8, "little", signed=True))
+        d = int(batch.nsegs[i])
+        h.update(d.to_bytes(4, "little"))
+        for di in range(d):
+            ln = int(batch.lens[i, di])
+            h.update(ln.to_bytes(4, "little"))
+            h.update(np.ascontiguousarray(
+                batch.seqs[i, di, :ln]).tobytes())
+        out.append(h.hexdigest())
+    return out
+
+
+def batch_digest(batch) -> str:
+    """One sha256 over a batch's :func:`row_digests` — digest-stable under
+    every re-batching transform that preserves row identity and order."""
+    import hashlib
+
+    return hashlib.sha256(
+        "".join(row_digests(batch)).encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Device-trust registry (ISSUE 20): the ratcheted TRUSTED -> SUSPECT ->
+# QUARANTINED state machine's persistent home, beside the compile-fingerprint
+# and capacity-ratchet registries. Same contract: best-effort atomic rewrite,
+# a read-only cache dir never sinks a run.
+# ---------------------------------------------------------------------------
+
+#: trust states a device ratchets through (strings: they go straight into
+#: JSON events and the registry file)
+TRUST_TRUSTED = "TRUSTED"
+TRUST_SUSPECT = "SUSPECT"
+TRUST_QUARANTINED = "QUARANTINED"
+
+
+def _trust_path() -> str | None:
+    import os
+
+    d = compcache_dir()
+    return os.path.join(d, "daccord_trust.json") if d else None
+
+
+def trust_registry() -> dict:
+    """The device-trust registry as ``{key: {"state", "strikes", "ts"}}``
+    — key is the supervisor's device identity string (e.g. ``cpu:m3``).
+    Empty when the cache dir is disabled or the file is unreadable."""
+    import json
+    import os
+
+    p = _trust_path()
+    if p is None or not os.path.exists(p):
+        return {}
+    try:
+        with open(p) as fh:
+            d = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    if not isinstance(d, dict):
+        return {}
+    return {str(k): v for k, v in d.items() if isinstance(v, dict)}
+
+
+def record_trust(key: str, state: str, strikes: int) -> None:
+    """Persist one device's trust state (atomic rewrite, best-effort).
+    Unlike the fingerprint registry this OVERWRITES the entry — trust is a
+    current-state machine, not an append-only telemetry fold."""
+    import json
+    import os
+    import time as _time
+
+    p = _trust_path()
+    if p is None:
+        return
+    try:
+        reg = trust_registry()
+        reg[key] = {"state": state, "strikes": int(strikes),
+                    "ts": round(_time.time(), 1)}
         os.makedirs(os.path.dirname(p), exist_ok=True)
         tmp = f"{p}.tmp.{os.getpid()}"
         with open(tmp, "wt") as fh:

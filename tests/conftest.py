@@ -25,3 +25,10 @@ jax.config.update("jax_platforms", "cpu")
 from daccord_tpu.utils.obs import enable_compilation_cache  # noqa: E402
 
 enable_compilation_cache()
+
+# shadow-audit default (ISSUE 20): production default is 1/64, but on the
+# CPU test tier the audit re-solves a sample of every supervised batch on
+# the SAME host ladder — pure duplication that inflates the fast tier's
+# wall. Off by default here; sdc/audit tests opt in with an explicit
+# audit_rate (config beats env), so the plane itself stays covered.
+os.environ.setdefault("DACCORD_AUDIT_RATE", "0")

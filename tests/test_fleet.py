@@ -251,6 +251,29 @@ def test_merge_gate_catches_truncated_fasta(two_shards, tmp_path):
                          allow_degraded=allow)
 
 
+def test_merge_gate_refuses_digest_mismatch(two_shards, tmp_path):
+    """Silent corruption (ISSUE 20): same byte COUNT, different bytes — the
+    size/truncation gates pass, only the content digest can refuse it."""
+    d = _copy(two_shards, tmp_path)
+    fasta = shard_paths(d, 1)["fasta"]
+    raw = open(fasta, "rb").read()
+    # flip one consensus base on a sequence line (never a header) — exactly
+    # what a lying chip's output looks like after a clean commit
+    seq_at = raw.index(b"\n") + 1
+    flip = b"C" if raw[seq_at:seq_at + 1] != b"C" else b"G"
+    with open(fasta, "r+b") as fh:
+        fh.seek(seq_at)
+        fh.write(flip)
+    assert os.path.getsize(fasta) == len(raw)
+    out = str(tmp_path / "out.fasta")
+    with pytest.raises(MergeGateError, match="digest"):
+        merge_shards(d, 2, out)
+    assert not os.path.exists(out)
+    # explicit override merges the bytes on disk (the operator's call)
+    merge_shards(d, 2, out, allow_degraded=True)
+    assert os.path.exists(out)
+
+
 def test_merge_gate_cross_checks_read_counts(two_shards, tmp_path):
     d = _copy(two_shards, tmp_path)
     fasta = shard_paths(d, 0)["fasta"]
@@ -258,12 +281,38 @@ def test_merge_gate_cross_checks_read_counts(two_shards, tmp_path):
         fh.write(">read99999/0\nACGT\n")
     mpath = shard_paths(d, 0)["manifest"]
     m = json.load(open(mpath))
+    from daccord_tpu.utils.obs import sha256_file
+
     m["fasta_bytes"] = os.path.getsize(fasta)  # size agrees; counts cannot
+    m["fasta_sha256"] = sha256_file(fasta)     # digest too (independent gate)
     json.dump(m, open(mpath, "wt"))
     out = str(tmp_path / "out.fasta")
     with pytest.raises(MergeGateError, match="fragments|reads"):
         merge_shards(d, 2, out)
     assert not os.path.exists(out)  # aborted before the durable rename
+
+
+def test_daccord_audit_offline_chain(two_shards, tmp_path, capsys):
+    """daccord-audit (ISSUE 20): the cold half of the integrity chain —
+    exit 0 on a clean tree, exit 1 naming the corrupted link, exit 2 when
+    there is nothing auditable."""
+    from daccord_tpu.tools.audit import audit_main
+
+    assert audit_main([two_shards]) == 0
+    d = _copy(two_shards, tmp_path)
+    fasta = shard_paths(d, 0)["fasta"]
+    raw = open(fasta, "rb").read()
+    seq_at = raw.index(b"\n") + 1
+    with open(fasta, "r+b") as fh:
+        fh.seek(seq_at)
+        fh.write(b"C" if raw[seq_at:seq_at + 1] != b"C" else b"G")
+    assert audit_main([d, "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    bad = [c for c in rep["checks"] if not c["ok"]]
+    assert bad and "shard 0" in bad[0]["check"]
+    empty = str(tmp_path / "nothing")
+    os.makedirs(empty)
+    assert audit_main([empty]) == 2
 
 
 def test_merge_gate_refuses_wrong_split(two_shards, tmp_path):

@@ -256,6 +256,44 @@ def test_mesh_device_lost_partial_mesh_rung(corpus, tmp_path, monkeypatch,
     assert validate_events(ev, strict=True) == []
 
 
+def test_mesh_sdc_detect_attribute_parity(corpus, tmp_path, monkeypatch,
+                                          throwaway_compcache):
+    """``sdc:1@2`` silently corrupts member 2's result slice — no exception,
+    valid alphabet, nothing downstream can notice by inspection. The shadow
+    audit (rate 1.0 here: every row sampled, detection deterministic) must
+    catch the byte divergence, attribute the culprit by per-member
+    re-dispatch, strike the trust ratchet, and re-solve the poisoned batch
+    on the reference so the FASTA stays byte-identical."""
+    monkeypatch.setenv("DACCORD_FAULT", "sdc:1@2")
+    # keep the ratchet below quarantine: this arm tests detect/attribute,
+    # the shrink rung is the storm soak's job (BENCH_SDC)
+    monkeypatch.setenv("DACCORD_TRUST_STRIKES", "99")
+    ev = str(tmp_path / "sdc.events.jsonl")
+    from daccord_tpu.runtime import PipelineConfig, correct_shard
+
+    cfg = PipelineConfig(**corpus["base"], mesh=8, events_path=ev,
+                         audit_rate=1.0)
+    got = [(rid, [f.tobytes() for f in frags])
+           for rid, frags, st in correct_shard(corpus["db"], corpus["las"],
+                                               cfg, profile=corpus["profile"])]
+    assert got == corpus["single"]            # the lie never reaches bytes
+    evs = [json.loads(x) for x in open(ev)]
+    sdc = [e for e in evs if e["event"] == "sup_sdc"]
+    assert sdc and sdc[0]["divergent"] >= 1
+    attrib = [e for e in evs if e["event"] == "audit.attrib"]
+    assert attrib and {e["culprit"] for e in sdc + attrib} == {2}
+    trust = [e for e in evs if e["event"] == "trust.state"]
+    assert trust and trust[0]["device"] == 2 \
+        and trust[0]["state_from"] == "TRUSTED" \
+        and trust[0]["state_to"] == "SUSPECT"
+    assert "mesh.shrink" not in [e["event"] for e in evs]  # no quarantine
+    done = [e for e in evs if e["event"] == "sup_done"][-1]
+    assert done["sdc_detected"] >= 1 and done["audits"] >= 1
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    assert validate_events(ev, strict=True) == []
+
+
 def test_mesh_device_oom_bisect_and_ratchet(corpus, tmp_path, monkeypatch,
                                             throwaway_compcache):
     """device_oom on a mesh dispatch walks the per-device bisect (widths

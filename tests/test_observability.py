@@ -282,6 +282,9 @@ def test_top_once_over_fixtures(capsys):
     srvdir = os.path.join(FIXTURES, "srv")
     snap = collect([rundir, srvdir])
     assert snap["mesh"]["devices"]["3"]["state"] == "lost"
+    # trust verdicts (ISSUE 20) ride the same device table; the later
+    # metrics-snapshot mesh dict must not erase the event-sourced verdict
+    assert snap["mesh"]["devices"]["2"]["trust"] == "SUSPECT"
     assert snap["slo"]["burn"] == 0.9
     assert snap["ratchets"]["cpu:B64xD16xL64:m4"] == 32
     screen = render(snap)
@@ -293,6 +296,9 @@ def test_top_once_over_fixtures(capsys):
     assert "SLO burn 0.9" in screen
     assert "cpu:B64xD16xL64:m4 -> 32" in screen
     assert "mesh.shrink" in screen and "culprit=3" in screen
+    # SDC plane on the operator screen: fault panel + TRUST column
+    assert "sup_sdc" in screen and "trust.state" in screen
+    assert "TRUST" in screen and "SUSPECT:1" in screen
     # the CLI one-shot form exits 0 and prints the same screen
     assert top_main([rundir, srvdir, "--once"]) == 0
     out = capsys.readouterr().out

@@ -1,0 +1,353 @@
+"""Silent-data-corruption defense plane (ISSUE 20): fast units.
+
+The plane's pieces, each anchored by a unit that runs in milliseconds:
+the ``sdc:N[@K]`` fault grammar (silent by contract — no event at
+injection), the shared digest helpers, the re-batching digest-stability
+property (the window→batch→shard composition the integrity chain rests
+on), the corruption/sampling/trust-ratchet mechanics on an inline
+supervisor with a fake mesh, registry persistence + probation, the
+eventcheck trust-transition lint, and the sentinel staleness advisory.
+The e2e mesh detection arms live in test_mesh.py (shared corpus) and the
+committed BENCH_SDC.json soak.
+"""
+
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+from daccord_tpu.runtime.faults import FaultPlan
+from daccord_tpu.runtime.supervisor import DeviceSupervisor
+
+
+class _CapLog:
+    """Minimal event sink with the JsonlLogger .log interface."""
+
+    def __init__(self):
+        self.records = []
+        self._fh = None          # Tracer probes this; None = spans disabled
+
+    def log(self, event, **kw):
+        self.records.append({"event": event, **kw})
+
+    def of(self, kind):
+        return [r for r in self.records if r["event"] == kind]
+
+
+class _FakeMesh:
+    """The slice of the mesh surface the trust/audit units touch."""
+
+    def __init__(self, nd=8):
+        self.nd = nd
+        self._members = list(range(nd))
+        self.device_stats = {}
+        self.shrunk = []
+
+    def member_ids(self):
+        return list(self._members)
+
+    def shrink(self, culprit=-1):
+        if self.nd <= 1:
+            return False
+        self.shrunk.append(culprit)
+        self._members = [m for m in self._members if m != culprit]
+        self.nd = len(self._members)
+        return True
+
+
+@pytest.fixture()
+def isolated_registries(tmp_path, monkeypatch):
+    """Trust strikes in these units must never land in the host's real
+    registry (same doctrine as the governor/pounce smokes)."""
+    monkeypatch.setenv("DACCORD_COMPCACHE", str(tmp_path / "cc"))
+    monkeypatch.delenv("DACCORD_FAULT", raising=False)
+    monkeypatch.delenv("DACCORD_TRUST_PROBATION", raising=False)
+
+
+def _sup(log=None, mesh=None, rate=1.0 / 64.0, factory=object):
+    """Inline supervisor with the audit plane armed (the factory is never
+    invoked by the units here — it only has to be non-None)."""
+    return DeviceSupervisor(
+        lambda b: b, lambda h: h, inline=True, log=log or _CapLog(),
+        faults=FaultPlan.parse(""), mesh=mesh,
+        audit_ref_factory=(factory if factory is not object else (lambda: None)),
+        audit_rate=rate)
+
+
+# ---------------------------------------------------------------------------
+# fault grammar: silent by contract
+# ---------------------------------------------------------------------------
+
+def test_sdc_grammar_one_shot_and_pinned():
+    p = FaultPlan.parse("sdc:3")
+    assert p.has_sdc_faults()
+    # fires exactly at the 3rd fetched result, unpinned (device -1)
+    assert p.sdc_check() is None and p.sdc_check() is None
+    s = p.sdc_check()
+    assert s is not None and s.kind == "sdc" and s.device == -1
+    assert p.sdc_check() is None          # one-shot: fired out
+    assert not p.has_sdc_faults()
+
+    p = FaultPlan.parse("sdc:1@2")
+    s = p.sdc_check()
+    assert s is not None and s.device == 2
+    # the fired member joins the persistent liar set: attribution probes
+    # keep seeing it lie even after the one-shot spent itself
+    assert p.sdc_liars() == {2}
+    assert p.has_sdc_faults()             # liar set keeps the gate open
+
+
+def test_sdc_grammar_storm_never_fires_out():
+    p = FaultPlan.parse("sdc:*@3")
+    # continuous: every fetched result perturbs, and the member is a liar
+    # even before the first hit (attribution must be deterministic)
+    assert p.sdc_liars() == {3}
+    for _ in range(5):
+        s = p.sdc_check()
+        assert s is not None and s.device == 3
+    assert p.has_sdc_faults()
+
+
+def test_sdc_grammar_rejects_bad_suffix():
+    with pytest.raises(ValueError):
+        FaultPlan.parse("device_oom:1@2")     # @device is sdc/device_lost only
+    with pytest.raises(ValueError):
+        FaultPlan.parse("sdc:1@banana")
+
+
+# ---------------------------------------------------------------------------
+# digest helpers: one implementation for manifest/merge/journal/audit
+# ---------------------------------------------------------------------------
+
+def test_sha256_file_streaming_and_limit(tmp_path):
+    from daccord_tpu.utils.obs import sha256_file
+
+    p = str(tmp_path / "blob")
+    data = bytes(range(256)) * 5000            # > one 1 MiB chunk
+    with open(p, "wb") as fh:
+        fh.write(data)
+    assert sha256_file(p) == hashlib.sha256(data).hexdigest()
+    # limit digests exactly the fsync'd prefix the journal recorded
+    assert sha256_file(p, limit=1000) == \
+        hashlib.sha256(data[:1000]).hexdigest()
+
+
+def test_result_digest_excludes_routing_fields():
+    from daccord_tpu.utils.obs import result_digest
+
+    out = {"cons": np.array([[0, 1, 2, 4], [3, 3, 0, 4]], dtype=np.int8),
+           "cons_len": np.array([3, 2], dtype=np.int32),
+           "solved": np.array([True, True]),
+           "err": np.array([0.1, 0.2], dtype=np.float32),
+           "tier": np.array([0, 1], dtype=np.int32)}
+    d0 = result_digest(out)
+    # err/tier steer routing, never output bytes: digest must not move
+    out2 = dict(out, err=out["err"] * 7, tier=out["tier"] + 1)
+    assert result_digest(out2) == d0
+    # live consensus bytes DO move it
+    out3 = dict(out, cons=out["cons"].copy())
+    out3["cons"][0, 0] = 2
+    assert result_digest(out3) != d0
+    # beyond-cons_len padding is excluded
+    out4 = dict(out, cons=out["cons"].copy())
+    out4["cons"][0, 3] = 1
+    assert result_digest(out4) == d0
+    # row subset: the shadow audit digests its sample
+    assert result_digest(out, rows=[0]) != result_digest(out, rows=[1])
+
+
+def test_rebatch_round_trips_digest_stable():
+    """pack_paged/unpack_paged/to_dense/slice_batch preserve every window's
+    content digest — the property that makes the integrity chain's
+    window→batch→shard composition sound without re-hashing at every hop."""
+    from daccord_tpu.kernels import paging
+    from daccord_tpu.kernels.tensorize import (BatchShape, pad_batch,
+                                               slice_batch, tensorize_windows)
+    from daccord_tpu.oracle.windows import WindowSegments
+    from daccord_tpu.utils.obs import batch_digest, row_digests
+
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(seed)
+        shape = BatchShape(depth=8, seg_len=64, wlen=40)
+        items = []
+        for i in range(23):
+            nseg = int(rng.integers(0, 9))
+            segs = [rng.integers(0, 4, size=int(rng.integers(0, 65)))
+                    .astype(np.int8) for _ in range(nseg)]
+            items.append((i, WindowSegments(wstart=i * 10, wlen=40,
+                                            segments=segs,
+                                            breads=[0] * nseg)))
+        dense = tensorize_windows(items, shape)
+        digests = row_digests(dense)
+        whole = batch_digest(dense)
+        assert len(digests) == dense.size
+
+        pg = paging.window_pages(dense.lens)
+        fam = paging.ShapeFamily(
+            depth=8, pages=1 << (max(int(pg.max(initial=1)), 1) - 1)
+            .bit_length())
+        pb = paging.pack_paged(dense, fam)
+        # paged batches digest through their dense view: identical rows
+        assert row_digests(pb) == digests
+        assert batch_digest(paging.unpack_paged(pb)) == whole
+        assert batch_digest(pb.to_dense()) == whole
+        # row slices carry exactly their windows' digests
+        for lo, hi in ((0, 7), (5, 23), (11, 12)):
+            assert row_digests(slice_batch(dense, lo, hi)) == digests[lo:hi]
+        # padding appends rows, never rewrites the live prefix
+        padded = pad_batch(dense, dense.size + 9)
+        assert row_digests(padded)[: dense.size] == digests
+
+
+# ---------------------------------------------------------------------------
+# corruption + sampling mechanics (inline supervisor, no XLA)
+# ---------------------------------------------------------------------------
+
+def test_corrupt_rows_touches_only_live_solved_bases(isolated_registries):
+    out = {"cons": np.array([[0, 1, 2, 4, 4],
+                             [3, 0, 4, 4, 4],
+                             [1, 1, 1, 1, 1]], dtype=np.int8),
+           "cons_len": np.array([3, 2, 0], dtype=np.int32),
+           "solved": np.array([True, False, True])}
+    before = out["cons"].copy()
+    DeviceSupervisor._corrupt_rows(out, [0, 1, 2])
+    # row 0: solved, live bases bumped +1 mod 4 — still a valid alphabet
+    np.testing.assert_array_equal(out["cons"][0], [1, 2, 3, 4, 4])
+    # row 1 unsolved and row 2 zero-length: untouched
+    np.testing.assert_array_equal(out["cons"][1], before[1])
+    np.testing.assert_array_equal(out["cons"][2], before[2])
+
+
+def test_audit_sample_covers_every_member_when_budget_allows(
+        isolated_registries):
+    sup = _sup(mesh=_FakeMesh(8), rate=1.0 / 64.0)
+    B, nd = 512, 8
+    per = -(-B // nd)
+    rows = sup._audit_sample(B)
+    # k = 512/64 = 8 = nd: one row in EVERY member slice, every batch —
+    # a lying member cannot hide in the unsampled rows
+    assert len(rows) == 8
+    assert {r // per for r in rows} == set(range(nd))
+    # deterministic for a fixed (seed, ordinal)
+    assert rows == sup._audit_sample(B)
+
+
+def test_audit_sample_rotates_member_slices_under_budget(
+        isolated_registries):
+    sup = _sup(mesh=_FakeMesh(8), rate=1.0 / 64.0)
+    B, nd = 64, 8
+    per = -(-B // nd)
+    hit = set()
+    for _ in range(8):
+        rows = sup._audit_sample(B)
+        assert len(rows) == 1            # k = 1: budget, not blanket
+        hit.add(rows[0] // per)
+        sup._n_audit += 1
+    # the rotation walks every member slice across 8 audited batches
+    assert hit == set(range(nd))
+
+
+# ---------------------------------------------------------------------------
+# trust ratchet + registry
+# ---------------------------------------------------------------------------
+
+def test_trust_ratchet_strikes_to_quarantine_and_persists(
+        isolated_registries, monkeypatch):
+    from daccord_tpu.utils.obs import trust_registry
+
+    monkeypatch.setenv("DACCORD_TRUST_STRIKES", "2")
+    log = _CapLog()
+    sup = _sup(log=log)                  # no mesh, no fallback: pure ratchet
+    sup._trust_strike(3, "unit")
+    sup._trust_strike(3, "unit")
+    sup._trust_strike(3, "unit")         # quarantine is sticky
+    states = [(r["state_from"], r["state_to"]) for r in log.of("trust.state")]
+    assert states == [("TRUSTED", "SUSPECT"),
+                      ("SUSPECT", "QUARANTINED"),
+                      ("QUARANTINED", "QUARANTINED")]
+    reg = trust_registry()
+    assert reg["m3"]["state"] == "QUARANTINED" and reg["m3"]["strikes"] == 3
+
+
+def test_trust_registry_load_shrinks_quarantined_member(
+        isolated_registries):
+    from daccord_tpu.utils.obs import TRUST_QUARANTINED, record_trust
+
+    record_trust("m5", TRUST_QUARANTINED, 2)
+    log = _CapLog()
+    mesh = _FakeMesh(8)
+    _sup(log=log, mesh=mesh)             # _trust_load runs at construction
+    # the member is out before it solves a single window
+    assert 5 not in mesh.member_ids() and mesh.shrunk == [5]
+    assert log.of("trust.load")[0] == {"event": "trust.load", "device": 5,
+                                       "state": "QUARANTINED", "strikes": 2}
+    shr = log.of("mesh.shrink")
+    assert shr and shr[0]["culprit"] == 5 \
+        and shr[0]["reason"] == "trust quarantined (registry)"
+
+
+def test_trust_probation_demotes_to_suspect(isolated_registries,
+                                            monkeypatch):
+    from daccord_tpu.utils.obs import (TRUST_QUARANTINED, record_trust,
+                                       trust_registry)
+
+    record_trust("m5", TRUST_QUARANTINED, 2)
+    monkeypatch.setenv("DACCORD_TRUST_PROBATION", "1")
+    log = _CapLog()
+    mesh = _FakeMesh(8)
+    _sup(log=log, mesh=mesh)
+    # probation: the member stays IN, demoted to SUSPECT one strike from
+    # re-quarantine — the governor's probation lever, mirrored
+    assert 5 in mesh.member_ids() and mesh.shrunk == []
+    demote = log.of("trust.state")
+    assert demote and demote[0]["state_from"] == "QUARANTINED" \
+        and demote[0]["state_to"] == "SUSPECT" and demote[0]["strikes"] == 1
+    assert trust_registry()["m5"]["state"] == "SUSPECT"
+
+
+# ---------------------------------------------------------------------------
+# eventcheck: trust transition lint
+# ---------------------------------------------------------------------------
+
+def test_eventcheck_trust_transition_lint(tmp_path):
+    from daccord_tpu.tools.eventcheck import validate_events
+
+    ok = tmp_path / "ok.jsonl"
+    ok.write_text("".join(json.dumps(r) + "\n" for r in [
+        {"event": "trust.state", "t": 0.1, "ts": 1.0, "device": 3,
+         "state_from": "TRUSTED", "state_to": "SUSPECT", "strikes": 1},
+        {"event": "trust.state", "t": 0.2, "ts": 1.1, "device": 3,
+         "state_from": "SUSPECT", "state_to": "QUARANTINED", "strikes": 2},
+        # probation demotion: the ONE legal loosening edge
+        {"event": "trust.state", "t": 0.3, "ts": 1.2, "device": 3,
+         "state_from": "QUARANTINED", "state_to": "SUSPECT", "strikes": 1},
+    ]))
+    assert validate_events(str(ok), strict=True) == []
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(
+        {"event": "trust.state", "t": 0.1, "ts": 1.0, "device": 3,
+         "state_from": "SUSPECT", "state_to": "TRUSTED", "strikes": 0})
+        + "\n")
+    errs = validate_events(str(bad), strict=True)
+    assert errs and "illegal trust transition" in errs[0]
+
+
+# ---------------------------------------------------------------------------
+# sentinel: trajectory staleness advisory (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+def test_sentinel_flags_stale_tpu_provenance():
+    from daccord_tpu.tools.sentinel import check_bench_series
+
+    fresh = [("A.json", {"metric": "x", "last_real_tpu_age_h": 102.0})]
+    stale = [("B.json", {"metric": "x", "last_real_tpu_age_h": 300.5})]
+    assert not [i for i in check_bench_series(fresh) if "life sign" in i]
+    hits = [i for i in check_bench_series(stale) if "life sign" in i]
+    assert hits and "300.5" in hits[0]
+    # threshold is a lever; 0 disables
+    assert [i for i in check_bench_series(fresh, tpu_stale_h=50.0)
+            if "life sign" in i]
+    assert not [i for i in check_bench_series(stale, tpu_stale_h=0)
+                if "life sign" in i]

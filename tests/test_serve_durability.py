@@ -300,6 +300,64 @@ def test_mid_commit_crash_finalizes_without_rerun(dataset, tmp_path):
 
 
 @needs_native
+def test_mid_commit_digest_mismatch_resolves_instead(dataset, tmp_path):
+    """ISSUE 20 integrity chain, takeover-finalize link: the ``committing``
+    record journals the sha256 of the fsync'd part bytes. A part file
+    silently corrupted between crash and recovery — same size, wrong
+    bytes, so the size gate passes — must NOT be renamed into place:
+    finalize refuses (``io.fault``), the orphan re-admits, and the job
+    re-solves to the byte-exact reference."""
+    import hashlib
+
+    out, d = dataset
+    ref = _solo_bytes(out, d)
+    w = tmp_path / "srv"
+    svc1 = _svc(w)
+    j1 = svc1.submit({"db": out["db"], "las": out["las"], "tenant": "a"})
+    assert _poll(svc1, j1["job"])["state"] == "done"
+    assert svc1.shutdown() is True
+    jobdir = os.path.join(str(w), "jobs", j1["job"])
+    fasta = os.path.join(jobdir, "out.fasta")
+    data = open(fasta, "rb").read()
+    # rewind to the mid-commit window, journaling the TRUE digest...
+    os.replace(fasta, os.path.join(jobdir, "out.fasta.part"))
+    os.remove(os.path.join(jobdir, "manifest.json"))
+    import dataclasses
+
+    from daccord_tpu.serve.jobs import JobSpec
+    from daccord_tpu.serve.journal import JobJournal
+
+    spec = JobSpec.from_json({"db": out["db"], "las": out["las"]}, jobdir)
+    jj = JobJournal(os.path.join(str(w), "journal.jsonl"))
+    jj.append("admitted", j1["job"], tenant="a", nbytes=1,
+              spec=dataclasses.asdict(spec), dir=jobdir)
+    jj.append("running", j1["job"])
+    jj.append("committing", j1["job"], bytes=len(data),
+              sha=hashlib.sha256(data).hexdigest())
+    jj.close()
+    # ...then corrupt the part in place: one flipped base, same length
+    part = os.path.join(jobdir, "out.fasta.part")
+    seq_at = data.index(b"\n") + 1
+    flip = b"C" if data[seq_at:seq_at + 1] != b"C" else b"G"
+    with open(part, "r+b") as fh:
+        fh.seek(seq_at)
+        fh.write(flip)
+    svc2 = _svc(w)
+    # finalize refused at replay: no wrong-bytes publish at construction
+    assert not os.path.exists(fasta)
+    st = _poll(svc2, j1["job"])
+    assert st["state"] == "done"
+    assert open(fasta, "rb").read() == ref       # re-solved, byte-exact
+    ev = [json.loads(ln) for ln in
+          open(os.path.join(str(w), "serve.events.jsonl")) if ln.strip()]
+    refusals = [r for r in ev if r.get("event") == "io.fault"
+                and r.get("op") == "finalize"]
+    assert refusals and "digest" in refusals[0]["error"]
+    assert svc2.shutdown() is True
+    _lint([os.path.join(str(w), "serve.events.jsonl")])
+
+
+@needs_native
 def test_bounded_drain_marks_interrupted_and_resumes(dataset, tmp_path):
     """A wedged group thread no longer hangs shutdown forever: past the
     drain deadline the in-flight job is journal-marked INTERRUPTED
@@ -490,9 +548,19 @@ def test_kill_matrix_sigkill_restart_parity(dataset, tmp_path, point,
     code, raw = _req(port2, "GET", f"/v1/jobs/{job_id}/result?wait=1",
                      timeout=300)
     assert code == 200 and raw == ref, f"{point}: resumed FASTA diverged"
-    # quota restored + no duplicate job dirs + journal terminal exactly once
-    code, raw = _req(port2, "GET", "/v1/metrics", timeout=60)
-    m = json.loads(raw)
+    # quota restored + no duplicate job dirs + journal terminal exactly once.
+    # The result becomes readable at state=DONE, a moment BEFORE the worker's
+    # finally block releases the admission quota — poll briefly so a loaded
+    # host doesn't observe that window as a leak
+    deadline = time.time() + 30
+    while True:
+        code, raw = _req(port2, "GET", "/v1/metrics", timeout=60)
+        m = json.loads(raw)
+        if all(t["queued"] == 0 and t["bytes"] == 0
+               for t in m["admission"]["tenants"].values()) \
+                or time.time() > deadline:
+            break
+        time.sleep(0.25)
     for t in m["admission"]["tenants"].values():
         assert t["queued"] == 0 and t["bytes"] == 0
     _req(port2, "POST", "/v1/shutdown", timeout=60)

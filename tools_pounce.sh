@@ -778,6 +778,63 @@ git add BENCH_NET.json \
 echo "tools_pounce: net-chaos smoke OK" >&2
 rm -rf "$netdir"
 
+# SDC smoke (ISSUE 20): a chip that LIES — sdc:1@2 silently corrupts mesh
+# member 2's result rows (no exception, valid alphabet) on the 1st fetch.
+# The shadow audit (rate 1.0: every row sampled, detection deterministic)
+# must catch it, attribute the culprit by replicated re-dispatch, and ship
+# reference bytes — so the faulted FASTA is byte-identical to the clean
+# control. Strict eventcheck covers the new sup_sdc/audit.*/trust.* kinds
+# (including the trust-transition state machine). Throwaway compcache: the
+# injected strike's trust verdict must not land in the host's real
+# registry (a real run would then shrink the member out at sup_init).
+sdcdir=$(mktemp -d)
+sdccc="DACCORD_COMPCACHE=$sdcdir/cc"
+python - "$sdcdir" <<'EOF' || { echo "tools_pounce: sdc synth failed" >&2; exit 1; }
+import sys
+from daccord_tpu.sim.synth import SimConfig, make_dataset
+make_dataset(sys.argv[1], SimConfig(genome_len=1500, coverage=10,
+                                    read_len_mean=500, min_overlap=200,
+                                    seed=5), name="sdc")
+EOF
+env "$sdccc" JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m daccord_tpu.tools.cli daccord "$sdcdir/sdc.db" "$sdcdir/sdc.las" \
+    --backend cpu -b 64 --mesh 8 --audit-rate 0 -o "$sdcdir/clean.fasta" \
+  || { echo "tools_pounce: sdc clean control run FAILED" >&2; exit 1; }
+env "$sdccc" JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    DACCORD_FAULT=sdc:1@2 DACCORD_TRUST_STRIKES=99 \
+    python -m daccord_tpu.tools.cli daccord "$sdcdir/sdc.db" "$sdcdir/sdc.las" \
+    --backend cpu -b 64 --mesh 8 --audit-rate 1.0 -o "$sdcdir/lie.fasta" \
+    --events "$sdcdir/lie.events.jsonl" \
+  || { echo "tools_pounce: sdc-injected mesh run FAILED" >&2; exit 1; }
+cmp -s "$sdcdir/clean.fasta" "$sdcdir/lie.fasta" \
+  || { echo "tools_pounce: the lie reached the FASTA (audit did not contain it)" >&2; exit 1; }
+grep -q '"event": "sup_sdc"' "$sdcdir/lie.events.jsonl" \
+  || { echo "tools_pounce: injected corruption was never detected" >&2; exit 1; }
+grep -q '"event": "audit.attrib"' "$sdcdir/lie.events.jsonl" \
+  || { echo "tools_pounce: detected corruption was never attributed" >&2; exit 1; }
+python - "$sdcdir" <<'EOF' || { echo "tools_pounce: sdc culprit attribution FAILED" >&2; exit 1; }
+import json, sys
+d = sys.argv[1]
+evs = [json.loads(x) for x in open(f"{d}/lie.events.jsonl")]
+blamed = {e["culprit"] for e in evs
+          if e.get("event") in ("sup_sdc", "audit.attrib")}
+assert blamed == {2}, f"blamed {blamed}, injected liar was member 2"
+trust = [e for e in evs if e.get("event") == "trust.state"]
+assert trust and trust[0]["device"] == 2 \
+    and trust[0]["state_to"] == "SUSPECT", trust
+print("sdc smoke: member 2 caught lying, struck SUSPECT, bytes clean")
+EOF
+python -m daccord_tpu.tools.cli eventcheck --strict "$sdcdir/lie.events.jsonl" \
+  || { echo "tools_pounce: sdc events failed schema lint" >&2; exit 1; }
+python -m daccord_tpu.tools.cli trace --check --no-timeline "$sdcdir/lie.events.jsonl" \
+  || { echo "tools_pounce: sdc sidecar failed daccord-trace lint" >&2; exit 1; }
+# the contained lie is not a degraded outcome: no failover, no DEGRADED
+# shard — the strict sentinel must stay green over the faulted sidecar
+python -m daccord_tpu.tools.cli sentinel --strict "$sdcdir/lie.events.jsonl" \
+  || { echo "tools_pounce: sdc sidecar tripped the regression sentinel" >&2; exit 1; }
+echo "tools_pounce: sdc smoke OK" >&2
+rm -rf "$sdcdir"
+
 # front-door bench stage (ISSUE 16 satellite): cold-peer TTFR with/without
 # the AOT cache + p99 through the router during a live scale-out
 env DACCORD_BENCH_ROUTER=1 python bench.py > "BENCH_ROUTER_${stamp}.log" 2>&1 \
